@@ -1,0 +1,38 @@
+"""Test harness: 8 virtual CPU devices simulating a TPU slice.
+
+The reference tests are torchrun multi-process scripts on real GPUs
+(SURVEY.md §4). Here every test runs single-process on a virtual 8-device
+CPU mesh; Pallas kernels execute under the TPU interpreter
+(InterpretParams), which faithfully simulates remote DMA + semaphores.
+Real-TPU execution of the same kernels is covered by bench.py and the
+driver's dryrun.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return Mesh(np.asarray(devs), ("x",))
+
+
+@pytest.fixture(scope="session")
+def mesh2x4():
+    devs = np.asarray(jax.devices()).reshape(2, 4)
+    return Mesh(devs, ("dp", "tp"))

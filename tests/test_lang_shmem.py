@@ -1,0 +1,131 @@
+"""lang-layer tests: the minimum end-to-end distributed slices.
+
+Equivalents of the reference's primitive tests: tutorial-01 notify/wait
+producer-consumer (tutorials/01-distributed-notify-wait.py), ring put
+(shmem/nvshmem_bind/pynvshmem/example/run_ring_put.py), barriers
+(test/nvidia/test_common_ops.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import lang
+from triton_distributed_tpu.utils import assert_allclose
+
+
+def test_ring_put(mesh8):
+    """Each device puts its shard to its right neighbor (ring shift)."""
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem):
+        me = lang.my_pe("x")
+        n = lang.n_pes("x")
+        dst = jax.lax.rem(me + 1, n)
+        h = lang.putmem_nbi_block(out_ref, x_ref, send_sem, recv_sem, dst)
+        lang.quiet(h)
+        h.wait_recv()
+
+    call = lang.shmem_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        in_specs=lang.vmem_specs(1),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())],
+    )
+    f = lang.on_mesh(mesh8, in_specs=P("x"), out_specs=P("x"))(call)
+    x = jnp.arange(64 * 128, dtype=jnp.float32).reshape(64, 128)
+    y = f(x)
+    assert_allclose(y, jnp.roll(x, 8, axis=0))
+
+
+def test_notify_wait_producer_consumer(mesh8):
+    """Tutorial-01 equivalent: producer writes data into consumer's buffer
+    then signals; the consumer spins on the signal before reading."""
+
+    def kernel(x_ref, out_ref, scratch_ref, send_sem, recv_sem, flag):
+        me = lang.my_pe("x")
+        n = lang.n_pes("x")
+        dst = jax.lax.rem(me + 1, n)
+        # producer role: put payload into peer's scratch, then notify peer.
+        h = lang.putmem_signal_nbi_block(scratch_ref, x_ref, send_sem, recv_sem, dst)
+        lang.quiet(h)
+        lang.signal_op(flag, 1, pe=dst)
+        # consumer role: wait for notify, then for the payload, then consume.
+        lang.signal_wait_until(flag, 1)
+        h.wait_recv()
+        out_ref[:] = scratch_ref[:] * 2.0
+
+    call = lang.shmem_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        in_specs=lang.vmem_specs(1),
+        scratch_shapes=[
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+    )
+    f = lang.on_mesh(mesh8, in_specs=P("x"), out_specs=P("x"))(call)
+    x = jnp.arange(64 * 128, dtype=jnp.float32).reshape(64, 128)
+    y = f(x)
+    assert_allclose(y, jnp.roll(x, 8, axis=0) * 2.0)
+
+
+def test_barrier_all(mesh8):
+    """barrier_all: all devices synchronize without deadlock, twice in a
+    row (the second round catches leftover un-consumed signals)."""
+
+    def kernel(x_ref, out_ref):
+        lang.barrier_all("x")
+        out_ref[:] = x_ref[:] * 2.0
+        lang.barrier_all("x")
+
+    call = lang.shmem_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        in_specs=lang.vmem_specs(1),
+        collective_id=1,
+    )
+    f = lang.on_mesh(mesh8, in_specs=P("x"), out_specs=P("x"))(call)
+    x = jnp.arange(64 * 128, dtype=jnp.float32).reshape(64, 128)
+    y = f(x)
+    assert_allclose(y, x * 2.0)
+
+
+def test_signal_wait_ping_pong(mesh8):
+    """Pure semaphore ping-pong (≡ test_notify.py / test_distributed_wait.py):
+    even devices signal odd neighbors, odd wait then reply."""
+
+    def kernel(x_ref, out_ref, flag):
+        me = lang.my_pe("x")
+        n = lang.n_pes("x")
+        partner = jax.lax.rem(me + 1, n)  # even→right, odd wraps
+
+        is_even = jax.lax.rem(me, 2) == 0
+
+        def even_role(_):
+            lang.signal_op(flag, 1, pe=partner)
+            lang.signal_wait_until(flag, 1)
+            return 0
+
+        def odd_role(_):
+            lang.signal_wait_until(flag, 1)
+            prev = jax.lax.rem(me + n - 1, n)
+            lang.signal_op(flag, 1, pe=prev)
+            return 0
+
+        jax.lax.cond(is_even, even_role, odd_role, 0)
+        out_ref[:] = x_ref[:] + 1.0
+
+    call = lang.shmem_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        in_specs=lang.vmem_specs(1),
+        scratch_shapes=[pltpu.SemaphoreType.REGULAR],
+    )
+    f = lang.on_mesh(mesh8, in_specs=P("x"), out_specs=P("x"))(call)
+    x = jnp.zeros((64, 128), jnp.float32)
+    y = f(x)
+    assert_allclose(y, x + 1.0)

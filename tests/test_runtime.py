@@ -1,0 +1,43 @@
+"""Runtime tests: bootstrap, symmetric buffers, topology classification."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu import runtime
+from triton_distributed_tpu.runtime import (
+    AllGatherMethod,
+    auto_allgather_method,
+    detect_topology,
+    symm_zeros,
+)
+from triton_distributed_tpu.runtime.topology import LinkKind
+
+
+def test_initialize_distributed_single_host():
+    ctx = runtime.initialize_distributed()
+    assert ctx.world_size == 1
+    assert ctx.num_devices == 8
+    assert ctx.mesh.shape["x"] == 8
+
+
+def test_symm_buffer_shapes(mesh8):
+    buf = symm_zeros(mesh8, "x", (4, 128), jnp.float32)
+    assert buf.array.shape == (32, 128)
+    assert buf.local_shape == (4, 128)
+    # one shard per device
+    assert len(buf.array.sharding.device_set) == 8
+
+
+def test_detect_topology_cpu(mesh8):
+    topo = detect_topology(mesh8)
+    assert topo.link_kind == LinkKind.HOST
+    assert topo.num_devices == 8
+
+
+def test_auto_allgather_method(mesh8):
+    topo = detect_topology(mesh8)
+    small = auto_allgather_method(topo, 1024)
+    big = auto_allgather_method(topo, 1 << 24)
+    assert small == AllGatherMethod.LL_SMALL
+    assert big == AllGatherMethod.RING_BIDIR

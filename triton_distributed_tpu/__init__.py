@@ -1,0 +1,51 @@
+"""triton_distributed_tpu — a TPU-native distributed kernel framework.
+
+A brand-new framework with the capability surface of Triton-distributed
+(ByteDance Seed), re-designed idiomatically for TPU on JAX/XLA/Pallas:
+
+- ``runtime``  — bootstrap, mesh/topology discovery, symmetric buffers
+                 (the reference's ``pynvshmem`` + ``utils.initialize_distributed``,
+                 reference: python/triton_dist/utils.py:91-111).
+- ``lang``     — SHMEM-like device-side primitives usable inside Pallas
+                 kernels: put/put-with-signal, signal ops, waits, barriers
+                 (reference: patches/triton/python/triton/language/extra/
+                 libshmem_device.py:28-335).
+- ``kernels``  — overlapping collective/compute kernels: AllGather,
+                 ReduceScatter, AllToAll, AG-GEMM, GEMM-RS, grouped-GEMM MoE,
+                 distributed flash-decode (reference:
+                 python/triton_dist/kernels/nvidia/).
+- ``layers``   — NN-module-level wrappers (reference:
+                 python/triton_dist/layers/nvidia/).
+- ``models``   — flagship model definitions exercising the layers.
+- ``parallel`` — mesh construction and TP/EP/SP/DP sharding plans.
+- ``ops``      — stable functional entry points (ag_gemm, gemm_rs, ...).
+- ``tune``     — distributed-consensus autotuner (reference:
+                 python/triton_dist/autotuner.py).
+- ``tools``    — AOT compile and profiling tools.
+- ``utils``    — dist_print, timing, allclose, chaos-delay testing helpers.
+"""
+
+from triton_distributed_tpu.version import __version__
+
+__all__ = [
+    "__version__",
+    "config",
+    "runtime",
+    "lang",
+    "kernels",
+    "layers",
+    "models",
+    "parallel",
+    "ops",
+    "tune",
+    "tools",
+    "utils",
+]
+
+
+def __getattr__(name):
+    if name in __all__ and name != "__version__":
+        import importlib
+
+        return importlib.import_module(f"triton_distributed_tpu.{name}")
+    raise AttributeError(f"module 'triton_distributed_tpu' has no attribute {name!r}")

@@ -1,0 +1,72 @@
+"""Global configuration and platform detection.
+
+The reference framework configures itself through env vars
+(``NVSHMEM_*``, ``USE_TRITON_DISTRIBUTED_AOT``; reference:
+python/triton_dist/layers/nvidia/sp_flash_decode_layer.py:32-39). Here the
+switches that matter are: which backend are we on (TPU vs CPU-simulated
+mesh), whether Pallas kernels should run under the TPU interpreter (the
+CPU path used by the test-suite), and test-only chaos/race knobs.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+def backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def on_tpu() -> bool:
+    return backend() == "tpu"
+
+
+@dataclass
+class Config:
+    # Force Pallas interpreter mode even on TPU (debugging).
+    force_interpret: bool = field(
+        default_factory=lambda: os.environ.get("TDTPU_FORCE_INTERPRET", "0") == "1"
+    )
+    # Enable the interpreter's DMA race detector (CPU test runs only).
+    # TPU-native answer to the reference's chaos-delay substitute for a race
+    # detector (reference: python/triton_dist/kernels/nvidia/allgather.py:72-77).
+    detect_races: bool = field(
+        default_factory=lambda: os.environ.get("TDTPU_DETECT_RACES", "0") == "1"
+    )
+    # Inject randomized delays into comm paths to widen race windows
+    # ("for_correctness" testing in the reference).
+    chaos_delay: bool = field(
+        default_factory=lambda: os.environ.get("TDTPU_CHAOS_DELAY", "0") == "1"
+    )
+    # Default symmetric workspace budget (bytes) for contexts that
+    # pre-allocate communication buffers (reference NVSHMEM_SYMMETRIC_SIZE,
+    # launch.sh:1-41).
+    symmetric_size: int = field(
+        default_factory=lambda: int(
+            float(os.environ.get("TDTPU_SYMMETRIC_SIZE", "1e9"))
+        )
+    )
+
+
+config = Config()
+
+
+def interpret_params(force: bool | None = None):
+    """Pallas ``interpret=`` argument for the current platform.
+
+    On TPU hardware: ``False`` (compile with Mosaic). Anywhere else (the
+    8-virtual-device CPU mesh the tests run on): ``InterpretParams`` so that
+    remote DMA + semaphore semantics are simulated faithfully.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    use_interp = config.force_interpret or not on_tpu() if force is None else force
+    if not use_interp:
+        return False
+    return pltpu.InterpretParams(
+        detect_races=config.detect_races,
+        dma_execution_mode="on_wait",
+    )

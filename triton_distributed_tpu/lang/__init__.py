@@ -1,0 +1,49 @@
+"""Device-side language layer: SHMEM-like primitives for Pallas kernels.
+
+TPU-native re-creation of the reference's device language (L3):
+``triton_dist.language`` (``dl.wait/notify/symm_at/rank``; reference
+python/triton_dist/language.py:57-112) and ``libshmem_device``
+(reference patches/triton/python/triton/language/extra/
+libshmem_device.py:28-335). Function names track the reference so its
+tutorials/kernels map one-to-one.
+"""
+
+from triton_distributed_tpu.lang.shmem import (
+    CMP_EQ,
+    CMP_GE,
+    SIGNAL_ADD,
+    SIGNAL_SET,
+    barrier_all,
+    barrier_sem_wait_all,
+    fence,
+    my_pe,
+    n_pes,
+    putmem_nbi_block,
+    putmem_signal_nbi_block,
+    quiet,
+    remote_copy,
+    signal_op,
+    signal_wait_until,
+)
+from triton_distributed_tpu.lang.launch import shmem_call, on_mesh, vmem_specs
+
+__all__ = [
+    "my_pe",
+    "n_pes",
+    "remote_copy",
+    "putmem_nbi_block",
+    "putmem_signal_nbi_block",
+    "signal_op",
+    "signal_wait_until",
+    "fence",
+    "quiet",
+    "barrier_all",
+    "barrier_sem_wait_all",
+    "SIGNAL_SET",
+    "SIGNAL_ADD",
+    "CMP_EQ",
+    "CMP_GE",
+    "shmem_call",
+    "on_mesh",
+    "vmem_specs",
+]

@@ -1,0 +1,92 @@
+"""Launch helpers: wrap Pallas SHMEM kernels for execution on a mesh.
+
+The reference launches distributed Triton kernels on torch streams after
+NVSHMEM module init (patches/triton/python/triton/compiler/compiler.py:
+414-425). The TPU equivalent is: ``pl.pallas_call`` (with a collective_id
+and the platform-appropriate interpret mode) wrapped in ``jax.shard_map``
+over the target mesh. These helpers cut that boilerplate.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.config import interpret_params
+
+
+def shmem_call(
+    kernel,
+    *,
+    out_shape,
+    in_specs=None,
+    out_specs=None,
+    grid=None,
+    grid_spec=None,
+    scratch_shapes=(),
+    collective_id=0,
+    cost_estimate=None,
+    vmem_limit_bytes=None,
+    interpret=None,
+    input_output_aliases=None,
+    name=None,
+):
+    """``pl.pallas_call`` preconfigured for SHMEM-style distributed kernels:
+    side-effecting, collective, interpreted off-TPU."""
+    compiler_params = pltpu.CompilerParams(
+        has_side_effects=True,
+        collective_id=collective_id,
+        vmem_limit_bytes=vmem_limit_bytes,
+    )
+    kwargs = {}
+    if grid_spec is not None:
+        kwargs["grid_spec"] = grid_spec
+    else:
+        if in_specs is not None:
+            kwargs["in_specs"] = in_specs
+        if out_specs is None and grid is None:
+            # default: whole-array blocks resident in VMEM (never ANY — the
+            # interpreter can't service remote DMA waits on ANY-space bufs)
+            out_specs = jax.tree.map(
+                lambda _: pl.BlockSpec(memory_space=pltpu.VMEM), out_shape
+            )
+        if out_specs is not None:
+            kwargs["out_specs"] = out_specs
+        if grid is not None:
+            kwargs["grid"] = grid
+    if cost_estimate is not None:
+        kwargs["cost_estimate"] = cost_estimate
+    if input_output_aliases is not None:
+        kwargs["input_output_aliases"] = input_output_aliases
+    if name is not None:
+        kwargs["name"] = name
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        scratch_shapes=list(scratch_shapes),
+        compiler_params=compiler_params,
+        interpret=interpret_params() if interpret is None else interpret,
+        **kwargs,
+    )
+
+
+def vmem_specs(n: int):
+    """n whole-array VMEM BlockSpecs (the common case for SHMEM kernels)."""
+    return [pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(n)]
+
+
+def on_mesh(mesh, in_specs, out_specs, axis_names=None, jit=True):
+    """Decorator: run ``fn`` SPMD on ``mesh`` via shard_map (+jit)."""
+
+    def wrap(fn):
+        mapped = jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+        if jit:
+            mapped = jax.jit(mapped)
+        return functools.wraps(fn)(mapped)
+
+    return wrap

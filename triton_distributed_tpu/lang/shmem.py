@@ -1,0 +1,161 @@
+"""SHMEM-style device primitives over Pallas TPU remote DMA + semaphores.
+
+Mapping from the reference's device API (libshmem_device.py:28-335 and the
+distributed dialect ops, dialect/include/Dialect/Distributed/IR/
+DistributedOps.td:45-190) onto TPU hardware mechanisms:
+
+==============================  =============================================
+reference (NVSHMEM)             here (Pallas TPU)
+==============================  =============================================
+``my_pe()/n_pes()``             ``lax.axis_index/axis_size`` inside shard_map
+``putmem_nbi_block``            ``make_async_remote_copy(...).start()``
+``putmem_signal_nbi_block``     same — the *recv* DMA semaphore IS the
+                                signal: TPU RDMA increments it only after
+                                the payload has landed, so the NVSHMEM
+                                "data then flag" ordering is a hardware
+                                guarantee here, no LL-packing needed.
+``signal_op(SET/ADD)``          ``semaphore_signal`` (ADD). TPU semaphores
+                                have no SET; counters are cumulative and
+                                callers wait on cumulative values
+                                (call_count patterns still work: wait for
+                                +1 per round instead of ==round).
+``signal_wait_until(CMP_EQ,v)`` ``semaphore_wait(sem, v)`` — consuming wait
+                                (decrements by v after the wait). This is
+                                the TPU idiom; kernels are written for
+                                consume semantics.
+``fence()/quiet()``             DMA-handle ``wait_send()`` — completion of
+                                outstanding puts is per-handle, made
+                                explicit by :func:`quiet`.
+``barrier_all``                 signal-all-peers + wait(n-1) on the global
+                                barrier semaphore (needs a ``collective_id``).
+``symm_at(ptr, rank)``          not needed: remote DMA takes a logical
+                                device id directly; peers are addressed by
+                                (ref, device_id), see runtime.flat_device_id.
+==============================  =============================================
+
+All functions here must be called from inside a Pallas kernel body that is
+itself invoked under ``shard_map`` (see :mod:`triton_distributed_tpu.lang.launch`).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+# Signal-op / compare constants, mirroring NVSHMEM_SIGNAL_* / NVSHMEM_CMP_*
+# (libshmem_device.py constants section).
+SIGNAL_SET = "set"   # emulated — see module docstring
+SIGNAL_ADD = "add"
+CMP_EQ = "eq"
+CMP_GE = "ge"
+
+
+def my_pe(axis) -> jax.Array:
+    """This device's index along mesh axis(es) ``axis`` (≡ nvshmem_my_pe)."""
+    return jax.lax.axis_index(axis)
+
+
+def n_pes(axis) -> jax.Array:
+    """Number of devices along ``axis`` (≡ nvshmem_n_pes)."""
+    return jax.lax.axis_size(axis)
+
+
+def remote_copy(src_ref, dst_ref, send_sem, recv_sem, pe):
+    """Build (don't start) an async remote copy descriptor to device ``pe``.
+
+    ``pe`` is a flat logical device id (use runtime.flat_device_id for
+    multi-axis meshes, or the axis index directly on a 1D mesh).
+    """
+    return pltpu.make_async_remote_copy(
+        src_ref=src_ref,
+        dst_ref=dst_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=pe,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+
+
+def putmem_nbi_block(dst_ref, src_ref, send_sem, recv_sem, pe):
+    """Non-blocking put of ``src_ref`` into ``dst_ref`` on device ``pe``.
+
+    Returns the DMA handle; pair with :func:`quiet` (sender) and
+    ``handle.wait_recv()`` or :func:`signal_wait_until` semantics on the
+    receiver (≡ libshmem_device.putmem_nbi_block).
+    """
+    h = remote_copy(src_ref, dst_ref, send_sem, recv_sem, pe)
+    h.start()
+    return h
+
+
+def putmem_signal_nbi_block(dst_ref, src_ref, send_sem, recv_sem, pe):
+    """Put + arrival signal (≡ putmem_signal_nbi_block, 6-variant family).
+
+    On TPU the receive semaphore is incremented after payload arrival, so a
+    single RDMA is both the data movement and the ordered signal.
+    """
+    return putmem_nbi_block(dst_ref, src_ref, send_sem, recv_sem, pe)
+
+
+def signal_op(sem, inc=1, pe=None):
+    """Increment a (possibly remote) regular semaphore
+    (≡ libshmem_device.signal_op with NVSHMEM_SIGNAL_ADD, and the dialect's
+    ``distributed.notify``, DistributedOps.td:151-164)."""
+    if pe is None:
+        pltpu.semaphore_signal(sem, inc=inc)
+    else:
+        pltpu.semaphore_signal(
+            sem, inc=inc, device_id=pe, device_id_type=pltpu.DeviceIdType.LOGICAL
+        )
+
+
+def signal_wait_until(sem, value):
+    """Wait until ``sem`` reaches ``value`` then consume it
+    (≡ signal_wait_until(CMP_EQ) and the dialect's ``distributed.wait``,
+    DistributedOps.td:45-77; consuming semantics are the TPU idiom)."""
+    pltpu.semaphore_wait(sem, value)
+
+
+def fence():
+    """Ordering fence between puts to the same peer.
+
+    TPU RDMA to a given destination is delivered in issue order per
+    (src, dst) pair and the recv semaphore fires post-arrival, so the
+    reference's fence (libshmem_device.fence) is a no-op here. Kept for
+    API parity.
+    """
+    return None
+
+
+def quiet(*handles):
+    """Block until all given put handles have drained locally
+    (≡ libshmem_device.quiet). Sender-side completion only."""
+    for h in handles:
+        h.wait_send()
+
+
+def barrier_all(axis):
+    """Grid-wide barrier across all devices along ``axis``
+    (≡ libshmem_device.barrier_all / barrier_all_block;
+    reference common_ops.py:62-130's barrier_all family).
+
+    Requires the enclosing pallas_call to set a ``collective_id`` in its
+    CompilerParams (the global barrier semaphore is keyed by it).
+    """
+    barrier_sem_wait_all(pltpu.get_barrier_semaphore(), axis)
+
+
+def barrier_sem_wait_all(sem, axis):
+    """Signal every peer on a user regular semaphore and wait for all."""
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+
+    def body(i, _):
+        peer = jax.lax.rem(me + i + 1, n)
+        pltpu.semaphore_signal(
+            sem, inc=1, device_id=peer, device_id_type=pltpu.DeviceIdType.LOGICAL
+        )
+        return 0
+
+    jax.lax.fori_loop(0, n - 1, body, 0)
+    pltpu.semaphore_wait(sem, n - 1)

@@ -1,0 +1,45 @@
+"""Runtime: bootstrap, mesh/topology discovery, symmetric buffers.
+
+TPU-native replacement for the reference's L0+L2 layers: ``pynvshmem``
+symmetric-memory management (reference: shmem/nvshmem_bind/pynvshmem/python/
+pynvshmem/__init__.py:94-196) and ``utils.initialize_distributed``
+(reference: python/triton_dist/utils.py:91-111).
+"""
+
+from triton_distributed_tpu.runtime.bootstrap import (
+    DistContext,
+    finalize_distributed,
+    get_context,
+    initialize_distributed,
+)
+from triton_distributed_tpu.runtime.symm import (
+    SymmetricBuffer,
+    symm_empty,
+    symm_full,
+    symm_zeros,
+)
+from triton_distributed_tpu.runtime.topology import (
+    AllGatherMethod,
+    TopologyInfo,
+    auto_allgather_method,
+    detect_topology,
+    flat_device_id,
+    ring_neighbors,
+)
+
+__all__ = [
+    "DistContext",
+    "initialize_distributed",
+    "finalize_distributed",
+    "get_context",
+    "SymmetricBuffer",
+    "symm_zeros",
+    "symm_empty",
+    "symm_full",
+    "TopologyInfo",
+    "AllGatherMethod",
+    "detect_topology",
+    "auto_allgather_method",
+    "ring_neighbors",
+    "flat_device_id",
+]

@@ -1,0 +1,62 @@
+"""Symmetric buffers: identically-shaped per-device arrays.
+
+Reference equivalent: ``pynvshmem.nvshmem_create_tensor`` — a tensor
+allocated at the same address on every PE's symmetric heap
+(shmem/nvshmem_bind/pynvshmem/python/pynvshmem/__init__.py:94-160).
+
+On TPU under shard_map the symmetric-memory property comes for free: a
+global array sharded so every device holds one identical-shape shard IS a
+symmetric buffer — Pallas refs to it on each device are the peer-visible
+windows, and remote DMA addresses them by logical device id. This module
+just packages the pattern.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass
+class SymmetricBuffer:
+    """A global array whose leading axis is sharded one-shard-per-device
+    along ``axis`` of ``mesh``; ``local_shape`` is each device's window."""
+
+    array: jax.Array
+    mesh: Mesh
+    axis: str
+
+    @property
+    def local_shape(self) -> tuple[int, ...]:
+        n = self.mesh.shape[self.axis]
+        return (self.array.shape[0] // n,) + tuple(self.array.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.array.dtype
+
+
+def symm_zeros(mesh: Mesh, axis: str, local_shape, dtype=jnp.float32) -> SymmetricBuffer:
+    n = mesh.shape[axis]
+    global_shape = (n * local_shape[0],) + tuple(local_shape[1:])
+    arr = jax.device_put(
+        jnp.zeros(global_shape, dtype=dtype), NamedSharding(mesh, P(axis))
+    )
+    return SymmetricBuffer(arr, mesh, axis)
+
+
+def symm_full(mesh: Mesh, axis: str, local_shape, fill_value, dtype=jnp.float32):
+    n = mesh.shape[axis]
+    global_shape = (n * local_shape[0],) + tuple(local_shape[1:])
+    arr = jax.device_put(
+        jnp.full(global_shape, fill_value, dtype=dtype), NamedSharding(mesh, P(axis))
+    )
+    return SymmetricBuffer(arr, mesh, axis)
+
+
+def symm_empty(mesh: Mesh, axis: str, local_shape, dtype=jnp.float32):
+    # XLA has no uninitialized alloc; zeros is the honest equivalent.
+    return symm_zeros(mesh, axis, local_shape, dtype)

@@ -1,0 +1,118 @@
+"""Topology discovery and communication-method selection.
+
+Reference equivalent: the NVLink-fullmesh / NUMA / PCIe probes in
+python/triton_dist/utils.py:504-786 and the ``AllGatherMethod`` auto
+selection in python/triton_dist/kernels/nvidia/allgather.py:44-69.
+
+On TPU the relevant facts are different: chips sit on a 2D/3D torus (ICI)
+inside a slice, and slices are joined over DCN. Rings are the *natural*
+method on a torus, full-mesh push is not. We classify each mesh axis as
+ICI (same slice) or DCN (cross-slice / cross-host on CPU) and pick ring
+variants accordingly.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+class AllGatherMethod(enum.Enum):
+    """Mirror of the reference's AllGatherMethod enum (allgather.py:44-56),
+    re-ranged for TPU: rings over ICI; LL-packed for small messages; XLA
+    collective fallback for DCN legs."""
+
+    RING_1D = "ring_1d"
+    RING_BIDIR = "ring_bidir"
+    LL_SMALL = "ll_small"          # low-latency packed, small messages
+    XLA_FALLBACK = "xla"           # lax.all_gather (DCN or no-pallas path)
+
+
+class LinkKind(enum.Enum):
+    ICI = "ici"       # within-slice torus links
+    DCN = "dcn"       # across slices / hosts
+    HOST = "host"     # CPU simulation
+
+
+@dataclass
+class TopologyInfo:
+    num_devices: int
+    link_kind: LinkKind
+    is_torus: bool
+    coords: tuple | None = None   # per-device coords when available
+
+
+def detect_topology(mesh: Mesh, axis: str | None = None) -> TopologyInfo:
+    """Classify the links along ``axis`` of ``mesh`` (whole mesh if None).
+
+    Only the devices that communicate along ``axis`` (one line of the mesh,
+    other coordinates fixed at 0) are inspected, so e.g. a cross-slice
+    ``dp`` axis doesn't poison the classification of a within-slice ``tp``
+    axis."""
+    if axis is None:
+        devices = mesh.devices.ravel()
+    else:
+        ax = mesh.axis_names.index(axis)
+        index = tuple(slice(None) if i == ax else 0 for i in range(mesh.devices.ndim))
+        devices = np.asarray(mesh.devices[index]).ravel()
+    n = devices.size
+    first = devices[0]
+    if first.platform != "tpu":
+        return TopologyInfo(num_devices=n, link_kind=LinkKind.HOST, is_torus=False)
+    # All devices on one process/slice → ICI. Devices with distinct
+    # slice_index (multi-slice) → DCN on the crossing axis.
+    slice_ids = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    coords = tuple(getattr(d, "coords", None) for d in devices)
+    if len(slice_ids) > 1:
+        return TopologyInfo(n, LinkKind.DCN, is_torus=False, coords=coords)
+    return TopologyInfo(n, LinkKind.ICI, is_torus=True, coords=coords)
+
+
+def auto_allgather_method(
+    topo: TopologyInfo, nbytes_per_shard: int, small_msg_threshold: int = 1 << 16
+) -> AllGatherMethod:
+    """Pick an AG method from topology + message size (≡ allgather.py:54-69)."""
+    if topo.link_kind == LinkKind.DCN:
+        return AllGatherMethod.XLA_FALLBACK
+    if nbytes_per_shard <= small_msg_threshold:
+        return AllGatherMethod.LL_SMALL
+    if topo.num_devices >= 4:
+        return AllGatherMethod.RING_BIDIR
+    return AllGatherMethod.RING_1D
+
+
+def ring_neighbors(idx, n):
+    """(left, right) neighbors on a ring of size ``n`` (traced-value safe)."""
+    right = jax.lax.rem(idx + 1, n)
+    left = jax.lax.rem(idx + n - 1, n)
+    return left, right
+
+
+def flat_device_id(mesh_axis_names, target_axis, target_idx):
+    """Flat logical device id for use as a Pallas remote-DMA ``device_id``.
+
+    Pallas LOGICAL device ids index the mesh's flattened device array. Inside
+    a shard_map over a multi-axis mesh, the peer "target_idx along
+    target_axis, same coords elsewhere" therefore has flat id
+    ``sum_over_axes(coord_i * stride_i)`` with row-major strides.
+
+    Must be called inside shard_map/pallas tracing (uses lax.axis_index).
+    """
+    sizes = [jax.lax.axis_size(a) for a in mesh_axis_names]
+    flat = 0
+    for name, size in zip(mesh_axis_names, sizes):
+        coord = target_idx if name == target_axis else jax.lax.axis_index(name)
+        flat = flat * size + coord
+    return flat
+
+
+def device_coords(mesh: Mesh) -> np.ndarray | None:
+    """Physical chip coords per mesh position (TPU only), for ring layout."""
+    devs = mesh.devices.ravel()
+    if devs[0].platform != "tpu" or getattr(devs[0], "coords", None) is None:
+        return None
+    return np.array([d.coords for d in devs])

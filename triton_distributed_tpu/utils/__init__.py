@@ -1,0 +1,11 @@
+"""Utilities: printing, timing, correctness checks, chaos testing.
+
+Reference equivalent: python/triton_dist/utils.py (dist_print :201,
+perf_func :186, assert_allclose :789, chaos-delay allgather.py:72-77).
+"""
+
+from triton_distributed_tpu.utils.debug import dist_print
+from triton_distributed_tpu.utils.testing import assert_allclose, chaos_delay
+from triton_distributed_tpu.utils.timing import perf_func
+
+__all__ = ["dist_print", "perf_func", "assert_allclose", "chaos_delay"]

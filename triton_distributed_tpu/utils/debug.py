@@ -1,0 +1,28 @@
+"""Rank-aware printing (≡ reference utils.dist_print, utils.py:201-230)."""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+
+
+def dist_print(*args, ranks=None, prefix=True, flush=True, file=None, **kwargs):
+    """Print only on the given process ranks (default: rank 0).
+
+    ``ranks=None`` → rank 0 only; ``ranks="all"`` → every rank, prefixed.
+    """
+    rank = jax.process_index()
+    if ranks is None:
+        allowed = {0}
+    elif ranks == "all":
+        allowed = set(range(jax.process_count()))
+    else:
+        allowed = set(ranks)
+    if rank not in allowed:
+        return
+    out = file or sys.stdout
+    if prefix and (ranks == "all" or len(allowed) > 1):
+        print(f"[rank {rank}]", *args, flush=flush, file=out, **kwargs)
+    else:
+        print(*args, flush=flush, file=out, **kwargs)

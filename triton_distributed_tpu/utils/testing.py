@@ -1,0 +1,51 @@
+"""Correctness helpers: allclose with diff dump, chaos delay.
+
+Reference: ``assert_allclose`` with mismatch dump (utils.py:789-820) and the
+``for_correctness`` random comm-stream sleep that widens race windows
+(allgather.py:72-77,118-121). On TPU the chaos delay is a Pallas in-kernel
+delay; the CPU interpreter additionally offers a true race detector
+(config.detect_races → InterpretParams(detect_races=True)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.config import config
+
+
+def assert_allclose(actual, expected, atol=1e-3, rtol=1e-3, verbose=True):
+    actual = np.asarray(actual)
+    expected = np.asarray(expected)
+    if actual.shape != expected.shape:
+        raise AssertionError(f"shape mismatch: {actual.shape} vs {expected.shape}")
+    close = np.isclose(actual, expected, atol=atol, rtol=rtol)
+    if close.all():
+        return
+    bad = np.argwhere(~close)
+    diff = np.abs(actual.astype(np.float64) - expected.astype(np.float64))
+    msg = [
+        f"allclose failed: {bad.shape[0]}/{actual.size} mismatched "
+        f"(atol={atol}, rtol={rtol})",
+        f"max |diff| = {diff.max()} at {np.unravel_index(diff.argmax(), diff.shape)}",
+    ]
+    if verbose:
+        for idx in bad[:10]:
+            t = tuple(idx)
+            msg.append(f"  at {t}: actual={actual[t]} expected={expected[t]}")
+    raise AssertionError("\n".join(msg))
+
+
+def chaos_delay(cycles: int = 100_000, enable: bool | None = None):
+    """In-kernel delay to widen race windows (call inside a Pallas kernel).
+
+    ≡ the reference's ``torch.cuda._sleep`` injection (allgather.py:72-77).
+    No-op unless chaos testing is enabled.
+    """
+    from jax.experimental import pallas as pl
+
+    on = config.chaos_delay if enable is None else enable
+    if on:
+        pl.delay(cycles)
