@@ -25,6 +25,17 @@ import pytest  # noqa: E402
 from jax.sharding import Mesh  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _fresh_interpreter_state():
+    """Isolate tests: the TPU interpreter keeps global shared memory /
+    semaphore state per process; stale state from a failed kernel must not
+    leak into the next test."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    pltpu.reset_tpu_interpret_mode_state()
+    yield
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     devs = jax.devices()

@@ -1,0 +1,39 @@
+"""Chaos-delay correctness runs (≡ the reference's ``for_correctness``
+random comm-stream sleep, allgather.py:72-77: prove consumers truly wait
+on signals by widening race windows)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.config import config
+from triton_distributed_tpu.kernels import all_gather, all_to_all, reduce_scatter
+from triton_distributed_tpu.runtime import AllGatherMethod
+from triton_distributed_tpu.utils import assert_allclose
+
+
+@pytest.fixture()
+def chaos():
+    config.chaos_delay = True
+    yield
+    config.chaos_delay = False
+
+
+def test_allgather_under_chaos(mesh8, chaos):
+    x = jnp.arange(64 * 128, dtype=jnp.float32).reshape(64, 128)
+    for method in [AllGatherMethod.RING_1D, AllGatherMethod.RING_BIDIR,
+                   AllGatherMethod.LL_SMALL]:
+        y = all_gather(x, mesh8, "x", method=method)
+        assert_allclose(y, x)
+
+
+def test_reduce_scatter_under_chaos(mesh8, chaos):
+    x = jnp.ones((8, 64, 128), jnp.float32) * jnp.arange(8).reshape(8, 1, 1)
+    y = reduce_scatter(x, mesh8, "x", stacked=True)
+    assert_allclose(y, np.full((64, 128), 28.0))
+
+
+def test_all_to_all_under_chaos(mesh8, chaos):
+    x = jnp.arange(64 * 128, dtype=jnp.float32).reshape(64, 128)
+    y = all_to_all(all_to_all(x, mesh8, "x"), mesh8, "x")
+    assert_allclose(y, x)

@@ -1,0 +1,19 @@
+"""Kernel library (L4): collective and compute-communication-overlap kernels.
+
+Reference: python/triton_dist/kernels/nvidia/ (see SURVEY.md §2.3).
+"""
+
+from triton_distributed_tpu.kernels.all_to_all import all_to_all, all_to_all_xla
+from triton_distributed_tpu.kernels.allgather import all_gather
+from triton_distributed_tpu.kernels.reduce_scatter import (
+    reduce_scatter,
+    reduce_scatter_xla,
+)
+
+__all__ = [
+    "all_gather",
+    "reduce_scatter",
+    "reduce_scatter_xla",
+    "all_to_all",
+    "all_to_all_xla",
+]

@@ -1,0 +1,209 @@
+"""AllGather engines (TPU-native re-design of the reference AG family).
+
+Reference: python/triton_dist/kernels/nvidia/allgather.py — copy-engine
+full-mesh push/pull (:79-135), 1D ring push (:138), NUMA-aware 2D ring
+(:194), inter-node NVSHMEM variants (:291-468), with ``AllGatherMethod``
+auto-selection (:44-69); low-latency variants in low_latency_allgather.py.
+
+TPU re-design: the torus makes rings the bandwidth-optimal method over
+ICI, so the workhorses are a unidirectional ring and a bidirectional ring
+(each direction carries half of every shard → 2× bandwidth). For small
+messages a direct all-to-all push minimizes hops (the role the reference's
+LL-packed protocol plays; TPU needs no flag packing because the RDMA recv
+semaphore is ordered after payload arrival). DCN / no-Pallas paths fall
+back to ``jax.lax.all_gather``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import lang
+from triton_distributed_tpu.config import config
+from triton_distributed_tpu.runtime import (
+    AllGatherMethod,
+    auto_allgather_method,
+    detect_topology,
+    ring_neighbors,
+)
+from triton_distributed_tpu.utils.testing import chaos_delay
+
+
+def _ring_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
+    """Unidirectional ring: at step s forward shard (me-s) to the right
+    neighbor; after n-1 steps everyone holds everything."""
+    me = lang.my_pe(axis)
+    m = x_ref.shape[0]
+    left, right = ring_neighbors(me, n)
+    left, right = lang.pe_flat(axis, left, mesh_axes), lang.pe_flat(axis, right, mesh_axes)
+
+    out_ref[pl.ds(me * m, m)] = x_ref[:]
+    # neighbor barrier: don't RDMA into a peer that hasn't entered the kernel
+    barrier = pltpu.get_barrier_semaphore()
+    lang.signal_op(barrier, 1, pe=left)
+    lang.signal_op(barrier, 1, pe=right)
+    pltpu.semaphore_wait(barrier, 2)
+
+    # One semaphore slot per step: a slot's credit can then only come from
+    # that step's DMA, so a wait being satisfied proves that *specific*
+    # transfer landed (slot reuse would let a later step's credit release an
+    # earlier wait while its data is still in flight).
+    for s in range(n - 1):
+        src = jax.lax.rem(me + n - s, n) if s > 0 else me
+        chaos_delay()
+        dma = lang.remote_copy(
+            out_ref.at[pl.ds(src * m, m)],
+            out_ref.at[pl.ds(src * m, m)],
+            send_sem.at[s],
+            recv_sem.at[s],
+            right,
+        )
+        dma.start()
+        dma.wait()  # drains send + the symmetric incoming recv
+
+
+def _ring_bidir_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
+    """Bidirectional ring: clockwise carries the left half-columns of every
+    shard, counter-clockwise the right half → each link moves half the
+    bytes, halving AG time on a torus."""
+    me = lang.my_pe(axis)
+    m = x_ref.shape[0]
+    k = x_ref.shape[1]
+    kh = k // 2
+    left, right = ring_neighbors(me, n)
+    left, right = lang.pe_flat(axis, left, mesh_axes), lang.pe_flat(axis, right, mesh_axes)
+
+    out_ref[pl.ds(me * m, m)] = x_ref[:]
+    barrier = pltpu.get_barrier_semaphore()
+    lang.signal_op(barrier, 1, pe=left)
+    lang.signal_op(barrier, 1, pe=right)
+    pltpu.semaphore_wait(barrier, 2)
+
+    # Per-step distinct semaphore slots (see _ring_ag_kernel): cw uses
+    # slots [0, n-1), ccw uses [n-1, 2(n-1)).
+    for s in range(n - 1):
+        cw_src = jax.lax.rem(me + n - s, n)   # shard forwarded clockwise
+        ccw_src = jax.lax.rem(me + s, n)      # shard forwarded counter-clockwise
+        chaos_delay()
+        cw = lang.remote_copy(
+            out_ref.at[pl.ds(cw_src * m, m), pl.ds(0, kh)],
+            out_ref.at[pl.ds(cw_src * m, m), pl.ds(0, kh)],
+            send_sem.at[s],
+            recv_sem.at[s],
+            right,
+        )
+        ccw = lang.remote_copy(
+            out_ref.at[pl.ds(ccw_src * m, m), pl.ds(kh, k - kh)],
+            out_ref.at[pl.ds(ccw_src * m, m), pl.ds(kh, k - kh)],
+            send_sem.at[n - 1 + s],
+            recv_sem.at[n - 1 + s],
+            left,
+        )
+        cw.start()
+        ccw.start()
+        cw.wait()
+        ccw.wait()
+
+
+def _ll_push_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
+    """Small-message path: push the local shard straight to every peer
+    (one hop, n-1 concurrent RDMAs), then wait for the n-1 arrivals.
+    ≡ the role of the reference's LL/multimem fast-allgather
+    (low_latency_allgather.py:532-624) — flag packing is unnecessary
+    because TPU recv semaphores fire after payload arrival."""
+    me = lang.my_pe(axis)
+    m = x_ref.shape[0]
+
+    out_ref[pl.ds(me * m, m)] = x_ref[:]
+    lang.barrier_all(axis, mesh_axes)
+
+    handles = []
+    for i in range(n - 1):
+        peer = lang.pe_flat(axis, jax.lax.rem(me + 1 + i, n), mesh_axes)
+        chaos_delay()
+        handles.append(
+            lang.putmem_signal_nbi_block(
+                out_ref.at[pl.ds(me * m, m)],
+                out_ref.at[pl.ds(me * m, m)],
+                send_sem.at[i],
+                recv_sem.at[i],
+                peer,
+            )
+        )
+    lang.quiet(*handles)
+    # wait for the n-1 incoming shards (equal-size, any order)
+    for i, h in enumerate(handles):
+        h.wait_recv()
+
+
+_KERNELS = {
+    # (kernel, number of semaphore slots as fn of n)
+    AllGatherMethod.RING_1D: (_ring_ag_kernel, lambda n: n - 1),
+    AllGatherMethod.RING_BIDIR: (_ring_bidir_ag_kernel, lambda n: 2 * (n - 1)),
+    AllGatherMethod.LL_SMALL: (_ll_push_ag_kernel, lambda n: n - 1),
+}
+
+
+@functools.lru_cache(maxsize=256)
+def _build_all_gather(mesh, axis, method, shape, dtype, collective_id, chaos):
+    """Compile-once factory: the jitted collective for one (mesh, shape)
+    configuration. lru_cache gives call-site reuse — without it every
+    invocation would rebuild pallas_call+shard_map+jit and retrace."""
+    n = mesh.shape[axis]
+    if method == AllGatherMethod.XLA_FALLBACK:
+        fn = jax.shard_map(
+            lambda s: jax.lax.all_gather(s, axis, tiled=True),
+            mesh=mesh,
+            in_specs=P(axis),
+            out_specs=P(None),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    kernel_fn, nsem_fn = _KERNELS[method]
+    nsem = max(nsem_fn(n), 1)
+    call = lang.shmem_call(
+        functools.partial(kernel_fn, n, axis, mesh.axis_names),
+        out_shape=jax.ShapeDtypeStruct(shape, dtype),
+        in_specs=lang.vmem_specs(1),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((nsem,)),
+            pltpu.SemaphoreType.DMA((nsem,)),
+        ],
+        collective_id=collective_id,
+        name=f"ag_{method.value}",
+    )
+    fn = jax.shard_map(
+        call, mesh=mesh, in_specs=P(axis), out_specs=P(None), check_vma=False
+    )
+    return jax.jit(fn)
+
+
+def all_gather(
+    x,
+    mesh,
+    axis: str = "x",
+    *,
+    method: AllGatherMethod | None = None,
+    collective_id: int = 2,
+):
+    """AllGather ``x`` (sharded on dim 0 along ``axis``) → replicated full array.
+
+    Host entry ≡ reference ``fast_allgather`` dispatcher
+    (low_latency_allgather.py:971) + method auto-selection (allgather.py:54-69).
+    """
+    n = mesh.shape[axis]
+    if method is None:
+        shard_bytes = (x.size // n) * x.dtype.itemsize
+        method = auto_allgather_method(detect_topology(mesh, axis), shard_bytes)
+    if n == 1:
+        return x
+    fn = _build_all_gather(
+        mesh, axis, method, x.shape, x.dtype, collective_id, config.chaos_delay
+    )
+    return fn(x)

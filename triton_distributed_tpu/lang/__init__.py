@@ -18,6 +18,7 @@ from triton_distributed_tpu.lang.shmem import (
     fence,
     my_pe,
     n_pes,
+    pe_flat,
     putmem_nbi_block,
     putmem_signal_nbi_block,
     quiet,
@@ -30,6 +31,7 @@ from triton_distributed_tpu.lang.launch import shmem_call, on_mesh, vmem_specs
 __all__ = [
     "my_pe",
     "n_pes",
+    "pe_flat",
     "remote_copy",
     "putmem_nbi_block",
     "putmem_signal_nbi_block",

@@ -55,6 +55,22 @@ def my_pe(axis) -> jax.Array:
     return jax.lax.axis_index(axis)
 
 
+def pe_flat(axis, idx, mesh_axes=None):
+    """Translate index ``idx`` along ``axis`` into the flat LOGICAL device id
+    Pallas wants, keeping this device's coordinates on all other axes.
+
+    ``mesh_axes`` is the full ordered tuple of mesh axis names; ``None``
+    means a 1D mesh where ``idx`` already is the flat id. Every cross-device
+    primitive here takes flat ids — forgetting this on a multi-axis mesh
+    makes RDMA target devices on the wrong mesh row (deadlock/corruption).
+    """
+    if mesh_axes is None or tuple(mesh_axes) == (axis,):
+        return idx
+    from triton_distributed_tpu.runtime.topology import flat_device_id
+
+    return flat_device_id(tuple(mesh_axes), axis, idx)
+
+
 def n_pes(axis) -> jax.Array:
     """Number of devices along ``axis`` (≡ nvshmem_n_pes)."""
     return jax.lax.axis_size(axis)
@@ -134,7 +150,7 @@ def quiet(*handles):
         h.wait_send()
 
 
-def barrier_all(axis):
+def barrier_all(axis, mesh_axes=None):
     """Grid-wide barrier across all devices along ``axis``
     (≡ libshmem_device.barrier_all / barrier_all_block;
     reference common_ops.py:62-130's barrier_all family).
@@ -142,16 +158,16 @@ def barrier_all(axis):
     Requires the enclosing pallas_call to set a ``collective_id`` in its
     CompilerParams (the global barrier semaphore is keyed by it).
     """
-    barrier_sem_wait_all(pltpu.get_barrier_semaphore(), axis)
+    barrier_sem_wait_all(pltpu.get_barrier_semaphore(), axis, mesh_axes)
 
 
-def barrier_sem_wait_all(sem, axis):
+def barrier_sem_wait_all(sem, axis, mesh_axes=None):
     """Signal every peer on a user regular semaphore and wait for all."""
     n = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
 
     def body(i, _):
-        peer = jax.lax.rem(me + i + 1, n)
+        peer = pe_flat(axis, jax.lax.rem(me + i + 1, n), mesh_axes)
         pltpu.semaphore_signal(
             sem, inc=1, device_id=peer, device_id_type=pltpu.DeviceIdType.LOGICAL
         )
