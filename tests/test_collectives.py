@@ -86,6 +86,15 @@ def test_all_to_all_roundtrip(mesh8):
     assert_allclose(y, x)
 
 
+def test_all_gather_rank1_bidir_demotes(mesh8):
+    """Regression: RING_BIDIR splits dim 1 across the two ring directions,
+    which is impossible on rank-1 inputs — the entry must demote to
+    RING_1D instead of crashing at trace time."""
+    x = jnp.arange(8 * 128, dtype=jnp.float32)
+    y = all_gather(x, mesh8, "x", method=AllGatherMethod.RING_BIDIR)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+
+
 def test_all_gather_multiaxis_mesh(mesh2x4):
     """Regression: collectives along the inner axis of a 2x4 ('dp','tp')
     mesh must translate axis-local peers to flat logical device ids —

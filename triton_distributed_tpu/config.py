@@ -51,7 +51,20 @@ class Config:
     )
 
 
+    # Per-core VMEM working-set budget (bytes) used to gate fused single
+    # -kernel engines (ag_gemm, gemm_rs) vs the streaming XLA ring paths.
+    fused_vmem_budget: int = field(
+        default_factory=lambda: int(
+            float(os.environ.get("TDTPU_FUSED_VMEM_BUDGET", str(96 * 1024 * 1024)))
+        )
+    )
+
+
 config = Config()
+
+
+def fused_vmem_budget() -> int:
+    return config.fused_vmem_budget
 
 
 def interpret_params(force: bool | None = None):

@@ -3,8 +3,10 @@
 Reference: python/triton_dist/kernels/nvidia/ (see SURVEY.md §2.3).
 """
 
+from triton_distributed_tpu.kernels.ag_gemm import AGGemmMethod, ag_gemm
 from triton_distributed_tpu.kernels.all_to_all import all_to_all, all_to_all_xla
 from triton_distributed_tpu.kernels.allgather import all_gather
+from triton_distributed_tpu.kernels.gemm_rs import GemmRSMethod, gemm_rs
 from triton_distributed_tpu.kernels.reduce_scatter import (
     reduce_scatter,
     reduce_scatter_xla,
@@ -16,4 +18,8 @@ __all__ = [
     "reduce_scatter_xla",
     "all_to_all",
     "all_to_all_xla",
+    "ag_gemm",
+    "AGGemmMethod",
+    "gemm_rs",
+    "GemmRSMethod",
 ]

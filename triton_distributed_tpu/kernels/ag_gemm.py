@@ -1,0 +1,230 @@
+"""AllGather-GEMM: tensor-parallel overlap of activation gather with matmul.
+
+Reference: python/triton_dist/kernels/nvidia/allgather_gemm.py — context
+(:407-490), producer copy engines + consumer persistent GEMM waiting
+per-tile on shard-arrival barriers (:133-254, dl.wait+consume_token
+:224-227), rank-swizzled tile order (:205-219), host entry ``ag_gemm``
+(:539) and the multi-stream dispatcher (:586-661).
+
+TPU re-design — no streams, two engines instead:
+
+* ``PALLAS_FUSED``: ONE Pallas kernel per device runs a shard-granular
+  ring: at step ``s`` it computes the MXU matmul for shard ``(me-s)``
+  while the RDMA forwarding that same shard to the right neighbor is in
+  flight. The DMA recv semaphore *is* the reference's per-tile barrier
+  (dl.wait ≡ ``wait_recv``; consume_token is unnecessary because the
+  semaphore wait orders the subsequent VMEM reads). Each rank starts on
+  its own local shard — the reference's rank-swizzled tile order falls
+  out of the ring schedule naturally.
+* ``XLA_RING``: shard_map loop of ``ppermute`` + ``jnp.dot`` —
+  XLA's async collective-permute overlaps the hop with the matmul. Works
+  for any size (shards stream through HBM, not VMEM); this is the DCN /
+  large-shape path, mirroring the reference's inter-node engine
+  (allgather.py:291-468).
+* ``XLA_NAIVE``: all_gather → dot (the torch_ag_gemm-style baseline,
+  reference test_ag_gemm.py).
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import lang
+from triton_distributed_tpu.config import config, fused_vmem_budget, on_tpu
+from triton_distributed_tpu.runtime import LinkKind, detect_topology, ring_neighbors
+from triton_distributed_tpu.utils.testing import chaos_delay
+
+
+class AGGemmMethod(enum.Enum):
+    PALLAS_FUSED = "pallas_fused"
+    XLA_RING = "xla_ring"
+    XLA_NAIVE = "xla_naive"
+
+
+def _fused_kernel(n, axis, mesh_axes, x_ref, b_ref, out_ref, ag_ref, send_sem, recv_sem):
+    """Ring AG-GEMM. Per step: wait shard arrival → start forwarding it →
+    matmul it against the local B shard while the RDMA is in flight."""
+    me = lang.my_pe(axis)
+    m = x_ref.shape[0]
+    left, right = ring_neighbors(me, n)
+    left = lang.pe_flat(axis, left, mesh_axes)
+    right = lang.pe_flat(axis, right, mesh_axes)
+
+    ag_ref[pl.ds(me * m, m)] = x_ref[:]
+    lang.neighbor_barrier(axis, left, right)
+
+    dmas = []
+    for s in range(n):
+        src = jax.lax.rem(me + n - s, n) if s > 0 else me
+        if s > 0:
+            # Shard ``src`` was sent by the left neighbor at its step s-1
+            # and lands with a credit on recv_sem[s-1]. The descriptor we
+            # wait on is our *outgoing* step s-1 copy — byte counts are
+            # identical for every shard, so the recv wait releases exactly
+            # when the incoming shard's payload is resident (the dl.wait +
+            # consume_token of allgather_gemm.py:224-227, done by hardware).
+            dmas[s - 1].wait_recv()
+        if s < n - 1:
+            chaos_delay()
+            dma = lang.remote_copy(
+                ag_ref.at[pl.ds(src * m, m)],
+                ag_ref.at[pl.ds(src * m, m)],
+                send_sem.at[s],
+                recv_sem.at[s],
+                right,
+            )
+            dma.start()
+            dmas.append(dma)
+        # MXU matmul for this shard, overlapped with the in-flight forward.
+        out_ref[pl.ds(src * m, m)] = jnp.dot(
+            ag_ref[pl.ds(src * m, m)], b_ref[:], preferred_element_type=jnp.float32
+        ).astype(out_ref.dtype)
+    for dma in dmas:
+        dma.wait_send()
+
+
+@functools.lru_cache(maxsize=256)
+def _build_fused(mesh, axis, a_shape, b_shape, dtype, out_dtype, collective_id, chaos):
+    n = mesh.shape[axis]
+    k = a_shape[1]
+    n_local = b_shape[1] // n
+
+    call = lang.shmem_call(
+        functools.partial(_fused_kernel, n, axis, mesh.axis_names),
+        out_shape=jax.ShapeDtypeStruct((a_shape[0], n_local), out_dtype),
+        in_specs=lang.vmem_specs(2),
+        scratch_shapes=[
+            pltpu.VMEM((a_shape[0], k), dtype),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+        ],
+        collective_id=collective_id,
+        name="ag_gemm_fused",
+    )
+    fn = jax.shard_map(
+        call,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(None, axis)),
+        out_specs=P(None, axis),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=256)
+def _build_xla_ring(mesh, axis, m_local, out_dtype):
+    n = mesh.shape[axis]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(a_loc, b_loc):
+        me = jax.lax.axis_index(axis)
+
+        def step(s, carry):
+            a_cur, out = carry
+            src = jax.lax.rem(me + n - s, n)
+            tile = jnp.dot(a_cur, b_loc, preferred_element_type=jnp.float32)
+            out = jax.lax.dynamic_update_slice(
+                out, tile.astype(out_dtype), (src * m_local, 0)
+            )
+            # Hop overlaps the next iteration's dot via XLA async permute.
+            a_next = jax.lax.ppermute(a_cur, axis, perm=perm)
+            return a_next, out
+
+        out = jnp.zeros((n * m_local, b_loc.shape[1]), out_dtype)
+        a_cur, out = jax.lax.fori_loop(0, n - 1, step, (a_loc, out))
+        src = jax.lax.rem(me + 1, n)  # after n-1 hops I hold shard me+1
+        tile = jnp.dot(a_cur, b_loc, preferred_element_type=jnp.float32)
+        return jax.lax.dynamic_update_slice(
+            out, tile.astype(out_dtype), (src * m_local, 0)
+        )
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(None, axis)),
+        out_specs=P(None, axis),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=256)
+def _build_xla_naive(mesh, axis, out_dtype):
+    def body(a_loc, b_loc):
+        a_full = jax.lax.all_gather(a_loc, axis, tiled=True)
+        return jnp.dot(a_full, b_loc, preferred_element_type=jnp.float32).astype(
+            out_dtype
+        )
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(None, axis)),
+        out_specs=P(None, axis),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def _fused_fits(n, m, k, n_local, itemsize) -> bool:
+    work = (m * k + k * n_local + m * n_local) * itemsize
+    return work <= fused_vmem_budget()
+
+
+def auto_ag_gemm_method(mesh, axis, a, b) -> AGGemmMethod:
+    """≡ reference method auto-selection (allgather.py:54-69): topology +
+    working-set size decide the engine."""
+    n = mesh.shape[axis]
+    topo = detect_topology(mesh, axis)
+    fits = _fused_fits(n, a.shape[0], a.shape[1], b.shape[1] // n, a.dtype.itemsize)
+    if topo.link_kind == LinkKind.DCN:
+        return AGGemmMethod.XLA_RING
+    if fits and (topo.link_kind == LinkKind.ICI or not on_tpu()):
+        return AGGemmMethod.PALLAS_FUSED
+    return AGGemmMethod.XLA_RING
+
+
+def ag_gemm(
+    a,
+    b,
+    mesh,
+    axis: str = "x",
+    *,
+    method: AGGemmMethod | None = None,
+    out_dtype=None,
+    collective_id: int = 5,
+):
+    """Fused AllGather(A) @ B for column-parallel TP.
+
+    ``a``: (M, K) sharded P(axis, None) — each device holds an M/n row
+    shard. ``b``: (K, N) sharded P(None, axis) — column-parallel weight.
+    Returns (M, N) sharded P(None, axis).
+
+    Host entry ≡ reference ``ag_gemm`` (allgather_gemm.py:539) +
+    ``rowise_ag_gemm_dispatcher`` (:586-661).
+    """
+    n = mesh.shape[axis]
+    out_dtype = out_dtype or a.dtype
+    assert a.shape[0] % n == 0 and b.shape[1] % n == 0
+    assert a.shape[1] == b.shape[0], f"contract dim mismatch {a.shape} @ {b.shape}"
+    if n == 1:
+        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
+    if method is None:
+        method = auto_ag_gemm_method(mesh, axis, a, b)
+    if method == AGGemmMethod.PALLAS_FUSED:
+        fn = _build_fused(
+            mesh, axis, a.shape, b.shape, a.dtype, out_dtype, collective_id,
+            config.chaos_delay,
+        )
+    elif method == AGGemmMethod.XLA_RING:
+        fn = _build_xla_ring(mesh, axis, a.shape[0] // n, out_dtype)
+    else:
+        fn = _build_xla_naive(mesh, axis, out_dtype)
+    return fn(a, b)

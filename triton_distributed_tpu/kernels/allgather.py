@@ -43,11 +43,7 @@ def _ring_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
     left, right = lang.pe_flat(axis, left, mesh_axes), lang.pe_flat(axis, right, mesh_axes)
 
     out_ref[pl.ds(me * m, m)] = x_ref[:]
-    # neighbor barrier: don't RDMA into a peer that hasn't entered the kernel
-    barrier = pltpu.get_barrier_semaphore()
-    lang.signal_op(barrier, 1, pe=left)
-    lang.signal_op(barrier, 1, pe=right)
-    pltpu.semaphore_wait(barrier, 2)
+    lang.neighbor_barrier(axis, left, right)
 
     # One semaphore slot per step: a slot's credit can then only come from
     # that step's DMA, so a wait being satisfied proves that *specific*
@@ -79,10 +75,7 @@ def _ring_bidir_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem
     left, right = lang.pe_flat(axis, left, mesh_axes), lang.pe_flat(axis, right, mesh_axes)
 
     out_ref[pl.ds(me * m, m)] = x_ref[:]
-    barrier = pltpu.get_barrier_semaphore()
-    lang.signal_op(barrier, 1, pe=left)
-    lang.signal_op(barrier, 1, pe=right)
-    pltpu.semaphore_wait(barrier, 2)
+    lang.neighbor_barrier(axis, left, right)
 
     # Per-step distinct semaphore slots (see _ring_ag_kernel): cw uses
     # slots [0, n-1), ccw uses [n-1, 2(n-1)).
@@ -201,6 +194,10 @@ def all_gather(
     if method is None:
         shard_bytes = (x.size // n) * x.dtype.itemsize
         method = auto_allgather_method(detect_topology(mesh, axis), shard_bytes)
+    if method == AllGatherMethod.RING_BIDIR and (x.ndim < 2 or x.shape[1] < 2):
+        # bidir splits dim 1 between the two directions — impossible on
+        # rank-1 / single-column inputs; fall back to the plain ring.
+        method = AllGatherMethod.RING_1D
     if n == 1:
         return x
     fn = _build_all_gather(

@@ -1,0 +1,203 @@
+"""GEMM-ReduceScatter: row-parallel TP overlap of matmul with reduction.
+
+Reference: python/triton_dist/kernels/nvidia/gemm_reduce_scatter.py —
+producer GEMM in rank-swizzled tile order signalling per-destination
+barriers (:124-235), consumer ``reduce_scatter_2d_op`` on separate
+streams (reduce_scatter.py:863), host entries ``gemm_rs_op``/``gemm_rs``
+(:498-560).
+
+TPU re-design: a reduce ring in which each step's contribution is
+*computed into the ring* by the MXU while the previous partial is in
+flight — the matmul for the next destination shard overlaps the RDMA of
+the current accumulator, replacing the reference's GEMM-stream /
+RS-stream pair with single-kernel software pipelining. Tile order is
+rank-swizzled by construction: device ``me`` computes destination shards
+``me+1, me+2, …, me`` so every shard's partial flows leftward and ends
+fully reduced on its owner.
+
+Engines: ``PALLAS_FUSED`` (VMEM-resident, ICI), ``XLA_RING``
+(ppermute+dot loop, any size / DCN), ``XLA_NAIVE`` (dot → psum_scatter
+baseline, ≡ the torch reference impl in test_gemm_rs.py).
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import lang
+from triton_distributed_tpu.config import config, fused_vmem_budget, on_tpu
+from triton_distributed_tpu.kernels.reduce_scatter import ring_reduce_core
+from triton_distributed_tpu.runtime import LinkKind, detect_topology
+
+
+class GemmRSMethod(enum.Enum):
+    PALLAS_FUSED = "pallas_fused"
+    XLA_RING = "xla_ring"
+    XLA_NAIVE = "xla_naive"
+
+
+def _fused_kernel(
+    n, axis, mesh_axes, a_ref, b_ref, out_ref, acc_ref, recv_ref, send_sem, recv_sem, ack_sem
+):
+    """Compute-into-the-ring GEMM-RS: the shared ring-reduce core
+    (kernels/reduce_scatter.py:ring_reduce_core) with the per-destination
+    contribution produced by the MXU. ``make_partial`` runs between a
+    slot DMA's start and wait, so each destination's matmul overlaps the
+    in-flight accumulator (the producer/consumer stream overlap of the
+    reference, collapsed into one kernel). Destination order me+1…me is
+    the rank-swizzle of gemm_reduce_scatter.py:205-219."""
+    m = out_ref.shape[0]
+
+    def make_partial(dst):
+        return jnp.dot(
+            a_ref[pl.ds(dst * m, m)], b_ref[:], preferred_element_type=jnp.float32
+        ).astype(acc_ref.dtype)
+
+    ring_reduce_core(
+        n, axis, mesh_axes, make_partial,
+        out_ref, acc_ref, recv_ref, send_sem, recv_sem, ack_sem,
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _build_fused(mesh, axis, a_shape, b_shape, dtype, out_dtype, collective_id, chaos):
+    n = mesh.shape[axis]
+    m_local = a_shape[0] // n
+    n_out = b_shape[1]
+
+    call = lang.shmem_call(
+        functools.partial(_fused_kernel, n, axis, mesh.axis_names),
+        out_shape=jax.ShapeDtypeStruct((m_local, n_out), out_dtype),
+        in_specs=lang.vmem_specs(2),
+        scratch_shapes=[
+            pltpu.VMEM((m_local, n_out), out_dtype),
+            pltpu.VMEM((2, m_local, n_out), out_dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        collective_id=collective_id,
+        name="gemm_rs_fused",
+    )
+    fn = jax.shard_map(
+        call,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(axis, None)),
+        out_specs=P(axis, None),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=256)
+def _build_xla_ring(mesh, axis, m_local, out_dtype):
+    n = mesh.shape[axis]
+    perm = [(i, (i - 1) % n) for i in range(n)]  # accumulator flows leftward
+
+    def body(a_loc, b_loc):
+        me = jax.lax.axis_index(axis)
+
+        def partial(dst):
+            rows = jax.lax.dynamic_slice(
+                a_loc, (dst * m_local, 0), (m_local, a_loc.shape[1])
+            )
+            return jnp.dot(rows, b_loc, preferred_element_type=jnp.float32).astype(
+                out_dtype
+            )
+
+        def step(s, acc):
+            acc = jax.lax.ppermute(acc, axis, perm=perm)
+            return acc + partial(jax.lax.rem(me + 2 + s, n))
+
+        acc = partial(jax.lax.rem(me + 1, n))
+        return jax.lax.fori_loop(0, n - 1, step, acc)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(axis, None)),
+        out_specs=P(axis, None),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=256)
+def _build_xla_naive(mesh, axis, out_dtype):
+    def body(a_loc, b_loc):
+        full = jnp.dot(a_loc, b_loc, preferred_element_type=jnp.float32).astype(
+            out_dtype
+        )
+        return jax.lax.psum_scatter(full, axis, scatter_dimension=0, tiled=True)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(axis, None)),
+        out_specs=P(axis, None),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def _fused_fits(n, m, k_local, n_out, itemsize) -> bool:
+    m_local = m // n
+    work = (m * k_local + k_local * n_out + 4 * m_local * n_out) * itemsize
+    return work <= fused_vmem_budget()
+
+
+def auto_gemm_rs_method(mesh, axis, a, b) -> GemmRSMethod:
+    n = mesh.shape[axis]
+    topo = detect_topology(mesh, axis)
+    fits = _fused_fits(n, a.shape[0], a.shape[1] // n, b.shape[1], a.dtype.itemsize)
+    if topo.link_kind == LinkKind.DCN:
+        return GemmRSMethod.XLA_RING
+    if fits and (topo.link_kind == LinkKind.ICI or not on_tpu()):
+        return GemmRSMethod.PALLAS_FUSED
+    return GemmRSMethod.XLA_RING
+
+
+def gemm_rs(
+    a,
+    b,
+    mesh,
+    axis: str = "x",
+    *,
+    method: GemmRSMethod | None = None,
+    out_dtype=None,
+    collective_id: int = 6,
+):
+    """Fused (A @ B) → ReduceScatter for row-parallel TP.
+
+    ``a``: (M, K) sharded P(None, axis) — each device holds a K/n column
+    shard. ``b``: (K, N) sharded P(axis, None) — row-parallel weight.
+    Returns (M, N) sharded P(axis, None): device i owns fully-reduced row
+    shard i.
+
+    Host entry ≡ reference ``gemm_rs`` (gemm_reduce_scatter.py:547).
+    """
+    n = mesh.shape[axis]
+    out_dtype = out_dtype or a.dtype
+    assert a.shape[0] % n == 0 and a.shape[1] % n == 0 and b.shape[0] % n == 0
+    assert a.shape[1] == b.shape[0], f"contract dim mismatch {a.shape} @ {b.shape}"
+    if n == 1:
+        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
+    if method is None:
+        method = auto_gemm_rs_method(mesh, axis, a, b)
+    if method == GemmRSMethod.PALLAS_FUSED:
+        fn = _build_fused(
+            mesh, axis, a.shape, b.shape, a.dtype, out_dtype, collective_id,
+            config.chaos_delay,
+        )
+    elif method == GemmRSMethod.XLA_RING:
+        fn = _build_xla_ring(mesh, axis, a.shape[0] // n, out_dtype)
+    else:
+        fn = _build_xla_naive(mesh, axis, out_dtype)
+    return fn(a, b)

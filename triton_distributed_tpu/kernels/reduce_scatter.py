@@ -27,8 +27,15 @@ from triton_distributed_tpu.runtime import ring_neighbors
 from triton_distributed_tpu.utils.testing import chaos_delay
 
 
-def _ring_rs_kernel(n, axis, mesh_axes, x_ref, out_ref, acc_ref, recv_ref, send_sem, recv_sem, ack_sem):
-    """Reduce ring with explicit flow control.
+def ring_reduce_core(
+    n, axis, mesh_axes, make_partial, out_ref, acc_ref, recv_ref, send_sem, recv_sem, ack_sem
+):
+    """Reduce ring with explicit flow control, parametrized over the
+    per-destination contribution producer.
+
+    ``make_partial(dst)`` returns this device's contribution to destination
+    shard ``dst``; it is invoked *between* a slot DMA's start and wait, so a
+    compute-heavy producer (e.g. the GEMM-RS matmul) overlaps the transfer.
 
     The receive buffer is double-buffered and the consumer acks its sender
     (my *right* neighbor, since data flows leftward) after folding a slot
@@ -37,18 +44,13 @@ def _ring_rs_kernel(n, axis, mesh_axes, x_ref, out_ref, acc_ref, recv_ref, send_
     overwrite a slot the receiver hasn't consumed (semaphore credits alone
     don't stop that — they count arrivals, not consumption)."""
     me = lang.my_pe(axis)
-    m = out_ref.shape[0]
     left, right = ring_neighbors(me, n)
     left, right = lang.pe_flat(axis, left, mesh_axes), lang.pe_flat(axis, right, mesh_axes)
 
-    barrier = pltpu.get_barrier_semaphore()
-    lang.signal_op(barrier, 1, pe=left)
-    lang.signal_op(barrier, 1, pe=right)
-    pltpu.semaphore_wait(barrier, 2)
+    lang.neighbor_barrier(axis, left, right)
 
     # acc starts as my contribution to shard (me+1), the first one I forward.
-    first = jax.lax.rem(me + 1, n)
-    acc_ref[:] = x_ref[pl.ds(first * m, m)]
+    acc_ref[:] = make_partial(jax.lax.rem(me + 1, n))
 
     for s in range(n - 1):
         chaos_delay()
@@ -63,17 +65,36 @@ def _ring_rs_kernel(n, axis, mesh_axes, x_ref, out_ref, acc_ref, recv_ref, send_
             left,
         )
         dma.start()
+        # produce my contribution to the next destination while the
+        # accumulator is in flight
+        nxt = jax.lax.rem(me + 2 + s, n)
+        partial = make_partial(nxt)
         dma.wait()  # send drained (acc reusable) + my slot s%2 arrival landed
         # received: partial sum of shard (me+2+s) accumulated so far by the
         # ring to my right; fold in my own contribution.
-        nxt = jax.lax.rem(me + 2 + s, n)
-        acc_ref[:] = recv_ref[s % 2] + x_ref[pl.ds(nxt * m, m)]
+        acc_ref[:] = recv_ref[s % 2] + partial
         # tell my sender (right neighbor) this slot is free again
         lang.signal_op(ack_sem, 1, pe=right)
 
     out_ref[:] = acc_ref[:]
     # drain leftover acks: n-1 received, max(n-3, 0) consumed in-loop
     pltpu.semaphore_wait(ack_sem, min(2, n - 1))
+
+
+def _ring_rs_kernel(n, axis, mesh_axes, x_ref, out_ref, acc_ref, recv_ref, send_sem, recv_sem, ack_sem):
+    m = out_ref.shape[0]
+    ring_reduce_core(
+        n,
+        axis,
+        mesh_axes,
+        lambda dst: x_ref[pl.ds(dst * m, m)],
+        out_ref,
+        acc_ref,
+        recv_ref,
+        send_sem,
+        recv_sem,
+        ack_sem,
+    )
 
 
 def reduce_scatter(

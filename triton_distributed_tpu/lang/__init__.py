@@ -17,6 +17,7 @@ from triton_distributed_tpu.lang.shmem import (
     barrier_sem_wait_all,
     fence,
     my_pe,
+    neighbor_barrier,
     n_pes,
     pe_flat,
     putmem_nbi_block,
@@ -41,6 +42,7 @@ __all__ = [
     "quiet",
     "barrier_all",
     "barrier_sem_wait_all",
+    "neighbor_barrier",
     "SIGNAL_SET",
     "SIGNAL_ADD",
     "CMP_EQ",

@@ -161,6 +161,16 @@ def barrier_all(axis, mesh_axes=None):
     barrier_sem_wait_all(pltpu.get_barrier_semaphore(), axis, mesh_axes)
 
 
+def neighbor_barrier(axis, left, right):
+    """Ring-neighbor barrier on the global barrier semaphore: no RDMA into
+    a peer that hasn't entered the kernel yet. ``left``/``right`` are flat
+    logical device ids (already pe_flat-translated)."""
+    sem = pltpu.get_barrier_semaphore()
+    signal_op(sem, 1, pe=left)
+    signal_op(sem, 1, pe=right)
+    pltpu.semaphore_wait(sem, 2)
+
+
 def barrier_sem_wait_all(sem, axis, mesh_axes=None):
     """Signal every peer on a user regular semaphore and wait for all."""
     n = jax.lax.axis_size(axis)

@@ -20,6 +20,7 @@ from triton_distributed_tpu.runtime.symm import (
 )
 from triton_distributed_tpu.runtime.topology import (
     AllGatherMethod,
+    LinkKind,
     TopologyInfo,
     auto_allgather_method,
     detect_topology,
@@ -38,6 +39,7 @@ __all__ = [
     "symm_full",
     "TopologyInfo",
     "AllGatherMethod",
+    "LinkKind",
     "detect_topology",
     "auto_allgather_method",
     "ring_neighbors",
