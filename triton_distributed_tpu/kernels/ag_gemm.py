@@ -90,85 +90,104 @@ def _fused_kernel(n, axis, mesh_axes, x_ref, b_ref, out_ref, ag_ref, send_sem, r
         dma.wait_send()
 
 
+def _specs(axis, batch_axes):
+    """(in_specs, out_specs) for AG-GEMM under shard_map over the full mesh.
+
+    Activation rows may additionally be sharded over ``batch_axes`` (data
+    parallelism): the kernel then gathers only the ``axis`` (sequence/TP)
+    factor of the rows inside each DP group."""
+    ba = tuple(batch_axes)
+    row = ba + (axis,) if ba else axis
+    a_spec = P(row, None)
+    b_spec = P(None, axis)
+    out_spec = P(ba if ba else None, axis)
+    return (a_spec, b_spec), out_spec
+
+
 @functools.lru_cache(maxsize=256)
-def _build_fused(mesh, axis, a_shape, b_shape, dtype, out_dtype, collective_id, chaos):
+def _build_fused(
+    mesh, axis, batch_axes, a_shape, b_shape, dtype, out_dtype, collective_id, chaos
+):
     n = mesh.shape[axis]
     k = a_shape[1]
     n_local = b_shape[1] // n
+    dp = 1
+    for ba in batch_axes:
+        dp *= mesh.shape[ba]
+    m_gathered = a_shape[0] // dp  # rows per device after the AG over `axis`
 
     call = lang.shmem_call(
         functools.partial(_fused_kernel, n, axis, mesh.axis_names),
-        out_shape=jax.ShapeDtypeStruct((a_shape[0], n_local), out_dtype),
+        out_shape=jax.ShapeDtypeStruct((m_gathered, n_local), out_dtype),
         in_specs=lang.vmem_specs(2),
         scratch_shapes=[
-            pltpu.VMEM((a_shape[0], k), dtype),
+            pltpu.VMEM((m_gathered, k), dtype),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
         ],
         collective_id=collective_id,
         name="ag_gemm_fused",
     )
+    in_specs, out_specs = _specs(axis, batch_axes)
     fn = jax.shard_map(
-        call,
-        mesh=mesh,
-        in_specs=(P(axis, None), P(None, axis)),
-        out_specs=P(None, axis),
-        check_vma=False,
+        call, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
     )
     return jax.jit(fn)
 
 
-@functools.lru_cache(maxsize=256)
-def _build_xla_ring(mesh, axis, m_local, out_dtype):
-    n = mesh.shape[axis]
+def ag_gemm_device(a_loc, b_loc, axis, *, out_dtype=None):
+    """Per-device XLA-ring AG-GEMM body — usable inside any shard_map.
+
+    ppermute hops overlap the next step's dot via XLA async collective
+    permute (the reference's comm-stream/GEMM-stream overlap, expressed
+    through the XLA scheduler instead of streams)."""
+    n = jax.lax.axis_size(axis)
+    m_local = a_loc.shape[0]
+    out_dtype = out_dtype or a_loc.dtype
+    me = jax.lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def body(a_loc, b_loc):
-        me = jax.lax.axis_index(axis)
-
-        def step(s, carry):
-            a_cur, out = carry
-            src = jax.lax.rem(me + n - s, n)
-            tile = jnp.dot(a_cur, b_loc, preferred_element_type=jnp.float32)
-            out = jax.lax.dynamic_update_slice(
-                out, tile.astype(out_dtype), (src * m_local, 0)
-            )
-            # Hop overlaps the next iteration's dot via XLA async permute.
-            a_next = jax.lax.ppermute(a_cur, axis, perm=perm)
-            return a_next, out
-
-        out = jnp.zeros((n * m_local, b_loc.shape[1]), out_dtype)
-        a_cur, out = jax.lax.fori_loop(0, n - 1, step, (a_loc, out))
-        src = jax.lax.rem(me + 1, n)  # after n-1 hops I hold shard me+1
+    def step(s, carry):
+        a_cur, out = carry
+        src = jax.lax.rem(me + n - s, n)
         tile = jnp.dot(a_cur, b_loc, preferred_element_type=jnp.float32)
-        return jax.lax.dynamic_update_slice(
+        out = jax.lax.dynamic_update_slice(
             out, tile.astype(out_dtype), (src * m_local, 0)
         )
+        a_next = jax.lax.ppermute(a_cur, axis, perm=perm)
+        return a_next, out
 
+    out = jnp.zeros((n * m_local, b_loc.shape[1]), out_dtype)
+    a_cur, out = jax.lax.fori_loop(0, n - 1, step, (a_loc, out))
+    src = jax.lax.rem(me + 1, n)  # after n-1 hops I hold shard me+1
+    tile = jnp.dot(a_cur, b_loc, preferred_element_type=jnp.float32)
+    return jax.lax.dynamic_update_slice(out, tile.astype(out_dtype), (src * m_local, 0))
+
+
+@functools.lru_cache(maxsize=256)
+def _build_xla_ring(mesh, axis, batch_axes, out_dtype):
+    in_specs, out_specs = _specs(axis, batch_axes)
     fn = jax.shard_map(
-        body,
+        functools.partial(ag_gemm_device, axis=axis, out_dtype=out_dtype),
         mesh=mesh,
-        in_specs=(P(axis, None), P(None, axis)),
-        out_specs=P(None, axis),
+        in_specs=in_specs,
+        out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=256)
-def _build_xla_naive(mesh, axis, out_dtype):
+def _build_xla_naive(mesh, axis, batch_axes, out_dtype):
     def body(a_loc, b_loc):
         a_full = jax.lax.all_gather(a_loc, axis, tiled=True)
         return jnp.dot(a_full, b_loc, preferred_element_type=jnp.float32).astype(
             out_dtype
         )
 
+    in_specs, out_specs = _specs(axis, batch_axes)
     fn = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(axis, None), P(None, axis)),
-        out_specs=P(None, axis),
-        check_vma=False,
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
     )
     return jax.jit(fn)
 
@@ -178,12 +197,12 @@ def _fused_fits(n, m, k, n_local, itemsize) -> bool:
     return work <= fused_vmem_budget()
 
 
-def auto_ag_gemm_method(mesh, axis, a, b) -> AGGemmMethod:
+def auto_ag_gemm_method(mesh, axis, a, b, dp: int = 1) -> AGGemmMethod:
     """≡ reference method auto-selection (allgather.py:54-69): topology +
     working-set size decide the engine."""
     n = mesh.shape[axis]
     topo = detect_topology(mesh, axis)
-    fits = _fused_fits(n, a.shape[0], a.shape[1], b.shape[1] // n, a.dtype.itemsize)
+    fits = _fused_fits(n, a.shape[0] // dp, a.shape[1], b.shape[1] // n, a.dtype.itemsize)
     if topo.link_kind == LinkKind.DCN:
         return AGGemmMethod.XLA_RING
     if fits and (topo.link_kind == LinkKind.ICI or not on_tpu()):
@@ -197,34 +216,41 @@ def ag_gemm(
     mesh,
     axis: str = "x",
     *,
+    batch_axes: tuple = (),
     method: AGGemmMethod | None = None,
     out_dtype=None,
     collective_id: int = 5,
 ):
     """Fused AllGather(A) @ B for column-parallel TP.
 
-    ``a``: (M, K) sharded P(axis, None) — each device holds an M/n row
-    shard. ``b``: (K, N) sharded P(None, axis) — column-parallel weight.
-    Returns (M, N) sharded P(None, axis).
+    ``a``: (M, K) with rows sharded over ``(*batch_axes, axis)`` — each
+    device holds an M/(dp·n) row shard; the kernel gathers the ``axis``
+    factor within each DP group (Megatron sequence-parallel layout).
+    ``b``: (K, N) sharded P(None, axis) — column-parallel weight.
+    Returns (M, N) with rows sharded over ``batch_axes``, cols over ``axis``.
 
     Host entry ≡ reference ``ag_gemm`` (allgather_gemm.py:539) +
     ``rowise_ag_gemm_dispatcher`` (:586-661).
     """
     n = mesh.shape[axis]
+    batch_axes = tuple(batch_axes)
+    dp = 1
+    for ba in batch_axes:
+        dp *= mesh.shape[ba]
     out_dtype = out_dtype or a.dtype
-    assert a.shape[0] % n == 0 and b.shape[1] % n == 0
+    assert a.shape[0] % (n * dp) == 0 and b.shape[1] % n == 0
     assert a.shape[1] == b.shape[0], f"contract dim mismatch {a.shape} @ {b.shape}"
     if n == 1:
         return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
     if method is None:
-        method = auto_ag_gemm_method(mesh, axis, a, b)
+        method = auto_ag_gemm_method(mesh, axis, a, b, dp=dp)
     if method == AGGemmMethod.PALLAS_FUSED:
         fn = _build_fused(
-            mesh, axis, a.shape, b.shape, a.dtype, out_dtype, collective_id,
-            config.chaos_delay,
+            mesh, axis, batch_axes, a.shape, b.shape, a.dtype, out_dtype,
+            collective_id, config.chaos_delay,
         )
     elif method == AGGemmMethod.XLA_RING:
-        fn = _build_xla_ring(mesh, axis, a.shape[0] // n, out_dtype)
+        fn = _build_xla_ring(mesh, axis, batch_axes, out_dtype)
     else:
-        fn = _build_xla_naive(mesh, axis, out_dtype)
+        fn = _build_xla_naive(mesh, axis, batch_axes, out_dtype)
     return fn(a, b)

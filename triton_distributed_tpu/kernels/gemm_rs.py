@@ -66,10 +66,29 @@ def _fused_kernel(
     )
 
 
+def _specs(axis, batch_axes):
+    """(in_specs, out_specs) for GEMM-RS under shard_map over the full mesh.
+
+    Activation rows may additionally be sharded over ``batch_axes`` (DP);
+    the reduce-scatter then runs over ``axis`` within each DP group and the
+    output rows end up sharded over (*batch_axes, axis) — the Megatron
+    sequence-parallel layout, the exact inverse of ag_gemm's."""
+    ba = tuple(batch_axes)
+    a_spec = P(ba if ba else None, axis)
+    b_spec = P(axis, None)
+    out_spec = P(ba + (axis,) if ba else axis, None)
+    return (a_spec, b_spec), out_spec
+
+
 @functools.lru_cache(maxsize=256)
-def _build_fused(mesh, axis, a_shape, b_shape, dtype, out_dtype, collective_id, chaos):
+def _build_fused(
+    mesh, axis, batch_axes, a_shape, b_shape, dtype, out_dtype, collective_id, chaos
+):
     n = mesh.shape[axis]
-    m_local = a_shape[0] // n
+    dp = 1
+    for ba in batch_axes:
+        dp *= mesh.shape[ba]
+    m_local = a_shape[0] // (dp * n)
     n_out = b_shape[1]
 
     call = lang.shmem_call(
@@ -86,63 +105,64 @@ def _build_fused(mesh, axis, a_shape, b_shape, dtype, out_dtype, collective_id, 
         collective_id=collective_id,
         name="gemm_rs_fused",
     )
+    in_specs, out_specs = _specs(axis, batch_axes)
     fn = jax.shard_map(
-        call,
+        call, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+    )
+    return jax.jit(fn)
+
+
+def gemm_rs_device(a_loc, b_loc, axis, *, out_dtype=None):
+    """Per-device XLA-ring GEMM-RS body — usable inside any shard_map.
+
+    The accumulator flows leftward around the ring while the next
+    destination's partial matmul runs, overlapped by XLA async permute."""
+    n = jax.lax.axis_size(axis)
+    out_dtype = out_dtype or a_loc.dtype
+    m_local = a_loc.shape[0] // n
+    me = jax.lax.axis_index(axis)
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    def partial(dst):
+        rows = jax.lax.dynamic_slice(
+            a_loc, (dst * m_local, 0), (m_local, a_loc.shape[1])
+        )
+        return jnp.dot(rows, b_loc, preferred_element_type=jnp.float32).astype(
+            out_dtype
+        )
+
+    def step(s, acc):
+        acc = jax.lax.ppermute(acc, axis, perm=perm)
+        return acc + partial(jax.lax.rem(me + 2 + s, n))
+
+    acc = partial(jax.lax.rem(me + 1, n))
+    return jax.lax.fori_loop(0, n - 1, step, acc)
+
+
+@functools.lru_cache(maxsize=256)
+def _build_xla_ring(mesh, axis, batch_axes, out_dtype):
+    in_specs, out_specs = _specs(axis, batch_axes)
+    fn = jax.shard_map(
+        functools.partial(gemm_rs_device, axis=axis, out_dtype=out_dtype),
         mesh=mesh,
-        in_specs=(P(None, axis), P(axis, None)),
-        out_specs=P(axis, None),
+        in_specs=in_specs,
+        out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=256)
-def _build_xla_ring(mesh, axis, m_local, out_dtype):
-    n = mesh.shape[axis]
-    perm = [(i, (i - 1) % n) for i in range(n)]  # accumulator flows leftward
-
-    def body(a_loc, b_loc):
-        me = jax.lax.axis_index(axis)
-
-        def partial(dst):
-            rows = jax.lax.dynamic_slice(
-                a_loc, (dst * m_local, 0), (m_local, a_loc.shape[1])
-            )
-            return jnp.dot(rows, b_loc, preferred_element_type=jnp.float32).astype(
-                out_dtype
-            )
-
-        def step(s, acc):
-            acc = jax.lax.ppermute(acc, axis, perm=perm)
-            return acc + partial(jax.lax.rem(me + 2 + s, n))
-
-        acc = partial(jax.lax.rem(me + 1, n))
-        return jax.lax.fori_loop(0, n - 1, step, acc)
-
-    fn = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(None, axis), P(axis, None)),
-        out_specs=P(axis, None),
-        check_vma=False,
-    )
-    return jax.jit(fn)
-
-
-@functools.lru_cache(maxsize=256)
-def _build_xla_naive(mesh, axis, out_dtype):
+def _build_xla_naive(mesh, axis, batch_axes, out_dtype):
     def body(a_loc, b_loc):
         full = jnp.dot(a_loc, b_loc, preferred_element_type=jnp.float32).astype(
             out_dtype
         )
         return jax.lax.psum_scatter(full, axis, scatter_dimension=0, tiled=True)
 
+    in_specs, out_specs = _specs(axis, batch_axes)
     fn = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(None, axis), P(axis, None)),
-        out_specs=P(axis, None),
-        check_vma=False,
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
     )
     return jax.jit(fn)
 
@@ -153,10 +173,10 @@ def _fused_fits(n, m, k_local, n_out, itemsize) -> bool:
     return work <= fused_vmem_budget()
 
 
-def auto_gemm_rs_method(mesh, axis, a, b) -> GemmRSMethod:
+def auto_gemm_rs_method(mesh, axis, a, b, dp: int = 1) -> GemmRSMethod:
     n = mesh.shape[axis]
     topo = detect_topology(mesh, axis)
-    fits = _fused_fits(n, a.shape[0], a.shape[1] // n, b.shape[1], a.dtype.itemsize)
+    fits = _fused_fits(n, a.shape[0] // dp, a.shape[1] // n, b.shape[1], a.dtype.itemsize)
     if topo.link_kind == LinkKind.DCN:
         return GemmRSMethod.XLA_RING
     if fits and (topo.link_kind == LinkKind.ICI or not on_tpu()):
@@ -170,34 +190,40 @@ def gemm_rs(
     mesh,
     axis: str = "x",
     *,
+    batch_axes: tuple = (),
     method: GemmRSMethod | None = None,
     out_dtype=None,
     collective_id: int = 6,
 ):
     """Fused (A @ B) → ReduceScatter for row-parallel TP.
 
-    ``a``: (M, K) sharded P(None, axis) — each device holds a K/n column
-    shard. ``b``: (K, N) sharded P(axis, None) — row-parallel weight.
-    Returns (M, N) sharded P(axis, None): device i owns fully-reduced row
-    shard i.
+    ``a``: (M, K) with rows sharded over ``batch_axes`` (DP) and cols
+    P(axis) — each device holds a K/n column shard. ``b``: (K, N) sharded
+    P(axis, None) — row-parallel weight. Returns (M, N) with rows sharded
+    over ``(*batch_axes, axis)``: within each DP group device i owns
+    fully-reduced row shard i (sequence-parallel layout).
 
     Host entry ≡ reference ``gemm_rs`` (gemm_reduce_scatter.py:547).
     """
     n = mesh.shape[axis]
+    batch_axes = tuple(batch_axes)
+    dp = 1
+    for ba in batch_axes:
+        dp *= mesh.shape[ba]
     out_dtype = out_dtype or a.dtype
-    assert a.shape[0] % n == 0 and a.shape[1] % n == 0 and b.shape[0] % n == 0
+    assert a.shape[0] % (dp * n) == 0 and a.shape[1] % n == 0 and b.shape[0] % n == 0
     assert a.shape[1] == b.shape[0], f"contract dim mismatch {a.shape} @ {b.shape}"
     if n == 1:
         return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
     if method is None:
-        method = auto_gemm_rs_method(mesh, axis, a, b)
+        method = auto_gemm_rs_method(mesh, axis, a, b, dp=dp)
     if method == GemmRSMethod.PALLAS_FUSED:
         fn = _build_fused(
-            mesh, axis, a.shape, b.shape, a.dtype, out_dtype, collective_id,
-            config.chaos_delay,
+            mesh, axis, batch_axes, a.shape, b.shape, a.dtype, out_dtype,
+            collective_id, config.chaos_delay,
         )
     elif method == GemmRSMethod.XLA_RING:
-        fn = _build_xla_ring(mesh, axis, a.shape[0] // n, out_dtype)
+        fn = _build_xla_ring(mesh, axis, batch_axes, out_dtype)
     else:
-        fn = _build_xla_naive(mesh, axis, out_dtype)
+        fn = _build_xla_naive(mesh, axis, batch_axes, out_dtype)
     return fn(a, b)

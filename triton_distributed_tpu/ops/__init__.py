@@ -1,0 +1,23 @@
+"""Op layer: differentiable, context-managed distributed ops.
+
+≡ the reference's public kernel API (python/triton_dist/kernels/nvidia/
+__init__.py:25-40: ag_gemm, gemm_rs, fast_all_to_all, … +
+create_*_context factories), with autodiff added so the same ops serve
+training, not just inference.
+"""
+
+from triton_distributed_tpu.ops.overlap import (
+    OverlapContext,
+    ag_gemm,
+    create_ag_gemm_context,
+    create_gemm_rs_context,
+    gemm_rs,
+)
+
+__all__ = [
+    "OverlapContext",
+    "ag_gemm",
+    "gemm_rs",
+    "create_ag_gemm_context",
+    "create_gemm_rs_context",
+]

@@ -1,0 +1,173 @@
+"""Differentiable overlap ops: context-managed AG-GEMM / GEMM-RS.
+
+Reference API surface: ``triton_dist.kernels`` exposes ``ag_gemm`` /
+``gemm_rs`` plus ``create_*_context`` factories
+(python/triton_dist/kernels/nvidia/__init__.py:25-40;
+AllGatherGEMMTensorParallelContext allgather_gemm.py:407-490;
+create_gemm_rs_context gemm_reduce_scatter.py:41-87). The reference is
+inference-only (torch, no autograd through the kernels); here the ops are
+differentiable, which is what makes the flagship *training* path possible:
+
+* d(AG-GEMM): dA = GEMM-RS(dC, Bᵀ); dB = psum_dp(AG(A)ᵀ @ dC)
+* d(GEMM-RS): dA = AG-GEMM(dC, Bᵀ); dB = psum_dp(Aᵀ @ AG(dC))
+
+i.e. the backward of each overlap op **is the dual overlap op**, so the
+backward pass gets the same compute/communication overlap as forward —
+a property the stream-based reference design cannot express.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.kernels.ag_gemm import ag_gemm as _ag_gemm_raw
+from triton_distributed_tpu.kernels.gemm_rs import gemm_rs as _gemm_rs_raw
+
+
+@dataclass(frozen=True)
+class OverlapContext:
+    """Shared context for the TP overlap ops (≡ the reference's
+    per-op *Context dataclasses, which own symmetric workspaces/streams;
+    on TPU the state that must persist is just mesh/axis/method/ids)."""
+
+    mesh: Mesh
+    axis: str = "x"
+    batch_axes: tuple = ()
+    method: object = None          # AGGemmMethod / GemmRSMethod / None=auto
+    out_dtype: object = None
+    collective_id: int = 8
+
+    @property
+    def tp(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_ag_gemm_context(mesh, axis="x", **kw) -> OverlapContext:
+    """≡ reference create_ag_gemm_context (allgather_gemm.py:490-537)."""
+    return OverlapContext(mesh=mesh, axis=axis, **kw)
+
+
+def create_gemm_rs_context(mesh, axis="x", **kw) -> OverlapContext:
+    """≡ reference create_gemm_rs_context (gemm_reduce_scatter.py:41-87)."""
+    kw.setdefault("collective_id", 9)
+    return OverlapContext(mesh=mesh, axis=axis, **kw)
+
+
+def _psum_if(x, axes):
+    return jax.lax.psum(x, axes) if axes else x
+
+
+@functools.lru_cache(maxsize=256)
+def _build_ag_wgrad(mesh, axis, batch_axes):
+    """dB for ag_gemm: psum_dp( AG(A)ᵀ @ dC ) — weight grads reduce over
+    the data-parallel axes, activations gather over the TP axis."""
+    ba = tuple(batch_axes)
+
+    def body(a_loc, g_loc):
+        a_full = jax.lax.all_gather(a_loc, axis, tiled=True)
+        db = jnp.dot(
+            a_full.T.astype(jnp.float32), g_loc.astype(jnp.float32)
+        )
+        return _psum_if(db, ba)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(ba + (axis,) if ba else axis, None), P(ba if ba else None, axis)),
+        out_specs=P(None, axis),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=256)
+def _build_rs_wgrad(mesh, axis, batch_axes):
+    """dB for gemm_rs: psum_dp( Aᵀ @ AG(dC) )."""
+    ba = tuple(batch_axes)
+
+    def body(a_loc, g_loc):
+        g_full = jax.lax.all_gather(g_loc, axis, tiled=True)
+        db = jnp.dot(
+            a_loc.T.astype(jnp.float32), g_full.astype(jnp.float32)
+        )
+        return _psum_if(db, ba)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(ba if ba else None, axis), P(ba + (axis,) if ba else axis, None)),
+        out_specs=P(axis, None),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def ag_gemm(a, b, ctx: OverlapContext):
+    """Differentiable AllGather(A) @ B (column-parallel / SP layout).
+
+    ``a``: (M, K) rows sharded (*batch_axes, axis); ``b``: (K, N) cols
+    sharded ``axis``. Returns (M, N) rows batch-sharded, cols axis-sharded.
+    """
+    return _ag_gemm_raw(
+        a, b, ctx.mesh, ctx.axis,
+        batch_axes=ctx.batch_axes, method=ctx.method,
+        out_dtype=ctx.out_dtype, collective_id=ctx.collective_id,
+    )
+
+
+def _ag_gemm_fwd(a, b, ctx):
+    return ag_gemm(a, b, ctx), (a, b)
+
+
+def _ag_gemm_bwd(ctx, res, g):
+    a, b = res
+    # dA: the dual overlap op — GEMM(dC, Bᵀ) fused with ReduceScatter.
+    da = _gemm_rs_raw(
+        g, b.T, ctx.mesh, ctx.axis,
+        batch_axes=ctx.batch_axes, out_dtype=a.dtype,
+        collective_id=ctx.collective_id + 1,
+    )
+    db = _build_ag_wgrad(ctx.mesh, ctx.axis, tuple(ctx.batch_axes))(a, g)
+    return da, db.astype(b.dtype)
+
+
+ag_gemm.defvjp(_ag_gemm_fwd, _ag_gemm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def gemm_rs(a, b, ctx: OverlapContext):
+    """Differentiable (A @ B) → ReduceScatter (row-parallel / SP layout).
+
+    ``a``: (M, K) rows batch-sharded, cols sharded ``axis``; ``b``: (K, N)
+    rows sharded ``axis``. Returns (M, N) rows sharded (*batch_axes, axis).
+    """
+    return _gemm_rs_raw(
+        a, b, ctx.mesh, ctx.axis,
+        batch_axes=ctx.batch_axes, method=ctx.method,
+        out_dtype=ctx.out_dtype, collective_id=ctx.collective_id,
+    )
+
+
+def _gemm_rs_fwd(a, b, ctx):
+    return gemm_rs(a, b, ctx), (a, b)
+
+
+def _gemm_rs_bwd(ctx, res, g):
+    a, b = res
+    # dA: the dual overlap op — AllGather(dC) fused with GEMM(·, Bᵀ).
+    da = _ag_gemm_raw(
+        g, b.T, ctx.mesh, ctx.axis,
+        batch_axes=ctx.batch_axes, out_dtype=a.dtype,
+        collective_id=ctx.collective_id + 1,
+    )
+    db = _build_rs_wgrad(ctx.mesh, ctx.axis, tuple(ctx.batch_axes))(a, g)
+    return da, db.astype(b.dtype)
+
+
+gemm_rs.defvjp(_gemm_rs_fwd, _gemm_rs_bwd)
