@@ -1,0 +1,118 @@
+"""Driver benchmark: fused AG-GEMM throughput on the north-star TP shape.
+
+Measures the flagship overlap op (BASELINE.md north-star: fused AG-GEMM on
+Llama-7B TP shapes, reference tutorial 07 / test_ag_gemm.py) on whatever
+devices are present — the one real TPU chip under the driver, or the
+virtual CPU mesh during development.
+
+Prints ONE JSON line:
+  {"metric": "ag_gemm_tflops_per_chip", "value": N, "unit": "TFLOP/s",
+   "vs_baseline": speedup_vs_unoverlapped}
+
+``vs_baseline`` is the speedup of our best engine over the unoverlapped
+baseline (all_gather → dot, ≡ the reference's torch_ag_gemm cuBLAS+NCCL
+baseline, test_ag_gemm.py) on the same hardware — the quantity the
+reference's perf charts report (README.md:181-182).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _sync(out):
+    # block_until_ready is a no-op over the axon relay; a host read of one
+    # element is the reliable device fence.
+    leaf = jax.tree.leaves(out)[0]
+    np.asarray(jax.device_get(leaf.ravel()[0]))
+
+
+def _bench(fn, *args, iters=32, warmup=3):
+    import time
+
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    from triton_distributed_tpu.kernels.ag_gemm import (
+        AGGemmMethod,
+        _build_fused,
+        _build_xla_naive,
+        _build_xla_ring,
+        _fused_fits,
+    )
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.asarray(devs), ("x",))
+
+    # Llama-7B TP up-projection shape (reference test_ag_gemm defaults,
+    # 8192 x 8192 x 28672), scaled down off-TPU to keep CI fast.
+    on_tpu = jax.default_backend() == "tpu"
+    m, k, nn = (8192, 8192, 28672) if on_tpu else (512, 512, 1024)
+    dtype = jnp.bfloat16
+
+    key = jax.random.PRNGKey(0)
+    a = jax.device_put(
+        jax.random.normal(key, (m, k), dtype), NamedSharding(mesh, P("x", None))
+    )
+    b = jax.device_put(
+        jax.random.normal(key, (k, nn), dtype), NamedSharding(mesh, P(None, "x"))
+    )
+
+    if n == 1:
+        # Single chip: no gather leg — both engines are the same MXU matmul.
+        fn = jax.jit(lambda a, b: jnp.dot(a, b, preferred_element_type=jnp.float32).astype(dtype))
+        t_best = t_naive = _bench(fn, a, b)
+    else:
+        t_naive = _bench(_build_xla_naive(mesh, "x", (), dtype), a, b)
+        candidates = [_build_xla_ring(mesh, "x", (), dtype)]
+        if _fused_fits(n, m, k, nn // n, a.dtype.itemsize):
+            candidates.append(
+                _build_fused(mesh, "x", (), a.shape, b.shape, a.dtype, dtype, 5, False)
+            )
+        t_best = min(min(_bench(c, a, b) for c in candidates), t_naive)
+
+    tflops_per_chip = 2.0 * m * k * nn / t_best / n / 1e12
+    print(
+        json.dumps(
+            {
+                "metric": "ag_gemm_tflops_per_chip",
+                "value": round(tflops_per_chip, 2),
+                "unit": "TFLOP/s",
+                "vs_baseline": round(t_naive / t_best, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # never leave the driver without a JSON line
+        print(
+            json.dumps(
+                {
+                    "metric": "ag_gemm_tflops_per_chip",
+                    "value": 0.0,
+                    "unit": "TFLOP/s",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            )
+        )
+        sys.exit(0)

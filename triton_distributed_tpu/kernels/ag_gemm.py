@@ -38,7 +38,12 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import lang
 from triton_distributed_tpu.config import config, fused_vmem_budget, on_tpu
-from triton_distributed_tpu.runtime import LinkKind, detect_topology, ring_neighbors
+from triton_distributed_tpu.runtime import (
+    LinkKind,
+    detect_topology,
+    mesh_axes_size,
+    ring_neighbors,
+)
 from triton_distributed_tpu.utils.testing import chaos_delay
 
 
@@ -111,9 +116,7 @@ def _build_fused(
     n = mesh.shape[axis]
     k = a_shape[1]
     n_local = b_shape[1] // n
-    dp = 1
-    for ba in batch_axes:
-        dp *= mesh.shape[ba]
+    dp = mesh_axes_size(mesh, batch_axes)
     m_gathered = a_shape[0] // dp  # rows per device after the AG over `axis`
 
     call = lang.shmem_call(
@@ -234,9 +237,7 @@ def ag_gemm(
     """
     n = mesh.shape[axis]
     batch_axes = tuple(batch_axes)
-    dp = 1
-    for ba in batch_axes:
-        dp *= mesh.shape[ba]
+    dp = mesh_axes_size(mesh, batch_axes)
     out_dtype = out_dtype or a.dtype
     assert a.shape[0] % (n * dp) == 0 and b.shape[1] % n == 0
     assert a.shape[1] == b.shape[0], f"contract dim mismatch {a.shape} @ {b.shape}"

@@ -34,7 +34,7 @@ from jax.sharding import PartitionSpec as P
 from triton_distributed_tpu import lang
 from triton_distributed_tpu.config import config, fused_vmem_budget, on_tpu
 from triton_distributed_tpu.kernels.reduce_scatter import ring_reduce_core
-from triton_distributed_tpu.runtime import LinkKind, detect_topology
+from triton_distributed_tpu.runtime import LinkKind, detect_topology, mesh_axes_size
 
 
 class GemmRSMethod(enum.Enum):
@@ -85,9 +85,7 @@ def _build_fused(
     mesh, axis, batch_axes, a_shape, b_shape, dtype, out_dtype, collective_id, chaos
 ):
     n = mesh.shape[axis]
-    dp = 1
-    for ba in batch_axes:
-        dp *= mesh.shape[ba]
+    dp = mesh_axes_size(mesh, batch_axes)
     m_local = a_shape[0] // (dp * n)
     n_out = b_shape[1]
 
@@ -207,9 +205,7 @@ def gemm_rs(
     """
     n = mesh.shape[axis]
     batch_axes = tuple(batch_axes)
-    dp = 1
-    for ba in batch_axes:
-        dp *= mesh.shape[ba]
+    dp = mesh_axes_size(mesh, batch_axes)
     out_dtype = out_dtype or a.dtype
     assert a.shape[0] % (dp * n) == 0 and a.shape[1] % n == 0 and b.shape[0] % n == 0
     assert a.shape[1] == b.shape[0], f"contract dim mismatch {a.shape} @ {b.shape}"

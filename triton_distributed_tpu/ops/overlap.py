@@ -11,9 +11,12 @@ differentiable, which is what makes the flagship *training* path possible:
 * d(AG-GEMM): dA = GEMM-RS(dC, Bᵀ); dB = psum_dp(AG(A)ᵀ @ dC)
 * d(GEMM-RS): dA = AG-GEMM(dC, Bᵀ); dB = psum_dp(Aᵀ @ AG(dC))
 
-i.e. the backward of each overlap op **is the dual overlap op**, so the
-backward pass gets the same compute/communication overlap as forward —
-a property the stream-based reference design cannot express.
+i.e. the backward of each overlap op's *activation gradient* is the dual
+overlap op, so dA gets the same compute/communication overlap as the
+forward — a property the stream-based reference design cannot express.
+The weight gradients run as plain all_gather + matmul (XLA overlaps the
+gather with neighbouring ops where it can, but there is no fused engine
+for them yet).
 """
 
 from __future__ import annotations
@@ -25,8 +28,17 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from triton_distributed_tpu.kernels.ag_gemm import ag_gemm as _ag_gemm_raw
-from triton_distributed_tpu.kernels.gemm_rs import gemm_rs as _gemm_rs_raw
+from triton_distributed_tpu.kernels.ag_gemm import AGGemmMethod, ag_gemm as _ag_gemm_raw
+from triton_distributed_tpu.kernels.gemm_rs import GemmRSMethod, gemm_rs as _gemm_rs_raw
+
+
+def _dual_method(method, target_enum):
+    """Map a pinned engine onto the dual op's enum (the backward of ag_gemm
+    is a gemm_rs and vice versa; the enums share member names). None stays
+    None (auto-select)."""
+    if method is None:
+        return None
+    return target_enum[method.name]
 
 
 @dataclass(frozen=True)
@@ -130,8 +142,8 @@ def _ag_gemm_bwd(ctx, res, g):
     # dA: the dual overlap op — GEMM(dC, Bᵀ) fused with ReduceScatter.
     da = _gemm_rs_raw(
         g, b.T, ctx.mesh, ctx.axis,
-        batch_axes=ctx.batch_axes, out_dtype=a.dtype,
-        collective_id=ctx.collective_id + 1,
+        batch_axes=ctx.batch_axes, method=_dual_method(ctx.method, GemmRSMethod),
+        out_dtype=a.dtype, collective_id=ctx.collective_id + 1,
     )
     db = _build_ag_wgrad(ctx.mesh, ctx.axis, tuple(ctx.batch_axes))(a, g)
     return da, db.astype(b.dtype)
@@ -163,8 +175,8 @@ def _gemm_rs_bwd(ctx, res, g):
     # dA: the dual overlap op — AllGather(dC) fused with GEMM(·, Bᵀ).
     da = _ag_gemm_raw(
         g, b.T, ctx.mesh, ctx.axis,
-        batch_axes=ctx.batch_axes, out_dtype=a.dtype,
-        collective_id=ctx.collective_id + 1,
+        batch_axes=ctx.batch_axes, method=_dual_method(ctx.method, AGGemmMethod),
+        out_dtype=a.dtype, collective_id=ctx.collective_id + 1,
     )
     db = _build_rs_wgrad(ctx.mesh, ctx.axis, tuple(ctx.batch_axes))(a, g)
     return da, db.astype(b.dtype)

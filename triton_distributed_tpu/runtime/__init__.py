@@ -25,6 +25,7 @@ from triton_distributed_tpu.runtime.topology import (
     auto_allgather_method,
     detect_topology,
     flat_device_id,
+    mesh_axes_size,
     ring_neighbors,
 )
 
@@ -42,6 +43,7 @@ __all__ = [
     "LinkKind",
     "detect_topology",
     "auto_allgather_method",
+    "mesh_axes_size",
     "ring_neighbors",
     "flat_device_id",
 ]

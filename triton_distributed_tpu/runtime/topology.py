@@ -85,6 +85,14 @@ def auto_allgather_method(
     return AllGatherMethod.RING_1D
 
 
+def mesh_axes_size(mesh, axes) -> int:
+    """Product of mesh extents over ``axes`` (e.g. total DP degree)."""
+    size = 1
+    for a in axes:
+        size *= mesh.shape[a]
+    return size
+
+
 def ring_neighbors(idx, n):
     """(left, right) neighbors on a ring of size ``n`` (traced-value safe)."""
     right = jax.lax.rem(idx + 1, n)
