@@ -67,6 +67,25 @@ def fused_vmem_budget() -> int:
     return config.fused_vmem_budget
 
 
+def _use_interpret(force: bool | None) -> bool:
+    """Shared should-we-interpret policy: forced, or running off-TPU."""
+    if force is not None:
+        return bool(force)
+    return config.force_interpret or not on_tpu()
+
+
+def local_interpret(force: bool | None = None):
+    """Pallas ``interpret=`` argument for kernels with NO cross-device ops.
+
+    Off-TPU these run under the *plain* Pallas interpreter (True), not the
+    TPU state machine: the simulation's io_callback threads starve XLA's
+    CPU thread pool on small hosts (observed as a flaky deadlock with 8
+    virtual devices on 1 core), and a kernel without remote DMA/semaphores
+    gains nothing from the heavyweight simulation.
+    """
+    return _use_interpret(force)
+
+
 def interpret_params(force: bool | None = None):
     """Pallas ``interpret=`` argument for the current platform.
 
@@ -76,8 +95,7 @@ def interpret_params(force: bool | None = None):
     """
     from jax.experimental.pallas import tpu as pltpu
 
-    use_interp = config.force_interpret or not on_tpu() if force is None else force
-    if not use_interp:
+    if not _use_interpret(force):
         return False
     return pltpu.InterpretParams(
         detect_races=config.detect_races,

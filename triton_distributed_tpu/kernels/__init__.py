@@ -6,6 +6,13 @@ Reference: python/triton_dist/kernels/nvidia/ (see SURVEY.md §2.3).
 from triton_distributed_tpu.kernels.ag_gemm import AGGemmMethod, ag_gemm
 from triton_distributed_tpu.kernels.all_to_all import all_to_all, all_to_all_xla
 from triton_distributed_tpu.kernels.allgather import all_gather
+from triton_distributed_tpu.kernels.flash_decode import (
+    combine_partials,
+    gqa_fwd_batch_decode,
+    gqa_fwd_batch_decode_xla,
+    sp_gqa_fwd_batch_decode,
+    sp_gqa_fwd_batch_decode_device,
+)
 from triton_distributed_tpu.kernels.gemm_rs import GemmRSMethod, gemm_rs
 from triton_distributed_tpu.kernels.reduce_scatter import (
     reduce_scatter,
@@ -22,4 +29,9 @@ __all__ = [
     "AGGemmMethod",
     "gemm_rs",
     "GemmRSMethod",
+    "gqa_fwd_batch_decode",
+    "gqa_fwd_batch_decode_xla",
+    "sp_gqa_fwd_batch_decode",
+    "sp_gqa_fwd_batch_decode_device",
+    "combine_partials",
 ]

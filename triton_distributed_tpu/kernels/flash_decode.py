@@ -1,0 +1,305 @@
+"""Distributed GQA flash-decode: split-KV attention + LSE-combine (SP/CP).
+
+Reference: python/triton_dist/kernels/nvidia/flash_decode.py —
+``kernel_gqa_fwd_batch_decode_split_kv`` (:130-280, online-softmax partial
+attention over KV splits), intra-rank combine (:393-451), inter-rank
+combine merging per-rank (out, lse) partials (:482-566), host entries
+``gqa_fwd_batch_decode{,_intra_rank}`` (:763-930); the SP layer
+sp_flash_decode_layer.py:78-184 shards the KV cache over ranks.
+
+TPU re-design:
+
+* The reference splits KV across SMs and re-combines to fill the GPU.
+  On TPU one core runs the grid sequentially with VMEM-resident
+  accumulators, so "split-KV + intra-rank combine" collapses into a
+  single Pallas kernel whose innermost grid dimension walks KV blocks,
+  carrying (m, l, acc) online-softmax state in scratch — the classic
+  TPU flash-attention schedule. No intra-rank combine kernel is needed;
+  the hardware pipeline plays the role of the split scheduler.
+* What remains distributed is exactly the reference's inter-rank stage:
+  each rank decodes over its local KV shard producing (out, lse), the
+  partials are all-gathered (small payload — the LL-allgather regime),
+  and a combine re-normalizes with the global LSE. Numerically this is
+  the ring-attention / blockwise-softmax merge, done once over ranks
+  (≡ kernel_inter_rank_gqa_fwd_batch_decode_combine_kv).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.config import local_interpret
+from triton_distributed_tpu.lang.launch import shmem_call
+
+NEG_INF = -1.0e30  # finite -inf stand-in: exp(NEG_INF - m) == 0 without NaNs
+
+
+def _decode_kernel(
+    scale, soft_cap, block_k, kv_lens_ref, q_ref, k_ref, v_ref,
+    out_ref, lse_ref, m_ref, l_ref, acc_ref,
+):
+    """One (batch, kv_head) group; grid dim 2 walks KV blocks sequentially.
+
+    q_ref: (1, 1, G, D) — the GQA query group of this kv head.
+    k_ref/v_ref: (1, block_k, D) — current KV block of this head, read
+    directly from the cache viewed as (B, S, Hkv·D) (a free reshape of the
+    native layout — no transposed copy; the block DMA slices the head's
+    D-column window).
+    Carries (m, l, acc) in f32 scratch across the KV walk (the online
+    softmax of the reference's split_kv kernel, :207-258).
+    """
+    b = pl.program_id(0)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0]                            # (G, D), input dtype
+    k = k_ref[0]                               # (block_k, D)
+    v = v_ref[0]                               # (block_k, D)
+
+    # Inputs stay in their native (bf16) dtype so the MXU runs at full
+    # rate; accumulation is f32 via preferred_element_type.
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                  # (G, block_k) f32
+    if soft_cap > 0.0:
+        s = soft_cap * jnp.tanh(s / soft_cap)
+
+    kv_len = kv_lens_ref[b]
+    pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(pos < kv_len, s, NEG_INF)
+
+    m_prev = m_ref[:]                          # (G, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                     # (G, block_k)
+    l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[:] = alpha * acc_ref[:] + jnp.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+    m_ref[:] = m_new
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finish():
+        l = l_ref[:]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        out_ref[0, 0] = (acc_ref[:] / safe_l).astype(out_ref.dtype)
+        lse_ref[0, 0] = jnp.where(
+            l > 0.0, m_ref[:] + jnp.log(safe_l), jnp.full_like(l, NEG_INF)
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "soft_cap", "block_k", "interpret")
+)
+def gqa_fwd_batch_decode(
+    q, k_cache, v_cache, kv_lens, *,
+    scale: float | None = None, soft_cap: float = 0.0,
+    block_k: int = 256, interpret=None,
+):
+    """Local GQA decode over a (sharded or whole) KV cache → (out, lse).
+
+    q: (B, Hq, D); k_cache/v_cache: (B, S, Hkv, D); kv_lens: (B,) int32
+    valid lengths. Returns out (B, Hq, D) in q.dtype and lse (B, Hq) f32
+    — the per-shard partials the SP combine consumes. ``lse`` is the
+    natural-log sum-exp of ``scale * q·k`` over valid positions
+    (≡ gqa_fwd_batch_decode, flash_decode.py:763-846, with the intra-rank
+    combine folded into the kernel's sequential KV walk).
+    """
+    batch, hq, d = q.shape
+    _, s_len, hkv, _ = k_cache.shape
+    assert hq % hkv == 0, f"GQA needs Hq % Hkv == 0, got {hq} % {hkv}"
+    g = hq // hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    block_k = min(block_k, s_len)
+    assert s_len % block_k == 0, f"S={s_len} not divisible by block_k={block_k}"
+
+    qg = q.reshape(batch, hkv, g, d)
+    kf = k_cache.reshape(batch, s_len, hkv * d)   # free view, no copy
+    vf = v_cache.reshape(batch, s_len, hkv * d)
+
+    grid = (batch, hkv, s_len // block_k)
+    kernel = functools.partial(_decode_kernel, scale, soft_cap, block_k)
+    call = shmem_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # kv_lens, whole (B,)
+            pl.BlockSpec((1, 1, g, d), lambda b, h, k: (b, h, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, h, k: (b, k, h)),
+            pl.BlockSpec((1, block_k, d), lambda b, h, k: (b, k, h)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda b, h, k: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, g, 1), lambda b, h, k: (b, h, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, hkv, g, d), q.dtype),
+            jax.ShapeDtypeStruct((batch, hkv, g, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+        ],
+        collective_id=None,
+        interpret=local_interpret() if interpret is None else interpret,
+        name="gqa_decode_split_kv",
+    )
+    out, lse = call(kv_lens.astype(jnp.int32), qg, kf, vf)
+    return out.reshape(batch, hq, d), lse.reshape(batch, hq)
+
+
+def gqa_fwd_batch_decode_xla(q, k_cache, v_cache, kv_lens, *, scale=None, soft_cap=0.0):
+    """Dense-XLA twin of :func:`gqa_fwd_batch_decode` (correctness
+    reference, ≡ the torch baselines in test_decode_attn.py)."""
+    batch, hq, d = q.shape
+    _, s_len, hkv, _ = k_cache.shape
+    g = hq // hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    qg = q.reshape(batch, hkv, g, d).astype(jnp.float32)
+    kt = k_cache.transpose(0, 2, 1, 3).astype(jnp.float32)   # (B,Hkv,S,D)
+    vt = v_cache.transpose(0, 2, 1, 3).astype(jnp.float32)
+    s = jnp.einsum("bhgd,bhsd->bhgs", qg, kt) * scale
+    if soft_cap > 0.0:
+        s = soft_cap * jnp.tanh(s / soft_cap)
+    mask = jnp.arange(s_len)[None, None, None, :] < kv_lens[:, None, None, None]
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhgs,bhsd->bhgd", p / jnp.maximum(l, 1e-30), vt)
+    lse = jnp.where(l[..., 0] > 0, m[..., 0] + jnp.log(jnp.maximum(l[..., 0], 1e-30)), NEG_INF)
+    return out.reshape(batch, hq, d).astype(q.dtype), lse.reshape(batch, hq)
+
+
+def combine_partials(outs, lses, out_dtype=None):
+    """Merge per-shard (out, lse) partials along axis 0.
+
+    outs: (R, B, Hq, D); lses: (R, B, Hq). The blockwise-softmax /
+    ring-attention merge (≡ kernel_inter_rank_gqa_fwd_batch_decode_
+    combine_kv, flash_decode.py:482-566): weight each shard by
+    exp(lse_r − lse_max) and renormalize. Shards with empty KV carry
+    lse == NEG_INF and contribute exactly zero.
+    """
+    out_dtype = out_dtype or outs.dtype
+    lses = lses.astype(jnp.float32)
+    m = jnp.max(lses, axis=0, keepdims=True)                 # (1, B, Hq)
+    w = jnp.exp(lses - m)                                    # (R, B, Hq)
+    denom = jnp.maximum(jnp.sum(w, axis=0), 1e-30)           # (B, Hq)
+    merged = jnp.einsum("rbh,rbhd->bhd", w, outs.astype(jnp.float32)) / denom[..., None]
+    lse = m[0] + jnp.log(denom)
+    return merged.astype(out_dtype), lse
+
+
+def _local_shard_decode(
+    q, k_shard, v_shard, global_kv_lens, axis, *,
+    scale, soft_cap, block_k, use_pallas, interpret=None,
+):
+    """Rank-local decode over this rank's contiguous KV slice → (out, lse)."""
+    r = jax.lax.axis_index(axis)
+    s_loc = k_shard.shape[1]
+    local_lens = jnp.clip(global_kv_lens - r * s_loc, 0, s_loc).astype(jnp.int32)
+    decode = gqa_fwd_batch_decode if use_pallas else gqa_fwd_batch_decode_xla
+    kwargs = dict(scale=scale, soft_cap=soft_cap)
+    if use_pallas:
+        kwargs.update(block_k=min(block_k, s_loc), interpret=interpret)
+    return decode(q, k_shard, v_shard, local_lens, **kwargs)
+
+
+def _merge_shard_partials(out, lse, axis):
+    """AG of per-rank (out, lse) + inter-rank combine, inside shard_map.
+
+    Small payload — the reference uses its LL allgather here
+    (low_latency_allgather_layer.py); XLA's all_gather over ICI is the
+    TPU fast path for this message size.
+    """
+    outs = jax.lax.all_gather(out, axis)                     # (R, B, Hq, D)
+    lses = jax.lax.all_gather(lse, axis)                     # (R, B, Hq)
+    merged, _ = combine_partials(outs, lses, out_dtype=out.dtype)
+    return merged
+
+
+def sp_gqa_fwd_batch_decode_device(
+    q, k_shard, v_shard, global_kv_lens, axis, *,
+    scale=None, soft_cap=0.0, block_k=256, use_pallas=True, interpret=None,
+):
+    """Per-device SP decode body — callable inside any shard_map.
+
+    q: (B, Hq, D) replicated across ``axis``; k_shard/v_shard:
+    (B, S/R, Hkv, D) — this rank's contiguous slice of the sequence;
+    global_kv_lens: (B,) TOTAL valid lengths. ≡ SpGQAFlashDecodeAttention
+    .forward (sp_flash_decode_layer.py:78-184): local decode → AG of
+    (out, lse) → inter-rank combine.
+    """
+    out, lse = _local_shard_decode(
+        q, k_shard, v_shard, global_kv_lens, axis,
+        scale=scale, soft_cap=soft_cap, block_k=block_k,
+        use_pallas=use_pallas, interpret=interpret,
+    )
+    return _merge_shard_partials(out, lse, axis)
+
+
+@functools.lru_cache(maxsize=64)
+def _sp_decode_fns(mesh, axis, scale, soft_cap, block_k, use_pallas):
+    """Jitted (local, merge) pair for :func:`sp_gqa_fwd_batch_decode`,
+    cached so repeated decode steps don't retrace/recompile."""
+    # Two dispatches, not one: on the CPU-interpreter path, mixing the
+    # io_callback-driven Pallas simulation and an XLA collective in a single
+    # program can starve the collective rendezvous threads (deadlock). On
+    # TPU the split costs one extra dispatch on a microseconds-scale op.
+    def local(q, k_shard, v_shard, lens):
+        return _local_shard_decode(
+            q, k_shard, v_shard, lens, axis,
+            scale=scale, soft_cap=soft_cap, block_k=block_k,
+            use_pallas=use_pallas,
+        )
+
+    local_fn = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(None, axis), P(None, axis), P()),
+            out_specs=(P(axis), P(axis)),
+            check_vma=False,
+        )
+    )
+    merge_fn = jax.jit(
+        jax.shard_map(
+            functools.partial(_merge_shard_partials, axis=axis),
+            mesh=mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    return local_fn, merge_fn
+
+
+def sp_gqa_fwd_batch_decode(
+    q, k_cache, v_cache, global_kv_lens, mesh, axis="x", *,
+    scale=None, soft_cap=0.0, block_k=256, use_pallas=True,
+):
+    """Host entry: sequence-parallel GQA decode on ``mesh``.
+
+    k_cache/v_cache: (B, S, Hkv, D) with S sharded over ``axis``; q and
+    global_kv_lens replicated. Returns (B, Hq, D) replicated.
+    """
+    local_fn, merge_fn = _sp_decode_fns(
+        mesh, axis, scale, soft_cap, block_k, use_pallas
+    )
+    out, lse = local_fn(q, k_cache, v_cache, global_kv_lens)
+    return merge_fn(out, lse)

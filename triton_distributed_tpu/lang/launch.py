@@ -36,6 +36,8 @@ def shmem_call(
 ):
     """``pl.pallas_call`` preconfigured for SHMEM-style distributed kernels:
     side-effecting, collective, interpreted off-TPU."""
+    # collective_id=None → a purely local kernel (no barrier semaphore);
+    # Mosaic requires it unset in that case.
     compiler_params = pltpu.CompilerParams(
         has_side_effects=True,
         collective_id=collective_id,
