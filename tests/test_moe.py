@@ -1,0 +1,208 @@
+"""MoE kernel tests: routing/alignment, grouped GEMM, EP AllToAll.
+
+Mirrors test_all_to_all.py / test_ep_a2a.py / test_ag_moe.py
+(python/triton_dist/test/nvidia/), with jax.lax collectives and dense
+einsums playing the role of the torch/NCCL baselines (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.kernels import group_gemm as gg
+from triton_distributed_tpu.kernels import moe_all_to_all as ma
+from triton_distributed_tpu.kernels import moe_utils as mu
+from triton_distributed_tpu.utils import assert_allclose
+
+
+def _routing(m, e, topk, seed=0):
+    logits = jax.random.normal(jax.random.PRNGKey(seed), (m, e))
+    return mu.select_experts(logits, topk)
+
+
+class TestRouting:
+    def test_select_experts_normalized(self):
+        weights, ids = _routing(32, 8, 2)
+        assert weights.shape == (32, 2) and ids.shape == (32, 2)
+        np.testing.assert_allclose(np.asarray(weights.sum(-1)), 1.0, rtol=1e-5)
+
+    def test_align_block_size_invariants(self):
+        m, e, topk, bm = 64, 8, 2, 16
+        _, ids = _routing(m, e, topk)
+        sti, be, splits = mu.moe_align_block_size(ids, e, bm)
+        sti, be, splits = map(np.asarray, (sti, be, splits))
+        total = m * topk
+        assert splits.sum() == total
+        # every non-sentinel source index appears exactly once
+        real = sti[sti < total]
+        assert sorted(real.tolist()) == list(range(total))
+        # each block's non-sentinel entries all route to the block's expert
+        flat_ids = np.asarray(ids).reshape(-1)
+        for b, exp in enumerate(be):
+            blk = sti[b * bm : (b + 1) * bm]
+            for s in blk[blk < total]:
+                assert flat_ids[s] == exp
+
+    def test_gather_scatter_roundtrip_identity_experts(self):
+        """gather → (identity expert) → weighted scatter == input when
+        weights sum to 1."""
+        m, e, topk, bm, h = 32, 4, 2, 8, 128
+        weights, ids = _routing(m, e, topk)
+        x = jax.random.normal(jax.random.PRNGKey(1), (m, h))
+        sti, _, _ = mu.moe_align_block_size(ids, e, bm)
+        xs = mu.gather_sorted(x, sti, topk)
+        out = mu.scatter_combine(xs, sti, weights, m)
+        assert_allclose(out, x, atol=1e-5, rtol=1e-5)
+
+
+class TestGroupedGemm:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_vs_ragged_dot(self, dtype):
+        m, k, n, e, topk, bm = 64, 128, 256, 8, 2, 16
+        _, ids = _routing(m, e, topk)
+        sti, be, splits = mu.moe_align_block_size(ids, e, bm)
+        cap = sti.shape[0]
+        x = jax.random.normal(jax.random.PRNGKey(1), (m, k), dtype)
+        w = jax.random.normal(jax.random.PRNGKey(2), (e, k, n), dtype) * 0.05
+        xs = mu.gather_sorted(x, sti, topk)
+        y = gg.grouped_matmul(xs, w, be, block_m=bm)
+        y_ref = gg.grouped_matmul_xla(xs, w, gg.padded_splits(splits, bm, cap))
+        tol = 1e-5 if dtype == jnp.float32 else 3e-2
+        assert_allclose(y, y_ref, atol=tol, rtol=tol)
+
+    def test_full_local_moe_vs_dense(self):
+        """sorted grouped-GEMM MoE == dense per-expert einsum reference."""
+        m, k, n, e, topk, bm = 32, 128, 128, 4, 2, 8
+        weights, ids = _routing(m, e, topk)
+        x = jax.random.normal(jax.random.PRNGKey(1), (m, k))
+        w = jax.random.normal(jax.random.PRNGKey(2), (e, k, n)) * 0.05
+        sti, be, _ = mu.moe_align_block_size(ids, e, bm)
+        xs = mu.gather_sorted(x, sti, topk)
+        y = gg.grouped_matmul(xs, w, be, block_m=bm)
+        out = mu.scatter_combine(y, sti, weights, m)
+
+        ref = jnp.zeros((m, n))
+        for t in range(topk):
+            ref += weights[:, t : t + 1] * jnp.einsum(
+                "mk,mkn->mn", x, w[ids[:, t]]
+            )
+        assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+class TestMoEAllToAll:
+    def _setup(self, mesh, n=8, epr=4, H=128, max_m=32, M=24, seed=0):
+        E = n * epr
+        ctx = ma.create_all_to_all_context(
+            mesh, "x", max_m=max_m, hidden=H,
+            experts_per_rank=epr, dtype=jnp.float32,
+        )
+        rng = np.random.default_rng(seed)
+        assign = np.sort(rng.integers(0, E, size=(n, M)), axis=1)
+        splits = np.stack(
+            [np.bincount(assign[d], minlength=E) for d in range(n)]
+        ).astype(np.int32)
+        toks = rng.standard_normal((n, M, H)).astype(np.float32)
+        sh = NamedSharding(mesh, P("x"))
+        toks_g = jax.device_put(jnp.asarray(toks).reshape(n * M, H), sh)
+        spl_g = jax.device_put(jnp.asarray(splits).reshape(n * E), sh)
+        return ctx, toks, splits, toks_g, spl_g
+
+    def _shard(self, mesh, fn, n_in, n_out):
+        return jax.jit(
+            jax.shard_map(
+                fn, mesh=mesh,
+                in_specs=tuple([P("x")] * n_in) if n_in > 1 else P("x"),
+                out_specs=tuple([P("x")] * n_out) if n_out > 1 else P("x"),
+                check_vma=False,
+            )
+        )
+
+    def test_transport_matches_xla(self, mesh8):
+        ctx, _, _, toks_g, spl_g = self._setup(mesh8)
+        stage = self._shard(
+            mesh8, lambda t, s: ma.dispatch_stage(ctx, t, s), 2, 1
+        )
+        send = stage(toks_g, spl_g)
+        recv = ma.fast_all_to_all(ctx, send)
+        recv_ref = ma.fast_all_to_all(ctx, send, use_xla=True)
+        np.testing.assert_array_equal(np.asarray(recv), np.asarray(recv_ref))
+
+    def test_recv_splits(self, mesh8):
+        n, epr = 8, 4
+        ctx, _, splits, toks_g, spl_g = self._setup(mesh8, n=n, epr=epr)
+        stage = self._shard(
+            mesh8, lambda t, s: ma.dispatch_stage(ctx, t, s), 2, 1
+        )
+        view = self._shard(
+            mesh8, lambda r: ma.recv_tokens_view(ctx, r)[1], 1, 1
+        )
+        rs = np.asarray(view(ma.fast_all_to_all(ctx, stage(toks_g, spl_g))))
+        rs = rs.reshape(n, n, epr)
+        for d in range(n):
+            for s in range(n):
+                np.testing.assert_array_equal(
+                    rs[d, s], splits[s, d * epr : (d + 1) * epr]
+                )
+
+    def test_dispatch_combine_roundtrip(self, mesh8):
+        n, M, H = 8, 24, 128
+        ctx, toks, _, toks_g, spl_g = self._setup(mesh8, n=n, M=M, H=H)
+        stage = self._shard(
+            mesh8, lambda t, s: ma.dispatch_stage(ctx, t, s), 2, 1
+        )
+        comb_in = self._shard(
+            mesh8,
+            lambda r: ma.combine_stage(ctx, ma.recv_tokens_view(ctx, r)[0]),
+            1, 1,
+        )
+        unstage = self._shard(
+            mesh8, lambda c, s: ma.combine_unstage(ctx, c, s, M), 2, 1
+        )
+        recv = ma.fast_all_to_all(ctx, stage(toks_g, spl_g))
+        comb = ma.fast_all_to_all(ctx, comb_in(recv))
+        back = np.asarray(unstage(comb, spl_g)).reshape(n, M, H)
+        np.testing.assert_allclose(back, toks, rtol=1e-6)
+
+    def test_overflow_truncates_to_zero_not_garbage(self, mesh8):
+        """A peer total above max_m must come back as ZERO rows (dropped),
+        never as duplicated slot data, and receiver splits must be
+        clamped to what actually arrived."""
+        n, epr, H, max_m, M = 8, 4, 128, 4, 24   # peers can get > 4 tokens
+        ctx, toks, splits, toks_g, spl_g = self._setup(
+            mesh8, n=n, epr=epr, H=H, max_m=max_m, M=M
+        )
+        stage = self._shard(
+            mesh8, lambda t, s: ma.dispatch_stage(ctx, t, s), 2, 1
+        )
+        view = self._shard(
+            mesh8, lambda r: ma.recv_tokens_view(ctx, r)[1], 1, 1
+        )
+        comb_in = self._shard(
+            mesh8,
+            lambda r: ma.combine_stage(ctx, ma.recv_tokens_view(ctx, r)[0]),
+            1, 1,
+        )
+        unstage = self._shard(
+            mesh8, lambda c, s: ma.combine_unstage(ctx, c, s, M), 2, 1
+        )
+        recv = ma.fast_all_to_all(ctx, stage(toks_g, spl_g))
+        rs = np.asarray(view(recv)).reshape(n, n, epr)
+        # receiver splits never claim more than max_m per source
+        assert rs.sum(axis=2).max() <= max_m
+        comb = ma.fast_all_to_all(ctx, comb_in(recv))
+        back = np.asarray(unstage(comb, spl_g)).reshape(n, M, H)
+        counts = splits.reshape(n, n, epr).sum(axis=2)   # (dev, peer)
+        offs = np.concatenate(
+            [np.zeros((n, 1), np.int64), np.cumsum(counts, axis=1)[:, :-1]],
+            axis=1,
+        )
+        for d in range(n):
+            for t in range(M):
+                j = np.searchsorted(np.cumsum(counts[d]), t, side="right")
+                pos = t - offs[d, j]
+                if pos < max_m:
+                    np.testing.assert_allclose(back[d, t], toks[d, t], rtol=1e-6)
+                else:
+                    np.testing.assert_array_equal(back[d, t], 0.0)

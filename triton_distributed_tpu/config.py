@@ -86,6 +86,59 @@ def local_interpret(force: bool | None = None):
     return _use_interpret(force)
 
 
+_io_callback_patched = False
+
+
+def ensure_interpreter_unblocked():
+    """Unblock the TPU-simulation interpreter on small hosts.
+
+    jax's ``io_callback_impl`` device_puts callback args onto cpu:0 and the
+    interpreter's callbacks then force that pending cross-device copy
+    (``np.array(val)`` in ``_allocate_buffer``). When every client thread is
+    already parked inside a device's blocked callback — guaranteed here,
+    where N virtual devices rendezvous through DMA waits on a 1-core host —
+    the copy can never be scheduled and the process deadlocks (observed
+    deterministically for buffers over ~128 KB/device). The interpreter's
+    callback args are always materialized host buffers, so converting them
+    in place with ``np.asarray`` needs no client thread at all.
+
+    Process-wide (affects all jax io_callbacks); applied only off-TPU,
+    opt-out via TDTPU_NO_IO_CALLBACK_PATCH=1.
+    """
+    global _io_callback_patched
+    if _io_callback_patched or on_tpu():
+        return
+    if os.environ.get("TDTPU_NO_IO_CALLBACK_PATCH") == "1":
+        return
+    import logging
+
+    import numpy as np
+    import jax._src.callback as _cb
+    from jax import tree_util
+    from jax._src import config as _jax_config
+    from jax._src import xla_bridge as _xb
+
+    logger = logging.getLogger("jax._src.callback")
+
+    def io_callback_impl(*args, result_avals, callback, sharding, ordered):
+        # Same contract as the original impl, minus the device_put of args
+        # onto cpu:0 (the deadlock); callbacks still run under a cpu
+        # default_device and failures are still logged.
+        del result_avals, sharding, ordered
+        args = tuple(np.asarray(a) for a in args)
+        cpu_device, *_ = _xb.local_devices(backend="cpu")
+        with _jax_config.default_device(cpu_device):
+            try:
+                return tree_util.tree_map(np.asarray, callback(*args))
+            except BaseException:
+                logger.exception("jax.io_callback failed")
+                raise
+
+    _cb.io_callback_impl = io_callback_impl
+    _cb.io_callback_p.def_impl(io_callback_impl)
+    _io_callback_patched = True
+
+
 def interpret_params(force: bool | None = None):
     """Pallas ``interpret=`` argument for the current platform.
 
@@ -97,6 +150,7 @@ def interpret_params(force: bool | None = None):
 
     if not _use_interpret(force):
         return False
+    ensure_interpreter_unblocked()
     return pltpu.InterpretParams(
         detect_races=config.detect_races,
         dma_execution_mode="on_wait",

@@ -14,6 +14,19 @@ from triton_distributed_tpu.kernels.flash_decode import (
     sp_gqa_fwd_batch_decode_device,
 )
 from triton_distributed_tpu.kernels.gemm_rs import GemmRSMethod, gemm_rs
+from triton_distributed_tpu.kernels.group_gemm import (
+    grouped_matmul,
+    grouped_matmul_xla,
+)
+from triton_distributed_tpu.kernels.moe_all_to_all import (
+    MoEAllToAllContext,
+    create_all_to_all_context,
+    fast_all_to_all,
+)
+from triton_distributed_tpu.kernels.moe_utils import (
+    moe_align_block_size,
+    select_experts,
+)
 from triton_distributed_tpu.kernels.reduce_scatter import (
     reduce_scatter,
     reduce_scatter_xla,
@@ -34,4 +47,11 @@ __all__ = [
     "sp_gqa_fwd_batch_decode",
     "sp_gqa_fwd_batch_decode_device",
     "combine_partials",
+    "select_experts",
+    "moe_align_block_size",
+    "grouped_matmul",
+    "grouped_matmul_xla",
+    "MoEAllToAllContext",
+    "create_all_to_all_context",
+    "fast_all_to_all",
 ]

@@ -1,0 +1,110 @@
+"""Grouped (per-expert) GEMM over block-aligned sorted tokens.
+
+Reference: the consumer grouped-GEMM kernels
+``kernel_consumer_m_parallel_scatter_group_gemm`` (python/triton_dist/
+kernels/nvidia/allgather_group_gemm.py:420-498) and the producer grouped
+GEMM of moe_reduce_rs.py:362-467 — tiles walk the block-aligned sorted
+token list, each M-block owned by exactly one expert whose weight matrix
+it multiplies.
+
+TPU re-design: the expert-id-per-block indirection becomes a Mosaic
+scalar-prefetch index map — ``block_expert`` rides in SMEM and the
+weight BlockSpec selects expert ``be[m]``'s (K, N) matrix per M-block
+(the canonical TPU grouped-matmul / Megablocks schedule). MXU does the
+FLOPs in bf16 with f32 accumulation in VMEM scratch. The XLA twin is
+``jax.lax.ragged_dot`` over the same layout (group_sizes = padded
+per-expert counts), used as the correctness baseline and as the
+fallback where a shape falls off the kernel's alignment.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.config import local_interpret
+
+
+def _ggemm_kernel(nsteps_k, be_ref, x_ref, w_ref, o_ref, acc_ref):
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jax.lax.dot_general(
+        x_ref[:], w_ref[0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kk == nsteps_k - 1)
+    def _store():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_m", "block_n", "block_k", "interpret"),
+)
+def grouped_matmul(
+    x_sorted, w, block_expert, *,
+    block_m: int = 256, block_n: int = 512, block_k: int = 512,
+    interpret=None,
+):
+    """x_sorted (cap, K) @ w (E, K, N) → (cap, N), expert per M-block.
+
+    ``cap`` must be a multiple of ``block_m`` and ``block_expert`` have
+    ``cap // block_m`` entries (from moe_utils.moe_align_block_size).
+    """
+    cap, kdim = x_sorted.shape
+    e, _, ndim = w.shape
+    assert cap % block_m == 0, f"cap={cap} not divisible by block_m={block_m}"
+    block_n = min(block_n, ndim)
+    block_k = min(block_k, kdim)
+    assert ndim % block_n == 0 and kdim % block_k == 0, (
+        f"(K={kdim}, N={ndim}) not divisible by ({block_k}, {block_n})"
+    )
+    nsteps_k = kdim // block_k
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(cap // block_m, ndim // block_n, nsteps_k),
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda m, n, k, be: (m, k)),
+            pl.BlockSpec(
+                (1, block_k, block_n), lambda m, n, k, be: (be[m], k, n)
+            ),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda m, n, k, be: (m, n)),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+    )
+    call = pl.pallas_call(
+        functools.partial(_ggemm_kernel, nsteps_k),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((cap, ndim), x_sorted.dtype),
+        interpret=local_interpret() if interpret is None else interpret,
+    )
+    return call(block_expert, x_sorted, w)
+
+
+def grouped_matmul_xla(x_sorted, w, splits_padded):
+    """``jax.lax.ragged_dot`` twin: group sizes are the block-aligned
+    per-expert counts (they sum to cap; padding rows are zero)."""
+    return jax.lax.ragged_dot(
+        x_sorted, w, splits_padded.astype(jnp.int32)
+    ).astype(x_sorted.dtype)
+
+
+def padded_splits(splits, block_m: int, cap: int):
+    """Block-aligned per-expert counts with the tail slack folded into the
+    last group so the sizes sum to ``cap`` (ragged_dot requires it)."""
+    from triton_distributed_tpu.kernels.moe_utils import round_up_to_block
+
+    padded = round_up_to_block(splits, block_m)
+    slack = cap - jnp.sum(padded)
+    return padded.at[-1].add(slack)

@@ -1,0 +1,227 @@
+"""MoE low-latency AllToAll: splits-aware dispatch/combine for EP.
+
+Reference: python/triton_dist/kernels/nvidia/low_latency_all_to_all.py —
+``all_to_all_kernel`` (:36-118, one block per peer: putmem_nbi of the
+peer's token range + splits, fence, signal_op/signal_wait_until on a
+call-count), ``AllToAllContext`` (:125-187, symmetric buffers padded to
+``max_m`` because token counts are runtime values), host entries
+``fast_all_to_all`` (:189-248) and ``all_to_all_post_process`` (:251-269);
+the EP layer ep_a2a_layer.py:40-240 drives dispatch → expert → combine.
+
+TPU re-design:
+
+* XLA is static-shape, so the reference's ``max_m`` padding is not an
+  implementation detail here but the core of the design: tokens ride in
+  per-peer slots of fixed capacity ``max_m`` rows, and the true counts
+  ride IN THE SAME payload as trailing rows (the NCCL-LL trick of
+  packing flag next to payload, applied to metadata). The transport
+  array is int32 — tokens are bitcast into int lanes, counts are native
+  ints — because TPU float units flush subnormals, so int32 COUNT bits
+  must never transit float lanes (a count of 6 bitcast to bf16 is a
+  denormal and silently becomes 0). Int lanes are flush-free for
+  arbitrary bits in both directions. One RDMA per peer moves data +
+  counts, and the recv DMA semaphore subsumes the reference's
+  call-count signal protocol (payload-then-flag ordering is a hardware
+  guarantee on TPU, so no separate flag write and no ``call_count % 2``
+  double buffering).
+* The transport is therefore exactly the dense AllToAll kernel
+  (kernels/all_to_all.py) over ``max_m + splits_rows`` rows per slot.
+* The runtime-value work the reference does on the GPU (per-expert
+  ranges from a splits cumsum) happens in XLA gather/scatter around the
+  kernel: ``dispatch_stage`` packs expert-sorted tokens into per-peer
+  slots, ``combine_unstage`` scatters processed tokens back into sorted
+  order. Both fuse into neighbouring ops under jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels.all_to_all import all_to_all, all_to_all_xla
+from triton_distributed_tpu.kernels.moe_utils import exclusive_cumsum
+
+
+@dataclass(frozen=True)
+class MoEAllToAllContext:
+    """Static geometry of the EP exchange (≡ AllToAllContext,
+    low_latency_all_to_all.py:125-165 — minus the symmetric buffers,
+    which on TPU are ordinary sharded arrays owned by the caller).
+
+    ``max_m``: per-peer token-slot capacity. Like the reference, a peer's
+    token count is TRUNCATED at ``max_m``: overflow tokens are dropped
+    (they come back as zero rows from the combine, and the receiver sees
+    clamped splits) — size it to the worst case (``num_tokens * topk``
+    for a pathological router).
+    """
+
+    mesh: jax.sharding.Mesh
+    axis: str
+    max_m: int
+    hidden: int
+    experts_per_rank: int
+    dtype: jnp.dtype
+    collective_id: int = 10
+
+    @property
+    def n(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    @property
+    def num_experts(self) -> int:
+        return self.n * self.experts_per_rank
+
+    @property
+    def ints_per_row(self) -> int:
+        return self.hidden * jnp.dtype(self.dtype).itemsize // 4
+
+    @property
+    def splits_rows(self) -> int:
+        """Trailing rows per slot carrying the bitcast int32 splits."""
+        return -(-self.experts_per_rank // self.ints_per_row)
+
+    @property
+    def slot_rows(self) -> int:
+        return self.max_m + self.splits_rows
+
+
+def create_all_to_all_context(
+    mesh, axis, *, max_m, hidden, experts_per_rank,
+    dtype=jnp.bfloat16, collective_id: int = 10,
+) -> MoEAllToAllContext:
+    """≡ create_all_to_all_context (low_latency_all_to_all.py:168-187)."""
+    dtype = jnp.dtype(dtype)
+    assert (hidden * dtype.itemsize) % 4 == 0, (
+        f"hidden={hidden} row of {dtype} not a whole number of int32s"
+    )
+    return MoEAllToAllContext(
+        mesh=mesh, axis=axis, max_m=max_m, hidden=hidden,
+        experts_per_rank=experts_per_rank, dtype=dtype,
+        collective_id=collective_id,
+    )
+
+
+def _pack_splits(ctx: MoEAllToAllContext, spl):
+    """(n, epr) int32 → (n, splits_rows, ints_per_row) int32 rows."""
+    pad = ctx.splits_rows * ctx.ints_per_row - ctx.experts_per_rank
+    spl = jnp.pad(spl, ((0, 0), (0, pad)))
+    return spl.reshape(ctx.n, ctx.splits_rows, ctx.ints_per_row)
+
+
+def _toks_to_ints(ctx: MoEAllToAllContext, toks):
+    """(..., H) ctx.dtype → (..., ints_per_row) int32, pure bitcast."""
+    lead = toks.shape[:-1]
+    itemsize = jnp.dtype(ctx.dtype).itemsize
+    if itemsize < 4:
+        toks = toks.reshape(*lead, ctx.ints_per_row, 4 // itemsize)
+    return jax.lax.bitcast_convert_type(toks, jnp.int32).reshape(
+        *lead, ctx.ints_per_row
+    )
+
+
+def _ints_to_toks(ctx: MoEAllToAllContext, ints):
+    """(..., ints_per_row) int32 → (..., H) ctx.dtype, pure bitcast."""
+    rows = jax.lax.bitcast_convert_type(ints, ctx.dtype)
+    return rows.reshape(*ints.shape[:-1], ctx.hidden)
+
+
+def peer_offsets(ctx: MoEAllToAllContext, splits):
+    """(counts, exclusive offsets) of this device's tokens per peer.
+
+    splits: (num_experts,) int32 — my token count per GLOBAL expert
+    (experts [j*epr, (j+1)*epr) live on peer j).
+    """
+    counts = splits.reshape(ctx.n, ctx.experts_per_rank).sum(axis=1)
+    return counts.astype(jnp.int32), exclusive_cumsum(counts)
+
+
+def dispatch_stage(ctx: MoEAllToAllContext, tokens, splits):
+    """Pack expert-sorted tokens + splits into per-peer padded slots.
+
+    tokens: (M, H) sorted by global expert id; splits: (num_experts,).
+    Returns an int32 (n * slot_rows, ints_per_row) array ready for
+    :func:`fast_all_to_all` — slot j = [max_m bitcast token rows for
+    peer j | native int32 splits rows].
+    ≡ the send_buf staging at low_latency_all_to_all.py:213-215.
+    """
+    m_total = tokens.shape[0]
+    counts, offs = peer_offsets(ctx, splits)
+    pos = jnp.arange(ctx.max_m, dtype=jnp.int32)
+    idx = offs[:, None] + pos[None, :]                       # (n, max_m)
+    valid = pos[None, :] < counts[:, None]
+    gathered = tokens[jnp.clip(idx, 0, m_total - 1)]         # (n, max_m, H)
+    toks = jnp.where(valid[..., None], gathered, 0).astype(ctx.dtype)
+
+    spl = splits.reshape(ctx.n, ctx.experts_per_rank).astype(jnp.int32)
+    slots = jnp.concatenate(
+        [_toks_to_ints(ctx, toks), _pack_splits(ctx, spl)], axis=1
+    )
+    return slots.reshape(ctx.n * ctx.slot_rows, ctx.ints_per_row)
+
+
+def fast_all_to_all(ctx: MoEAllToAllContext, send, *, use_xla: bool = False):
+    """Padded-slot exchange: slot j of device i → slot i of device j
+    (≡ fast_all_to_all, low_latency_all_to_all.py:189-248). ``send`` is
+    the global int32 (n² · slot_rows, ints_per_row) array sharded
+    P(axis) on dim 0.
+    """
+    if use_xla:
+        return all_to_all_xla(send, ctx.mesh, ctx.axis)
+    return all_to_all(
+        send, ctx.mesh, ctx.axis, collective_id=ctx.collective_id
+    )
+
+
+def recv_tokens_view(ctx: MoEAllToAllContext, recv):
+    """Per-device slice → ((n, max_m, H) tokens, (n, epr) int32 splits).
+
+    Row i of the splits = source rank i's counts for MY experts
+    (≡ all_to_all_post_process, low_latency_all_to_all.py:251-269).
+    Splits are clamped to what actually fit in the slot: a sender whose
+    per-peer total exceeded ``max_m`` shipped only the first ``max_m``
+    rows (in expert order), so the clamped cumulative counts name
+    exactly the rows that arrived.
+    """
+    slots = recv.reshape(ctx.n, ctx.slot_rows, ctx.ints_per_row)
+    toks = _ints_to_toks(ctx, slots[:, : ctx.max_m])
+    spl = slots[:, ctx.max_m :].reshape(ctx.n, -1)[:, : ctx.experts_per_rank]
+    cum = jnp.minimum(jnp.cumsum(spl, axis=1), ctx.max_m)
+    spl = jnp.diff(cum, axis=1, prepend=0)
+    return toks, spl
+
+
+def combine_stage(ctx: MoEAllToAllContext, toks):
+    """(n, max_m, H) processed tokens → slots for the return transport.
+    The splits rows are zero-filled; the combiner already knows its own
+    original splits."""
+    ints = _toks_to_ints(ctx, toks.astype(ctx.dtype))
+    zeros = jnp.zeros((ctx.n, ctx.splits_rows, ctx.ints_per_row), jnp.int32)
+    return jnp.concatenate([ints, zeros], axis=1).reshape(
+        ctx.n * ctx.slot_rows, ctx.ints_per_row
+    )
+
+
+def combine_unstage(ctx: MoEAllToAllContext, comb, splits, m_total: int):
+    """Scatter combined per-peer slots back into expert-sorted order.
+
+    comb: int32 (n * slot_rows, ints_per_row) return-leg transport
+    output — slot j holds MY tokens as processed by peer j; splits:
+    this device's ORIGINAL dispatch splits. Returns (m_total, H) in the
+    original sorted order.
+    """
+    ints = comb.reshape(ctx.n, ctx.slot_rows, ctx.ints_per_row)[:, : ctx.max_m]
+    toks = _ints_to_toks(ctx, ints).reshape(ctx.n * ctx.max_m, ctx.hidden)
+    counts, offs = peer_offsets(ctx, splits)
+    ends = jnp.cumsum(counts)
+    t = jnp.arange(m_total, dtype=jnp.int32)
+    j = jnp.searchsorted(ends, t, side="right").astype(jnp.int32)
+    j = jnp.clip(j, 0, ctx.n - 1)
+    pos = t - offs[j]
+    flat = j * ctx.max_m + jnp.clip(pos, 0, ctx.max_m - 1)
+    out = toks[flat]
+    # overflow tokens (pos >= max_m) were never shipped — zero, not
+    # duplicates of the last slot row
+    valid = (t < ends[-1]) & (pos < ctx.max_m)
+    return jnp.where(valid[:, None], out, 0)

@@ -1,0 +1,119 @@
+"""MoE routing utilities: expert selection, token sort, block alignment.
+
+Reference: ``select_experts`` (python/triton_dist/kernels/nvidia/
+moe_reduce_rs.py:180-213, softmax+topk routing), ``full_moe_align_block_
+size`` (moe_reduce_rs.py:87-179) and the CUDA ``moe_ag_scatter_align_
+block_size`` (csrc/lib/moe_utils.cu:61-356): sort the (token, expert)
+pairs by expert and pad each expert's segment to a GEMM block boundary so
+a grouped GEMM can walk whole blocks with a single expert id per block.
+
+TPU re-design: the alignment is a handful of cumsums/scatters over a few
+thousand int32s — XLA fuses it into the surrounding program, so it stays
+jnp (no custom kernel needed; the reference needed CUDA because torch ops
+for this were the bottleneck at sub-microsecond latencies). Shapes are
+static: the padded capacity is the worst case ``M·k`` rounded up plus one
+partial block per expert, and unused slots carry a sentinel row id that
+gathers a zero row.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def round_up_to_block(x, block: int):
+    """Round ``x`` (int or int array) up to a multiple of ``block``."""
+    return ((x + block - 1) // block) * block
+
+
+def exclusive_cumsum(x):
+    """[0, x0, x0+x1, ...] — segment start offsets from segment sizes."""
+    return jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(x)[:-1].astype(jnp.int32)]
+    )
+
+
+def select_experts(gate_logits, topk: int, *, renormalize: bool = True):
+    """Softmax router → (weights (M, k) f32, expert ids (M, k) int32).
+
+    ≡ select_experts (moe_reduce_rs.py:180-213).
+    """
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    weights, ids = jax.lax.top_k(probs, topk)
+    if renormalize:
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    return weights, ids.astype(jnp.int32)
+
+
+def aligned_capacity(total: int, num_experts: int, block_m: int) -> int:
+    """Static worst-case padded length: every expert wastes < block_m."""
+    return round_up_to_block(total + num_experts * (block_m - 1), block_m)
+
+
+def moe_align_block_size(topk_ids, num_experts: int, block_m: int):
+    """Sort (token, slot) pairs by expert and pad segments to block_m.
+
+    topk_ids: (M, k) int32. Returns:
+      sorted_token_ids: (cap,) int32 — flat source index ``row*k + slot``
+        per padded position, sentinel ``M*k`` for padding (gather a zero
+        row there);
+      block_expert: (cap//block_m,) int32 — owning expert of each block;
+      splits: (num_experts,) int32 — true token count per expert.
+    ≡ moe_ag_scatter_align_block_size (csrc/lib/moe_utils.cu:61-356).
+    """
+    m, k = topk_ids.shape
+    total = m * k
+    cap = aligned_capacity(total, num_experts, block_m)
+    flat = topk_ids.reshape(-1).astype(jnp.int32)
+
+    splits = jnp.zeros((num_experts,), jnp.int32).at[flat].add(1)
+    padded = round_up_to_block(splits, block_m)
+    padded_offs = exclusive_cumsum(padded)
+    offs = exclusive_cumsum(splits)
+
+    order = jnp.argsort(flat, stable=True).astype(jnp.int32)   # (total,)
+    sorted_experts = flat[order]
+    rank_in_expert = jnp.arange(total, dtype=jnp.int32) - offs[sorted_experts]
+    dest = padded_offs[sorted_experts] + rank_in_expert
+
+    sorted_token_ids = jnp.full((cap,), total, jnp.int32).at[dest].set(order)
+
+    nblocks = cap // block_m
+    block_start = jnp.arange(nblocks, dtype=jnp.int32) * block_m
+    block_expert = jnp.searchsorted(
+        jnp.cumsum(padded), block_start, side="right"
+    ).astype(jnp.int32)
+    block_expert = jnp.clip(block_expert, 0, num_experts - 1)
+    return sorted_token_ids, block_expert, splits
+
+
+def gather_sorted(x, sorted_token_ids, topk: int):
+    """Rows of ``x`` (M, H) in padded-sorted order, zeros at padding.
+
+    ``sorted_token_ids`` indexes the flattened (M·k) token-slot space;
+    the row is ``id // k``.
+    """
+    total = x.shape[0] * topk
+    rows = jnp.clip(sorted_token_ids // topk, 0, x.shape[0] - 1)
+    valid = sorted_token_ids < total
+    return jnp.where(valid[:, None], x[rows], 0)
+
+
+def scatter_combine(y_sorted, sorted_token_ids, weights, m: int):
+    """Weighted scatter-add of expert outputs back to token order.
+
+    y_sorted: (cap, H) grouped-GEMM output in padded-sorted order;
+    weights: (M, k) router weights. Returns (M, H) — each token is the
+    weighted sum of its k expert outputs (≡ the topk-reduce stage of
+    moe_reduce_rs.py:468-545).
+    """
+    k = weights.shape[1]
+    total = m * k
+    valid = sorted_token_ids < total
+    safe = jnp.where(valid, sorted_token_ids, 0)
+    w = weights.reshape(-1)[safe] * valid                      # (cap,)
+    rows = jnp.where(valid, safe // k, m)                      # sentinel → m
+    out = jnp.zeros((m + 1, y_sorted.shape[1]), jnp.float32)
+    out = out.at[rows].add(y_sorted.astype(jnp.float32) * w[:, None])
+    return out[:m]
