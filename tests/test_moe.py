@@ -119,11 +119,13 @@ class TestMoEAllToAll:
             )
         )
 
+    @staticmethod
+    def _stage_packed(ctx):
+        return lambda t, s: ma.pack_slots(ctx, *ma.dispatch_stage(ctx, t, s))
+
     def test_transport_matches_xla(self, mesh8):
         ctx, _, _, toks_g, spl_g = self._setup(mesh8)
-        stage = self._shard(
-            mesh8, lambda t, s: ma.dispatch_stage(ctx, t, s), 2, 1
-        )
+        stage = self._shard(mesh8, self._stage_packed(ctx), 2, 1)
         send = stage(toks_g, spl_g)
         recv = ma.fast_all_to_all(ctx, send)
         recv_ref = ma.fast_all_to_all(ctx, send, use_xla=True)
@@ -132,9 +134,7 @@ class TestMoEAllToAll:
     def test_recv_splits(self, mesh8):
         n, epr = 8, 4
         ctx, _, splits, toks_g, spl_g = self._setup(mesh8, n=n, epr=epr)
-        stage = self._shard(
-            mesh8, lambda t, s: ma.dispatch_stage(ctx, t, s), 2, 1
-        )
+        stage = self._shard(mesh8, self._stage_packed(ctx), 2, 1)
         view = self._shard(
             mesh8, lambda r: ma.recv_tokens_view(ctx, r)[1], 1, 1
         )
@@ -149,16 +149,18 @@ class TestMoEAllToAll:
     def test_dispatch_combine_roundtrip(self, mesh8):
         n, M, H = 8, 24, 128
         ctx, toks, _, toks_g, spl_g = self._setup(mesh8, n=n, M=M, H=H)
-        stage = self._shard(
-            mesh8, lambda t, s: ma.dispatch_stage(ctx, t, s), 2, 1
-        )
+        stage = self._shard(mesh8, self._stage_packed(ctx), 2, 1)
         comb_in = self._shard(
             mesh8,
             lambda r: ma.combine_stage(ctx, ma.recv_tokens_view(ctx, r)[0]),
             1, 1,
         )
         unstage = self._shard(
-            mesh8, lambda c, s: ma.combine_unstage(ctx, c, s, M), 2, 1
+            mesh8,
+            lambda c, s: ma.combine_unstage(
+                ctx, ma.combine_unpack(ctx, c), s, M
+            ),
+            2, 1,
         )
         recv = ma.fast_all_to_all(ctx, stage(toks_g, spl_g))
         comb = ma.fast_all_to_all(ctx, comb_in(recv))
@@ -173,9 +175,7 @@ class TestMoEAllToAll:
         ctx, toks, splits, toks_g, spl_g = self._setup(
             mesh8, n=n, epr=epr, H=H, max_m=max_m, M=M
         )
-        stage = self._shard(
-            mesh8, lambda t, s: ma.dispatch_stage(ctx, t, s), 2, 1
-        )
+        stage = self._shard(mesh8, self._stage_packed(ctx), 2, 1)
         view = self._shard(
             mesh8, lambda r: ma.recv_tokens_view(ctx, r)[1], 1, 1
         )
@@ -185,7 +185,11 @@ class TestMoEAllToAll:
             1, 1,
         )
         unstage = self._shard(
-            mesh8, lambda c, s: ma.combine_unstage(ctx, c, s, M), 2, 1
+            mesh8,
+            lambda c, s: ma.combine_unstage(
+                ctx, ma.combine_unpack(ctx, c), s, M
+            ),
+            2, 1,
         )
         recv = ma.fast_all_to_all(ctx, stage(toks_g, spl_g))
         rs = np.asarray(view(recv)).reshape(n, n, epr)

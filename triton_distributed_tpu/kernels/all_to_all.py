@@ -17,6 +17,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
@@ -53,14 +54,16 @@ def _a2a_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
 
 
 @functools.lru_cache(maxsize=256)
-def _build_all_to_all(mesh, axis, shape, dtype, collective_id, chaos):
-    n = mesh.shape[axis]
-    local_shape = (shape[0] // n,) + tuple(shape[1:])
+def _build_a2a_call(mesh_axes, axis, n, local_shape, dtype, collective_id,
+                    chaos=False):
+    """Bare per-device Pallas a2a call — usable inside any shard_map over
+    a mesh with ``mesh_axes`` (the device variant; ≡ how flash_decode
+    exposes sp_gqa_fwd_batch_decode_device for composition)."""
     assert local_shape[0] % n == 0, (
         f"per-device rows {local_shape[0]} not divisible by {n}"
     )
-    call = lang.shmem_call(
-        functools.partial(_a2a_kernel, n, axis, mesh.axis_names),
+    return lang.shmem_call(
+        functools.partial(_a2a_kernel, n, axis, mesh_axes),
         out_shape=jax.ShapeDtypeStruct(local_shape, dtype),
         in_specs=lang.vmem_specs(1),
         scratch_shapes=[
@@ -69,6 +72,30 @@ def _build_all_to_all(mesh, axis, shape, dtype, collective_id, chaos):
         ],
         collective_id=collective_id,
         name="a2a_dense",
+    )
+
+
+def all_to_all_device(x_loc, n, axis, mesh_axes, *, collective_id: int = 4):
+    """Dense a2a on this device's shard, callable inside shard_map.
+
+    ``x_loc``: (rows, ...) with rows divisible by ``n`` (= size of
+    ``axis``). Row block j goes to peer j's block ``me``.
+    """
+    if n == 1:
+        return x_loc
+    call = _build_a2a_call(
+        tuple(mesh_axes), axis, n, tuple(x_loc.shape),
+        jnp.dtype(x_loc.dtype), collective_id, config.chaos_delay,
+    )
+    return call(x_loc)
+
+
+@functools.lru_cache(maxsize=256)
+def _build_all_to_all(mesh, axis, shape, dtype, collective_id, chaos):
+    n = mesh.shape[axis]
+    local_shape = (shape[0] // n,) + tuple(shape[1:])
+    call = _build_a2a_call(
+        mesh.axis_names, axis, n, local_shape, dtype, collective_id, chaos
     )
     fn = jax.shard_map(
         call, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False

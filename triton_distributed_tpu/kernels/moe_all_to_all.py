@@ -138,12 +138,13 @@ def peer_offsets(ctx: MoEAllToAllContext, splits):
 
 
 def dispatch_stage(ctx: MoEAllToAllContext, tokens, splits):
-    """Pack expert-sorted tokens + splits into per-peer padded slots.
+    """Stage expert-sorted tokens into per-peer padded slots.
 
     tokens: (M, H) sorted by global expert id; splits: (num_experts,).
-    Returns an int32 (n * slot_rows, ints_per_row) array ready for
-    :func:`fast_all_to_all` — slot j = [max_m bitcast token rows for
-    peer j | native int32 splits rows].
+    Returns (toks (n, max_m, H) ctx.dtype, spl (n, epr) int32) — pass
+    through :func:`pack_slots` for the single-payload Pallas transport,
+    or exchange the pair directly with two ``lax.all_to_all`` calls
+    (the differentiable path: no bitcast touches the float tokens).
     ≡ the send_buf staging at low_latency_all_to_all.py:213-215.
     """
     m_total = tokens.shape[0]
@@ -153,12 +154,28 @@ def dispatch_stage(ctx: MoEAllToAllContext, tokens, splits):
     valid = pos[None, :] < counts[:, None]
     gathered = tokens[jnp.clip(idx, 0, m_total - 1)]         # (n, max_m, H)
     toks = jnp.where(valid[..., None], gathered, 0).astype(ctx.dtype)
-
     spl = splits.reshape(ctx.n, ctx.experts_per_rank).astype(jnp.int32)
+    return toks, spl
+
+
+def pack_slots(ctx: MoEAllToAllContext, toks, spl):
+    """(toks (n, max_m, H), spl (n, epr)) → one int32 payload
+    (n * slot_rows, ints_per_row) for :func:`fast_all_to_all`. The
+    bitcast is gradient-opaque — inference transport only."""
     slots = jnp.concatenate(
-        [_toks_to_ints(ctx, toks), _pack_splits(ctx, spl)], axis=1
+        [_toks_to_ints(ctx, toks.astype(ctx.dtype)), _pack_splits(ctx, spl)],
+        axis=1,
     )
     return slots.reshape(ctx.n * ctx.slot_rows, ctx.ints_per_row)
+
+
+def clamp_recv_splits(ctx: MoEAllToAllContext, spl):
+    """Clamp receiver splits to what actually fit in the slot: a sender
+    whose per-peer total exceeded ``max_m`` shipped only the first
+    ``max_m`` rows (in expert order), so the clamped cumulative counts
+    name exactly the rows that arrived."""
+    cum = jnp.minimum(jnp.cumsum(spl, axis=1), ctx.max_m)
+    return jnp.diff(cum, axis=1, prepend=0)
 
 
 def fast_all_to_all(ctx: MoEAllToAllContext, send, *, use_xla: bool = False):
@@ -179,40 +196,39 @@ def recv_tokens_view(ctx: MoEAllToAllContext, recv):
 
     Row i of the splits = source rank i's counts for MY experts
     (≡ all_to_all_post_process, low_latency_all_to_all.py:251-269).
-    Splits are clamped to what actually fit in the slot: a sender whose
-    per-peer total exceeded ``max_m`` shipped only the first ``max_m``
-    rows (in expert order), so the clamped cumulative counts name
-    exactly the rows that arrived.
+    Splits are clamped via :func:`clamp_recv_splits`.
     """
     slots = recv.reshape(ctx.n, ctx.slot_rows, ctx.ints_per_row)
     toks = _ints_to_toks(ctx, slots[:, : ctx.max_m])
     spl = slots[:, ctx.max_m :].reshape(ctx.n, -1)[:, : ctx.experts_per_rank]
-    cum = jnp.minimum(jnp.cumsum(spl, axis=1), ctx.max_m)
-    spl = jnp.diff(cum, axis=1, prepend=0)
-    return toks, spl
+    return toks, clamp_recv_splits(ctx, spl)
 
 
 def combine_stage(ctx: MoEAllToAllContext, toks):
-    """(n, max_m, H) processed tokens → slots for the return transport.
-    The splits rows are zero-filled; the combiner already knows its own
-    original splits."""
-    ints = _toks_to_ints(ctx, toks.astype(ctx.dtype))
-    zeros = jnp.zeros((ctx.n, ctx.splits_rows, ctx.ints_per_row), jnp.int32)
-    return jnp.concatenate([ints, zeros], axis=1).reshape(
-        ctx.n * ctx.slot_rows, ctx.ints_per_row
+    """(n, max_m, H) processed tokens → int32 slots for the Pallas
+    return transport. The splits rows are zero-filled; the combiner
+    already knows its own original splits."""
+    return pack_slots(
+        ctx, toks, jnp.zeros((ctx.n, ctx.experts_per_rank), jnp.int32)
     )
 
 
-def combine_unstage(ctx: MoEAllToAllContext, comb, splits, m_total: int):
+def combine_unpack(ctx: MoEAllToAllContext, comb):
+    """Int32 return-leg payload → (n, max_m, H) token slots."""
+    ints = comb.reshape(ctx.n, ctx.slot_rows, ctx.ints_per_row)[:, : ctx.max_m]
+    return _ints_to_toks(ctx, ints)
+
+
+def combine_unstage(ctx: MoEAllToAllContext, toks, splits, m_total: int):
     """Scatter combined per-peer slots back into expert-sorted order.
 
-    comb: int32 (n * slot_rows, ints_per_row) return-leg transport
-    output — slot j holds MY tokens as processed by peer j; splits:
-    this device's ORIGINAL dispatch splits. Returns (m_total, H) in the
-    original sorted order.
+    toks: (n, max_m, H) return-leg token slots (from
+    :func:`combine_unpack` on the Pallas path, or directly from a
+    ``lax.all_to_all`` on the differentiable path) — slot j holds MY
+    tokens as processed by peer j; splits: this device's ORIGINAL
+    dispatch splits. Returns (m_total, H) in the original sorted order.
     """
-    ints = comb.reshape(ctx.n, ctx.slot_rows, ctx.ints_per_row)[:, : ctx.max_m]
-    toks = _ints_to_toks(ctx, ints).reshape(ctx.n * ctx.max_m, ctx.hidden)
+    toks = toks.reshape(ctx.n * ctx.max_m, ctx.hidden)
     counts, offs = peer_offsets(ctx, splits)
     ends = jnp.cumsum(counts)
     t = jnp.arange(m_total, dtype=jnp.int32)

@@ -6,6 +6,12 @@ create_*_context factories), with autodiff added so the same ops serve
 training, not just inference.
 """
 
+from triton_distributed_tpu.ops.moe import (
+    EPMoEContext,
+    create_ep_moe_context,
+    ep_moe,
+    ep_moe_device,
+)
 from triton_distributed_tpu.ops.overlap import (
     OverlapContext,
     ag_gemm,
@@ -20,4 +26,8 @@ __all__ = [
     "gemm_rs",
     "create_ag_gemm_context",
     "create_gemm_rs_context",
+    "EPMoEContext",
+    "ep_moe",
+    "ep_moe_device",
+    "create_ep_moe_context",
 ]

@@ -1,0 +1,230 @@
+"""Expert-parallel MoE MLP op: AllToAll dispatch → grouped GEMMs → combine.
+
+Reference: the EP layer ``EPAll2AllLayer`` (python/triton_dist/layers/
+nvidia/ep_a2a_layer.py:40-240 — preprocess splits/indices → dispatch →
+caller's expert compute → combine) over the low-latency AllToAll
+(low_latency_all_to_all.py) and the grouped GEMMs of
+allgather_group_gemm.py:420 / moe_reduce_rs.py:362; routing ≡
+select_experts (moe_reduce_rs.py:180).
+
+TPU re-design: one ``shard_map`` body does route → expert-sort →
+dispatch (padded-slot a2a) → local grouped GEMM MLP over the owned
+experts → return a2a → weighted combine. Two transports:
+
+* ``transport="pallas"``: the in-kernel remote-DMA a2a
+  (kernels/all_to_all.all_to_all_device) — the low-latency inference
+  path.
+* ``transport="xla"``: ``lax.all_to_all`` — differentiable end-to-end
+  (sort/gather/scatter/topk-softmax all have transpose rules), which is
+  what makes EP *training* possible; the reference is inference-only.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.kernels import moe_all_to_all as ma
+from triton_distributed_tpu.kernels import moe_utils as mu
+from triton_distributed_tpu.kernels.all_to_all import all_to_all_device
+from triton_distributed_tpu.kernels.group_gemm import grouped_matmul, padded_splits
+
+
+@dataclass(frozen=True)
+class EPMoEContext:
+    """Static geometry of the EP MoE layer (≡ EPAll2AllLayer's ctor state
+    + AllToAllContext). Experts are sharded over ``axis``: rank r owns
+    experts [r*epr, (r+1)*epr)."""
+
+    mesh: Mesh
+    axis: str
+    num_experts: int
+    topk: int
+    max_m: int                      # per-peer token-slot capacity
+    hidden: int
+    dtype: jnp.dtype = jnp.bfloat16
+    activation: str = "silu"        # silu | gelu | none
+    transport: str = "pallas"       # pallas | xla
+    block_m: int = 128
+    use_pallas_gemm: bool = True
+    collective_id: int = 10
+
+    @property
+    def n(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    @property
+    def experts_per_rank(self) -> int:
+        return self.num_experts // self.n
+
+    @property
+    def a2a(self) -> ma.MoEAllToAllContext:
+        return ma.create_all_to_all_context(
+            self.mesh, self.axis, max_m=self.max_m, hidden=self.hidden,
+            experts_per_rank=self.experts_per_rank, dtype=self.dtype,
+            collective_id=self.collective_id,
+        )
+
+
+def create_ep_moe_context(
+    mesh, axis, *, num_experts, topk, max_m, hidden, **kw
+) -> EPMoEContext:
+    n = mesh.shape[axis]
+    assert num_experts % n == 0, f"{num_experts} experts over {n} ranks"
+    return EPMoEContext(
+        mesh=mesh, axis=axis, num_experts=num_experts, topk=topk,
+        max_m=max_m, hidden=hidden, **kw,
+    )
+
+
+def _act(name: str, x):
+    if name == "silu":
+        return jax.nn.silu(x)
+    if name == "gelu":
+        return jax.nn.gelu(x)
+    return x
+
+
+def _a2a(ctx: EPMoEContext, x):
+    """Transpose leading (n, ...) slot dim across ranks."""
+    if ctx.transport == "pallas":
+        flat = x.reshape(ctx.n * x.shape[1], -1)
+        out = all_to_all_device(
+            flat, ctx.n, ctx.axis, ctx.mesh.axis_names,
+            collective_id=ctx.collective_id,
+        )
+        return out.reshape(x.shape)
+    return jax.lax.all_to_all(x, ctx.axis, 0, 0, tiled=False)
+
+
+def _dispatch(ctx: EPMoEContext, x_sorted, splits):
+    """Stage + exchange → ((n, max_m, H) tokens, clamped (n, epr) splits).
+
+    Pallas: one bitcast int32 payload per peer (inference fast path).
+    XLA: tokens and splits ride two ``lax.all_to_all`` calls so the
+    float tokens never cross a gradient-opaque bitcast (training path).
+    """
+    a2a = ctx.a2a
+    toks, spl = ma.dispatch_stage(a2a, x_sorted, splits)
+    if ctx.transport == "pallas":
+        recv = _a2a(ctx, ma.pack_slots(a2a, toks, spl).reshape(
+            ctx.n, a2a.slot_rows, a2a.ints_per_row))
+        return ma.recv_tokens_view(a2a, recv)
+    rtoks = _a2a(ctx, toks)
+    rspl = _a2a(ctx, spl[:, None, :])[:, 0, :]
+    return rtoks, ma.clamp_recv_splits(a2a, rspl)
+
+
+def _combine(ctx: EPMoEContext, y_slots, splits, total):
+    """Return-leg exchange + unstage → (total, H) in sorted order."""
+    a2a = ctx.a2a
+    if ctx.transport == "pallas":
+        comb = _a2a(ctx, ma.combine_stage(a2a, y_slots).reshape(
+            ctx.n, a2a.slot_rows, a2a.ints_per_row))
+        toks = ma.combine_unpack(a2a, comb)
+    else:
+        toks = _a2a(ctx, y_slots)
+    return ma.combine_unstage(a2a, toks, splits, total)
+
+
+def _expert_mlp(ctx: EPMoEContext, rows, eid, valid, w_up, w_down):
+    """Grouped MLP over this rank's experts.
+
+    rows: (R, H) received tokens; eid: (R,) local expert ids; valid: (R,)
+    bool. w_up: (epr, H, F); w_down: (epr, F, H). Invalid rows are zero
+    and sorted into a trailing dummy group, so they contribute zeros.
+    """
+    epr = ctx.experts_per_rank
+    r = rows.shape[0]
+    # sort received rows by local expert, invalid rows to a dummy tail
+    # group — the align-block trick over receive-side data
+    ids = jnp.where(valid, eid, epr).astype(jnp.int32)[:, None]
+    sti, be, counts = mu.moe_align_block_size(ids, epr + 1, ctx.block_m)
+    cap = sti.shape[0]
+    safe = jnp.clip(sti, 0, r - 1)
+    ok = (sti < r) & valid[safe]
+    xs = jnp.where(ok[:, None], rows[safe], 0).astype(ctx.dtype)
+    # dummy blocks (be == epr) read the LAST expert's weights; their rows
+    # are zero so the product is zero regardless
+    be_w = jnp.clip(be, 0, epr - 1)
+
+    if ctx.use_pallas_gemm:
+        h = grouped_matmul(xs, w_up, be_w, block_m=ctx.block_m)
+        h = _act(ctx.activation, h).astype(ctx.dtype)
+        y = grouped_matmul(h, w_down, be_w, block_m=ctx.block_m)
+    else:
+        # aligned group sizes; the dummy group and tail slack are zero
+        # rows — fold them into the last real expert
+        gs_all = padded_splits(counts, ctx.block_m, cap)
+        gs = gs_all[:epr].at[-1].add(gs_all[epr])
+        h = jax.lax.ragged_dot(xs, w_up, gs)
+        h = _act(ctx.activation, h).astype(ctx.dtype)
+        y = jax.lax.ragged_dot(h, w_down, gs)
+    y = jnp.where(ok[:, None], y, 0)
+    # scatter back to received-row order
+    out = jnp.zeros((r + 1, y.shape[-1]), ctx.dtype)
+    dest = jnp.where(sti < r, sti, r)
+    return out.at[dest].set(y)[:r]
+
+
+def ep_moe_device(x, logits, w_up, w_down, ctx: EPMoEContext):
+    """Per-device EP MoE body — callable inside any shard_map.
+
+    x: (M, H) this rank's tokens; logits: (M, E); w_up: (epr, H, F),
+    w_down: (epr, F, H) — this rank's experts. Returns (M, H).
+    """
+    m = x.shape[0]
+    total = m * ctx.topk
+    weights, ids = mu.select_experts(logits, ctx.topk)
+    flat = ids.reshape(-1)
+    order = jnp.argsort(flat, stable=True).astype(jnp.int32)
+    splits = jnp.zeros((ctx.num_experts,), jnp.int32).at[flat].add(1)
+    x_sorted = x[order // ctx.topk].astype(ctx.dtype)
+
+    # dispatch: tokens to the ranks owning their experts
+    toks, rspl = _dispatch(ctx, x_sorted, splits)      # (n,max_m,H),(n,epr)
+    rows = toks.reshape(ctx.n * ctx.max_m, ctx.hidden)
+    pos = jnp.arange(ctx.max_m, dtype=jnp.int32)
+    cum = jnp.cumsum(rspl, axis=1)                     # (n, epr)
+    eid = jax.vmap(lambda c: jnp.searchsorted(c, pos, side="right"))(cum)
+    eid = jnp.clip(eid, 0, ctx.experts_per_rank - 1).reshape(-1)
+    valid = (pos[None, :] < cum[:, -1][:, None]).reshape(-1)
+
+    y = _expert_mlp(ctx, rows, eid, valid, w_up, w_down)
+
+    # combine: processed tokens back to their owners
+    y_sorted = _combine(
+        ctx, y.reshape(ctx.n, ctx.max_m, ctx.hidden), splits, total
+    )
+    w_flat = weights.reshape(-1)[order].astype(jnp.float32)
+    out = jnp.zeros((m, ctx.hidden), jnp.float32)
+    out = out.at[order // ctx.topk].add(
+        y_sorted.astype(jnp.float32) * w_flat[:, None]
+    )
+    return out.astype(x.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_ep_moe(ctx: EPMoEContext):
+    fn = jax.shard_map(
+        functools.partial(ep_moe_device, ctx=ctx),
+        mesh=ctx.mesh,
+        in_specs=(P(ctx.axis), P(ctx.axis), P(ctx.axis), P(ctx.axis)),
+        out_specs=P(ctx.axis),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def ep_moe(x, logits, w_up, w_down, ctx: EPMoEContext):
+    """Host entry: EP MoE MLP on ``ctx.mesh``.
+
+    Global shapes: x (M, H) and logits (M, E) token-sharded over
+    ``ctx.axis``; w_up (E, H, F) / w_down (E, F, H) expert-sharded over
+    ``ctx.axis``. Returns (M, H) token-sharded.
+    """
+    return _build_ep_moe(ctx)(x, logits, w_up, w_down)
