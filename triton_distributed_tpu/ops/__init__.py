@@ -12,6 +12,14 @@ from triton_distributed_tpu.ops.moe import (
     ep_moe,
     ep_moe_device,
 )
+from triton_distributed_tpu.ops.moe_tp import (
+    MoETPContext,
+    ag_group_gemm,
+    align_routing,
+    create_ag_group_gemm_context,
+    create_moe_rs_context,
+    moe_reduce_rs,
+)
 from triton_distributed_tpu.ops.overlap import (
     OverlapContext,
     ag_gemm,
@@ -30,4 +38,10 @@ __all__ = [
     "ep_moe",
     "ep_moe_device",
     "create_ep_moe_context",
+    "MoETPContext",
+    "ag_group_gemm",
+    "align_routing",
+    "moe_reduce_rs",
+    "create_ag_group_gemm_context",
+    "create_moe_rs_context",
 ]

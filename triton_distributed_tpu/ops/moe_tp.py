@@ -1,0 +1,175 @@
+"""MoE tensor-parallel overlap ops: AG-GroupGEMM and GroupGEMM-Reduce-RS.
+
+Reference: python/triton_dist/kernels/nvidia/allgather_group_gemm.py —
+AG of activations overlapped with a grouped GEMM whose tiles wait on
+producer barriers (:420-498, sort_topk_ids_align_block_size :106), and
+moe_reduce_rs.py — producer grouped GEMM scattering weighted expert
+outputs (:362-467) into a consumer topk-reduce + reduce-scatter pipeline
+(:468-622, orchestration :882-1020).
+
+TPU re-design (composed v1): the gather leg rides ``lax.all_gather``
+(XLA's async collective overlaps it with the routing sort that follows)
+and the reduce leg rides the Pallas ring reduce-scatter; the grouped
+GEMM is the scalar-prefetch Mosaic kernel. A single-kernel ring variant
+(grouped-GEMM tiles waiting on per-shard DMA arrival like ag_gemm's
+PALLAS_FUSED) is the planned upgrade once the autotuner can pick
+between them.
+
+Layouts (Megatron MoE-TP):
+
+* ``ag_group_gemm``: tokens row-sharded over TP → gathered; experts'
+  up-projection weights column-sharded (E, K, N/tp). Output: sorted
+  expert rows (cap, N/tp), plus the routing artifacts needed downstream.
+* ``moe_reduce_rs``: sorted expert rows (cap, F/tp)? No — the dual:
+  down-projection weights row-sharded (E, F/tp, H) so each rank's
+  grouped GEMM yields a PARTIAL (cap, H); the topk-weighted combine to
+  token order is also partial, and the reduce-scatter both sums the TP
+  partials and returns each rank its token rows.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.kernels import moe_utils as mu
+from triton_distributed_tpu.kernels.group_gemm import (
+    grouped_matmul,
+    grouped_matmul_xla,
+    padded_splits,
+)
+from triton_distributed_tpu.kernels.reduce_scatter import reduce_scatter
+
+
+@dataclass(frozen=True)
+class MoETPContext:
+    """Static geometry of the MoE TP pipeline (≡ the contexts built by
+    create_ag_group_gemm_context, allgather_group_gemm.py:272-330, and
+    MoEReduceRSContext, moe_reduce_rs.py:253-360)."""
+
+    mesh: Mesh
+    axis: str
+    num_experts: int
+    topk: int
+    block_m: int = 128
+    dtype: jnp.dtype = jnp.bfloat16
+    use_pallas_gemm: bool = True
+    rs_collective_id: int = 12
+
+    @property
+    def tp(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_ag_group_gemm_context(mesh, axis, *, num_experts, topk, **kw):
+    """≡ create_ag_group_gemm_context (allgather_group_gemm.py:272)."""
+    return MoETPContext(
+        mesh=mesh, axis=axis, num_experts=num_experts, topk=topk, **kw
+    )
+
+
+def create_moe_rs_context(mesh, axis, *, num_experts, topk, **kw):
+    """≡ create_moe_rs_context (moe_reduce_rs.py:253)."""
+    return MoETPContext(
+        mesh=mesh, axis=axis, num_experts=num_experts, topk=topk, **kw
+    )
+
+
+def _ggemm(ctx: MoETPContext, xs, w, be, counts, cap):
+    if ctx.use_pallas_gemm:
+        return grouped_matmul(xs, w, be, block_m=ctx.block_m)
+    return grouped_matmul_xla(xs, w, padded_splits(counts, ctx.block_m, cap))
+
+
+def align_routing(ctx: MoETPContext, topk_ids):
+    """Routing alignment shared by both pipeline stages: returns
+    (sorted_token_ids, block_expert, splits) from moe_align_block_size.
+    Compute ONCE per step and thread through ag_group_gemm and
+    moe_reduce_rs — both stages need the identical layout, and the
+    stable argsort is the expensive part (≡ the single
+    sort_topk_ids_align_block_size call at allgather_group_gemm.py:106).
+    """
+    return mu.moe_align_block_size(topk_ids, ctx.num_experts, ctx.block_m)
+
+
+def ag_group_gemm_device(a_loc, sti, be, counts, w_loc, ctx: MoETPContext):
+    """Per-device body: gather tokens, grouped GEMM over sorted layout.
+
+    a_loc: (M/tp, K) this rank's token rows; sti/be/counts: REPLICATED
+    routing from :func:`align_routing`; w_loc: (E, K, N/tp) this rank's
+    expert weight columns. Returns (cap, N/tp) sorted expert rows.
+    """
+    a_full = jax.lax.all_gather(a_loc, ctx.axis, tiled=True)   # (M, K)
+    xs = mu.gather_sorted(a_full, sti, ctx.topk).astype(ctx.dtype)
+    return _ggemm(ctx, xs, w_loc.astype(ctx.dtype), be, counts, sti.shape[0])
+
+
+@functools.lru_cache(maxsize=64)
+def _build_ag_group_gemm(ctx: MoETPContext):
+    fn = jax.shard_map(
+        functools.partial(ag_group_gemm_device, ctx=ctx),
+        mesh=ctx.mesh,
+        in_specs=(P(ctx.axis), P(), P(), P(), P(None, None, ctx.axis)),
+        out_specs=P(None, ctx.axis),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def ag_group_gemm(a, routing, w, ctx: MoETPContext):
+    """Host entry (≡ ag_group_gemm, allgather_group_gemm.py:272-420).
+
+    a: (M, K) token rows sharded over ``ctx.axis``; routing: the
+    replicated (sti, be, counts) triple from :func:`align_routing`;
+    w: (E, K, N) with N sharded. Returns (cap, N) sorted expert rows
+    with N sharded.
+    """
+    sti, be, counts = routing
+    return _build_ag_group_gemm(ctx)(a, sti, be, counts, w)
+
+
+def moe_reduce_rs(y, routing, weights, w, ctx: MoETPContext):
+    """Host entry (≡ moe_reduce_rs, moe_reduce_rs.py:882-1020).
+
+    y: (cap, F) sorted expert rows with F sharded over ``ctx.axis``;
+    routing: the same triple passed to :func:`ag_group_gemm`; weights:
+    (M, k) replicated router weights; w: (E, F, H) with F sharded.
+    Returns (M, H) token rows sharded over ``ctx.axis``.
+    """
+    sti, be, counts = routing
+    return _build_moe_reduce_rs(ctx)(y, sti, be, counts, weights, w)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_moe_reduce_rs(ctx: MoETPContext):
+    def body(y_loc, sti, be, counts, weights, w_loc):
+        part = _ggemm(
+            ctx, y_loc.astype(ctx.dtype), w_loc.astype(ctx.dtype),
+            be, counts, sti.shape[0],
+        )                                                    # (cap, H) partial
+        m = weights.shape[0]
+        tok = mu.scatter_combine(part, sti, weights, m)      # (M, H) partial
+        return tok.astype(ctx.dtype)[None]                   # stack dim for RS
+
+    inner = jax.shard_map(
+        body,
+        mesh=ctx.mesh,
+        in_specs=(P(None, ctx.axis), P(), P(), P(), P(), P(None, ctx.axis)),
+        out_specs=P(ctx.axis, None, None),
+        check_vma=False,
+    )
+
+    def entry(y, sti, be, counts, weights, w):
+        # shard_map body returns per-rank partials laid out (tp, M, H);
+        # the ring reduce-scatter sums them and scatters token rows
+        parts = inner(y, sti, be, counts, weights, w)
+        return reduce_scatter(
+            parts, ctx.mesh, ctx.axis,
+            collective_id=ctx.rs_collective_id, stacked=True,
+        )
+
+    return jax.jit(entry)
