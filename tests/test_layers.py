@@ -1,0 +1,203 @@
+"""Layer (L5) tests: AG layer, TP linears/MLP, MoE MLPs, SP decode layer.
+
+Mirrors the reference's layer-level tests (test_sp_decode_attn.py,
+test_ep_moe_inference.py, low_latency_allgather_layer usage) with
+jax.lax/dense references (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu import layers, ops
+from triton_distributed_tpu.kernels import moe_utils as mu
+from triton_distributed_tpu.kernels.flash_decode import gqa_fwd_batch_decode_xla
+from triton_distributed_tpu.runtime import AllGatherMethod
+from triton_distributed_tpu.utils import assert_allclose
+
+
+def _put(mesh, x, spec):
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+class TestAllGatherLayer:
+    def test_all_variants_match(self, mesh8):
+        x = jax.random.normal(jax.random.PRNGKey(0), (64, 128))
+        layer = layers.AllGatherLayer(mesh8, "x")
+        ref = np.asarray(layer.forward_xla(_put(mesh8, x, P("x"))))
+        np.testing.assert_allclose(ref, np.asarray(x), rtol=1e-6)
+        for fwd in (layer.forward_ring, layer.forward_ring_bidir, layer.forward_ll, layer):
+            out = np.asarray(fwd(_put(mesh8, x, P("x"))))
+            np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+class TestParallelMLP:
+    def test_mlp_vs_dense(self, mesh8):
+        m, h, f = 64, 128, 512
+        ag_ctx = ops.create_ag_gemm_context(mesh8, "x")
+        rs_ctx = ops.create_gemm_rs_context(mesh8, "x")
+        mlp = layers.ParallelMLP(
+            layers.ColumnParallelLinear(ag_ctx),
+            layers.RowParallelLinear(rs_ctx),
+        )
+        params = mlp.init(jax.random.PRNGKey(0), h, f, jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (m, h), jnp.float32)
+        out = mlp(
+            {
+                "up": {"w": _put(mesh8, params["up"]["w"], P(None, "x"))},
+                "down": {"w": _put(mesh8, params["down"]["w"], P("x", None))},
+            },
+            _put(mesh8, x, P("x")),
+        )
+        ref = jax.nn.gelu(x @ params["up"]["w"]) @ params["down"]["w"]
+        assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+    def test_mlp_trains(self, mesh8):
+        """Gradients flow through both overlap ops."""
+        m, h, f = 64, 128, 256
+        ag_ctx = ops.create_ag_gemm_context(mesh8, "x")
+        rs_ctx = ops.create_gemm_rs_context(mesh8, "x")
+        mlp = layers.ParallelMLP(
+            layers.ColumnParallelLinear(ag_ctx),
+            layers.RowParallelLinear(rs_ctx),
+        )
+        params = mlp.init(jax.random.PRNGKey(0), h, f, jnp.float32)
+        sharded = {
+            "up": {"w": _put(mesh8, params["up"]["w"], P(None, "x"))},
+            "down": {"w": _put(mesh8, params["down"]["w"], P("x", None))},
+        }
+        x = jax.random.normal(jax.random.PRNGKey(1), (m, h), jnp.float32)
+        y = jax.random.normal(jax.random.PRNGKey(2), (m, h), jnp.float32)
+
+        def loss(p, x):
+            return jnp.mean((mlp(p, x) - y) ** 2)
+
+        def loss_ref(p, x):
+            return jnp.mean((jax.nn.gelu(x @ p["up"]["w"]) @ p["down"]["w"] - y) ** 2)
+
+        g = jax.grad(loss)(sharded, _put(mesh8, x, P("x")))
+        g_ref = jax.grad(loss_ref)(params, x)
+        assert_allclose(g["up"]["w"], g_ref["up"]["w"], atol=1e-4, rtol=1e-3)
+        assert_allclose(g["down"]["w"], g_ref["down"]["w"], atol=1e-4, rtol=1e-3)
+
+
+class TestMoELayers:
+    def test_ep_moe_mlp(self, mesh8):
+        n, e, topk, h, f, mtok = 8, 16, 2, 128, 256, 16
+        ctx = ops.create_ep_moe_context(
+            mesh8, "x", num_experts=e, topk=topk, max_m=mtok * topk,
+            hidden=h, dtype=jnp.float32, transport="pallas", block_m=8,
+        )
+        mlp = layers.EPMoEMLP(ctx)
+        params = mlp.init(jax.random.PRNGKey(0), f, jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (n * mtok, h), jnp.float32)
+
+        out = mlp(
+            {
+                "router": params["router"],
+                "up": _put(mesh8, params["up"], P("x")),
+                "down": _put(mesh8, params["down"], P("x")),
+            },
+            _put(mesh8, x, P("x")),
+        )
+        logits = x @ params["router"]
+        weights, ids = mu.select_experts(logits, topk)
+        ref = jnp.zeros((n * mtok, h))
+        for t in range(topk):
+            hh = jax.nn.silu(jnp.einsum("mh,mhf->mf", x, params["up"][ids[:, t]]))
+            ref += weights[:, t : t + 1] * jnp.einsum(
+                "mf,mfh->mh", hh, params["down"][ids[:, t]]
+            )
+        assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+    def test_moe_tp_mlp(self, mesh8):
+        e, topk, m, h, f = 16, 2, 64, 128, 512
+        ctx = ops.create_ag_group_gemm_context(
+            mesh8, "x", num_experts=e, topk=topk, block_m=8, dtype=jnp.float32
+        )
+        mlp = layers.MoETPMLP(ctx)
+        w_up = jax.random.normal(jax.random.PRNGKey(0), (e, h, f), jnp.float32) * 0.05
+        w_down = jax.random.normal(jax.random.PRNGKey(1), (e, f, h), jnp.float32) * 0.05
+        x = jax.random.normal(jax.random.PRNGKey(2), (m, h), jnp.float32)
+        logits = jax.random.normal(jax.random.PRNGKey(3), (m, e))
+        weights, ids = mu.select_experts(logits, topk)
+        out = mlp(
+            {
+                "up": _put(mesh8, w_up, P(None, None, "x")),
+                "down": _put(mesh8, w_down, P(None, "x")),
+            },
+            _put(mesh8, x, P("x")),
+            ids, weights,
+        )
+        ref = jnp.zeros((m, h))
+        for t in range(topk):
+            hh = jax.nn.silu(jnp.einsum("mk,mkf->mf", x, w_up[ids[:, t]]))
+            ref += weights[:, t : t + 1] * jnp.einsum(
+                "mf,mfh->mh", hh, w_down[ids[:, t]]
+            )
+        assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_ep_a2a_layer_roundtrip(self, mesh8):
+        """dispatch → identity → combine returns the sorted tokens."""
+        from triton_distributed_tpu.kernels import moe_all_to_all as ma
+
+        n, epr, hdim, max_m, m = 8, 2, 128, 16, 12
+        e = n * epr
+        a2a = ma.create_all_to_all_context(
+            mesh8, "x", max_m=max_m, hidden=hdim,
+            experts_per_rank=epr, dtype=jnp.float32,
+        )
+        layer = layers.EPAll2AllLayer(a2a)
+        rng = np.random.default_rng(0)
+        assign = np.sort(rng.integers(0, e, size=(n, m)), axis=1)
+        splits = np.stack(
+            [np.bincount(assign[d], minlength=e) for d in range(n)]
+        ).astype(np.int32)
+        toks = rng.standard_normal((n, m, hdim)).astype(np.float32)
+
+        def body(t, s):
+            recv, rs = layer.dispatch(t, s)
+            return layer.combine(recv, s, m)
+
+        fn = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh8, in_specs=(P("x"), P("x")),
+                out_specs=P("x"), check_vma=False,
+            )
+        )
+        back = fn(
+            _put(mesh8, jnp.asarray(toks).reshape(n * m, hdim), P("x")),
+            _put(mesh8, jnp.asarray(splits).reshape(n * e), P("x")),
+        )
+        np.testing.assert_allclose(
+            np.asarray(back).reshape(n, m, hdim), toks, rtol=1e-6
+        )
+
+
+class TestSpDecodeLayer:
+    def test_vs_xla(self, mesh8):
+        b, hq, hkv, d, s = 2, 8, 2, 128, 1024
+        layer = layers.SpGQAFlashDecodeAttention(
+            mesh8, "x", q_heads=hq, kv_heads=hkv, head_dim=d, block_k=128
+        )
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, hq, d), jnp.float32)
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, d), jnp.float32)
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d), jnp.float32)
+        lens = jnp.array([900, 400], jnp.int32)
+        out = layer(q, k, v, lens)
+        ref, _ = gqa_fwd_batch_decode_xla(q, k, v, lens)
+        assert_allclose(out, ref, atol=2e-2, rtol=2e-2)
+
+    def test_append_kv(self):
+        b, s, hkv, d = 2, 8, 2, 128
+        k = jnp.zeros((b, s, hkv, d))
+        v = jnp.zeros((b, s, hkv, d))
+        lens = jnp.array([3, 5], jnp.int32)
+        kn = jnp.ones((b, hkv, d))
+        k2, v2, lens2 = layers.append_kv(k, v, lens, kn, kn * 2)
+        np.testing.assert_array_equal(np.asarray(lens2), [4, 6])
+        assert float(k2[0, 3].sum()) == hkv * d
+        assert float(v2[1, 5].sum()) == 2 * hkv * d
+        assert float(k2[0, 4].sum()) == 0

@@ -1,0 +1,32 @@
+"""Layer package (L5): composable modules over the kernel/op layers.
+
+≡ python/triton_dist/layers/nvidia/ — SpGQAFlashDecodeAttention
+(sp_flash_decode_layer.py:43), EPAll2AllLayer (ep_a2a_layer.py:40),
+AllGatherLayer (low_latency_allgather_layer.py:31) — plus the
+tensor-parallel linear/MLP layers that make the overlap ops composable
+into transformer blocks (beyond the reference's inference-only scope).
+"""
+
+from triton_distributed_tpu.layers.allgather import AllGatherLayer
+from triton_distributed_tpu.layers.attention import (
+    SpGQAFlashDecodeAttention,
+    append_kv,
+)
+from triton_distributed_tpu.layers.linear import (
+    ColumnParallelLinear,
+    ParallelMLP,
+    RowParallelLinear,
+)
+from triton_distributed_tpu.layers.moe import EPAll2AllLayer, EPMoEMLP, MoETPMLP
+
+__all__ = [
+    "AllGatherLayer",
+    "SpGQAFlashDecodeAttention",
+    "append_kv",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "ParallelMLP",
+    "EPAll2AllLayer",
+    "EPMoEMLP",
+    "MoETPMLP",
+]

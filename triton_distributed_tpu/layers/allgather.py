@@ -1,0 +1,55 @@
+"""AllGather layer exposing every engine variant with shared bookkeeping.
+
+Reference: python/triton_dist/layers/nvidia/low_latency_allgather_layer
+.py — ``AllGatherLayer`` (:31-195) exposing 8 fast-AG variants
+(pull / push-2d / push-3d / LL × scopes) with per-call signal-target
+bookkeeping.
+
+TPU re-design: the signal bookkeeping is the DMA semaphore's job, so
+the layer reduces to method selection + jit caches: RING_1D (torus
+neighbor ring), RING_BIDIR (both directions, halves latency), LL_SMALL
+(single-shot full-mesh push for latency-bound sizes — the LL-protocol
+analogue), XLA_FALLBACK (lax.all_gather). ``auto`` picks by topology
+and message size like AllGatherMethod selection (allgather.py:44-69).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+
+from triton_distributed_tpu.kernels.allgather import all_gather
+from triton_distributed_tpu.runtime import AllGatherMethod
+
+
+@dataclass(frozen=True)
+class AllGatherLayer:
+    """≡ AllGatherLayer (low_latency_allgather_layer.py:31-195)."""
+
+    mesh: jax.sharding.Mesh
+    axis: str = "x"
+    collective_id: int = 2
+
+    def __call__(self, x, method: AllGatherMethod | None = None):
+        """x: (M, ...) rows sharded over ``axis`` → gathered (M, ...)
+        replicated rows on every rank."""
+        return all_gather(
+            x, self.mesh, self.axis,
+            method=method, collective_id=self.collective_id,
+        )
+
+    # Named variants, mirroring the reference's forward_* family
+    def forward_ring(self, x):
+        return self(x, AllGatherMethod.RING_1D)
+
+    def forward_ring_bidir(self, x):
+        return self(x, AllGatherMethod.RING_BIDIR)
+
+    def forward_ll(self, x):
+        """Low-latency small-message path (≡ the LL-protocol variants,
+        low_latency_allgather.py:532-624)."""
+        return self(x, AllGatherMethod.LL_SMALL)
+
+    def forward_xla(self, x):
+        return self(x, AllGatherMethod.XLA_FALLBACK)

@@ -1,0 +1,86 @@
+"""Sequence-parallel flash-decode attention layer.
+
+Reference: python/triton_dist/layers/nvidia/sp_flash_decode_layer.py —
+``SpGQAFlashDecodeAttention(nn.Module)`` (:45-184): local split-kv
+attention on the rank's KV shard → low-latency AG of per-rank partial
+(out, lse) → inter-rank combine, with symmetric AG buffers grown on
+demand (:60-77).
+
+TPU re-design: the layer is a thin stateless callable over the
+flash-decode kernels (kernels/flash_decode.py) — no buffer management
+is needed because XLA owns allocation; the only state worth keeping is
+the geometry + jit caches, which the kernel module already holds.
+Exposes both the host entry (global arrays on a mesh) and the device
+body (for composition inside a model's shard_map).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels.flash_decode import (
+    sp_gqa_fwd_batch_decode,
+    sp_gqa_fwd_batch_decode_device,
+)
+
+
+@dataclass(frozen=True)
+class SpGQAFlashDecodeAttention:
+    """SP/CP decode attention: KV cache sequence-sharded over ``axis``.
+
+    q_heads/kv_heads/head_dim describe the GQA geometry; ``scale`` defaults
+    to 1/sqrt(head_dim); ``soft_cap`` > 0 enables logit soft-capping
+    (≡ the ctor args at sp_flash_decode_layer.py:45-59).
+    """
+
+    mesh: jax.sharding.Mesh
+    axis: str = "x"
+    q_heads: int = 32
+    kv_heads: int = 8
+    head_dim: int = 128
+    scale: float | None = None
+    soft_cap: float = 0.0
+    block_k: int = 256
+    use_pallas: bool = True
+
+    def __call__(self, q, k_cache, v_cache, global_kv_lens):
+        """q: (B, Hq, D) replicated; k/v_cache: (B, S, Hkv, D) with S
+        sharded over ``axis``; global_kv_lens: (B,) total lengths.
+        Returns (B, Hq, D) replicated (≡ forward,
+        sp_flash_decode_layer.py:78-184)."""
+        return sp_gqa_fwd_batch_decode(
+            q, k_cache, v_cache, global_kv_lens, self.mesh, self.axis,
+            scale=self.scale, soft_cap=self.soft_cap,
+            block_k=self.block_k, use_pallas=self.use_pallas,
+        )
+
+    def device_body(self, q, k_shard, v_shard, global_kv_lens):
+        """Per-device body for composition inside a model's shard_map."""
+        return sp_gqa_fwd_batch_decode_device(
+            q, k_shard, v_shard, global_kv_lens, self.axis,
+            scale=self.scale, soft_cap=self.soft_cap,
+            block_k=self.block_k, use_pallas=self.use_pallas,
+        )
+
+
+def append_kv(k_cache, v_cache, kv_lens, k_new, v_new):
+    """Append one decode step's K/V at each batch row's current length.
+
+    k_cache/v_cache: (B, S, Hkv, D); k_new/v_new: (B, Hkv, D); kv_lens:
+    (B,) lengths BEFORE the append. Returns updated caches and lengths.
+    (The reference leaves cache management to the serving stack; provided
+    here so the models package can run real decode loops.)
+
+    A row whose length has reached the cache capacity S drops the write
+    (JAX out-of-bounds scatter semantics) while the returned length
+    still increments — callers must enforce capacity up front (see the
+    check in models.Transformer.generate).
+    """
+    b = k_cache.shape[0]
+    rows = jnp.arange(b)
+    k_cache = k_cache.at[rows, kv_lens].set(k_new.astype(k_cache.dtype))
+    v_cache = v_cache.at[rows, kv_lens].set(v_new.astype(v_cache.dtype))
+    return k_cache, v_cache, kv_lens + 1

@@ -1,0 +1,76 @@
+"""Tensor-parallel linear layers over the differentiable overlap ops.
+
+The reference stops at raw kernels + thin modules; these are the
+Megatron-style column/row-parallel linears that make the overlap ops
+(ops/overlap.py: ag_gemm / gemm_rs) composable into transformer blocks,
+in the sequence-parallel layout (activations row-sharded between
+blocks). Column then row = one AG-GEMM and one GEMM-RS per MLP, the
+flagship overlap pattern of the reference (tutorials 07/08).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.ops.overlap import OverlapContext, ag_gemm, gemm_rs
+
+
+@dataclass(frozen=True)
+class ColumnParallelLinear:
+    """y = AG(x) @ W, W col-sharded: (K, N/tp) per rank.
+
+    Input (M, K) row-sharded (sequence-parallel); output (M, N) with N
+    sharded — feeds a RowParallelLinear.
+    """
+
+    ctx: OverlapContext
+
+    def init(self, key, in_dim: int, out_dim: int, dtype=jnp.bfloat16):
+        s = 1.0 / (in_dim ** 0.5)
+        return {"w": jax.random.normal(key, (in_dim, out_dim), dtype) * s}
+
+    def __call__(self, params, x):
+        return ag_gemm(x, params["w"], self.ctx)
+
+
+@dataclass(frozen=True)
+class RowParallelLinear:
+    """y = RS(x @ W), W row-sharded: (K/tp, N) per rank.
+
+    Input (M, K) with K sharded; output (M, N) row-sharded — the
+    sequence-parallel residual layout.
+    """
+
+    ctx: OverlapContext
+
+    def init(self, key, in_dim: int, out_dim: int, dtype=jnp.bfloat16):
+        s = 1.0 / (in_dim ** 0.5)
+        return {"w": jax.random.normal(key, (in_dim, out_dim), dtype) * s}
+
+    def __call__(self, params, x):
+        return gemm_rs(x, params["w"], self.ctx)
+
+
+@dataclass(frozen=True)
+class ParallelMLP:
+    """Column → activation → Row: the canonical TP MLP (one AG-GEMM and
+    one GEMM-RS per call — reference tutorials 07+08 fused pattern)."""
+
+    up: ColumnParallelLinear
+    down: RowParallelLinear
+    activation: str = "gelu"
+
+    def init(self, key, hidden: int, ffn: int, dtype=jnp.bfloat16):
+        k1, k2 = jax.random.split(key)
+        return {
+            "up": self.up.init(k1, hidden, ffn, dtype),
+            "down": self.down.init(k2, ffn, hidden, dtype),
+        }
+
+    def __call__(self, params, x):
+        h = self.up(params["up"], x)
+        act = jax.nn.silu if self.activation == "silu" else jax.nn.gelu
+        return self.down(params["down"], act(h))

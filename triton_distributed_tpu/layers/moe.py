@@ -1,0 +1,129 @@
+"""MoE layers: the EP AllToAll layer and the two full MoE MLP flavours.
+
+Reference: python/triton_dist/layers/nvidia/ep_a2a_layer.py —
+``EPAll2AllLayer`` (:40-240): preprocess (splits/cumsum/indices) →
+dispatch → (expert compute by caller) → combine, owning the symmetric
+buffers. The full MLP compositions correspond to
+test_ep_moe_inference.py and the ag_group_gemm/moe_reduce_rs pipelines.
+
+TPU re-design: buffers belong to XLA, so the layer state is just the
+context; ``EPAll2AllLayer`` keeps the reference's dispatch/combine
+split so callers can run custom expert code between the legs, while
+``EPMoEMLP`` / ``MoETPMLP`` are the one-call layers models use.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels import moe_all_to_all as ma
+from triton_distributed_tpu.ops.moe import EPMoEContext, ep_moe, ep_moe_device
+from triton_distributed_tpu.ops.moe_tp import (
+    MoETPContext,
+    ag_group_gemm,
+    align_routing,
+    moe_reduce_rs,
+    moe_tp_mlp,
+)
+
+
+@dataclass(frozen=True)
+class EPAll2AllLayer:
+    """Dispatch/combine pair around caller-provided expert compute
+    (≡ EPAll2AllLayer, ep_a2a_layer.py:40-240). Device-level: call the
+    methods inside a shard_map over ``ctx.mesh``."""
+
+    ctx: ma.MoEAllToAllContext
+
+    def dispatch(self, tokens_sorted, splits):
+        """(M, H) expert-sorted tokens + (E,) splits → ((n, max_m, H)
+        received tokens, (n, epr) received splits)."""
+        from triton_distributed_tpu.kernels.all_to_all import all_to_all_device
+
+        packed = ma.pack_slots(
+            self.ctx, *ma.dispatch_stage(self.ctx, tokens_sorted, splits)
+        )
+        recv = all_to_all_device(
+            packed, self.ctx.n, self.ctx.axis, self.ctx.mesh.axis_names,
+            collective_id=self.ctx.collective_id,
+        )
+        return ma.recv_tokens_view(self.ctx, recv)
+
+    def combine(self, toks, splits, m_total: int):
+        """(n, max_m, H) processed tokens → (m_total, H) back in this
+        rank's original sorted order."""
+        from triton_distributed_tpu.kernels.all_to_all import all_to_all_device
+
+        comb = all_to_all_device(
+            ma.combine_stage(self.ctx, toks),
+            self.ctx.n, self.ctx.axis, self.ctx.mesh.axis_names,
+            collective_id=self.ctx.collective_id,
+        )
+        return ma.combine_unstage(
+            self.ctx, ma.combine_unpack(self.ctx, comb), splits, m_total
+        )
+
+
+@dataclass(frozen=True)
+class EPMoEMLP:
+    """Expert-parallel MoE MLP layer (router + dispatch + grouped MLP +
+    combine in one call). Params: {"router": (H, E), "up": (E, H, F),
+    "down": (E, F, H)} — expert dims sharded over ``ctx.axis``."""
+
+    ctx: EPMoEContext
+
+    def init(self, key, ffn_dim: int, dtype=None):
+        dtype = dtype or self.ctx.dtype
+        h, e = self.ctx.hidden, self.ctx.num_experts
+        k1, k2, k3 = jax.random.split(key, 3)
+        s = 1.0 / (h ** 0.5)
+        return {
+            "router": jax.random.normal(k1, (h, e), jnp.float32) * s,
+            "up": jax.random.normal(k2, (e, h, ffn_dim), dtype) * s,
+            "down": jax.random.normal(k3, (e, ffn_dim, h), dtype)
+            * (1.0 / (ffn_dim ** 0.5)),
+        }
+
+    def __call__(self, params, x):
+        """x: (M, H) token-sharded over ``ctx.axis``. Returns (M, H)."""
+        logits = x.astype(jnp.float32) @ params["router"]
+        return ep_moe(x, logits, params["up"], params["down"], self.ctx)
+
+    def device_body(self, params, x):
+        """Per-device body for composition inside a model's shard_map."""
+        logits = x.astype(jnp.float32) @ params["router"]
+        return ep_moe_device(x, logits, params["up"], params["down"], self.ctx)
+
+
+@dataclass(frozen=True)
+class MoETPMLP:
+    """Tensor-parallel MoE MLP layer. Weights: up (E, H, F) F-sharded,
+    down (E, F, H) F-sharded over ``ctx.axis``.
+
+    ``fused=True`` (default): the single-body moe_tp_mlp op — one sort,
+    both grouped GEMMs, psum_scatter; differentiable, DP-aware via
+    ``ctx.batch_axes``. ``fused=False``: the composed ag_group_gemm →
+    act → moe_reduce_rs pipeline over the Pallas ring reduce-scatter
+    (inference; routing threaded once, ≡ the reference's two-kernel
+    orchestration, moe_reduce_rs.py:882-1020)."""
+
+    ctx: MoETPContext
+    activation: str = "silu"
+    fused: bool = True
+
+    def __call__(self, params, x, topk_ids, topk_weights):
+        """x: (M, H) token-sharded; topk_ids/topk_weights: (M, k)
+        routing (row-sharded like x, or replicated — the entry
+        reshards). Returns (M, H) token-sharded."""
+        if self.fused:
+            return moe_tp_mlp(
+                x, topk_ids, topk_weights, params["up"], params["down"],
+                self.ctx, activation=self.activation,
+            )
+        routing = align_routing(self.ctx, topk_ids)
+        y = ag_group_gemm(x, routing, params["up"], self.ctx)
+        act = jax.nn.silu if self.activation == "silu" else jax.nn.gelu
+        return moe_reduce_rs(act(y), routing, topk_weights, params["down"], self.ctx)

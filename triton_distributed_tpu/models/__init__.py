@@ -1,0 +1,14 @@
+"""Model package: the flagship TP+SP(+DP) transformer (dense and MoE).
+
+The reference ships kernels, not models (SURVEY.md §0); this package is
+the framework-level completion — decoders whose projections run through
+the fused overlap ops so the reference's flagship patterns are the hot
+path of a real model, trainable and decodable.
+"""
+
+from triton_distributed_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+)
+
+__all__ = ["Transformer", "TransformerConfig"]

@@ -1,0 +1,391 @@
+"""Flagship transformer: TP+SP(+DP) decoder built on the overlap ops.
+
+The reference is a kernel library, not a training framework — its model
+surface is the TP shapes its tests use (Llama-7B/70B GEMMs,
+test_ag_gemm.py; DeepSeek MoE shapes, test_ep_moe_inference.py) and the
+SP decode layer. This module is the framework-level completion: a
+decoder whose every projection runs through the fused overlap ops, so
+the reference's flagship patterns (AG-GEMM up/qkv, GEMM-RS down/out —
+tutorials 07/08; MoE TP — ag_group_gemm/moe_reduce_rs; SP flash-decode
+— sp_flash_decode_layer.py) ARE the model's hot path, for training and
+decode alike.
+
+Layout (Megatron sequence-parallel):
+
+* Between blocks, activations are (B·S, H) row-sharded over
+  (*dp_axes, tp) — the SP layout.
+* qkv/up projections: AG-GEMM (gather rows, col-shard heads/ffn).
+* out/down projections: GEMM-RS (row-shard K, scatter rows back).
+* Attention runs with heads sharded over tp (plain jnp between the
+  overlap ops — XLA keeps the head dim local, no resharding).
+* MoE blocks: MoETPMLP (TP over experts' F dim) or EPMoEMLP (EP over
+  the same axis) — selectable per config.
+* LM head: weights replicated, rows stay sharded, loss is computed on
+  the row shards (no logit gather).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu import ops
+from triton_distributed_tpu.kernels import moe_utils as mu
+from triton_distributed_tpu.layers import (
+    ColumnParallelLinear,
+    ParallelMLP,
+    RowParallelLinear,
+    SpGQAFlashDecodeAttention,
+)
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    n_layers: int = 2
+    hidden: int = 512
+    ffn: int = 1024
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    head_dim: int = 64
+    # MoE: "none" = dense MLP everywhere; "tp" / "ep" put a MoE MLP in
+    # every block whose index is in moe_layers
+    moe: str = "none"
+    moe_layers: tuple = ()
+    num_experts: int = 8
+    topk: int = 2
+    norm_eps: float = 1e-5
+    dtype: object = jnp.bfloat16
+    param_dtype: object = jnp.float32
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    @property
+    def qkv_dim(self) -> int:
+        return self.q_dim + 2 * self.kv_dim
+
+
+@dataclass(frozen=True)
+class Transformer:
+    """The model object: config + mesh/axes + derived contexts."""
+
+    config: TransformerConfig
+    mesh: Mesh
+    tp_axis: str = "tp"
+    dp_axes: tuple = ()
+
+    @property
+    def tp(self) -> int:
+        return self.mesh.shape[self.tp_axis]
+
+    @property
+    def row_spec(self):
+        """Sequence-parallel activation sharding: rows over (dp..., tp)."""
+        return P(tuple(self.dp_axes) + (self.tp_axis,))
+
+    @functools.cached_property
+    def _ag_ctx(self):
+        return ops.create_ag_gemm_context(
+            self.mesh, self.tp_axis, batch_axes=tuple(self.dp_axes)
+        )
+
+    @functools.cached_property
+    def _rs_ctx(self):
+        return ops.create_gemm_rs_context(
+            self.mesh, self.tp_axis, batch_axes=tuple(self.dp_axes)
+        )
+
+    @functools.cached_property
+    def _mlp(self):
+        return ParallelMLP(
+            ColumnParallelLinear(self._ag_ctx),
+            RowParallelLinear(self._rs_ctx),
+            activation="silu",
+        )
+
+    @functools.cached_property
+    def _moe_tp_ctx(self):
+        c = self.config
+        return ops.create_ag_group_gemm_context(
+            self.mesh, self.tp_axis, num_experts=c.num_experts, topk=c.topk,
+            dtype=c.dtype, use_pallas_gemm=False,
+            batch_axes=tuple(self.dp_axes),
+        )
+
+    def _moe_ep_ctx(self, m_local: int):
+        c = self.config
+        return ops.create_ep_moe_context(
+            self.mesh, self.tp_axis, num_experts=c.num_experts, topk=c.topk,
+            max_m=m_local * c.topk, hidden=c.hidden, dtype=c.dtype,
+            transport="xla", use_pallas_gemm=False,
+            batch_axes=tuple(self.dp_axes),
+        )
+
+    # ---------------------------------------------------------------- params
+
+    def init(self, key):
+        c = self.config
+        keys = iter(jax.random.split(key, 4 + 8 * c.n_layers))
+        pd = c.param_dtype
+        s = 1.0 / (c.hidden ** 0.5)
+
+        def dense(k, shape, scale=None):
+            return jax.random.normal(k, shape, pd) * (scale or s)
+
+        params = {
+            "embed": dense(next(keys), (c.vocab, c.hidden), 0.02),
+            "norm_f": jnp.ones((c.hidden,), pd),
+            "lm_head": dense(next(keys), (c.hidden, c.vocab)),
+            "blocks": [],
+        }
+        for i in range(c.n_layers):
+            blk = {
+                "norm_attn": jnp.ones((c.hidden,), pd),
+                "norm_mlp": jnp.ones((c.hidden,), pd),
+                "wqkv": dense(next(keys), (c.hidden, c.qkv_dim)),
+                "wo": dense(next(keys), (c.q_dim, c.hidden)),
+            }
+            if c.moe != "none" and i in c.moe_layers:
+                blk["router"] = dense(next(keys), (c.hidden, c.num_experts))
+                blk["moe_up"] = dense(next(keys), (c.num_experts, c.hidden, c.ffn))
+                blk["moe_down"] = dense(
+                    next(keys), (c.num_experts, c.ffn, c.hidden),
+                    1.0 / (c.ffn ** 0.5),
+                )
+            else:
+                blk["up"] = dense(next(keys), (c.hidden, c.ffn))
+                blk["down"] = dense(
+                    next(keys), (c.ffn, c.hidden), 1.0 / (c.ffn ** 0.5)
+                )
+            params["blocks"].append(blk)
+        return params
+
+    def shardings(self):
+        """NamedSharding pytree matching :meth:`init` — TP dims sharded,
+        the rest replicated (DP gradients reduce via batch_axes)."""
+        c = self.config
+        t = self.tp_axis
+
+        def ns(*spec):
+            return NamedSharding(self.mesh, P(*spec))
+
+        rep = ns()
+        out = {
+            "embed": rep, "norm_f": rep, "lm_head": rep, "blocks": [],
+        }
+        for i in range(c.n_layers):
+            blk = {
+                "norm_attn": rep, "norm_mlp": rep,
+                "wqkv": ns(None, t), "wo": ns(t, None),
+            }
+            if c.moe != "none" and i in c.moe_layers:
+                if c.moe == "ep":
+                    # experts sharded over tp (each rank owns E/tp experts)
+                    blk.update(router=rep, moe_up=ns(t), moe_down=ns(t))
+                else:
+                    # TP flavour: the ffn dim sharded
+                    blk.update(
+                        router=rep,
+                        moe_up=ns(None, None, t), moe_down=ns(None, t, None),
+                    )
+            else:
+                blk.update(up=ns(None, t), down=ns(t, None))
+            out["blocks"].append(blk)
+        return out
+
+    # --------------------------------------------------------------- forward
+
+    def _rmsnorm(self, x, w):
+        xf = x.astype(jnp.float32)
+        r = jax.lax.rsqrt(
+            jnp.mean(xf * xf, axis=-1, keepdims=True) + self.config.norm_eps
+        )
+        return (xf * r).astype(x.dtype) * w.astype(x.dtype)
+
+    def _attention(self, blk, x, b, s):
+        """x: (B·S, H) SP rows → (B·S, H) SP rows. Heads sharded tp."""
+        c = self.config
+        qkv = ops.ag_gemm(x, blk["wqkv"].astype(c.dtype), self._ag_ctx)
+        q, k, v = jnp.split(qkv, [c.q_dim, c.q_dim + c.kv_dim], axis=-1)
+        hq, hkv, d = c.n_heads, c.n_kv_heads, c.head_dim
+        q = q.reshape(b, s, hq, d)
+        k = k.reshape(b, s, hkv, d)
+        v = v.reshape(b, s, hkv, d)
+        g = hq // hkv
+        qg = q.reshape(b, s, hkv, g, d)
+        logits = jnp.einsum(
+            "bshgd,bthd->bhgst", qg.astype(jnp.float32), k.astype(jnp.float32)
+        ) / (d ** 0.5)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(c.dtype)
+        o = jnp.einsum("bhgst,bthd->bshgd", probs, v)
+        o = o.reshape(b * s, hq * d)
+        return ops.gemm_rs(o, blk["wo"].astype(c.dtype), self._rs_ctx)
+
+    def _mlp_block(self, blk, x):
+        c = self.config
+        if "up" in blk:
+            p = {
+                "up": {"w": blk["up"].astype(c.dtype)},
+                "down": {"w": blk["down"].astype(c.dtype)},
+            }
+            return self._mlp(p, x)
+        moe_params = {
+            "router": blk["router"],
+            "up": blk["moe_up"].astype(c.dtype),
+            "down": blk["moe_down"].astype(c.dtype),
+        }
+        if c.moe == "ep":
+            # EP flavour: experts sharded over tp, tokens stay row-sharded;
+            # fully differentiable (XLA transport) — the training MoE.
+            from triton_distributed_tpu.layers import EPMoEMLP
+
+            m_local = x.shape[0] // (self.tp * int(
+                np.prod([self.mesh.shape[a] for a in self.dp_axes]) or 1
+            ))
+            return EPMoEMLP(self._moe_ep_ctx(m_local))(moe_params, x)
+        # TP flavour: fused single-body op, per-replica routing
+        from triton_distributed_tpu.layers import MoETPMLP
+
+        logits = x.astype(jnp.float32) @ blk["router"]
+        weights, ids = mu.select_experts(logits, c.topk)
+        return MoETPMLP(self._moe_tp_ctx)(moe_params, x, ids, weights)
+
+    def forward(self, params, tokens):
+        """tokens: (B, S) int32 → logits (B·S, vocab) SP-row-sharded."""
+        c = self.config
+        b, s = tokens.shape
+        x = params["embed"][tokens.reshape(-1)].astype(c.dtype)  # (B·S, H)
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, self.row_spec)
+        )
+        for blk in params["blocks"]:
+            h = self._attention(blk, self._rmsnorm(x, blk["norm_attn"]), b, s)
+            x = x + h
+            h = self._mlp_block(blk, self._rmsnorm(x, blk["norm_mlp"]))
+            x = x + h
+        x = self._rmsnorm(x, params["norm_f"])
+        return x.astype(jnp.float32) @ params["lm_head"]
+
+    def loss(self, params, tokens, targets):
+        """Causal LM loss; logits stay row-sharded end to end."""
+        logits = self.forward(params, tokens)
+        tgt = targets.reshape(-1)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, tgt[:, None], axis=-1)[:, 0]
+        return jnp.mean(nll)
+
+    def train_step(self, params, tokens, targets, lr=1e-3):
+        """One SGD step (the driver's dryrun entry; real training would
+        wrap this in optax — the grads are ordinary pytrees)."""
+        l, g = jax.value_and_grad(self.loss)(params, tokens, targets)
+        new = jax.tree.map(lambda p, d: p - lr * d.astype(p.dtype), params, g)
+        return l, new
+
+    # ---------------------------------------------------------------- decode
+
+    @functools.cached_property
+    def _sp_attn(self):
+        c = self.config
+        return SpGQAFlashDecodeAttention(
+            self.mesh, self.tp_axis, q_heads=c.n_heads,
+            kv_heads=c.n_kv_heads, head_dim=c.head_dim,
+        )
+
+    def init_cache(self, batch: int, max_len: int):
+        """Per-layer (k, v) caches, (B, S, Hkv, D) sequence-sharded over
+        tp — the SP decode layout (≡ the KV sharding of
+        sp_flash_decode_layer.py: each rank holds its slice of the
+        sequence)."""
+        c = self.config
+        spec = NamedSharding(self.mesh, P(None, self.tp_axis))
+        z = jnp.zeros((batch, max_len, c.n_kv_heads, c.head_dim), c.dtype)
+        return [
+            (jax.device_put(z, spec), jax.device_put(z, spec))
+            for _ in range(c.n_layers)
+        ]
+
+    def decode_step(self, params, caches, kv_lens, last_tokens):
+        """One token of SP decode: replicated (B,) last tokens + seq-
+        sharded caches → (B, vocab) logits, updated caches/lens.
+
+        Attention runs through the distributed flash-decode layer
+        (local split-kv + AG(out,lse) + LSE combine); projections are
+        plain matmuls — at decode the M dim is B, far too small for the
+        overlap engines (matching the reference, whose decode path is
+        the SP attention kernel, not AG-GEMM).
+        """
+        c = self.config
+        from triton_distributed_tpu.layers import append_kv
+
+        x = params["embed"][last_tokens].astype(c.dtype)        # (B, H)
+        b = x.shape[0]
+        new_caches = []
+        for blk, (ck, cv) in zip(params["blocks"], caches):
+            xn = self._rmsnorm(x, blk["norm_attn"])
+            qkv = xn @ blk["wqkv"].astype(c.dtype)              # (B, qkv)
+            q, k, v = jnp.split(qkv, [c.q_dim, c.q_dim + c.kv_dim], axis=-1)
+            q = q.reshape(b, c.n_heads, c.head_dim)
+            k = k.reshape(b, c.n_kv_heads, c.head_dim)
+            v = v.reshape(b, c.n_kv_heads, c.head_dim)
+            ck, cv, _ = append_kv(ck, cv, kv_lens, k, v)
+            new_caches.append((ck, cv))
+            o = self._sp_attn(q, ck, cv, kv_lens + 1)           # (B, Hq, D)
+            o = o.reshape(b, c.q_dim) @ blk["wo"].astype(c.dtype)
+            x = x + o
+            xn = self._rmsnorm(x, blk["norm_mlp"])
+            if "up" in blk:
+                h = jax.nn.silu(xn @ blk["up"].astype(c.dtype))
+                x = x + h @ blk["down"].astype(c.dtype)
+            else:
+                logits_r = xn.astype(jnp.float32) @ blk["router"]
+                w, ids = mu.select_experts(logits_r, c.topk)
+                y = jnp.zeros_like(xn, dtype=jnp.float32)
+                for t in range(c.topk):
+                    hh = jax.nn.silu(
+                        jnp.einsum("bh,bhf->bf", xn, blk["moe_up"][ids[:, t]].astype(c.dtype))
+                    )
+                    y += w[:, t:t + 1] * jnp.einsum(
+                        "bf,bfh->bh", hh, blk["moe_down"][ids[:, t]].astype(c.dtype)
+                    ).astype(jnp.float32)
+                x = x + y.astype(x.dtype)
+        x = self._rmsnorm(x, params["norm_f"])
+        logits = x.astype(jnp.float32) @ params["lm_head"]
+        return logits, new_caches, kv_lens + 1
+
+    @functools.cached_property
+    def _decode_jit(self):
+        return jax.jit(self.decode_step)
+
+    def generate(self, params, caches, kv_lens, last_tokens, steps: int):
+        """Greedy decode ``steps`` tokens. The whole decode step is one
+        jitted program (cached across steps and calls by shape)."""
+        cap = caches[0][0].shape[1]
+        try:
+            max_len = int(np.asarray(kv_lens).max()) + steps
+            assert max_len <= cap, (
+                f"cache capacity {cap} < {max_len} needed — writes past "
+                f"capacity are silently dropped (see layers.append_kv)"
+            )
+        except jax.errors.TracerArrayConversionError:
+            pass  # traced lens: caller owns the capacity contract
+        out = []
+        for _ in range(steps):
+            logits, caches, kv_lens = self._decode_jit(
+                params, caches, kv_lens, last_tokens
+            )
+            last_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out.append(last_tokens)
+        return jnp.stack(out, axis=1), caches, kv_lens

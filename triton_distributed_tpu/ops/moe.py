@@ -52,6 +52,7 @@ class EPMoEContext:
     block_m: int = 128
     use_pallas_gemm: bool = True
     collective_id: int = 10
+    batch_axes: tuple = ()          # extra (DP) axes sharding token rows
 
     @property
     def n(self) -> int:
@@ -210,11 +211,12 @@ def ep_moe_device(x, logits, w_up, w_down, ctx: EPMoEContext):
 
 @functools.lru_cache(maxsize=64)
 def _build_ep_moe(ctx: EPMoEContext):
+    rows = P(tuple(ctx.batch_axes) + (ctx.axis,))
     fn = jax.shard_map(
         functools.partial(ep_moe_device, ctx=ctx),
         mesh=ctx.mesh,
-        in_specs=(P(ctx.axis), P(ctx.axis), P(ctx.axis), P(ctx.axis)),
-        out_specs=P(ctx.axis),
+        in_specs=(rows, rows, P(ctx.axis), P(ctx.axis)),
+        out_specs=rows,
         check_vma=False,
     )
     return jax.jit(fn)

@@ -59,6 +59,11 @@ class MoETPContext:
     dtype: jnp.dtype = jnp.bfloat16
     use_pallas_gemm: bool = True
     rs_collective_id: int = 12
+    batch_axes: tuple = ()          # extra (DP) axes sharding token rows
+
+    @property
+    def row_spec(self):
+        return P(tuple(self.batch_axes) + (self.axis,))
 
     @property
     def tp(self) -> int:
@@ -173,3 +178,63 @@ def _build_moe_reduce_rs(ctx: MoETPContext):
         )
 
     return jax.jit(entry)
+
+
+def moe_tp_mlp_device(
+    x_loc, ids_loc, weights_loc, w_up_loc, w_down_loc,
+    ctx: MoETPContext, activation: str = "silu",
+):
+    """Fused per-replica body: AG → route → grouped up/act/down → RS.
+
+    Inside a shard_map over (*batch_axes, axis): gathers this replica's
+    tokens and routing over ``axis``, sorts once, runs both grouped
+    GEMMs (up col-sharded, down row-sharded → partial), combines
+    topk-weighted token rows, and ``psum_scatter``s the partials so
+    each rank ends with its token shard. Differentiable end to end —
+    the training-capable TP MoE (the composed ag_group_gemm /
+    moe_reduce_rs pair with the Pallas ring RS is the inference path).
+    """
+    x_full = jax.lax.all_gather(x_loc, ctx.axis, tiled=True)       # (M, K)
+    ids = jax.lax.all_gather(ids_loc, ctx.axis, tiled=True)        # (M, k)
+    weights = jax.lax.all_gather(weights_loc, ctx.axis, tiled=True)
+    sti, be, counts = mu.moe_align_block_size(
+        ids, ctx.num_experts, ctx.block_m
+    )
+    cap = sti.shape[0]
+    xs = mu.gather_sorted(x_full, sti, ctx.topk).astype(ctx.dtype)
+    h = _ggemm(ctx, xs, w_up_loc.astype(ctx.dtype), be, counts, cap)
+    act = jax.nn.silu if activation == "silu" else jax.nn.gelu
+    h = act(h).astype(ctx.dtype)
+    part = _ggemm(ctx, h, w_down_loc.astype(ctx.dtype), be, counts, cap)
+    tok = mu.scatter_combine(part, sti, weights, x_full.shape[0])
+    return jax.lax.psum_scatter(
+        tok, ctx.axis, scatter_dimension=0, tiled=True
+    ).astype(ctx.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_moe_tp_mlp(ctx: MoETPContext, activation: str):
+    rows = ctx.row_spec
+    fn = jax.shard_map(
+        functools.partial(moe_tp_mlp_device, ctx=ctx, activation=activation),
+        mesh=ctx.mesh,
+        in_specs=(rows, rows, rows,
+                  P(None, None, ctx.axis), P(None, ctx.axis)),
+        out_specs=rows,
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def moe_tp_mlp(x, topk_ids, topk_weights, w_up, w_down, ctx: MoETPContext,
+               activation: str = "silu"):
+    """Host entry for the fused TP MoE MLP.
+
+    x (M, K), topk_ids/topk_weights (M, k): all row-sharded over
+    (*batch_axes, axis) — per-DP-replica routing; w_up (E, K, F) with F
+    sharded; w_down (E, F, H) with F sharded. Returns (M, H)
+    row-sharded like ``x``.
+    """
+    return _build_moe_tp_mlp(ctx, activation)(
+        x, topk_ids, topk_weights, w_up, w_down
+    )
