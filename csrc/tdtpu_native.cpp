@@ -1,0 +1,201 @@
+// Native runtime utilities for triton_distributed_tpu.
+//
+// The reference keeps its host-side hot paths native: moe_utils.cu
+// (csrc/lib/moe_utils.cu:61-356, token sort/pad for grouped GEMM),
+// the AOT runtime (tools/runtime/triton_aot_runtime.cc:26-61, artifact
+// loading outside any framework), and pybind glue (csrc/op_pybind.cc).
+// This file is the TPU-native equivalent set, exposed as a plain C ABI
+// (loaded via ctypes — no pybind11 in this toolchain):
+//
+//   * artifact store  — atomic write + FNV-1a-checksummed mmap read for
+//                       serialized XLA executables (tools/aot.py).
+//   * moe align       — host-side moe_align_block_size for CPU-side
+//                       preprocessing (dataloaders / request routers),
+//                       same layout contract as kernels/moe_utils.py.
+//   * token dataset   — mmap'd uint32 token file with seeded random
+//                       batch sampling: the IO path of the training
+//                       loop, zero-copy until the final pack.
+//
+// Build: g++ -O3 -shared -fPIC -o libtdtpu_native.so tdtpu_native.cpp
+// (driven by tools/native.py, cached under csrc/build/).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+extern "C" {
+
+// ------------------------------------------------------------------ artifact
+
+static const uint64_t kMagic = 0x5452415550544454ULL;  // "TDTPUART"
+
+static uint64_t fnv1a(const uint8_t* p, uint64_t n) {
+  uint64_t h = 1469598103934665603ULL;
+  for (uint64_t i = 0; i < n; ++i) {
+    h ^= p[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+// Atomic checksummed write: tmp file + rename. Returns 0 on success.
+int tdtpu_artifact_write(const char* path, const uint8_t* buf, uint64_t len) {
+  std::vector<char> tmp(strlen(path) + 8);
+  snprintf(tmp.data(), tmp.size(), "%s.tmp", path);
+  FILE* f = fopen(tmp.data(), "wb");
+  if (!f) return -1;
+  uint64_t h = fnv1a(buf, len);
+  int ok = fwrite(&kMagic, 8, 1, f) == 1 && fwrite(&len, 8, 1, f) == 1 &&
+           (len == 0 || fwrite(buf, 1, len, f) == len) &&
+           fwrite(&h, 8, 1, f) == 1;
+  ok = fclose(f) == 0 && ok;
+  if (!ok) { remove(tmp.data()); return -2; }
+  if (rename(tmp.data(), path) != 0) { remove(tmp.data()); return -3; }
+  return 0;
+}
+
+// Returns payload size, or <0 on error (-2: bad magic, -3: bad checksum).
+int64_t tdtpu_artifact_size(const char* path) {
+  struct stat st;
+  if (stat(path, &st) != 0 || st.st_size < 24) return -1;
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  uint64_t magic = 0, len = 0;
+  if (fread(&magic, 8, 1, f) != 1 || fread(&len, 8, 1, f) != 1 ||
+      magic != kMagic || (uint64_t)st.st_size != 24 + len) {
+    fclose(f);
+    return -2;
+  }
+  fclose(f);
+  return (int64_t)len;
+}
+
+// mmap + verify + copy into caller buffer. Returns 0 on success.
+int tdtpu_artifact_read(const char* path, uint8_t* out, uint64_t len) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  uint64_t total = 24 + len;
+  void* m = mmap(nullptr, total, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (m == MAP_FAILED) return -1;
+  const uint8_t* base = (const uint8_t*)m;
+  uint64_t stored_h;
+  memcpy(&stored_h, base + 16 + len, 8);
+  int rc = 0;
+  if (fnv1a(base + 16, len) != stored_h) {
+    rc = -3;
+  } else {
+    memcpy(out, base + 16, len);
+  }
+  munmap(m, total);
+  return rc;
+}
+
+// ----------------------------------------------------------------- moe align
+
+// Host-side moe_align_block_size: sort (token,slot) pairs by expert,
+// pad each expert segment to block_m. Layout identical to
+// kernels/moe_utils.moe_align_block_size (sentinel = total).
+// sorted_token_ids: capacity entries; block_expert: capacity/block_m;
+// splits: num_experts. Returns used capacity, or <0 on error.
+int64_t tdtpu_moe_align_block_size(
+    const int32_t* topk_ids, int64_t m, int64_t k, int64_t num_experts,
+    int64_t block_m, int32_t* sorted_token_ids, int32_t* block_expert,
+    int32_t* splits, int64_t capacity) {
+  int64_t total = m * k;
+  std::vector<int64_t> count(num_experts, 0);
+  for (int64_t i = 0; i < total; ++i) {
+    int32_t e = topk_ids[i];
+    if (e < 0 || e >= num_experts) return -1;
+    count[e]++;
+  }
+  std::vector<int64_t> padded_off(num_experts + 1, 0);
+  for (int64_t e = 0; e < num_experts; ++e) {
+    splits[e] = (int32_t)count[e];
+    int64_t padded = (count[e] + block_m - 1) / block_m * block_m;
+    padded_off[e + 1] = padded_off[e] + padded;
+  }
+  int64_t used = padded_off[num_experts];
+  if (used > capacity) return -2;
+  for (int64_t i = 0; i < capacity; ++i) sorted_token_ids[i] = (int32_t)total;
+  std::vector<int64_t> cursor(padded_off.begin(), padded_off.end() - 1);
+  for (int64_t i = 0; i < total; ++i) {        // stable: ascending i
+    int32_t e = topk_ids[i];
+    sorted_token_ids[cursor[e]++] = (int32_t)i;
+  }
+  int64_t nblocks = capacity / block_m;
+  for (int64_t b = 0; b < nblocks; ++b) {
+    int64_t start = b * block_m;
+    int64_t e = (int64_t)(std::upper_bound(padded_off.begin() + 1,
+                                           padded_off.end(), start) -
+                          (padded_off.begin() + 1));
+    block_expert[b] = (int32_t)std::min<int64_t>(e, num_experts - 1);
+  }
+  return used;
+}
+
+// -------------------------------------------------------------- token dataset
+
+struct TdtpuDataset {
+  uint32_t* data;
+  uint64_t n_tokens;
+  uint64_t map_len;
+};
+
+// Opens an mmap'd file of little-endian uint32 tokens. Returns handle
+// (opaque pointer) or null.
+void* tdtpu_dataset_open(const char* path) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 4) { close(fd); return nullptr; }
+  void* m = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (m == MAP_FAILED) return nullptr;
+  auto* ds = new TdtpuDataset{(uint32_t*)m, (uint64_t)st.st_size / 4,
+                              (uint64_t)st.st_size};
+  return ds;
+}
+
+uint64_t tdtpu_dataset_len(void* handle) {
+  return ((TdtpuDataset*)handle)->n_tokens;
+}
+
+// splitmix64 — deterministic cross-platform sampling.
+static uint64_t splitmix64(uint64_t& s) {
+  uint64_t z = (s += 0x9E3779B97F4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+// Fill (batch, seqlen+1) with random contiguous windows — inputs and
+// shifted targets come from one window. Returns 0, or -1 if the file
+// is shorter than one window.
+int tdtpu_dataset_sample(void* handle, uint64_t seed, int64_t batch,
+                         int64_t seqlen, uint32_t* out) {
+  auto* ds = (TdtpuDataset*)handle;
+  int64_t window = seqlen + 1;
+  if (ds->n_tokens < (uint64_t)window) return -1;
+  uint64_t range = ds->n_tokens - window + 1;
+  uint64_t s = seed;
+  for (int64_t b = 0; b < batch; ++b) {
+    uint64_t off = splitmix64(s) % range;
+    memcpy(out + b * window, ds->data + off, window * 4);
+  }
+  return 0;
+}
+
+void tdtpu_dataset_close(void* handle) {
+  auto* ds = (TdtpuDataset*)handle;
+  munmap(ds->data, ds->map_len);
+  delete ds;
+}
+
+}  // extern "C"

@@ -1,0 +1,140 @@
+"""Tools tests: AOT, native library, profiler merge.
+
+Mirrors the reference's AOT path (compile_aot.py + triton_aot_runtime)
+and group_profile merge (utils.py:282-502).
+"""
+
+import gzip
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.tools import (
+    AotLibrary,
+    TokenDataset,
+    aot_compile,
+    aot_load,
+    artifact_read,
+    artifact_write,
+    group_profile,
+    merge_chrome_traces,
+    moe_align_block_size_host,
+)
+
+
+class TestAot:
+    def test_roundtrip(self, tmp_path):
+        def f(a, b):
+            return a @ b + 1
+
+        args = (jnp.ones((16, 32)), jnp.ones((32, 8)))
+        p = aot_compile(f, args, name="mm", cache_dir=tmp_path)
+        g = aot_load(p)
+        np.testing.assert_allclose(np.asarray(g(*args)), np.asarray(f(*args)))
+
+    def test_library_dispatch_and_disk_reload(self, tmp_path):
+        def f(a):
+            return a * 2
+
+        lib = AotLibrary(f, name="dbl", cache_dir=tmp_path)
+        lib.compile(jnp.ones((8, 8)))
+        # a fresh library instance must find the artifact on disk
+        lib2 = AotLibrary(f, name="dbl", cache_dir=tmp_path)
+        out = lib2(jnp.ones((8, 8)))
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+        # unseen shape falls back to jit
+        out2 = lib2(jnp.ones((4, 4)))
+        np.testing.assert_allclose(np.asarray(out2), 2.0)
+
+
+class TestNative:
+    def test_artifact_roundtrip(self, tmp_path):
+        blob = bytes(range(256)) * 100
+        path = str(tmp_path / "a.art")
+        artifact_write(path, blob)
+        assert artifact_read(path) == blob
+
+    def test_artifact_corruption_detected(self, tmp_path):
+        from triton_distributed_tpu.tools.native import native_lib
+
+        if native_lib() is None:
+            pytest.skip("native library unavailable")
+        path = str(tmp_path / "a.art")
+        artifact_write(path, b"payload-bytes-here")
+        raw = bytearray(pathlib.Path(path).read_bytes())
+        raw[20] ^= 0xFF                       # flip a payload byte
+        pathlib.Path(path).write_bytes(raw)
+        with pytest.raises(IOError):
+            artifact_read(path)
+
+    def test_artifact_cross_environment(self, tmp_path, monkeypatch):
+        """Native-written artifacts must be readable by the pure-python
+        path and vice versa (same framed on-disk format)."""
+        from triton_distributed_tpu.tools import native as nat
+
+        blob = b"cross-env-payload" * 50
+        p_native = str(tmp_path / "n.art")
+        artifact_write(p_native, blob)
+        # force the fallback reader
+        monkeypatch.setattr(nat, "_lib_cache", [None])
+        assert artifact_read(p_native) == blob
+        p_py = str(tmp_path / "p.art")
+        artifact_write(p_py, blob)              # python writer
+        monkeypatch.setattr(nat, "_lib_cache", [])
+        assert artifact_read(p_py) == blob      # native reader (if built)
+
+    def test_moe_align_rejects_bad_ids(self):
+        ids = np.array([[0, 16]], np.int32)     # 16 == num_experts
+        with pytest.raises(ValueError, match="out of range"):
+            moe_align_block_size_host(ids, 16, 8)
+
+    def test_moe_align_matches_jax(self):
+        from triton_distributed_tpu.kernels import moe_utils as mu
+
+        ids = np.random.default_rng(0).integers(0, 16, (64, 2)).astype(np.int32)
+        sti_n, be_n, spl_n = moe_align_block_size_host(ids, 16, 8)
+        sti_j, be_j, spl_j = mu.moe_align_block_size(jnp.asarray(ids), 16, 8)
+        np.testing.assert_array_equal(sti_n, np.asarray(sti_j))
+        np.testing.assert_array_equal(be_n, np.asarray(be_j))
+        np.testing.assert_array_equal(spl_n, np.asarray(spl_j))
+
+    def test_token_dataset(self, tmp_path):
+        toks = np.arange(5000, dtype=np.uint32)
+        path = tmp_path / "toks.bin"
+        toks.tofile(path)
+        ds = TokenDataset(str(path))
+        assert len(ds) == 5000
+        b = ds.sample(4, 64, seed=7)
+        assert b.shape == (4, 65)
+        for row in b:                          # contiguous windows
+            np.testing.assert_array_equal(
+                row, np.arange(row[0], row[0] + 65, dtype=np.uint32)
+            )
+        np.testing.assert_array_equal(b, ds.sample(4, 64, seed=7))
+        ds.close()
+
+
+class TestProfile:
+    def test_merge_remaps_pids(self, tmp_path):
+        for i in range(2):
+            sub = tmp_path / f"process-{i}" / "plugins" / "profile"
+            sub.mkdir(parents=True)
+            with gzip.open(sub / "host.trace.json.gz", "wt") as f:
+                json.dump(
+                    {"traceEvents": [{"pid": 1, "tid": 1, "name": f"op{i}"}]}, f
+                )
+        out = merge_chrome_traces(tmp_path)
+        ev = json.load(gzip.open(out, "rt"))["traceEvents"]
+        assert sorted(e["pid"] for e in ev) == [1, 100000001]
+
+    def test_merge_empty_returns_none(self, tmp_path):
+        assert merge_chrome_traces(tmp_path) is None
+
+    def test_group_profile_writes(self, tmp_path):
+        with group_profile(tmp_path):
+            jnp.dot(jnp.ones((32, 32)), jnp.ones((32, 32))).block_until_ready()
+        assert list(pathlib.Path(tmp_path).rglob("*"))

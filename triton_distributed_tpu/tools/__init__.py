@@ -1,0 +1,37 @@
+"""Tools package (L6): profiling, AOT compilation, native runtime utilities.
+
+≡ the reference's tools/ (compile.py, compile_aot.py, runtime/
+triton_aot_runtime.cc) and utils.group_profile (utils.py:417-502).
+"""
+
+from triton_distributed_tpu.tools.aot import (
+    AotLibrary,
+    aot_compile,
+    aot_compile_spaces,
+    aot_load,
+)
+from triton_distributed_tpu.tools.native import (
+    TokenDataset,
+    artifact_read,
+    artifact_write,
+    moe_align_block_size_host,
+    native_lib,
+)
+from triton_distributed_tpu.tools.profile import (
+    group_profile,
+    merge_chrome_traces,
+)
+
+__all__ = [
+    "aot_compile",
+    "aot_load",
+    "aot_compile_spaces",
+    "AotLibrary",
+    "group_profile",
+    "merge_chrome_traces",
+    "native_lib",
+    "artifact_write",
+    "artifact_read",
+    "moe_align_block_size_host",
+    "TokenDataset",
+]

@@ -1,0 +1,104 @@
+"""AOT compilation: serialize jitted programs, reload without retracing.
+
+Reference: tools/compile.py + tools/compile_aot.py (:61-116 —
+``aot_compile_spaces`` declares signature/grid spaces per kernel;
+:183-460 — generated C sources + dispatcher over function pointers;
+runtime tools/runtime/triton_aot_runtime.{h,cc} loads cubins via the
+cuLibrary API) and the ``USE_TRITON_DISTRIBUTED_AOT`` toggle
+(sp_flash_decode_layer.py:32-39).
+
+TPU re-design: XLA already owns codegen, so AOT is ``jit(fn).lower()``
+→ ``compile()`` → ``jax.export`` serialization. ``aot_compile_spaces``
+maps a signature *space* (the reference's dict of shape variants) to a
+set of serialized executables keyed by shape; ``AotLibrary`` is the
+dispatcher that picks the artifact matching the call shapes — the role
+of the generated C dispatcher. Artifacts are plain files, mmap-loaded
+by the C++ store (csrc/aot_store.cpp) where present, with a pure-python
+fallback.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+
+import jax
+
+from triton_distributed_tpu.tools.native import artifact_read, artifact_write
+
+
+def _key(name: str, shapes) -> str:
+    h = hashlib.sha256(json.dumps([name, shapes], sort_keys=True).encode())
+    return h.hexdigest()[:24]
+
+
+def _shapes_of(args):
+    return [[list(a.shape), str(a.dtype)] for a in args]
+
+
+def aot_compile(fn, example_args, *, name: str, cache_dir=".aot_cache"):
+    """Serialize ``jit(fn)`` specialized to ``example_args``' shapes.
+
+    Returns the artifact path. ≡ compile_aot.py generating one artifact
+    per (signature × config) point.
+    """
+    cache_dir = pathlib.Path(cache_dir)
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    exported = jax.export.export(jax.jit(fn))(*example_args)
+    blob = exported.serialize()
+    path = cache_dir / f"{name}-{_key(name, _shapes_of(example_args))}.jaxexp"
+    artifact_write(str(path), blob)
+    return path
+
+
+def aot_load(path):
+    """Reload a serialized program as a callable (no retracing; XLA
+    compiles the embedded StableHLO for the local topology —
+    ≡ CUDAModuleLoadData, triton_aot_runtime.cc:26-61)."""
+    blob = artifact_read(str(path))
+    exported = jax.export.deserialize(bytearray(blob))
+    return jax.jit(exported.call)
+
+
+class AotLibrary:
+    """Shape-dispatching store of AOT artifacts for one function
+    (≡ the generated dispatcher over function pointers,
+    compile_aot.py:183-460)."""
+
+    def __init__(self, fn, *, name: str, cache_dir=".aot_cache"):
+        self.fn = fn
+        self.name = name
+        self.cache_dir = pathlib.Path(cache_dir)
+        self._loaded: dict = {}
+
+    def compile(self, *example_args):
+        path = aot_compile(
+            self.fn, example_args, name=self.name, cache_dir=self.cache_dir
+        )
+        self._loaded[json.dumps(_shapes_of(example_args))] = aot_load(path)
+        return path
+
+    def __call__(self, *args):
+        key = json.dumps(_shapes_of(args))
+        loaded = self._loaded.get(key)
+        if loaded is None:
+            path = self.cache_dir / (
+                f"{self.name}-{_key(self.name, _shapes_of(args))}.jaxexp"
+            )
+            if path.exists():
+                loaded = aot_load(path)
+            else:
+                loaded = jax.jit(self.fn)   # fallback: JIT on miss
+            self._loaded[key] = loaded
+        return loaded(*args)
+
+
+def aot_compile_spaces(fn, spaces, *, name: str, cache_dir=".aot_cache"):
+    """Pre-build a signature space (≡ aot_compile_spaces decorator,
+    compile_aot.py:61-116): ``spaces`` is a list of example-arg tuples;
+    returns the populated :class:`AotLibrary`."""
+    lib = AotLibrary(fn, name=name, cache_dir=cache_dir)
+    for example in spaces:
+        lib.compile(*example)
+    return lib

@@ -1,0 +1,227 @@
+"""ctypes bindings for the native runtime library (csrc/tdtpu_native.cpp).
+
+Reference: csrc/{op_pybind.cc,registry.cc} expose CUDA host utilities
+into Python via pybind11/torch; here the binding layer is ctypes over a
+plain C ABI (pybind11 is not in this toolchain) and the library is
+built on first use with g++ (cached under csrc/build/). Every entry
+point has a pure-python fallback so the package works where no
+compiler exists — the native path is the fast path, not a hard
+dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import struct
+import subprocess
+import threading
+
+import numpy as np
+
+_ART_MAGIC = 0x5452415550544454          # "TDTPUART" little-endian
+_FNV_OFF, _FNV_PRIME = 1469598103934665603, 1099511628211
+
+
+def _fnv1a(data: bytes) -> int:
+    h = _FNV_OFF
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+_ROOT = pathlib.Path(__file__).resolve().parents[2]
+_SRC = _ROOT / "csrc" / "tdtpu_native.cpp"
+_SO = _ROOT / "csrc" / "build" / "libtdtpu_native.so"
+_lock = threading.Lock()
+_lib_cache: list = []          # [lib or None] once resolved
+
+
+def _build() -> bool:
+    _SO.parent.mkdir(parents=True, exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", str(_SO), str(_SRC)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def native_lib():
+    """The loaded library, or None (build failed / disabled)."""
+    with _lock:
+        if _lib_cache:
+            return _lib_cache[0]
+        lib = None
+        if os.environ.get("TDTPU_NO_NATIVE") != "1":
+            fresh = _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime
+            if fresh or _build():
+                try:
+                    lib = ctypes.CDLL(str(_SO))
+                    u8p = ctypes.POINTER(ctypes.c_uint8)
+                    lib.tdtpu_artifact_write.argtypes = [
+                        ctypes.c_char_p, u8p, ctypes.c_uint64]
+                    lib.tdtpu_artifact_size.restype = ctypes.c_int64
+                    lib.tdtpu_artifact_size.argtypes = [ctypes.c_char_p]
+                    lib.tdtpu_artifact_read.argtypes = [
+                        ctypes.c_char_p, u8p, ctypes.c_uint64]
+                    lib.tdtpu_moe_align_block_size.restype = ctypes.c_int64
+                    lib.tdtpu_dataset_open.restype = ctypes.c_void_p
+                    lib.tdtpu_dataset_len.restype = ctypes.c_uint64
+                    lib.tdtpu_dataset_close.argtypes = [ctypes.c_void_p]
+                    lib.tdtpu_dataset_len.argtypes = [ctypes.c_void_p]
+                except OSError:
+                    lib = None
+        _lib_cache.append(lib)
+        return lib
+
+
+# ------------------------------------------------------------------ artifact
+
+def artifact_write(path: str, blob: bytes) -> None:
+    """Atomic checksummed write. Both paths emit the SAME on-disk format
+    (magic | len | payload | fnv1a) so artifacts stay readable across
+    hosts with and without the native library."""
+    lib = native_lib()
+    if lib is not None:
+        buf = (ctypes.c_uint8 * len(blob)).from_buffer_copy(blob)
+        rc = lib.tdtpu_artifact_write(path.encode(), buf, len(blob))
+        if rc == 0:
+            return
+    framed = (
+        struct.pack("<QQ", _ART_MAGIC, len(blob)) + blob
+        + struct.pack("<Q", _fnv1a(blob))
+    )
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(framed)
+    os.replace(tmp, path)
+
+
+def artifact_read(path: str) -> bytes:
+    lib = native_lib()
+    if lib is not None:
+        size = lib.tdtpu_artifact_size(path.encode())
+        if size >= 0:
+            out = (ctypes.c_uint8 * size)()
+            rc = lib.tdtpu_artifact_read(path.encode(), out, size)
+            if rc == -3:
+                raise IOError(f"artifact checksum mismatch: {path}")
+            if rc == 0:
+                return bytes(out)
+    raw = pathlib.Path(path).read_bytes()
+    if len(raw) >= 24:
+        magic, length = struct.unpack_from("<QQ", raw, 0)
+        if magic == _ART_MAGIC and len(raw) == 24 + length:
+            payload = raw[16 : 16 + length]
+            (stored,) = struct.unpack_from("<Q", raw, 16 + length)
+            if _fnv1a(payload) != stored:
+                raise IOError(f"artifact checksum mismatch: {path}")
+            return payload
+    return raw                     # pre-framing legacy file: raw payload
+
+
+# ----------------------------------------------------------------- moe align
+
+def moe_align_block_size_host(topk_ids, num_experts: int, block_m: int):
+    """Host (numpy) twin of kernels/moe_utils.moe_align_block_size —
+    native-accelerated token sort/pad for CPU-side preprocessing
+    (≡ moe_ag_scatter_align_block_size, csrc/lib/moe_utils.cu:61-356).
+    Returns (sorted_token_ids, block_expert, splits) numpy arrays."""
+    ids = np.ascontiguousarray(topk_ids, dtype=np.int32)
+    if ids.size and (ids.min() < 0 or ids.max() >= num_experts):
+        raise ValueError(
+            f"expert ids out of range [0, {num_experts}): "
+            f"[{ids.min()}, {ids.max()}]"
+        )
+    m, k = ids.shape
+    total = m * k
+    cap = int(np.ceil((total + num_experts * (block_m - 1)) / block_m)) * block_m
+    lib = native_lib()
+    if lib is not None:
+        sti = np.empty((cap,), np.int32)
+        be = np.empty((cap // block_m,), np.int32)
+        splits = np.empty((num_experts,), np.int32)
+        rc = lib.tdtpu_moe_align_block_size(
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int64(m), ctypes.c_int64(k),
+            ctypes.c_int64(num_experts), ctypes.c_int64(block_m),
+            sti.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            be.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            splits.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int64(cap),
+        )
+        if rc < 0:
+            raise RuntimeError(
+                f"tdtpu_moe_align_block_size failed (rc={rc})"
+            )
+        return sti, be, splits
+    # numpy fallback — same layout contract
+    flat = ids.reshape(-1)
+    splits = np.bincount(flat, minlength=num_experts).astype(np.int32)
+    padded = (splits + block_m - 1) // block_m * block_m
+    padded_offs = np.concatenate([[0], np.cumsum(padded)[:-1]]).astype(np.int64)
+    offs = np.concatenate([[0], np.cumsum(splits)[:-1]]).astype(np.int64)
+    order = np.argsort(flat, kind="stable").astype(np.int32)
+    se = flat[order]
+    dest = padded_offs[se] + (np.arange(total) - offs[se])
+    sti = np.full((cap,), total, np.int32)
+    sti[dest] = order
+    starts = np.arange(cap // block_m) * block_m
+    be = np.searchsorted(np.cumsum(padded), starts, side="right").astype(np.int32)
+    be = np.clip(be, 0, num_experts - 1)
+    return sti, be, splits
+
+
+# -------------------------------------------------------------- token dataset
+
+class TokenDataset:
+    """mmap'd uint32 token file with seeded random-window sampling — the
+    native IO path of the training loop. ``sample`` returns
+    (batch, seqlen+1) uint32: inputs = [:, :-1], targets = [:, 1:]."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._lib = native_lib()
+        self._handle = None
+        if self._lib is not None:
+            self._handle = self._lib.tdtpu_dataset_open(self.path.encode())
+        if self._handle is None:
+            self._mm = np.memmap(self.path, dtype=np.uint32, mode="r")
+
+    def __len__(self):
+        if self._handle is not None:
+            return int(self._lib.tdtpu_dataset_len(self._handle))
+        return int(self._mm.shape[0])
+
+    def sample(self, batch: int, seqlen: int, seed: int):
+        out = np.empty((batch, seqlen + 1), np.uint32)
+        if self._handle is not None:
+            rc = self._lib.tdtpu_dataset_sample(
+                ctypes.c_void_p(self._handle), ctypes.c_uint64(seed),
+                ctypes.c_int64(batch), ctypes.c_int64(seqlen),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            )
+            if rc == 0:
+                return out
+            raise ValueError(f"dataset shorter than seqlen+1={seqlen + 1}")
+        n = len(self)
+        if n < seqlen + 1:
+            raise ValueError(f"dataset shorter than seqlen+1={seqlen + 1}")
+        rng = np.random.default_rng(seed)
+        offs = rng.integers(0, n - seqlen, size=batch)
+        for b, off in enumerate(offs):
+            out[b] = self._mm[off : off + seqlen + 1]
+        return out
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.tdtpu_dataset_close(ctypes.c_void_p(self._handle))
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,85 @@
+"""Profiling tools: per-process traces gathered into one timeline.
+
+Reference: utils.group_profile (python/triton_dist/utils.py:417-502) —
+torch.profiler per rank → chrome traces → gather to rank0 over
+torch.distributed → pid/tid remap by rank*1e8 → one merged, gzipped
+timeline (merge machinery :282-414).
+
+TPU re-design: ``group_profile`` wraps ``jax.profiler.trace`` writing
+one subdir per process (the profiler is already whole-device — every
+TPU op lands in the trace, no per-kernel hooks needed), and
+``merge_chrome_traces`` performs the same pid-offset merge over any
+chrome-format ``*.trace.json(.gz)`` the runs produced. On multi-host
+deployments each host writes to the shared log dir; the merge runs
+wherever the files are visible (no in-band gather needed — TPU pods
+mount shared storage, unlike the reference's NCCL gather).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import pathlib
+
+import jax
+
+PID_STRIDE = 10**8   # ≡ the reference's rank*1e8 remap (utils.py:330)
+
+
+@contextlib.contextmanager
+def group_profile(log_dir=".profiles", *, enabled: bool = True,
+                  create_perfetto_trace: bool = False):
+    """Trace the enclosed block on every process (≡ group_profile,
+    utils.py:417). Writes ``<log_dir>/process-<i>/``."""
+    if not enabled:
+        yield None
+        return
+    path = pathlib.Path(log_dir) / f"process-{jax.process_index()}"
+    path.mkdir(parents=True, exist_ok=True)
+    with jax.profiler.trace(
+        str(path), create_perfetto_trace=create_perfetto_trace
+    ):
+        yield path
+
+
+def _load_trace(fname):
+    op = gzip.open if fname.endswith(".gz") else open
+    with op(fname, "rt") as f:
+        data = json.load(f)
+    return data["traceEvents"] if isinstance(data, dict) else data
+
+
+def merge_chrome_traces(log_dir=".profiles", out="merged_trace.json.gz"):
+    """Merge every chrome trace under ``log_dir`` into one timeline,
+    remapping pids by process index (≡ utils.py:282-414). Returns the
+    output path, or None if no traces were found."""
+    log_dir = pathlib.Path(log_dir)
+    merged = []
+    found = False
+    for proc_dir in sorted(log_dir.glob("process-*")):
+        try:
+            idx = int(proc_dir.name.split("-")[1])
+        except (IndexError, ValueError):
+            continue
+        pats = ("**/*.trace.json.gz", "**/*.trace.json", "**/trace.json.gz")
+        files = sorted({f for p in pats for f in glob.glob(
+            str(proc_dir / p), recursive=True)})
+        for fname in files:
+            found = True
+            for ev in _load_trace(fname):
+                ev = dict(ev)
+                if "pid" in ev:
+                    try:
+                        ev["pid"] = int(ev["pid"]) + idx * PID_STRIDE
+                    except (TypeError, ValueError):
+                        pass
+                merged.append(ev)
+    if not found:
+        return None
+    out_path = log_dir / out
+    with gzip.open(out_path, "wt") as f:
+        json.dump({"traceEvents": merged}, f)
+    return out_path

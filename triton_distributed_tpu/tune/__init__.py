@@ -1,0 +1,34 @@
+"""Tuning package (L6): contextual autotuner + analytical perf models.
+
+≡ python/triton_dist/autotuner.py (thunk-level distributed autotune
+with cross-rank consensus) and kernels/nvidia/{comm,gemm}_perf_model.py
+(speed-of-light estimators keyed by device generation).
+"""
+
+from triton_distributed_tpu.tune.autotuner import (
+    ContextualAutoTuner,
+    contextual_autotune,
+)
+from triton_distributed_tpu.tune.perf_model import (
+    TPU_SPECS,
+    TpuSpec,
+    detect_spec,
+    estimate_all_gather_ms,
+    estimate_all_to_all_ms,
+    estimate_gemm_ms,
+    estimate_reduce_scatter_ms,
+    overlap_efficiency,
+)
+
+__all__ = [
+    "ContextualAutoTuner",
+    "contextual_autotune",
+    "TPU_SPECS",
+    "TpuSpec",
+    "detect_spec",
+    "estimate_gemm_ms",
+    "estimate_all_gather_ms",
+    "estimate_reduce_scatter_ms",
+    "estimate_all_to_all_ms",
+    "overlap_efficiency",
+]

@@ -1,0 +1,138 @@
+"""Contextual autotuner with distributed consensus.
+
+Reference: python/triton_dist/autotuner.py — ``ContextualAutoTuner`` /
+``contextual_autotune(is_dist=True)`` (:97-253): tunes whole *thunks*
+(not single kernels) because distributed kernels are not side-effect
+free; resumable iterator-based benching across failing configs
+(:78-94); per-rank logs (:57-67); and the load-bearing trick —
+**distributed consensus: all-reduce MAX of per-config times so every
+rank picks the same config** (:225-238), without which ranks deadlock
+inside mismatched collectives.
+
+TPU re-design: a decorator that benchmarks each config by running the
+wrapped callable end to end (``perf_func``), skipping configs that fail
+to compile or run (the reference's KernelError retry loop). Consensus
+runs the same MAX-reduction across *processes* via
+``multihost_utils.process_allgather`` — on a single process it is a
+no-op, exactly like the reference's single-rank path. Winning configs
+are cached in memory per (name, shape-key) and appended to a JSONL log
+(``TDTPU_AUTOTUNE_LOG_DIR``, default ``.autotune_logs/``), one file per
+process like the reference's ``.autotune_logs/rank-N.log``.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import pathlib
+import time
+import traceback
+
+import jax
+import numpy as np
+
+from triton_distributed_tpu.utils.timing import perf_func
+
+
+def _shape_key(args, kwargs):
+    def one(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return (tuple(x.shape), str(x.dtype))
+        if isinstance(x, (int, float, str, bool, type(None))):
+            return x
+        return type(x).__name__
+    return (
+        tuple(one(a) for a in args),
+        tuple(sorted((k, one(v)) for k, v in kwargs.items())),
+    )
+
+
+def _consensus_times(times: np.ndarray) -> np.ndarray:
+    """MAX of per-config timings across processes (≡ the all-reduce at
+    autotuner.py:225-238): every process then argmins the same vector,
+    so collective code paths stay aligned. Failed configs carry +inf and
+    stay +inf for everyone."""
+    if jax.process_count() == 1:
+        return times
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(times)   # (procs, cfgs)
+    return np.max(np.asarray(gathered), axis=0)
+
+
+class ContextualAutoTuner:
+    """Tune ``fn(*args, **cfg)`` over ``configs`` (list of kwarg dicts)."""
+
+    def __init__(self, fn, configs, *, name=None, warmup=1, iters=5, log=True):
+        self.fn = fn
+        self.configs = list(configs)
+        self.name = name or getattr(fn, "__name__", "thunk")
+        self.warmup = warmup
+        self.iters = iters
+        self.log = log
+        self.cache: dict = {}
+        functools.update_wrapper(self, fn)
+
+    def _log_path(self):
+        d = pathlib.Path(os.environ.get("TDTPU_AUTOTUNE_LOG_DIR", ".autotune_logs"))
+        d.mkdir(parents=True, exist_ok=True)
+        return d / f"process-{jax.process_index()}.jsonl"
+
+    def _bench(self, args, kwargs):
+        times = np.full((len(self.configs),), np.inf)
+        for i, cfg in enumerate(self.configs):
+            try:
+                _, ms = perf_func(
+                    lambda: self.fn(*args, **kwargs, **cfg),
+                    warmup=self.warmup, iters=self.iters,
+                )
+                times[i] = ms
+            except Exception:
+                # a config that fails anywhere must fail everywhere —
+                # +inf survives the MAX consensus (≡ KernelError skip,
+                # autotuner.py:78-94)
+                if self.log:
+                    with open(self._log_path(), "a") as f:
+                        f.write(json.dumps({
+                            "name": self.name, "config": self.configs[i],
+                            "error": traceback.format_exc(limit=1),
+                        }) + "\n")
+        return _consensus_times(times)
+
+    def __call__(self, *args, **kwargs):
+        key = (self.name, _shape_key(args, kwargs))
+        best = self.cache.get(key)
+        if best is None:
+            times = self._bench(args, kwargs)
+            idx = int(np.argmin(times))
+            if not np.isfinite(times[idx]):
+                raise RuntimeError(
+                    f"autotune({self.name}): every config failed"
+                )
+            best = self.configs[idx]
+            self.cache[key] = best
+            if self.log:
+                with open(self._log_path(), "a") as f:
+                    f.write(json.dumps({
+                        "name": self.name, "key": str(key[1]),
+                        "best": best, "ms": float(times[idx]),
+                        "times": [None if not np.isfinite(t) else float(t)
+                                  for t in times],
+                        "ts": time.time(),
+                    }) + "\n")
+        return self.fn(*args, **kwargs, **best)
+
+
+def contextual_autotune(configs, **opts):
+    """Decorator form (≡ contextual_autotune, autotuner.py:97)::
+
+        @contextual_autotune(configs=[{"block_m": 128}, {"block_m": 256}])
+        def step(x, w, *, block_m):
+            return grouped_matmul(x, w, ..., block_m=block_m)
+    """
+
+    def wrap(fn):
+        return ContextualAutoTuner(fn, configs, **opts)
+
+    return wrap

@@ -1,0 +1,96 @@
+"""Analytical performance models for TPU generations.
+
+Reference: python/triton_dist/kernels/nvidia/comm_perf_model.py (NIC /
+NVLink / PCIe bandwidth discovery, ``estimate_reduce_scatter_time``
+:91) and gemm_perf_model.py (tensor-core TFLOPS tables by device name,
+``estimate_gemm_sol_time_ms`` :233) — used to pick SM budgets and
+sanity-check measured numbers.
+
+TPU re-design: per-generation datasheet tables (MXU TFLOPS, HBM GB/s,
+ICI GB/s per link and links per chip) + speed-of-light estimators for
+the collectives this framework ships (ring AG/RS, dense A2A, LL small
+messages). The same two consumers: engine auto-selection thresholds and
+"is this measurement sane" checks in benches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+
+
+@dataclass(frozen=True)
+class TpuSpec:
+    name: str
+    bf16_tflops: float       # peak MXU, per chip
+    hbm_gbps: float          # HBM bandwidth, per chip
+    ici_gbps: float          # ICI bandwidth per link, per direction
+    ici_links: int           # torus links per chip
+
+
+# Public datasheet numbers (cloud.google.com/tpu/docs/system-architecture).
+TPU_SPECS = {
+    "v4": TpuSpec("v4", 275.0, 1228.0, 50.0, 6),
+    "v5e": TpuSpec("v5e", 197.0, 819.0, 50.0, 4),
+    "v5p": TpuSpec("v5p", 459.0, 2765.0, 100.0, 6),
+    "v6e": TpuSpec("v6e", 918.0, 1640.0, 100.0, 4),
+}
+_DEFAULT = TPU_SPECS["v5e"]
+
+
+def detect_spec(device=None) -> TpuSpec:
+    """Map jax's device_kind onto a spec row (≡ get_device_name-keyed
+    tables, gemm_perf_model.py). Unknown kinds fall back to v5e."""
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, spec in TPU_SPECS.items():
+        if key in kind.replace(" ", "").replace("lite", "e"):
+            return spec
+    if "v5" in kind:
+        return TPU_SPECS["v5e" if "lite" in kind else "v5p"]
+    return _DEFAULT
+
+
+def estimate_gemm_ms(m: int, k: int, n: int, spec: TpuSpec | None = None,
+                     efficiency: float = 0.75) -> float:
+    """Speed-of-light matmul time (≡ estimate_gemm_sol_time_ms,
+    gemm_perf_model.py:233): max of MXU flops time and HBM traffic time."""
+    spec = spec or detect_spec()
+    flops_ms = (2 * m * k * n) / (spec.bf16_tflops * 1e12 * efficiency) * 1e3
+    bytes_moved = 2 * (m * k + k * n + m * n)
+    mem_ms = bytes_moved / (spec.hbm_gbps * 1e9) * 1e3
+    return max(flops_ms, mem_ms)
+
+
+def estimate_all_gather_ms(shard_bytes: int, n: int,
+                           spec: TpuSpec | None = None) -> float:
+    """Bidirectional-ring AG over ICI: each chip receives (n-1) shards
+    across 2 directions (≡ estimate_allgather in comm_perf_model)."""
+    spec = spec or detect_spec()
+    wire = shard_bytes * (n - 1) / 2
+    return wire / (spec.ici_gbps * 1e9) * 1e3
+
+
+def estimate_reduce_scatter_ms(shard_bytes: int, n: int,
+                               spec: TpuSpec | None = None) -> float:
+    """Ring RS moves the same wire bytes as ring AG
+    (≡ estimate_reduce_scatter_time, comm_perf_model.py:91)."""
+    return estimate_all_gather_ms(shard_bytes, n, spec)
+
+
+def estimate_all_to_all_ms(local_bytes: int, n: int,
+                           spec: TpuSpec | None = None) -> float:
+    """Dense A2A: (n-1)/n of the local buffer crosses the bisection;
+    on a torus every chip drives ici_links links concurrently."""
+    spec = spec or detect_spec()
+    wire = local_bytes * (n - 1) / n
+    return wire / (spec.ici_gbps * spec.ici_links * 1e9) * 1e3
+
+
+def overlap_efficiency(compute_ms: float, comm_ms: float) -> float:
+    """Fraction of comm hidden if perfectly pipelined under compute —
+    the 'overlap %' north-star metric (BASELINE.json)."""
+    if comm_ms <= 0:
+        return 1.0
+    return min(compute_ms, comm_ms) / comm_ms
