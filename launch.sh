@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Multi-host launcher for triton_distributed_tpu programs.
+#
+# ≡ reference launch.sh (torchrun + NVSHMEM env, launch.sh:1-41): one
+# process per host, rendezvous via env vars that
+# runtime.initialize_distributed() consumes (jax.distributed bootstrap
+# replaces the NCCL process group + NVSHMEM uniqueid broadcast).
+#
+# Usage:
+#   Single host (real chips or dev CPU mesh):
+#     bash launch.sh python tutorials/06-ag-gemm.py
+#     TDTPU_LOCAL_DEVICES=8 bash launch.sh python my_script.py   # CPU mesh
+#
+#   Multi-host (run on EVERY host, e.g. via `gcloud compute tpus tpu-vm
+#   ssh --worker=all --command=...`; on Cloud TPU pods the three vars
+#   are auto-detected by jax and may be omitted):
+#     JAX_COORDINATOR_ADDRESS=host0:8476 \
+#     JAX_NUM_PROCESSES=4 JAX_PROCESS_ID=$(hostname_index) \
+#     bash launch.sh python train.py
+set -euo pipefail
+
+# Dev convenience: a virtual CPU mesh of N devices (the test-harness env).
+if [[ -n "${TDTPU_LOCAL_DEVICES:-}" ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${TDTPU_LOCAL_DEVICES}"
+  export JAX_PLATFORMS=cpu
+fi
+
+export JAX_TRACEBACK_FILTERING="${JAX_TRACEBACK_FILTERING:-auto}"
+
+# Quiet the usual noise, mirroring NCCL_DEBUG=ERROR in the reference.
+export TPU_STDERR_LOG_LEVEL="${TPU_STDERR_LOG_LEVEL:-3}"
+export TF_CPP_MIN_LOG_LEVEL="${TF_CPP_MIN_LOG_LEVEL:-2}"
+
+if [[ $# -eq 0 ]]; then
+  echo "usage: launch.sh <command...>   (e.g. launch.sh python train.py)" >&2
+  exit 64
+fi
+
+exec "$@"

@@ -1,0 +1,47 @@
+"""Tutorial 04: MoE low-latency AllToAll (EP dispatch/combine).
+
+≡ reference tutorial 04 (DeepEP-style a2a, low_latency_all_to_all.py):
+tokens sorted by destination expert ride per-peer padded slots with
+their counts packed in the same RDMA payload; the combine leg returns
+processed tokens to their owners.
+"""
+
+from _common import get_mesh
+
+mesh = get_mesh()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.kernels import moe_all_to_all as ma
+
+n, epr, H, M = mesh.shape["x"], 2, 128, 16
+E = n * epr
+ctx = ma.create_all_to_all_context(
+    mesh, "x", max_m=M, hidden=H, experts_per_rank=epr, dtype=jnp.float32
+)
+
+rng = np.random.default_rng(0)
+assign = np.sort(rng.integers(0, E, (n, M)), axis=1)
+splits = np.stack([np.bincount(a, minlength=E) for a in assign]).astype(np.int32)
+toks = rng.standard_normal((n, M, H)).astype(np.float32)
+
+sh = NamedSharding(mesh, P("x"))
+stage = jax.jit(jax.shard_map(
+    lambda t, s: ma.pack_slots(ctx, *ma.dispatch_stage(ctx, t, s)),
+    mesh=mesh, in_specs=(P("x"), P("x")), out_specs=P("x"), check_vma=False))
+send = stage(jax.device_put(jnp.asarray(toks).reshape(n * M, H), sh),
+             jax.device_put(jnp.asarray(splits).reshape(n * E), sh))
+recv = ma.fast_all_to_all(ctx, send)              # dispatch: one RDMA per peer
+back_in = jax.jit(jax.shard_map(
+    lambda r: ma.combine_stage(ctx, ma.recv_tokens_view(ctx, r)[0]),
+    mesh=mesh, in_specs=P("x"), out_specs=P("x"), check_vma=False))(recv)
+comb = ma.fast_all_to_all(ctx, back_in)           # combine: the return leg
+out = jax.jit(jax.shard_map(
+    lambda c, s: ma.combine_unstage(ctx, ma.combine_unpack(ctx, c), s, M),
+    mesh=mesh, in_specs=(P("x"), P("x")), out_specs=P("x"), check_vma=False))(
+        comb, jax.device_put(jnp.asarray(splits).reshape(n * E), sh))
+np.testing.assert_allclose(np.asarray(out).reshape(n, M, H), toks, rtol=1e-6)
+print("tutorial 04 OK: dispatch/combine round-trip is exact")

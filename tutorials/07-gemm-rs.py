@@ -1,0 +1,26 @@
+"""Tutorial 07: fused GEMM-ReduceScatter (the dual overlap op).
+
+≡ reference tutorial 08 / test_gemm_rs.py: the row-parallel matmul's
+partial outputs feed the ring reduce-scatter as they complete.
+"""
+
+from _common import get_mesh
+
+mesh = get_mesh()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu import ops
+
+M, K, N = 256, 512, 128
+ctx = ops.create_gemm_rs_context(mesh, "x")
+a = jax.random.normal(jax.random.PRNGKey(0), (M, K), jnp.float32)
+b = jax.random.normal(jax.random.PRNGKey(1), (K, N), jnp.float32)
+ag = jax.device_put(a, NamedSharding(mesh, P(None, "x")))
+bg = jax.device_put(b, NamedSharding(mesh, P("x", None)))
+y = ops.gemm_rs(ag, bg, ctx)
+np.testing.assert_allclose(np.asarray(y), np.asarray(a @ b), atol=2e-4, rtol=2e-4)
+print("tutorial 07 OK: fused GEMM-RS == dot -> reduce_scatter")

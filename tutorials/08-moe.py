@@ -1,0 +1,52 @@
+"""Tutorial 08: MoE both ways — expert-parallel and tensor-parallel.
+
+≡ reference test_ep_moe_inference.py (EP over the a2a) and
+test_ag_moe.py / test_moe_reduce_rs.py (MoE TP): the same router +
+expert weights, two distributions of work.
+"""
+
+from _common import get_mesh
+
+mesh = get_mesh()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu import ops
+from triton_distributed_tpu.kernels import moe_utils as mu
+
+n = mesh.shape["x"]
+E, topk, H, F, Mtok = 2 * n, 2, 128, 256, 16
+x = jax.random.normal(jax.random.PRNGKey(0), (n * Mtok, H), jnp.float32)
+logits = jax.random.normal(jax.random.PRNGKey(1), (n * Mtok, E))
+w_up = jax.random.normal(jax.random.PRNGKey(2), (E, H, F), jnp.float32) * 0.05
+w_down = jax.random.normal(jax.random.PRNGKey(3), (E, F, H), jnp.float32) * 0.05
+weights, ids = mu.select_experts(logits, topk)
+ref = jnp.zeros((n * Mtok, H))
+for t in range(topk):
+    h = jax.nn.silu(jnp.einsum("mh,mhf->mf", x, w_up[ids[:, t]]))
+    ref += weights[:, t:t + 1] * jnp.einsum("mf,mfh->mh", h, w_down[ids[:, t]])
+
+rows = NamedSharding(mesh, P("x"))
+# --- EP: experts sharded over ranks, tokens dispatched to them
+ep = ops.create_ep_moe_context(mesh, "x", num_experts=E, topk=topk,
+                               max_m=Mtok * topk, hidden=H,
+                               dtype=jnp.float32, block_m=8)
+y_ep = ops.ep_moe(jax.device_put(x, rows), jax.device_put(logits, rows),
+                  jax.device_put(w_up, rows), jax.device_put(w_down, rows), ep)
+np.testing.assert_allclose(np.asarray(y_ep), np.asarray(ref), atol=1e-4)
+print("  EP MoE OK")
+
+# --- TP: every rank holds a column slice of every expert
+from triton_distributed_tpu.layers import MoETPMLP
+tp = ops.create_ag_group_gemm_context(mesh, "x", num_experts=E, topk=topk,
+                                      block_m=8, dtype=jnp.float32)
+y_tp = MoETPMLP(tp)(
+    {"up": jax.device_put(w_up, NamedSharding(mesh, P(None, None, "x"))),
+     "down": jax.device_put(w_down, NamedSharding(mesh, P(None, "x")))},
+    jax.device_put(x, rows), ids, weights)
+np.testing.assert_allclose(np.asarray(y_tp), np.asarray(ref), atol=1e-4)
+print("  TP MoE OK")
+print("tutorial 08 OK: EP and TP MoE agree with the dense reference")

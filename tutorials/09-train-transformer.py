@@ -1,0 +1,38 @@
+"""Tutorial 09: train the flagship transformer on a dp x tp mesh.
+
+Beyond the reference's scope (it ships kernels, not a trainer): every
+projection runs through the fused overlap ops, the MoE block through
+the EP a2a, gradients reduce over dp — one jitted program.
+"""
+
+from _common import get_mesh
+
+mesh1d = get_mesh()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.models import Transformer, TransformerConfig
+
+devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+mesh = Mesh(devs, ("dp", "tp"))
+cfg = TransformerConfig(vocab=128, n_layers=2, hidden=128, ffn=256,
+                        n_heads=8, n_kv_heads=4, head_dim=16,
+                        moe="ep", moe_layers=(1,), num_experts=8, topk=2,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
+model = Transformer(cfg, mesh, "tp", ("dp",))
+params = jax.tree.map(lambda p, s: jax.device_put(p, s),
+                      model.init(jax.random.PRNGKey(0)), model.shardings())
+toks = jax.device_put(
+    jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 128),
+    NamedSharding(mesh, P("dp")))
+step = jax.jit(model.train_step)
+losses = []
+for _ in range(3):
+    loss, params = step(params, toks, toks)
+    losses.append(float(loss))
+print("losses:", [f"{l:.4f}" for l in losses])
+assert losses[-1] < losses[0]
+print("tutorial 09 OK: loss decreases under dp x tp training")
