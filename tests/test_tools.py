@@ -138,3 +138,70 @@ class TestProfile:
         with group_profile(tmp_path):
             jnp.dot(jnp.ones((32, 32)), jnp.ones((32, 32))).block_until_ready()
         assert list(pathlib.Path(tmp_path).rglob("*"))
+
+
+class TestCheckpoint:
+    def test_roundtrip_with_resharding(self, mesh8, tmp_path):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from triton_distributed_tpu.tools import (
+            restore_checkpoint,
+            save_checkpoint,
+        )
+
+        params = {
+            "w": jax.device_put(
+                jnp.arange(64.0).reshape(8, 8),
+                NamedSharding(mesh8, P("x", None)),
+            ),
+            "b": jnp.zeros((4,)),
+            "nested": [jnp.ones((2, 2)), jnp.full((3,), 7)],
+        }
+        path = tmp_path / "ckpt"
+        save_checkpoint(path, params)
+        # restore onto a DIFFERENT sharding for w
+        like = dict(params)
+        like["w"] = jax.device_put(
+            jnp.zeros((8, 8)), NamedSharding(mesh8, P(None, "x"))
+        )
+        out = restore_checkpoint(path, like)
+        np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(params["w"]))
+        assert out["w"].sharding.spec == P(None, "x")
+        np.testing.assert_array_equal(np.asarray(out["nested"][1]), 7)
+
+    def test_shape_mismatch_raises(self, tmp_path):
+        from triton_distributed_tpu.tools import (
+            restore_checkpoint,
+            save_checkpoint,
+        )
+
+        save_checkpoint(tmp_path / "c", {"w": jnp.zeros((4,))})
+        with pytest.raises(ValueError, match="shape mismatch"):
+            restore_checkpoint(tmp_path / "c", {"w": jnp.zeros((5,))})
+
+    def test_manager_retention_and_latest(self, tmp_path):
+        from triton_distributed_tpu.tools import CheckpointManager
+
+        mgr = CheckpointManager(tmp_path, keep=2)
+        assert mgr.latest_step() is None
+        assert mgr.restore({"w": jnp.zeros((2,))}) is None
+        for s in (1, 5, 9):
+            mgr.save(s, {"w": jnp.full((2,), float(s))})
+        assert mgr.latest_step() == 9
+        assert sorted(p.name for p in tmp_path.iterdir()) == ["step_5", "step_9"]
+        out = mgr.restore({"w": jnp.zeros((2,))})
+        np.testing.assert_allclose(np.asarray(out["w"]), 9.0)
+        out5 = mgr.restore({"w": jnp.zeros((2,))}, step=5)
+        np.testing.assert_allclose(np.asarray(out5["w"]), 5.0)
+
+    def test_structure_mismatch_raises(self, tmp_path):
+        from triton_distributed_tpu.tools import (
+            restore_checkpoint,
+            save_checkpoint,
+        )
+
+        save_checkpoint(tmp_path / "c", {"a": jnp.zeros((4,)), "b": jnp.ones((4,))})
+        with pytest.raises(ValueError, match="tree structure"):
+            restore_checkpoint(
+                tmp_path / "c", {"a": jnp.zeros((4,)), "c": jnp.ones((4,))}
+            )

@@ -4,6 +4,11 @@
 triton_aot_runtime.cc) and utils.group_profile (utils.py:417-502).
 """
 
+from triton_distributed_tpu.tools.checkpoint import (
+    CheckpointManager,
+    restore_checkpoint,
+    save_checkpoint,
+)
 from triton_distributed_tpu.tools.aot import (
     AotLibrary,
     aot_compile,
@@ -34,4 +39,7 @@ __all__ = [
     "artifact_read",
     "moe_align_block_size_host",
     "TokenDataset",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "CheckpointManager",
 ]

@@ -1,0 +1,132 @@
+"""Sharding-aware checkpoint save/restore + step management.
+
+The reference has no checkpointing ("kernel library, not a trainer",
+SURVEY.md §5) — but this framework ships a trainer, so checkpoint /
+resume is part of completeness. Format: one .npz of flattened leaves +
+a JSON manifest of the tree structure (dependable across versions —
+no serialization-API drift), with the framed artifact store
+(tools/native.py) providing the checksummed IO. Restore places each
+leaf onto the sharding of a matching "like" pytree, so a checkpoint
+written on one mesh restores onto another (the resharding is a
+device_put).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pathlib
+import re
+
+import jax
+import numpy as np
+
+from triton_distributed_tpu.tools.native import artifact_read, artifact_write
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _flatten(pytree):
+    leaves, treedef = jax.tree_util.tree_flatten(pytree)
+    return leaves, treedef
+
+
+def save_checkpoint(path, pytree) -> None:
+    """Write ``pytree`` (arrays at the leaves) to ``path``.
+
+    Multi-host: call from every process; only process 0 writes (leaves
+    are fully-addressable host copies via device_get).
+    """
+    leaves, treedef = _flatten(pytree)
+    arrays = []
+    for l in leaves:
+        if isinstance(l, jax.Array) and not l.is_fully_addressable:
+            # multi-host sharded leaf: assemble the global value on every
+            # process (device_get would raise on non-addressable shards)
+            from jax.experimental import multihost_utils
+
+            arrays.append(np.asarray(multihost_utils.process_allgather(
+                l, tiled=True)))
+        else:
+            arrays.append(np.asarray(jax.device_get(l)))
+    if jax.process_index() != 0:
+        return
+    buf = io.BytesIO()
+    np.savez(buf, *arrays)
+    manifest = json.dumps({"treedef": str(treedef), "n": len(arrays)})
+    blob = manifest.encode() + b"\x00" + buf.getvalue()
+    pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
+    artifact_write(str(path), blob)
+
+
+def restore_checkpoint(path, like):
+    """Restore onto the structure AND shardings of ``like``.
+
+    ``like`` supplies the tree structure, dtypes, and target shardings
+    (its leaves may be jax.Arrays or ShapeDtypeStructs + shardings via
+    ``.sharding``); each stored leaf is device_put onto the matching
+    target sharding.
+    """
+    blob = artifact_read(str(path))
+    sep = blob.index(b"\x00")
+    manifest = json.loads(blob[:sep].decode())
+    data = np.load(io.BytesIO(blob[sep + 1 :]))
+    arrays = [data[k] for k in data.files]
+    leaves, treedef = _flatten(like)
+    if len(arrays) != len(leaves):
+        raise ValueError(
+            f"checkpoint has {len(arrays)} leaves, target has {len(leaves)}"
+        )
+    if manifest["treedef"] != str(treedef):
+        # same leaf count but different structure/key order — restoring
+        # would silently assign leaves to the wrong parameters
+        raise ValueError(
+            "checkpoint tree structure does not match target:\n"
+            f"  stored: {manifest['treedef']}\n  target: {treedef}"
+        )
+    out = []
+    for arr, tgt in zip(arrays, leaves):
+        if tuple(arr.shape) != tuple(tgt.shape):
+            raise ValueError(
+                f"leaf shape mismatch: stored {arr.shape} vs target {tgt.shape}"
+            )
+        arr = arr.astype(tgt.dtype)
+        sharding = getattr(tgt, "sharding", None)
+        out.append(jax.device_put(arr, sharding) if sharding is not None
+                   else jax.numpy.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention (``step_N`` files in a
+    directory; the trainer-loop counterpart of orbax's manager, kept
+    dependency-light)."""
+
+    def __init__(self, directory, *, keep: int = 3):
+        self.dir = pathlib.Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.keep = keep
+
+    def _steps(self):
+        steps = []
+        for p in self.dir.iterdir():
+            m = _STEP_RE.match(p.name)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def save(self, step: int, pytree) -> None:
+        save_checkpoint(self.dir / f"step_{step}", pytree)
+        if jax.process_index() == 0:
+            for old in self._steps()[: -self.keep]:
+                (self.dir / f"step_{old}").unlink(missing_ok=True)
+
+    def latest_step(self):
+        steps = self._steps()
+        return steps[-1] if steps else None
+
+    def restore(self, like, step: int | None = None):
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        return restore_checkpoint(self.dir / f"step_{step}", like)
