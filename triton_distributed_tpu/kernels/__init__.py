@@ -27,6 +27,10 @@ from triton_distributed_tpu.kernels.moe_utils import (
     moe_align_block_size,
     select_experts,
 )
+from triton_distributed_tpu.kernels.ring_attention import (
+    ring_attention,
+    ulysses_attention,
+)
 from triton_distributed_tpu.kernels.reduce_scatter import (
     reduce_scatter,
     reduce_scatter_xla,
@@ -54,4 +58,6 @@ __all__ = [
     "MoEAllToAllContext",
     "create_all_to_all_context",
     "fast_all_to_all",
+    "ring_attention",
+    "ulysses_attention",
 ]

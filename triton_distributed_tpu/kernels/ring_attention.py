@@ -1,0 +1,197 @@
+"""Context-parallel attention for training: ring and Ulysses.
+
+Reference scope: the reference's sequence parallelism is decode-side
+only (KV-sharded flash-decode, flash_decode.py:482-566; SURVEY.md §5
+"no training-time ring attention or Ulysses"). Long-context TRAINING
+is first-class here, so this module adds both standard CP schemes over
+the same mesh axes the rest of the framework uses:
+
+* **Ring attention** (blockwise causal): Q stays put; KV blocks rotate
+  around the ring via ``ppermute`` while each step's partial attention
+  folds into carried online-softmax state (m, l, acc) — the classic
+  blockwise-parallel formulation. Communication overlaps compute via
+  XLA's async collective-permute, and every op has a transpose rule so
+  ``jax.grad`` works through the whole ring (the backward rotates the
+  opposite direction automatically).
+* **Ulysses** (all-to-all head scatter): re-shard seq→heads with one
+  a2a, run plain local attention on full sequences of the local head
+  subset, a2a back. Cheaper at moderate sequence lengths; needs
+  heads % cp == 0.
+
+Both consume (B, S, H, D) with S sharded over ``axis`` and are
+numerically the same computation as dense causal attention.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1.0e30
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One blockwise partial: returns (scores_max, exp-sums, weighted V)
+    in f32. q: (B, Sq, Hkv, G, D); k/v: (B, Skv, Hkv, D); mask
+    broadcastable to (B, Sq, Hkv, G, Skv)."""
+    s = jnp.einsum(
+        "bqhgd,bkhd->bqhgk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    s = jnp.where(mask, s, NEG_INF)
+    # the max is a pure numerical stabilizer: it must be a constant to
+    # autodiff everywhere (exponent AND the cross-block combine factors),
+    # or the blockwise gradients pick up spurious max-subgradient terms
+    m = jax.lax.stop_gradient(jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bqhgk,bkhd->bqhgd", p, v.astype(jnp.float32))
+    return m, l, o
+
+
+def ring_attention_device(q, k, v, axis, *, causal: bool = True, scale=None):
+    """Per-device ring attention body (callable inside shard_map).
+
+    q/k/v: (B, S_loc, H, D) — this rank's sequence block; H is Hq for q
+    and Hkv for k/v (GQA supported, Hq % Hkv == 0). Returns
+    (B, S_loc, Hq, D) in q.dtype.
+    """
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    b, s_loc, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    qg = q.reshape(b, s_loc, hkv, g, d)
+    pos_q = me * s_loc + jnp.arange(s_loc)                    # global q rows
+
+    def block_mask(src):
+        if not causal:
+            return jnp.ones((1, 1, 1, 1, s_loc), bool)
+        pos_k = src * s_loc + jnp.arange(s_loc)
+        return (pos_q[:, None] >= pos_k[None, :])[None, :, None, None, :]
+
+    def combine(acc, blk):
+        m_acc, l_acc, o_acc = acc
+        m_blk, l_blk, o_blk = blk
+        m_new = jnp.maximum(m_acc, m_blk)
+        a_old = jnp.exp(m_acc - m_new)
+        a_blk = jnp.exp(m_blk - m_new)
+        return (m_new, a_old * l_acc + a_blk * l_blk,
+                a_old * o_acc + a_blk * o_blk)
+
+    # step 0 peeled: the local block needs no rotation, so the scan does
+    # exactly n-1 ppermute pairs (no discarded final rotation)
+    acc = _block_attn(qg, k, v, scale, block_mask(me))
+
+    def step(carry, i):
+        k_blk, v_blk, acc = carry
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        src = jax.lax.rem(me - i + n, n)                      # block owner
+        blk = _block_attn(qg, k_blk, v_blk, scale, block_mask(src))
+        return (k_blk, v_blk, combine(acc, blk)), None
+
+    (_, _, (_, l_f, o_f)), _ = jax.lax.scan(
+        step, (k, v, acc), jnp.arange(1, n)
+    )
+    out = o_f / jnp.maximum(l_f, 1e-30)
+    return out.reshape(b, s_loc, hq, d).astype(q.dtype)
+
+
+def ulysses_attention_device(q, k, v, axis, *, causal: bool = True, scale=None):
+    """Per-device Ulysses body: a2a seq→heads, local attention over the
+    FULL sequence on H/cp local heads, a2a back.
+
+    q/k/v: (B, S_loc, H, D), S sharded over ``axis``; needs
+    Hq % cp == 0 and Hkv % cp == 0.
+    """
+    n = jax.lax.axis_size(axis)
+    b, s_loc, hq, d = q.shape
+    hkv = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    assert hq % n == 0, f"Ulysses needs Hq % cp == 0, got {hq} % {n}"
+    if hkv % n != 0:
+        # GQA with fewer KV heads than the CP degree: replicate KV heads
+        # so each rank gets a whole head (the standard Ulysses-GQA trick;
+        # replicated heads attend identically, numerics unchanged)
+        assert n % hkv == 0, f"need Hkv % cp == 0 or cp % Hkv == 0 ({hkv}, {n})"
+        rep = n // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        hkv = n
+
+    def scatter_heads(x):
+        # (B, S_loc, H, D) → (B, n*S_loc, H/n, D): head chunk i goes to
+        # rank i; received seq blocks stack in source order (global seq)
+        return jax.lax.all_to_all(
+            x, axis, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def gather_heads(x):
+        # inverse: (B, S, H/n, D) → (B, S_loc, H, D); received head
+        # chunks stack in source order (global head = src·H/n + local)
+        return jax.lax.all_to_all(
+            x, axis, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    out = dense_attention_reference(
+        scatter_heads(q), scatter_heads(k), scatter_heads(v),
+        causal=causal, scale=scale,
+    )
+    return gather_heads(out)
+
+
+@functools.lru_cache(maxsize=64)
+def _build(mesh, axis, kind, causal, batch_axes):
+    body = {
+        "ring": ring_attention_device,
+        "ulysses": ulysses_attention_device,
+    }[kind]
+    spec = P(tuple(batch_axes) if batch_axes else None, axis)
+    fn = jax.shard_map(
+        functools.partial(body, axis=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def ring_attention(q, k, v, mesh, axis="x", *, causal: bool = True,
+                   batch_axes: tuple = ()):
+    """Host entry: (B, S, H, D) with S sharded over ``axis`` (and B over
+    ``batch_axes``, if given)."""
+    return _build(mesh, axis, "ring", causal, tuple(batch_axes))(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh, axis="x", *, causal: bool = True,
+                      batch_axes: tuple = ()):
+    """Host entry: (B, S, H, D) with S sharded over ``axis`` (and B over
+    ``batch_axes``, if given)."""
+    return _build(mesh, axis, "ulysses", causal, tuple(batch_axes))(q, k, v)
+
+
+def dense_attention_reference(q, k, v, *, causal: bool = True, scale=None):
+    """Unsharded causal GQA attention — the correctness baseline and the
+    local body of Ulysses (full sequence, local head subset)."""
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    qg = q.reshape(b, s, hkv, g, d)
+    if causal:
+        pos = jnp.arange(s)
+        mask = (pos[:, None] >= pos[None, :])[None, :, None, None, :]
+    else:
+        mask = jnp.ones((1, 1, 1, 1, s), bool)
+    m, l, o = _block_attn(qg, k, v, scale, mask)
+    return (o / jnp.maximum(l, 1e-30)).reshape(b, s, hq, d).astype(q.dtype)

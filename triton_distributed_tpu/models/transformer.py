@@ -53,6 +53,11 @@ class TransformerConfig:
     n_heads: int = 8
     n_kv_heads: int = 4
     head_dim: int = 64
+    # Attention parallelism: "tp" = heads sharded via AG-GEMM/GEMM-RS
+    # projections; "ring" / "ulysses" = context parallelism over the tp
+    # axis (sequence-sharded attention, replicated projection weights) —
+    # the long-context training modes
+    attn: str = "tp"
     # MoE: "none" = dense MLP everywhere; "tp" / "ep" put a MoE MLP in
     # every block whose index is in moe_layers
     moe: str = "none"
@@ -62,6 +67,16 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     dtype: object = jnp.bfloat16
     param_dtype: object = jnp.float32
+
+    def __post_init__(self):
+        if self.attn not in ("tp", "ring", "ulysses"):
+            raise ValueError(
+                f"attn must be 'tp', 'ring' or 'ulysses', got {self.attn!r}"
+            )
+        if self.moe not in ("none", "tp", "ep"):
+            raise ValueError(
+                f"moe must be 'none', 'tp' or 'ep', got {self.moe!r}"
+            )
 
     @property
     def q_dim(self) -> int:
@@ -185,9 +200,13 @@ class Transformer:
             "embed": rep, "norm_f": rep, "lm_head": rep, "blocks": [],
         }
         for i in range(c.n_layers):
+            if c.attn == "tp":
+                attn_sh = {"wqkv": ns(None, t), "wo": ns(t, None)}
+            else:
+                # CP attention: projections replicated, sequence sharded
+                attn_sh = {"wqkv": rep, "wo": rep}
             blk = {
-                "norm_attn": rep, "norm_mlp": rep,
-                "wqkv": ns(None, t), "wo": ns(t, None),
+                "norm_attn": rep, "norm_mlp": rep, **attn_sh,
             }
             if c.moe != "none" and i in c.moe_layers:
                 if c.moe == "ep":
@@ -213,9 +232,41 @@ class Transformer:
         )
         return (xf * r).astype(x.dtype) * w.astype(x.dtype)
 
+    def _cp_attention(self, blk, x, b, s):
+        """Context-parallel attention: sequence sharded over tp, heads
+        whole, projection weights replicated (the long-context layout).
+        x: (B·S, H) SP rows → (B·S, H) SP rows."""
+        from triton_distributed_tpu.kernels.ring_attention import (
+            ring_attention,
+            ulysses_attention,
+        )
+
+        c = self.config
+        ba = tuple(self.dp_axes)
+        seq_sharding = NamedSharding(
+            self.mesh, P(ba if ba else None, self.tp_axis)
+        )
+        xr = jax.lax.with_sharding_constraint(
+            x.reshape(b, s, c.hidden), seq_sharding
+        )
+        qkv = xr @ blk["wqkv"].astype(c.dtype)                # replicated W
+        q, k, v = jnp.split(qkv, [c.q_dim, c.q_dim + c.kv_dim], axis=-1)
+        q = q.reshape(b, s, c.n_heads, c.head_dim)
+        k = k.reshape(b, s, c.n_kv_heads, c.head_dim)
+        v = v.reshape(b, s, c.n_kv_heads, c.head_dim)
+        attn = ring_attention if c.attn == "ring" else ulysses_attention
+        o = attn(q, k, v, self.mesh, self.tp_axis, batch_axes=ba)
+        o = o.reshape(b, s, c.q_dim) @ blk["wo"].astype(c.dtype)
+        return jax.lax.with_sharding_constraint(
+            o.reshape(b * s, c.hidden),
+            NamedSharding(self.mesh, self.row_spec),
+        )
+
     def _attention(self, blk, x, b, s):
         """x: (B·S, H) SP rows → (B·S, H) SP rows. Heads sharded tp."""
         c = self.config
+        if c.attn != "tp":
+            return self._cp_attention(blk, x, b, s)
         qkv = ops.ag_gemm(x, blk["wqkv"].astype(c.dtype), self._ag_ctx)
         q, k, v = jnp.split(qkv, [c.q_dim, c.q_dim + c.kv_dim], axis=-1)
         hq, hkv, d = c.n_heads, c.n_kv_heads, c.head_dim

@@ -1,0 +1,39 @@
+"""Tutorial 11: long-context training attention — ring and Ulysses.
+
+Beyond the reference's decode-only sequence parallelism: both standard
+context-parallel schemes, differentiable end to end. Ring rotates KV
+blocks around the mesh while partial attention folds into online-softmax
+state; Ulysses re-shards seq->heads with one all-to-all and runs local
+attention over the full sequence.
+"""
+
+from _common import get_mesh
+
+mesh = get_mesh()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.kernels import ring_attention, ulysses_attention
+from triton_distributed_tpu.kernels.ring_attention import (
+    dense_attention_reference,
+)
+
+B, S, Hq, Hkv, D = 2, 512, 8, 4, 64   # sequence 8x longer than one shard
+q = jax.random.normal(jax.random.PRNGKey(0), (B, S, Hq, D), jnp.float32)
+k = jax.random.normal(jax.random.PRNGKey(1), (B, S, Hkv, D), jnp.float32)
+v = jax.random.normal(jax.random.PRNGKey(2), (B, S, Hkv, D), jnp.float32)
+sh = NamedSharding(mesh, P(None, "x"))
+qs, ks, vs = (jax.device_put(t, sh) for t in (q, k, v))
+
+ref = dense_attention_reference(q, k, v)
+for name, fn in (("ring", ring_attention), ("ulysses", ulysses_attention)):
+    out = fn(qs, ks, vs, mesh, "x")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+    # gradients flow through the collectives
+    g = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v, mesh, "x") ** 2))(qs, ks, vs)
+    assert np.isfinite(np.asarray(g).sum())
+    print(f"  {name}: fwd == dense causal, grads finite")
+print("tutorial 11 OK: context-parallel attention, trainable")
