@@ -6,9 +6,10 @@ the fused overlap ops so the reference's flagship patterns are the hot
 path of a real model, trainable and decodable.
 """
 
+from triton_distributed_tpu.models import presets
 from triton_distributed_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
 )
 
-__all__ = ["Transformer", "TransformerConfig"]
+__all__ = ["Transformer", "TransformerConfig", "presets"]

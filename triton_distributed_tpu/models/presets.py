@@ -1,0 +1,84 @@
+"""Model-family presets on the north-star shapes.
+
+The reference benchmarks its kernels on Llama-7B/70B TP GEMMs
+(test_ag_gemm.py defaults, BASELINE.json) and DeepSeek-style MoE
+AllToAll shapes (README.md:87); these presets pin the same families as
+runnable model configs — full-size for deployment, "tiny" twins with
+identical topology for tests/CI.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from triton_distributed_tpu.models.transformer import TransformerConfig
+
+
+def llama_7b(**overrides) -> TransformerConfig:
+    """Llama-2-7B geometry (the reference's intra-node AG-GEMM bench
+    family: hidden 4096, ffn 11008)."""
+    cfg = dict(
+        vocab=32000, n_layers=32, hidden=4096, ffn=11008,
+        n_heads=32, n_kv_heads=32, head_dim=128,
+        dtype=jnp.bfloat16,
+    )
+    cfg.update(overrides)
+    return TransformerConfig(**cfg)
+
+
+def llama_70b(**overrides) -> TransformerConfig:
+    """Llama-2-70B geometry (GQA 8 KV heads; the inter-node bench
+    family: hidden 8192, ffn 28672)."""
+    cfg = dict(
+        vocab=32000, n_layers=80, hidden=8192, ffn=28672,
+        n_heads=64, n_kv_heads=8, head_dim=128,
+        dtype=jnp.bfloat16,
+    )
+    cfg.update(overrides)
+    return TransformerConfig(**cfg)
+
+
+def mixtral_8x7b(**overrides) -> TransformerConfig:
+    """Mixtral-style MoE: 8 experts topk 2 in every block (the EP a2a
+    + grouped-GEMM family)."""
+    cfg = dict(
+        vocab=32000, n_layers=32, hidden=4096, ffn=14336,
+        n_heads=32, n_kv_heads=8, head_dim=128,
+        moe="ep", moe_layers=tuple(range(32)), num_experts=8, topk=2,
+        dtype=jnp.bfloat16,
+    )
+    cfg.update(overrides)
+    return TransformerConfig(**cfg)
+
+
+def deepseek_moe_16b(**overrides) -> TransformerConfig:
+    """DeepSeek-MoE-16B-style geometry: many small experts, topk 6
+    (the low-latency AllToAll headline family, README.md:87)."""
+    cfg = dict(
+        vocab=102400, n_layers=28, hidden=2048, ffn=1408,
+        n_heads=16, n_kv_heads=16, head_dim=128,
+        moe="ep", moe_layers=tuple(range(1, 28)), num_experts=64, topk=6,
+        dtype=jnp.bfloat16,
+    )
+    cfg.update(overrides)
+    return TransformerConfig(**cfg)
+
+
+def tiny(preset=None, **overrides) -> TransformerConfig:
+    """CI-sized twin: same topology knobs as ``preset`` (or dense
+    defaults), tiny dims — what the tests and the driver dryrun use."""
+    cfg = dict(
+        vocab=128, n_layers=2, hidden=128, ffn=256,
+        n_heads=8, n_kv_heads=4, head_dim=16,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    if preset is not None:
+        cfg.update(
+            moe=preset.moe,
+            moe_layers=tuple(i for i in preset.moe_layers if i < 2),
+            num_experts=min(preset.num_experts, 8),
+            topk=min(preset.topk, 2),
+            attn=preset.attn,
+        )
+    cfg.update(overrides)
+    return TransformerConfig(**cfg)

@@ -12,6 +12,11 @@ from triton_distributed_tpu.runtime.bootstrap import (
     get_context,
     initialize_distributed,
 )
+from triton_distributed_tpu.runtime.multislice import (
+    create_hybrid_mesh,
+    is_dcn_axis,
+    num_slices,
+)
 from triton_distributed_tpu.runtime.symm import (
     SymmetricBuffer,
     symm_empty,
@@ -46,4 +51,7 @@ __all__ = [
     "mesh_axes_size",
     "ring_neighbors",
     "flat_device_id",
+    "create_hybrid_mesh",
+    "is_dcn_axis",
+    "num_slices",
 ]

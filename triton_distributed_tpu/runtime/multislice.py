@@ -1,0 +1,89 @@
+"""Multi-slice (DCN) mesh construction and scope-aware collectives.
+
+Reference: the CommScope {GPU, INTRA_NODE, INTER_NODE} attribute
+(dialect/include/Dialect/Distributed/IR/DistributedAttrDefs.td:45-53)
+picks st.gpu / st.sys / nvshmemx per scope, and the kernels split
+intra-node (NVLink P2P) from inter-node (RDMA) legs (e.g.
+allgather.py:291-375, ep_a2a.py:36-150).
+
+TPU re-design: the scope split is ICI (within a slice — Pallas remote
+DMA reaches it) vs DCN (across slices — only XLA collectives ride it,
+SURVEY.md §7 hard part d). This module builds hybrid meshes whose axes
+are explicitly ICI- or DCN-backed and exposes the predicate the kernel
+entries use to auto-select engines: Pallas kernels on ICI axes, XLA
+fallbacks on DCN axes (topology.detect_topology → LinkKind.DCN already
+routes AllGatherMethod; this is the construction side).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from triton_distributed_tpu.runtime.topology import (
+    LinkKind,
+    detect_topology,
+    slice_id,
+)
+
+
+def num_slices() -> int:
+    """Number of ICI-connected slices among the visible devices (1 on a
+    single slice or CPU; == process count on typical multi-slice pods)."""
+    return max(len({slice_id(d) for d in jax.devices()}), 1)
+
+
+def create_hybrid_mesh(
+    ici_shape, *, dcn_axis: str = "dcn", ici_axes=None,
+) -> Mesh:
+    """Mesh with a leading DCN axis over slices and ICI axes within.
+
+    ``ici_shape``: per-slice mesh shape (e.g. ``(2, 4)``) — it must
+    cover each slice EXACTLY (jax's hybrid-mesh builder groups devices
+    by slice and requires a full granule per slice). ``ici_axes`` names
+    the axes (default ``("dp", "tp")`` style, last axis "tp"). On a
+    single slice the DCN axis has size 1 and any prefix of the devices
+    may be used, so the same program runs unchanged — mirroring the
+    reference's nnodes==1 specialization (SURVEY.md §4).
+    """
+    ici_axes = tuple(ici_axes or _default_ici_axes(len(ici_shape)))
+    assert len(ici_axes) == len(ici_shape)
+    devices = jax.devices()
+    n_slices = num_slices()
+    per_slice = int(np.prod(ici_shape))
+    if n_slices > 1:
+        from collections import Counter
+
+        sizes = Counter(slice_id(d) for d in devices)
+        bad = {s: c for s, c in sizes.items() if c != per_slice}
+        assert not bad, (
+            f"ici_shape {ici_shape} (= {per_slice} chips) must cover each "
+            f"slice exactly; slice sizes: {dict(sizes)}"
+        )
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, (n_slices,) + (1,) * (len(ici_shape) - 1),
+            devices=devices,
+        ).reshape((n_slices,) + tuple(ici_shape))
+    else:
+        assert per_slice <= len(devices), (
+            f"need {per_slice} devices, have {len(devices)}"
+        )
+        dev_array = np.asarray(devices[:per_slice]).reshape(
+            (1,) + tuple(ici_shape)
+        )
+    return Mesh(dev_array, (dcn_axis,) + ici_axes)
+
+
+def _default_ici_axes(n: int):
+    named = {1: ("tp",), 2: ("dp", "tp"), 3: ("dp", "pp", "tp")}
+    return named.get(n) or tuple(f"ici{i}" for i in range(n))
+
+
+def is_dcn_axis(mesh: Mesh, axis: str) -> bool:
+    """True if collectives along ``axis`` cross slices (DCN) — Pallas
+    remote DMA must not be used there; the op entries fall back to XLA
+    collectives (≡ the reference's CommScope INTER_NODE dispatch)."""
+    return detect_topology(mesh, axis).link_kind == LinkKind.DCN

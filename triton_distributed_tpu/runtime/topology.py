@@ -46,6 +46,14 @@ class TopologyInfo:
     coords: tuple | None = None   # per-device coords when available
 
 
+def slice_id(device) -> int:
+    """The ICI-slice a device belongs to (0 when the backend doesn't
+    report one). The single definition of "what counts as a slice" —
+    used by both DCN classification here and hybrid-mesh construction
+    (runtime/multislice.py)."""
+    return getattr(device, "slice_index", 0) or 0
+
+
 def detect_topology(mesh: Mesh, axis: str | None = None) -> TopologyInfo:
     """Classify the links along ``axis`` of ``mesh`` (whole mesh if None).
 
@@ -65,7 +73,7 @@ def detect_topology(mesh: Mesh, axis: str | None = None) -> TopologyInfo:
         return TopologyInfo(num_devices=n, link_kind=LinkKind.HOST, is_torus=False)
     # All devices on one process/slice → ICI. Devices with distinct
     # slice_index (multi-slice) → DCN on the crossing axis.
-    slice_ids = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    slice_ids = {slice_id(d) for d in devices}
     coords = tuple(getattr(d, "coords", None) for d in devices)
     if len(slice_ids) > 1:
         return TopologyInfo(n, LinkKind.DCN, is_torus=False, coords=coords)
