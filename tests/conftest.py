@@ -47,3 +47,36 @@ def mesh8():
 def mesh2x4():
     devs = np.asarray(jax.devices()).reshape(2, 4)
     return Mesh(devs, ("dp", "tp"))
+
+
+# ---------------------------------------------------------------- MoE helpers
+# Shared by test_ep_moe / test_moe / test_chaos so the dense reference and
+# the routed-data construction exist exactly once.
+
+def dense_moe_ref(x, logits, w_up, w_down, topk, activation="silu"):
+    """Per-token dense MoE reference: topk-weighted expert MLPs."""
+    import jax
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.kernels import moe_utils as mu
+
+    weights, ids = mu.select_experts(logits, topk)
+    act = jax.nn.silu if activation == "silu" else jax.nn.gelu
+    out = jnp.zeros((x.shape[0], w_down.shape[-1]))
+    for t in range(topk):
+        h = act(jnp.einsum("mh,mhf->mf", x, w_up[ids[:, t]]))
+        out += weights[:, t : t + 1] * jnp.einsum(
+            "mf,mfh->mh", h, w_down[ids[:, t]]
+        )
+    return out
+
+
+def moe_splits_data(n, m, num_experts, hidden, seed=0):
+    """Random expert-sorted tokens + per-device splits (numpy)."""
+    rng = np.random.default_rng(seed)
+    assign = np.sort(rng.integers(0, num_experts, (n, m)), axis=1)
+    splits = np.stack(
+        [np.bincount(a, minlength=num_experts) for a in assign]
+    ).astype(np.int32)
+    toks = rng.standard_normal((n, m, hidden)).astype(np.float32)
+    return toks, splits

@@ -28,15 +28,9 @@ def _data(dtype=jnp.float32):
 
 
 def _dense_ref(x, logits, w_up, w_down, activation="silu"):
-    weights, ids = mu.select_experts(logits, TOPK)
-    act = jax.nn.silu if activation == "silu" else jax.nn.gelu
-    out = jnp.zeros((x.shape[0], H))
-    for t in range(TOPK):
-        h = act(jnp.einsum("mh,mhf->mf", x, w_up[ids[:, t]]))
-        out += weights[:, t : t + 1] * jnp.einsum(
-            "mf,mfh->mh", h, w_down[ids[:, t]]
-        )
-    return out
+    from conftest import dense_moe_ref
+
+    return dense_moe_ref(x, logits, w_up, w_down, TOPK, activation)
 
 
 def _put(mesh, *arrays):

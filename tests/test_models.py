@@ -148,3 +148,46 @@ class TestDecode:
             last = jnp.argmax(x @ params["lm_head"], -1).astype(jnp.int32)
             outs.append(last)
         return jnp.stack(outs, 1)
+
+
+class TestRemat:
+    def test_remat_matches_no_remat(self, mesh_dp_tp, monkeypatch):
+        """jax.checkpoint must not change values or gradients. The
+        interpreted Pallas engines carry io_callback effects that
+        jax.checkpoint rejects, so this pins the XLA engines (what a
+        remat run uses off-TPU; on hardware Mosaic kernels compose)."""
+        from triton_distributed_tpu.config import config as tdtpu_config
+
+        monkeypatch.setattr(tdtpu_config, "fused_vmem_budget", 0)
+        toks = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 128),
+            NamedSharding(mesh_dp_tp, P("dp")),
+        )
+        losses, grads = {}, {}
+        for remat in (False, True):
+            cfg = TransformerConfig(**CFG, remat=remat)
+            m = Transformer(cfg, mesh_dp_tp, "tp", ("dp",))
+            params = jax.tree.map(
+                lambda p, s: jax.device_put(p, s),
+                m.init(jax.random.PRNGKey(0)), m.shardings(),
+            )
+            l, g = jax.value_and_grad(m.loss)(params, toks, toks)
+            losses[remat], grads[remat] = float(l), g
+        assert abs(losses[True] - losses[False]) < 1e-6
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+            ),
+            grads[True], grads[False],
+        )
+
+    def test_remat_with_pallas_engines_rejected_off_tpu(self, mesh_dp_tp):
+        cfg = TransformerConfig(**CFG, remat=True)
+        m = Transformer(cfg, mesh_dp_tp, "tp", ("dp",))
+        params = _sharded_params(m)
+        toks = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 128),
+            NamedSharding(mesh_dp_tp, P("dp")),
+        )
+        with pytest.raises(ValueError, match="TDTPU_FUSED_VMEM_BUDGET"):
+            m.forward(params, toks)

@@ -65,6 +65,13 @@ class TransformerConfig:
     num_experts: int = 8
     topk: int = 2
     norm_eps: float = 1e-5
+    # rematerialize each block in backward (jax.checkpoint): trades one
+    # extra forward per block for O(n_layers) less activation memory —
+    # the standard long-context / large-model training knob. Off-TPU the
+    # INTERPRETED Pallas engines carry io_callback effects that
+    # jax.checkpoint rejects — use the XLA engines there (e.g.
+    # TDTPU_FUSED_VMEM_BUDGET=0); compiled Mosaic kernels compose fine.
+    remat: bool = False
     dtype: object = jnp.bfloat16
     param_dtype: object = jnp.float32
 
@@ -322,11 +329,28 @@ class Transformer:
         x = jax.lax.with_sharding_constraint(
             x, NamedSharding(self.mesh, self.row_spec)
         )
-        for blk in params["blocks"]:
+        def block(x, blk):
             h = self._attention(blk, self._rmsnorm(x, blk["norm_attn"]), b, s)
             x = x + h
             h = self._mlp_block(blk, self._rmsnorm(x, blk["norm_mlp"]))
-            x = x + h
+            return x + h
+
+        if c.remat:
+            from triton_distributed_tpu.config import (
+                _use_interpret,
+                fused_vmem_budget,
+            )
+
+            if _use_interpret(None) and fused_vmem_budget() > 0:
+                raise ValueError(
+                    "remat=True off-TPU requires the XLA engines: the "
+                    "interpreted Pallas engines carry io_callback effects "
+                    "jax.checkpoint rejects. Set TDTPU_FUSED_VMEM_BUDGET=0 "
+                    "(or config.config.fused_vmem_budget = 0) to pin them."
+                )
+            block = jax.checkpoint(block)
+        for blk in params["blocks"]:
+            x = block(x, blk)
         x = self._rmsnorm(x, params["norm_f"])
         return x.astype(jnp.float32) @ params["lm_head"]
 
