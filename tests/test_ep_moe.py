@@ -86,3 +86,27 @@ def test_grads_match_dense(mesh8):
     np.testing.assert_allclose(
         np.asarray(gx), np.asarray(gx_ref), atol=1e-6, rtol=1e-4
     )
+
+
+def test_ep_moe_tuned_matches_and_caches(mesh8, tmp_path, monkeypatch):
+    """Autotuned entry: same numerics as ep_moe, one bench pass, then
+    cache hits (≡ wrapping kernels in contextual_autotune)."""
+    monkeypatch.setenv("TDTPU_AUTOTUNE_LOG_DIR", str(tmp_path))
+    from triton_distributed_tpu.ops import create_ep_moe_context, ep_moe_tuned
+    from triton_distributed_tpu.ops import moe as moe_mod
+
+    monkeypatch.setattr(moe_mod, "_EP_MOE_TUNERS", type(moe_mod._EP_MOE_TUNERS)())
+
+    x, logits, w_up, w_down = _data()
+    ref = _dense_ref(x, logits, w_up, w_down)
+    ctx = create_ep_moe_context(
+        mesh8, "x", num_experts=E, topk=TOPK, max_m=MTOK * TOPK, hidden=H,
+        dtype=jnp.float32, transport="xla", use_pallas_gemm=False,
+    )
+    args = _put(mesh8, x, logits, w_up, w_down)
+    out = ep_moe_tuned(*args, ctx, candidates=(8, 16))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    out2 = ep_moe_tuned(*args, ctx, candidates=(8, 16))   # cache hit
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref), atol=1e-5)
+    log = (tmp_path / "process-0.jsonl").read_text()
+    assert log.count('"best"') == 1

@@ -11,6 +11,7 @@ from triton_distributed_tpu.ops.moe import (
     create_ep_moe_context,
     ep_moe,
     ep_moe_device,
+    ep_moe_tuned,
 )
 from triton_distributed_tpu.ops.moe_tp import (
     MoETPContext,
@@ -37,6 +38,7 @@ __all__ = [
     "EPMoEContext",
     "ep_moe",
     "ep_moe_device",
+    "ep_moe_tuned",
     "create_ep_moe_context",
     "MoETPContext",
     "ag_group_gemm",

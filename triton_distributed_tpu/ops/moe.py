@@ -22,7 +22,8 @@ experts → return a2a → weighted combine. Two transports:
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass
+from collections import OrderedDict
+from dataclasses import dataclass, replace
 
 import jax
 import jax.numpy as jnp
@@ -230,3 +231,37 @@ def ep_moe(x, logits, w_up, w_down, ctx: EPMoEContext):
     ``ctx.axis``. Returns (M, H) token-sharded.
     """
     return _build_ep_moe(ctx)(x, logits, w_up, w_down)
+
+
+_EP_MOE_TUNERS: OrderedDict = OrderedDict()
+_EP_MOE_TUNERS_MAX = 64          # bounded like the sibling _build caches
+
+
+def ep_moe_tuned(x, logits, w_up, w_down, ctx: EPMoEContext,
+                 candidates: tuple = (64, 128, 256)):
+    """``ep_moe`` with ``block_m`` autotuned per input shape.
+
+    The L6→L4 integration the reference gets from wrapping kernels in
+    ``contextual_autotune`` (autotuner.py:97): the whole thunk is
+    benchmarked per block size (alignment capacity changes with it, so
+    the tuning unit must be the op, not the inner GEMM), the winner is
+    cached per shape, and on multi-process meshes the MAX-consensus
+    keeps every process on the same config.
+    """
+    from triton_distributed_tpu.tune import ContextualAutoTuner  # cycle: tune→ops is none, but keep ops importable without tune at module load
+
+    key = (ctx, tuple(candidates))
+    tuner = _EP_MOE_TUNERS.get(key)
+    if tuner is None:
+        def run(x, logits, up, down, *, block_m):
+            return ep_moe(x, logits, up, down, replace(ctx, block_m=block_m))
+
+        tuner = ContextualAutoTuner(
+            run, [{"block_m": b} for b in candidates], name="ep_moe"
+        )
+        _EP_MOE_TUNERS[key] = tuner
+        while len(_EP_MOE_TUNERS) > _EP_MOE_TUNERS_MAX:
+            _EP_MOE_TUNERS.popitem(last=False)
+    else:
+        _EP_MOE_TUNERS.move_to_end(key)
+    return tuner(x, logits, w_up, w_down)
