@@ -91,3 +91,33 @@ def test_sp_decode_matches_dense(mesh8, use_pallas, global_len):
     )
     out_ref, _ = gqa_fwd_batch_decode_xla(q, k, v, lens)
     assert_allclose(np.asarray(out), np.asarray(out_ref), atol=3e-5, rtol=3e-5)
+
+
+def test_aot_twin_roundtrip(tmp_path):
+    """The AOT library serializes the decode entry and reloads it with
+    identical numerics (≡ the *_aot entries, flash_decode.py:1007-1160)."""
+    from triton_distributed_tpu.kernels.flash_decode import (
+        gqa_fwd_batch_decode,
+        gqa_fwd_batch_decode_aot,
+    )
+
+    b, hq, hkv, d, s = 2, 8, 2, 128, 512
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d), jnp.float32)
+    lens = jnp.array([400, 100], jnp.int32)
+
+    lib = gqa_fwd_batch_decode_aot(block_k=128, cache_dir=tmp_path)
+    path = lib.compile(q, k, v, lens)
+    assert path.exists()
+    # a fresh library finds the artifact on disk — no retrace
+    lib2 = gqa_fwd_batch_decode_aot(block_k=128, cache_dir=tmp_path)
+    out, lse = lib2(q, k, v, lens)
+    assert lib2.stats == {"artifact_loads": 1, "jit_fallbacks": 0}
+    # different hyperparameters must NOT reuse the artifact
+    lib3 = gqa_fwd_batch_decode_aot(block_k=128, soft_cap=30.0, cache_dir=tmp_path)
+    lib3(q, k, v, lens)
+    assert lib3.stats["jit_fallbacks"] == 1
+    ref, ref_lse = gqa_fwd_batch_decode(q, k, v, lens, block_k=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=1e-5)

@@ -162,6 +162,28 @@ def gqa_fwd_batch_decode(
     return out.reshape(batch, hq, d), lse.reshape(batch, hq)
 
 
+def gqa_fwd_batch_decode_aot(
+    *, scale: float | None = None, soft_cap: float = 0.0,
+    block_k: int = 256, cache_dir=".aot_cache",
+):
+    """AOT twin of :func:`gqa_fwd_batch_decode` (≡ the ``*_aot`` entries
+    calling pre-compiled kernels, flash_decode.py:1007-1160): returns a
+    shape-dispatching artifact library — ``.compile(q, k, v, lens)``
+    serializes one shape point, calls reload it without retracing."""
+    from triton_distributed_tpu.tools.aot import AotLibrary
+
+    def entry(q, k_cache, v_cache, kv_lens):
+        return gqa_fwd_batch_decode(
+            q, k_cache, v_cache, kv_lens,
+            scale=scale, soft_cap=soft_cap, block_k=block_k,
+        )
+
+    # hyperparameters are part of the artifact identity — two libraries
+    # sharing a cache_dir must never reuse each other's kernels
+    name = f"gqa_decode-bk{block_k}-sc{soft_cap}-s{scale}"
+    return AotLibrary(entry, name=name, cache_dir=cache_dir)
+
+
 def gqa_fwd_batch_decode_xla(q, k_cache, v_cache, kv_lens, *, scale=None, soft_cap=0.0):
     """Dense-XLA twin of :func:`gqa_fwd_batch_decode` (correctness
     reference, ≡ the torch baselines in test_decode_attn.py)."""

@@ -45,6 +45,11 @@ class SpGQAFlashDecodeAttention:
     soft_cap: float = 0.0
     block_k: int = 256
     use_pallas: bool = True
+    # For serialized-artifact (AOT) deployment of the local decode, use
+    # kernels.flash_decode.gqa_fwd_batch_decode_aot directly (≡ the
+    # reference's USE_TRITON_DISTRIBUTED_AOT path picking *_aot entries,
+    # sp_flash_decode_layer.py:32-39); this layer always dispatches the
+    # jit-cached SP pipeline.
 
     def __call__(self, q, k_cache, v_cache, global_kv_lens):
         """q: (B, Hq, D) replicated; k/v_cache: (B, S, Hkv, D) with S

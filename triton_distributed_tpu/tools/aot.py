@@ -71,6 +71,9 @@ class AotLibrary:
         self.name = name
         self.cache_dir = pathlib.Path(cache_dir)
         self._loaded: dict = {}
+        # provenance: how many shape points came from disk artifacts vs
+        # fell back to fresh JIT (lets callers/tests assert "no retrace")
+        self.stats = {"artifact_loads": 0, "jit_fallbacks": 0}
 
     def compile(self, *example_args):
         path = aot_compile(
@@ -88,8 +91,10 @@ class AotLibrary:
             )
             if path.exists():
                 loaded = aot_load(path)
+                self.stats["artifact_loads"] += 1
             else:
                 loaded = jax.jit(self.fn)   # fallback: JIT on miss
+                self.stats["jit_fallbacks"] += 1
             self._loaded[key] = loaded
         return loaded(*args)
 
