@@ -5,20 +5,38 @@ Llama-7B TP shapes, reference tutorial 07 / test_ag_gemm.py) on whatever
 devices are present — the one real TPU chip under the driver, or the
 virtual CPU mesh during development.
 
-Prints ONE JSON line:
-  {"metric": "ag_gemm_tflops_per_chip", "value": N, "unit": "TFLOP/s",
-   "vs_baseline": speedup_vs_unoverlapped}
+Methodology (the round-1 numbers were dispatch-overhead artifacts):
 
-``vs_baseline`` is the speedup of our best engine over the unoverlapped
-baseline (all_gather → dot, ≡ the reference's torch_ag_gemm cuBLAS+NCCL
-baseline, test_ag_gemm.py) on the same hardware — the quantity the
-reference's perf charts report (README.md:181-182).
+* Every timing is an **in-jit ``lax.fori_loop``** whose carry chains each
+  iteration's output back into the next iteration's input, timed as the
+  *difference* between a high and a low iteration count — the ~90 ms
+  axon-relay dispatch round-trip cancels out.
+* The loop dependency folds ``jnp.sum(out)`` into the carry so XLA cannot
+  narrow the benched computation to the part feeding one element (it
+  will happily turn ``dot(a, b)[0, 0]`` into a dot-product).
+* ``block_until_ready`` is a no-op over the axon relay; a host fetch of
+  the scalar result is the reliable fence.
+* Numbers are reported with ``device_kind`` and MFU / %-of-SOL against
+  ``tune.perf_model.detect_spec()`` so they are explainable as
+  %-of-speed-of-light.
+
+Prints ONE JSON line on stdout:
+  {"metric": "ag_gemm_tflops_per_chip", "value": N, "unit": "TFLOP/s",
+   "vs_baseline": speedup_vs_unoverlapped, ...}
+
+``vs_baseline`` compares the fused flagship engine against the
+unoverlapped baseline (all_gather → dot, ≡ the reference's torch_ag_gemm
+cuBLAS+NCCL baseline, test_ag_gemm.py) measured the same way on the same
+hardware; the baseline's own TFLOPs ride along so both sides are visible.
+Secondary metrics (gemm_rs, grouped-GEMM MFU, MoE a2a transport,
+flash-decode HBM%) go to stderr, one JSON line each.
 """
 
 from __future__ import annotations
 
 import json
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -26,44 +44,78 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _sync(out):
-    # block_until_ready is a no-op over the axon relay; a host read of one
-    # element is the reliable device fence.
-    leaf = jax.tree.leaves(out)[0]
-    np.asarray(jax.device_get(leaf.ravel()[0]))
+def bench_loop(step, state, *, lo=4, hi=20, reps=3):
+    """Time ``step`` (state, s) -> (state, s) via in-jit fori_loop deltas.
+
+    Returns seconds per iteration. ``s`` is a f32 scalar the step must
+    fold a full-output reduction into (the anti-DCE / anti-narrowing
+    dependency); fetching it on the host is the execution fence.
+    """
+
+    def make(iters):
+        @jax.jit
+        def run(state):
+            def body(i, carry):
+                return step(*carry)
+
+            return jax.lax.fori_loop(0, iters, body, (state, jnp.float32(0)))[1]
+
+        float(run(state))  # compile
+        float(run(state))  # steady-state warm
+        return run
+
+    run_lo, run_hi = make(lo), make(hi)
+    best_lo = best_hi = 1e9
+    for _ in range(reps):  # interleaved so drift hits both equally
+        t0 = time.perf_counter()
+        float(run_lo(state))
+        best_lo = min(best_lo, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        float(run_hi(state))
+        best_hi = min(best_hi, time.perf_counter() - t0)
+    dt = (best_hi - best_lo) / (hi - lo)
+    if dt <= 0:
+        raise RuntimeError(
+            f"bench_loop: non-positive timing delta ({best_hi:.4f}s @ {hi} it "
+            f"vs {best_lo:.4f}s @ {lo} it) — dispatch overhead swamped the "
+            "measurement; raise the iteration counts"
+        )
+    return dt
 
 
-def _bench(fn, *args, iters=32, warmup=3):
-    import time
-
-    out = None
-    for _ in range(warmup):
-        out = fn(*args)
-    _sync(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    _sync(out)
-    return (time.perf_counter() - t0) / iters
+def perturb(a, s):
+    """Tiny dynamic data dependency: keeps the loop carry live without
+    changing values beyond an underflowing-to-zero epsilon."""
+    return a + (s * jnp.float32(1e-30)).astype(a.dtype)
 
 
 def main() -> None:
     from triton_distributed_tpu.kernels.ag_gemm import (
-        AGGemmMethod,
         _build_fused,
         _build_xla_naive,
-        _build_xla_ring,
-        _fused_fits,
+    )
+    from triton_distributed_tpu.tune.perf_model import (
+        detect_spec,
+        overlap_efficiency,
     )
 
     devs = jax.devices()
     n = len(devs)
     mesh = Mesh(np.asarray(devs), ("x",))
-
-    # Llama-7B TP up-projection shape (reference test_ag_gemm defaults,
-    # 8192 x 8192 x 28672), scaled down off-TPU to keep CI fast.
     on_tpu = jax.default_backend() == "tpu"
-    m, k, nn = (8192, 8192, 28672) if on_tpu else (512, 512, 1024)
+    spec = detect_spec()
+    device_kind = getattr(devs[0], "device_kind", "cpu")
+
+    # Llama-7B TP8 up-projection (reference test_ag_gemm defaults
+    # 8192×8192×28672): each chip's work is the full gathered A against
+    # its N/8 weight shard. On one chip we bench exactly that per-chip
+    # work; off-TPU (CPU dev runs) shapes shrink to keep CI fast.
+    tp = 8
+    if on_tpu:
+        m, k, n_shard = 8192, 8192, 28672 // tp
+    else:
+        m, k, n_shard = 256, 256, 512 // tp
+    nn = n_shard * n  # global N for the n-device mesh
     dtype = jnp.bfloat16
 
     key = jax.random.PRNGKey(0)
@@ -74,48 +126,155 @@ def main() -> None:
         jax.random.normal(key, (k, nn), dtype), NamedSharding(mesh, P(None, "x"))
     )
 
-    if n == 1:
-        # Single chip: no gather leg — both engines are the same MXU matmul.
-        fn = jax.jit(lambda a, b: jnp.dot(a, b, preferred_element_type=jnp.float32).astype(dtype))
-        t_best = t_naive = _bench(fn, a, b)
-    else:
-        t_naive = _bench(_build_xla_naive(mesh, "x", (), dtype), a, b)
-        candidates = [_build_xla_ring(mesh, "x", (), dtype)]
-        if _fused_fits(n, m, k, nn // n, a.dtype.itemsize):
-            candidates.append(
-                _build_fused(mesh, "x", (), a.shape, b.shape, a.dtype, dtype, 5, False)
-            )
-        t_best = min(min(_bench(c, a, b) for c in candidates), t_naive)
+    fused = _build_fused(
+        mesh, "x", (), (m, k), (k, nn), jnp.dtype(dtype), jnp.dtype(dtype), 5, False
+    )
+    naive = _build_xla_naive(mesh, "x", (), jnp.dtype(dtype))
 
-    tflops_per_chip = 2.0 * m * k * nn / t_best / n / 1e12
-    # headline FIRST: a hang in a secondary bench must not starve the
-    # driver of the already-computed metric
+    def fused_step(state, s):
+        a, b = state
+        out, _ag = fused(a, b)
+        s = s + jnp.sum(out.astype(jnp.float32))
+        return (perturb(a, s), b), s
+
+    def naive_step(state, s):
+        a, b = state
+        out = naive(a, b)
+        s = s + jnp.sum(out.astype(jnp.float32))
+        return (perturb(a, s), b), s
+
+    lo, hi = (4, 16) if on_tpu else (1, 3)
+    t_fused = bench_loop(fused_step, (a, b), lo=lo, hi=hi)
+    t_naive = bench_loop(naive_step, (a, b), lo=lo, hi=hi)
+
+    flops = 2.0 * m * k * nn
+    tflops_per_chip = flops / t_fused / n / 1e12
+    tflops_naive = flops / t_naive / n / 1e12
+    mfu = tflops_per_chip / spec.bf16_tflops
+    # Overlap: per ring step the fused kernel hides ONE shard transfer
+    # (m/tp·k bytes, unidirectional, one ICI link) under ONE shard matmul
+    # (1/tp of the whole per-chip job). Measured job time / ring length
+    # gives the per-step compute; n=1 projects the TP8 ring from the same
+    # per-chip work.
+    ring = n if n > 1 else tp
+    compute_step_ms = t_fused / ring * 1e3
+    shard_bytes = (m // ring) * k * jnp.dtype(dtype).itemsize
+    comm_step_ms = shard_bytes / (spec.ici_gbps * 1e9) * 1e3
+    overlap = overlap_efficiency(compute_step_ms, comm_step_ms)
+
     print(
         json.dumps(
             {
                 "metric": "ag_gemm_tflops_per_chip",
                 "value": round(tflops_per_chip, 2),
                 "unit": "TFLOP/s",
-                "vs_baseline": round(t_naive / t_best, 4),
+                # fused vs unoverlapped AG→dot measured identically. At
+                # n=1 the baseline's gather leg is free while the fused
+                # ring still publishes the gathered-A workspace, so <1 is
+                # expected there; the overlap advantage exists only where
+                # there is comm to hide (n>1).
+                "vs_baseline": round(t_naive / t_fused, 4),
+                "baseline_tflops_per_chip": round(tflops_naive, 2),
+                "device_kind": device_kind,
+                "n_chips": n,
+                "mfu": round(mfu, 4),
+                "overlap_pct": round(100 * overlap, 1),
+                "overlap_kind": "measured" if n > 1 else "projected_tp8",
+                "config": f"M={m} K={k} N={nn} bf16 fused-streaming",
             }
         ),
         flush=True,
     )
 
-    # Secondary metrics (stderr — the driver consumes exactly one stdout
-    # line): MoE a2a dispatch latency on the reference's headline config
-    # (128 tok/rank, topk 8, hidden 7168 — README.md:87, 137 µs on 32
-    # GPUs) and distributed flash-decode step time.
-    for fn in (_bench_moe_a2a, _bench_flash_decode):
+    for fn in (_bench_gemm_rs, _bench_group_gemm, _bench_moe_a2a, _bench_flash_decode):
         try:
-            print(json.dumps(fn(mesh, n, on_tpu)), file=sys.stderr)
+            print(json.dumps(fn(mesh, n, on_tpu, spec)), file=sys.stderr, flush=True)
         except Exception as e:
-            print(json.dumps({"metric": fn.__name__, "error": str(e)[:200]}),
-                  file=sys.stderr)
+            print(
+                json.dumps({"metric": fn.__name__, "error": f"{type(e).__name__}: {e}"[:300]}),
+                file=sys.stderr,
+                flush=True,
+            )
 
 
-def _bench_moe_a2a(mesh, n, on_tpu):
+def _bench_gemm_rs(mesh, n, on_tpu, spec):
+    """North-star GEMM-RS (Llama-7B down-projection 8192×28672×8192 TP8):
+    per-chip K shard against the full output."""
+    from triton_distributed_tpu.kernels.gemm_rs import _build_fused
+
+    tp = 8
+    m, k_shard, nn = (8192, 28672 // tp, 8192) if on_tpu else (128, 64, 256)
+    k = k_shard * n
+    dtype = jnp.bfloat16
+    a = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (m, k), dtype),
+        NamedSharding(mesh, P(None, "x")),
+    )
+    b = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(2), (k, nn), dtype),
+        NamedSharding(mesh, P("x", None)),
+    )
+    fused = _build_fused(
+        mesh, "x", (), (m, k), (k, nn), jnp.dtype(dtype), jnp.dtype(dtype), 6, False
+    )
+
+    def step(state, s):
+        a, b = state
+        out = fused(a, b)
+        s = s + jnp.sum(out.astype(jnp.float32))
+        return (perturb(a, s), b), s
+
+    lo, hi = (4, 16) if on_tpu else (1, 3)
+    t = bench_loop(step, (a, b), lo=lo, hi=hi)
+    tflops = 2.0 * m * k * nn / t / n / 1e12
+    return {
+        "metric": "gemm_rs_tflops_per_chip",
+        "value": round(tflops, 2),
+        "unit": "TFLOP/s",
+        "mfu": round(tflops / spec.bf16_tflops, 4),
+        "config": f"n={n} M={m} K={k} N={nn} bf16 fused-streaming",
+    }
+
+
+def _bench_group_gemm(mesh, n, on_tpu, spec):
+    """Grouped-GEMM MFU proxy (the MoE expert-compute hot loop)."""
+    from triton_distributed_tpu.kernels.group_gemm import grouped_matmul
+
+    if on_tpu:
+        e, m_per, h, f, block_m = 8, 1024, 4096, 2048, 256
+    else:
+        e, m_per, h, f, block_m = 4, 64, 128, 128, 64
+    m_total = e * m_per
+    x = jax.random.normal(jax.random.PRNGKey(3), (m_total, h), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(4), (e, h, f), jnp.bfloat16)
+    block_expert = jnp.repeat(jnp.arange(e, dtype=jnp.int32), m_per // block_m)
+
+    def step(state, s):
+        x, w = state
+        out = grouped_matmul(x, w, block_expert, block_m=block_m)
+        s = s + jnp.sum(out.astype(jnp.float32))
+        return (perturb(x, s), w), s
+
+    lo, hi = (4, 16) if on_tpu else (1, 3)
+    t = bench_loop(step, (x, w), lo=lo, hi=hi)
+    tflops = 2.0 * m_total * h * f / t / 1e12
+    return {
+        "metric": "group_gemm_tflops",
+        "value": round(tflops, 2),
+        "unit": "TFLOP/s",
+        "mfu": round(tflops / spec.bf16_tflops, 4),
+        "config": f"experts={e} m/e={m_per} {h}x{f} bf16",
+    }
+
+
+def _bench_moe_a2a(mesh, n, on_tpu, spec):
+    """MoE dispatch leg on the reference's headline config (128 tok/rank,
+    topk 8, hidden 7168 — README.md:87). With one chip the ring has no
+    wire to cross; what is measured (and labeled) is the full dispatch
+    machinery — expert-sort staging, slot packing, the compiled transport
+    kernel, unpacking — i.e. the non-wire part of the latency."""
     from triton_distributed_tpu.kernels import moe_all_to_all as ma
+    from triton_distributed_tpu.kernels.all_to_all import _build_a2a_call
 
     epr, hidden, tok, topk = (8, 7168, 128, 8) if on_tpu else (2, 256, 16, 2)
     max_m = tok * topk
@@ -123,35 +282,92 @@ def _bench_moe_a2a(mesh, n, on_tpu):
         mesh, "x", max_m=max_m, hidden=hidden,
         experts_per_rank=epr, dtype=jnp.bfloat16,
     )
-    rows = NamedSharding(mesh, P("x"))
-    send = jax.device_put(
-        jnp.zeros((n * n * ctx.slot_rows, ctx.ints_per_row), jnp.int32), rows
+    # Force the Pallas transport even at n=1 (all_to_all() itself
+    # shortcuts to identity there, which round 1 mis-measured as latency).
+    call = _build_a2a_call(
+        mesh.axis_names, "x", n,
+        (n * ctx.slot_rows, ctx.ints_per_row), jnp.dtype(jnp.int32), 10,
     )
-    t = _bench(lambda s: ma.fast_all_to_all(ctx, s), send, iters=64)
+    transport = jax.jit(
+        jax.shard_map(call, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+                      check_vma=False)
+    )
+    toks = jax.device_put(
+        jax.random.normal(
+            jax.random.PRNGKey(5), (n * max_m, hidden), jnp.bfloat16
+        ),
+        NamedSharding(mesh, P("x")),
+    )
+    splits = jax.device_put(
+        jnp.tile(
+            jnp.full((ctx.num_experts,), max_m // ctx.num_experts, jnp.int32),
+            (n, 1),
+        ).reshape(n, ctx.num_experts),
+        NamedSharding(mesh, P("x")),
+    )
+
+    stage = jax.jit(
+        jax.shard_map(
+            lambda t, sp: ma.pack_slots(ctx, *ma.dispatch_stage(ctx, t, sp[0])),
+            mesh=mesh, in_specs=(P("x"), P("x")), out_specs=P("x"),
+            check_vma=False,
+        )
+    )
+    unview = jax.jit(
+        jax.shard_map(
+            lambda r: ma.recv_tokens_view(ctx, r)[0],
+            mesh=mesh, in_specs=P("x"), out_specs=P("x"), check_vma=False,
+        )
+    )
+
+    def step(state, s):
+        toks = state
+        recv = transport(stage(toks, splits))
+        out = unview(recv)
+        s = s + jnp.sum(out.astype(jnp.float32))
+        return perturb(toks, s), s
+
+    lo, hi = (8, 40) if on_tpu else (1, 3)
+    t = bench_loop(step, toks, lo=lo, hi=hi)
     return {
-        "metric": "moe_a2a_dispatch_latency", "value": round(t * 1e6, 1),
+        "metric": "moe_a2a_dispatch_latency",
+        "value": round(t * 1e6, 1),
         "unit": "us",
-        "config": f"n={n} tok/rank={tok} topk={topk} hidden={hidden} bf16",
+        "config": (
+            f"n={n} tok/rank={tok} topk={topk} hidden={hidden} bf16 "
+            + ("self-transport(no wire)" if n == 1 else "ring")
+        ),
     }
 
 
-def _bench_flash_decode(mesh, n, on_tpu):
+def _bench_flash_decode(mesh, n, on_tpu, spec):
     from triton_distributed_tpu.kernels.flash_decode import gqa_fwd_batch_decode
 
-    b, hq, hkv, d, s = (4, 32, 8, 128, 8192) if on_tpu else (2, 8, 2, 128, 1024)
+    b, hq, hkv, d, s_len = (4, 32, 8, 128, 8192) if on_tpu else (2, 8, 2, 128, 1024)
     q = jax.random.normal(jax.random.PRNGKey(0), (b, hq, d), jnp.bfloat16)
-    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, d), jnp.bfloat16)
-    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d), jnp.bfloat16)
-    lens = jnp.full((b,), s, jnp.int32)
-    t = _bench(
-        lambda *a: gqa_fwd_batch_decode(*a, block_k=512 if on_tpu else 256),
-        q, k, v, lens, iters=16,
-    )
-    kv_bytes = 2 * b * s * hkv * d * 2
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s_len, hkv, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s_len, hkv, d), jnp.bfloat16)
+    lens = jnp.full((b,), s_len, jnp.int32)
+
+    def step(state, s):
+        q, k, v = state
+        out, _lse = gqa_fwd_batch_decode(
+            q, k, v, lens, block_k=512 if on_tpu else 256
+        )
+        s = s + jnp.sum(out.astype(jnp.float32))
+        return (perturb(q, s), k, v), s
+
+    lo, hi = (8, 40) if on_tpu else (1, 3)
+    t = bench_loop(step, (q, k, v), lo=lo, hi=hi)
+    kv_bytes = 2 * b * s_len * hkv * d * 2
+    gbps = kv_bytes / t / 1e9
     return {
-        "metric": "flash_decode_step", "value": round(t * 1e6, 1),
-        "unit": "us", "kv_gbps": round(kv_bytes / t / 1e9, 1),
-        "config": f"B={b} Hq={hq} Hkv={hkv} D={d} S={s} bf16",
+        "metric": "flash_decode_step",
+        "value": round(t * 1e6, 1),
+        "unit": "us",
+        "kv_gbps": round(gbps, 1),
+        "hbm_pct": round(100 * gbps / spec.hbm_gbps, 1),
+        "config": f"B={b} Hq={hq} Hkv={hkv} D={d} S={s_len} bf16",
     }
 
 

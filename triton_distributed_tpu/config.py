@@ -87,6 +87,47 @@ def local_interpret(force: bool | None = None):
 
 
 _io_callback_patched = False
+_pipeline_shim_applied = False
+
+
+def ensure_pipeline_shim():
+    """Make ``pltpu.emit_pipeline`` traceable off-TPU.
+
+    The pipeline helper's ragged-edge DMA tiling asks the *runtime* for the
+    TPU generation (jax._src.pallas.mosaic.pipeline._get_tpu_generation) at
+    trace time, which raises on the CPU interpreter mesh. The generation
+    only picks the second-minor tile multiple used to round up ragged tail
+    blocks — our streaming kernels use even blockings and the interpreter
+    ignores tiling entirely, so answering a fixed modern generation is
+    semantically inert here.
+
+    Guarded: applied only off-TPU, only when the private helper still has
+    the expected zero-arg shape; if jax internals drift, raises a clear
+    error instead of silently patching (set TDTPU_NO_INTERPRETER_SHIMS=1
+    to skip the shim and run without emit_pipeline-based kernels).
+    """
+    global _pipeline_shim_applied
+    if _pipeline_shim_applied or on_tpu():
+        return
+    if os.environ.get("TDTPU_NO_INTERPRETER_SHIMS") == "1":
+        return
+    import inspect
+
+    try:
+        import jax._src.pallas.mosaic.pipeline as _pipe
+
+        fn = _pipe._get_tpu_generation
+        if len(inspect.signature(fn).parameters) != 0:
+            raise AttributeError("unexpected _get_tpu_generation signature")
+    except (AttributeError, ImportError) as e:
+        raise RuntimeError(
+            "triton_distributed_tpu interpreter shim: jax internals have "
+            "drifted (jax._src.pallas.mosaic.pipeline._get_tpu_generation "
+            f"not patchable: {e}). Pin jax to a tested version or set "
+            "TDTPU_NO_INTERPRETER_SHIMS=1."
+        ) from e
+    _pipe._get_tpu_generation = lambda: 5
+    _pipeline_shim_applied = True
 
 
 def ensure_interpreter_unblocked():
@@ -110,6 +151,7 @@ def ensure_interpreter_unblocked():
         return
     if os.environ.get("TDTPU_NO_IO_CALLBACK_PATCH") == "1":
         return
+    import inspect
     import logging
 
     import numpy as np
@@ -117,6 +159,19 @@ def ensure_interpreter_unblocked():
     from jax import tree_util
     from jax._src import config as _jax_config
     from jax._src import xla_bridge as _xb
+
+    try:
+        expected = {"result_avals", "callback", "sharding", "ordered"}
+        params = inspect.signature(_cb.io_callback_impl).parameters
+        if not expected.issubset(params) or not hasattr(_cb, "io_callback_p"):
+            raise AttributeError(f"io_callback_impl params {set(params)}")
+    except AttributeError as e:
+        raise RuntimeError(
+            "triton_distributed_tpu interpreter shim: jax internals have "
+            f"drifted (jax._src.callback.io_callback_impl not patchable: {e})."
+            " Pin jax to a tested version or set TDTPU_NO_IO_CALLBACK_PATCH=1"
+            " (large interpreted kernels may then deadlock on small hosts)."
+        ) from e
 
     logger = logging.getLogger("jax._src.callback")
 
@@ -151,6 +206,7 @@ def interpret_params(force: bool | None = None):
     if not _use_interpret(force):
         return False
     ensure_interpreter_unblocked()
+    ensure_pipeline_shim()
     return pltpu.InterpretParams(
         detect_races=config.detect_races,
         dma_execution_mode="on_wait",

@@ -8,18 +8,23 @@ per-tile on shard-arrival barriers (:133-254, dl.wait+consume_token
 
 TPU re-design — no streams, two engines instead:
 
-* ``PALLAS_FUSED``: ONE Pallas kernel per device runs a shard-granular
-  ring: at step ``s`` it computes the MXU matmul for shard ``(me-s)``
-  while the RDMA forwarding that same shard to the right neighbor is in
-  flight. The DMA recv semaphore *is* the reference's per-tile barrier
-  (dl.wait ≡ ``wait_recv``; consume_token is unnecessary because the
-  semaphore wait orders the subsequent VMEM reads). Each rank starts on
-  its own local shard — the reference's rank-swizzled tile order falls
-  out of the ring schedule naturally.
-* ``XLA_RING``: shard_map loop of ``ppermute`` + ``jnp.dot`` —
-  XLA's async collective-permute overlaps the hop with the matmul. Works
-  for any size (shards stream through HBM, not VMEM); this is the DCN /
-  large-shape path, mirroring the reference's inter-node engine
+* ``PALLAS_FUSED``: ONE persistent Pallas kernel per device runs an
+  HBM-streaming ring. Operands and the gathered-A workspace live in HBM
+  (ANY memory space); the matmul is a tiled ``emit_pipeline`` whose
+  (m, n, k) blocks are double-buffered HBM→VMEM DMAs, so the engine has
+  no whole-working-set VMEM gate and engages at any shape (the Llama-7B
+  TP8 north-star included — the reference's persistent TMA consumer GEMM,
+  allgather_gemm.py:133-254, translated to Mosaic's DMA pipeline). At
+  ring step ``s`` the kernel (1) waits on the recv DMA semaphore for
+  shard ``(me-s)`` — the hardware equivalent of dl.wait+consume_token
+  (:224-227) — (2) starts the RDMA forwarding that shard to the right
+  neighbor (HBM→HBM over ICI, touching no VMEM), and (3) streams the
+  shard through the MXU while the forward is in flight. Each rank starts
+  on its own local shard, so the reference's rank-swizzled tile order
+  falls out of the ring schedule naturally.
+* ``XLA_RING``: shard_map loop of ``ppermute`` + ``jnp.dot`` — XLA's
+  async collective-permute overlaps the hop with the matmul. This is the
+  DCN path, mirroring the reference's inter-node engine
   (allgather.py:291-468).
 * ``XLA_NAIVE``: all_gather → dot (the torch_ag_gemm-style baseline,
   reference test_ag_gemm.py).
@@ -29,6 +34,7 @@ from __future__ import annotations
 
 import enum
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +43,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import lang
-from triton_distributed_tpu.config import config, fused_vmem_budget, on_tpu
+from triton_distributed_tpu.config import config, fused_vmem_budget
 from triton_distributed_tpu.runtime import (
     LinkKind,
     detect_topology,
@@ -46,6 +52,15 @@ from triton_distributed_tpu.runtime import (
 )
 from triton_distributed_tpu.utils.testing import chaos_delay
 
+logger = logging.getLogger(__name__)
+_warned = set()
+
+
+def _warn_once(key, msg):
+    if key not in _warned:
+        _warned.add(key)
+        logger.warning(msg)
+
 
 class AGGemmMethod(enum.Enum):
     PALLAS_FUSED = "pallas_fused"
@@ -53,46 +68,159 @@ class AGGemmMethod(enum.Enum):
     XLA_NAIVE = "xla_naive"
 
 
-def _fused_kernel(n, axis, mesh_axes, x_ref, b_ref, out_ref, ag_ref, send_sem, recv_sem):
-    """Ring AG-GEMM. Per step: wait shard arrival → start forwarding it →
-    matmul it against the local B shard while the RDMA is in flight."""
+# ------------------------------------------------------------- block chooser
+
+#: default tile targets for the streaming matmul pipeline (bm, bk, bn).
+#: Swept on a real v5e at the Llama-7B TP8 north-star shard
+#: (8192×8192 @ 8192×3584 bf16): (512, 512, 1792) → 146 TFLOP/s vs 131-141
+#: for the (1024, 1024, ·) / large-bk variants and ~170 for XLA's dot.
+_TILE_TARGETS = (512, 512, 1792)
+
+
+def _divisor_block(dim: int, target: int, mult: int, strict: bool) -> int | None:
+    """Largest divisor of ``dim`` ≤ ``target``, preferring multiples of
+    ``mult`` (the hardware tile granule). ``strict`` (real-TPU): an
+    unaligned *interior* block shape is a Mosaic lowering error, so only a
+    multiple-of-mult divisor or the whole dim (single block — ragged
+    edges are padded, interiors never misalign) is acceptable; off-TPU the
+    interpreter ignores tiling and any divisor works."""
+    best = None
+    for b in range(min(target, dim), 0, -1):
+        if dim % b == 0:
+            if b % mult == 0:
+                return b
+            if best is None:
+                best = b
+    if strict and best != dim:
+        return None
+    return best
+
+
+def pick_mm_blocks(m: int, k: int, n: int, itemsize: int, budget: int | None = None):
+    """(bm, bk, bn) for the streaming matmul pipeline, or None if the shape
+    admits no (TPU-lowerable) divisor blocking. Shrinks targets until the
+    double-buffered tile working set fits the VMEM budget."""
+    from triton_distributed_tpu.config import on_tpu
+
+    budget = budget or fused_vmem_budget()
+    strict = on_tpu()
+    sublane = 8 * (4 // itemsize)  # (8·packing, 128) native tile
+    tm, tk, tn = _TILE_TARGETS
+    while True:
+        bm = _divisor_block(m, tm, sublane, strict)
+        # bk is A's lane dim and B's sublane dim; 128 covers both granules
+        bk = _divisor_block(k, tk, 128, strict)
+        bn = _divisor_block(n, tn, 128, strict)
+        if bm is None or bk is None or bn is None:
+            return None
+        # 2 A-tiles + 2 B-tiles + 2 out-tiles + 1 f32 accumulator
+        work = 2 * (bm * bk + bk * bn) * itemsize + 2 * bm * bn * itemsize + 4 * bm * bn
+        if work <= budget:
+            return bm, bk, bn
+        if tm <= 64 and tk <= 128 and tn <= 128:
+            return None  # pathological budget
+        tm, tk, tn = max(tm // 2, 64), max(tk // 2, 128), max(tn // 2, 128)
+
+
+def mm_pipeline(mb, nb, kb, bm, bk, bn, acc_ref, *, m_off=0, n_off=0, out_m_off=None):
+    """Tiled (m, n, k) matmul pipeline over HBM refs: C[out_m_off:, n_off:]
+    = A[m_off:, :] @ B[:, n_off:] for one (mb·bm, kb·bk)×(kb·bk, nb·bn)
+    slab. Offsets are *block* offsets (may be traced), so callers address
+    shard windows without slicing the HBM refs (index arithmetic replaces
+    the reference's rank-swizzled tile-id remap, allgather_gemm.py:205-219).
+    ``out_m_off`` defaults to ``m_off`` (in-place shard layout); pass 0 to
+    write a compact (mb·bm)-row slab (the GEMM-RS work buffers)."""
+    if out_m_off is None:
+        out_m_off = m_off
+
+    def inner(a_ref, b_ref, o_ref):
+        @pl.when(pl.program_id(2) == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        acc_ref[...] += jnp.dot(
+            a_ref[...], b_ref[...], preferred_element_type=jnp.float32
+        )
+
+        @pl.when(pl.program_id(2) == kb - 1)
+        def _():
+            o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+    return pltpu.emit_pipeline(
+        inner,
+        grid=(mb, nb, kb),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (m_off + i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, n_off + j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda i, j, kk: (out_m_off + i, n_off + j))
+        ],
+    )
+
+
+# ----------------------------------------------------------- fused engine
+
+
+def _fused_kernel(
+    n, axis, mesh_axes, blocks,
+    x_hbm, b_hbm, out_hbm, ag_hbm, acc_ref, local_sem, send_sem, recv_sem,
+):
+    """HBM-streaming ring AG-GEMM. Per step: wait shard arrival → start
+    forwarding it → stream it through the MXU while the RDMA is in flight."""
     me = lang.my_pe(axis)
-    m = x_ref.shape[0]
+    m = x_hbm.shape[0]  # shard rows
+    k = x_hbm.shape[1]
+    nl = b_hbm.shape[1]
+    bm, bk, bn = blocks
+    mb, nb, kb = m // bm, nl // bn, k // bk
     left, right = ring_neighbors(me, n)
     left = lang.pe_flat(axis, left, mesh_axes)
     right = lang.pe_flat(axis, right, mesh_axes)
 
-    ag_ref[pl.ds(me * m, m)] = x_ref[:]
+    # Publish the local shard into the gathered workspace (HBM→HBM local
+    # DMA ≡ local_copy_and_barrier_all, allgather_gemm.py:100-117). The
+    # copy overlaps step 0 entirely: the first forward and the first
+    # matmul read the local shard straight from x_hbm.
+    cp = pltpu.make_async_copy(x_hbm, ag_hbm.at[pl.ds(me * m, m)], local_sem)
+    cp.start()
     lang.neighbor_barrier(axis, left, right)
 
-    dmas = []
+    def fwd(src, slot, from_x=False):
+        # Descriptor for forwarding shard ``src`` to the right neighbor.
+        # Reconstructed at wait time: the wait is on the slot semaphore and
+        # byte counts are identical for every shard, so the recv wait
+        # releases exactly when the incoming shard's payload is resident
+        # (the dl.wait + consume_token of allgather_gemm.py:224-227, done
+        # by hardware).
+        src_ref = x_hbm if from_x else ag_hbm.at[pl.ds(src * m, m)]
+        return lang.remote_copy(
+            src_ref,
+            ag_hbm.at[pl.ds(src * m, m)],
+            send_sem.at[slot],
+            recv_sem.at[slot],
+            right,
+        )
+
     for s in range(n):
         src = jax.lax.rem(me + n - s, n) if s > 0 else me
         if s > 0:
-            # Shard ``src`` was sent by the left neighbor at its step s-1
-            # and lands with a credit on recv_sem[s-1]. The descriptor we
-            # wait on is our *outgoing* step s-1 copy — byte counts are
-            # identical for every shard, so the recv wait releases exactly
-            # when the incoming shard's payload is resident (the dl.wait +
-            # consume_token of allgather_gemm.py:224-227, done by hardware).
-            dmas[s - 1].wait_recv()
+            fwd(src, s - 1, from_x=(s == 1)).wait_recv()
         if s < n - 1:
             chaos_delay()
-            dma = lang.remote_copy(
-                ag_ref.at[pl.ds(src * m, m)],
-                ag_ref.at[pl.ds(src * m, m)],
-                send_sem.at[s],
-                recv_sem.at[s],
-                right,
+            fwd(src, s, from_x=(s == 0)).start()
+        # Stream this shard through the MXU while the forward is in flight.
+        if s == 0:
+            mm_pipeline(mb, nb, kb, bm, bk, bn, acc_ref, m_off=0,
+                        out_m_off=src * mb)(x_hbm, b_hbm, out_hbm)
+        else:
+            mm_pipeline(mb, nb, kb, bm, bk, bn, acc_ref, m_off=src * mb)(
+                ag_hbm, b_hbm, out_hbm
             )
-            dma.start()
-            dmas.append(dma)
-        # MXU matmul for this shard, overlapped with the in-flight forward.
-        out_ref[pl.ds(src * m, m)] = jnp.dot(
-            ag_ref[pl.ds(src * m, m)], b_ref[:], preferred_element_type=jnp.float32
-        ).astype(out_ref.dtype)
-    for dma in dmas:
-        dma.wait_send()
+    for s in range(n - 1):
+        src = jax.lax.rem(me + n - s, n) if s > 0 else me
+        fwd(src, s, from_x=(s == 0)).wait_send()
+    cp.wait()
 
 
 def _specs(axis, batch_axes):
@@ -118,22 +246,47 @@ def _build_fused(
     n_local = b_shape[1] // n
     dp = mesh_axes_size(mesh, batch_axes)
     m_gathered = a_shape[0] // dp  # rows per device after the AG over `axis`
+    m_shard = m_gathered // n
+    blocks = pick_mm_blocks(m_shard, k, n_local, dtype.itemsize)
+    if blocks is None:
+        raise ValueError(
+            f"ag_gemm PALLAS_FUSED: no divisor blocking for shard "
+            f"({m_shard}, {k}) @ ({k}, {n_local}); use XLA_RING"
+        )
 
     call = lang.shmem_call(
-        functools.partial(_fused_kernel, n, axis, mesh.axis_names),
-        out_shape=jax.ShapeDtypeStruct((m_gathered, n_local), out_dtype),
-        in_specs=lang.vmem_specs(2),
+        functools.partial(_fused_kernel, n, axis, mesh.axis_names, blocks),
+        out_shape=[
+            jax.ShapeDtypeStruct((m_gathered, n_local), out_dtype),
+            jax.ShapeDtypeStruct((m_gathered, k), dtype),  # gathered A
+        ],
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
         scratch_shapes=[
-            pltpu.VMEM((m_gathered, k), dtype),
+            pltpu.VMEM((blocks[0], blocks[2]), jnp.float32),
+            pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
         ],
         collective_id=collective_id,
+        vmem_limit_bytes=fused_vmem_budget(),
         name="ag_gemm_fused",
     )
     in_specs, out_specs = _specs(axis, batch_axes)
+    ba = tuple(batch_axes)
+    ag_spec = P(ba if ba else None, None)
     fn = jax.shard_map(
-        call, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        call,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(out_specs, ag_spec),
+        check_vma=False,
     )
     return jax.jit(fn)
 
@@ -195,22 +348,32 @@ def _build_xla_naive(mesh, axis, batch_axes, out_dtype):
     return jax.jit(fn)
 
 
-def _fused_fits(n, m, k, n_local, itemsize) -> bool:
-    work = (m * k + k * n_local + m * n_local) * itemsize
-    return work <= fused_vmem_budget()
-
-
 def auto_ag_gemm_method(mesh, axis, a, b, dp: int = 1) -> AGGemmMethod:
     """≡ reference method auto-selection (allgather.py:54-69): topology +
-    working-set size decide the engine."""
+    shape blockability decide the engine. The streaming fused engine has no
+    working-set VMEM gate; it is skipped only on DCN meshes (no Pallas
+    remote DMA across slices) or shapes with no divisor blocking — and the
+    fallback is *logged* so nobody silently benchmarks XLA believing it is
+    the fused kernel."""
     n = mesh.shape[axis]
     topo = detect_topology(mesh, axis)
-    fits = _fused_fits(n, a.shape[0] // dp, a.shape[1], b.shape[1] // n, a.dtype.itemsize)
     if topo.link_kind == LinkKind.DCN:
+        _warn_once(
+            ("ag_gemm", "dcn", axis),
+            f"ag_gemm: axis {axis!r} crosses DCN; using XLA_RING engine",
+        )
         return AGGemmMethod.XLA_RING
-    if fits and (topo.link_kind == LinkKind.ICI or not on_tpu()):
-        return AGGemmMethod.PALLAS_FUSED
-    return AGGemmMethod.XLA_RING
+    m_shard = a.shape[0] // (dp * n)
+    blocks = pick_mm_blocks(m_shard, a.shape[1], b.shape[1] // n, a.dtype.itemsize)
+    if blocks is None:
+        _warn_once(
+            ("ag_gemm", "blocks", a.shape, b.shape),
+            f"ag_gemm: shard ({m_shard}, {a.shape[1]}) @ "
+            f"({a.shape[1]}, {b.shape[1] // n}) admits no divisor blocking; "
+            "falling back to XLA_RING",
+        )
+        return AGGemmMethod.XLA_RING
+    return AGGemmMethod.PALLAS_FUSED
 
 
 def ag_gemm(
@@ -223,6 +386,7 @@ def ag_gemm(
     method: AGGemmMethod | None = None,
     out_dtype=None,
     collective_id: int = 5,
+    return_gathered: bool = False,
 ):
     """Fused AllGather(A) @ B for column-parallel TP.
 
@@ -231,6 +395,11 @@ def ag_gemm(
     factor within each DP group (Megatron sequence-parallel layout).
     ``b``: (K, N) sharded P(None, axis) — column-parallel weight.
     Returns (M, N) with rows sharded over ``batch_axes``, cols over ``axis``.
+
+    ``return_gathered=True`` additionally returns the gathered activations
+    (the reference exposes them in its symmetric workspace; callers reuse
+    them for subsequent ops). Only the fused engine produces them for free;
+    other engines re-gather via ``lax.all_gather``.
 
     Host entry ≡ reference ``ag_gemm`` (allgather_gemm.py:539) +
     ``rowise_ag_gemm_dispatcher`` (:586-661).
@@ -242,7 +411,8 @@ def ag_gemm(
     assert a.shape[0] % (n * dp) == 0 and b.shape[1] % n == 0
     assert a.shape[1] == b.shape[0], f"contract dim mismatch {a.shape} @ {b.shape}"
     if n == 1:
-        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
+        out = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
+        return (out, a) if return_gathered else out
     if method is None:
         method = auto_ag_gemm_method(mesh, axis, a, b, dp=dp)
     if method == AGGemmMethod.PALLAS_FUSED:
@@ -250,8 +420,22 @@ def ag_gemm(
             mesh, axis, batch_axes, a.shape, b.shape, a.dtype, out_dtype,
             collective_id, config.chaos_delay,
         )
-    elif method == AGGemmMethod.XLA_RING:
+        out, gathered = fn(a, b)
+        return (out, gathered) if return_gathered else out
+    if method == AGGemmMethod.XLA_RING:
         fn = _build_xla_ring(mesh, axis, batch_axes, out_dtype)
     else:
         fn = _build_xla_naive(mesh, axis, batch_axes, out_dtype)
-    return fn(a, b)
+    out = fn(a, b)
+    if return_gathered:
+        gathered = jax.jit(
+            jax.shard_map(
+                lambda x: jax.lax.all_gather(x, axis, tiled=True),
+                mesh=mesh,
+                in_specs=_specs(axis, batch_axes)[0][0],
+                out_specs=P(batch_axes if batch_axes else None, None),
+                check_vma=False,
+            )
+        )(a)
+        return out, gathered
+    return out

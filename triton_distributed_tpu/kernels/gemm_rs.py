@@ -15,8 +15,15 @@ rank-swizzled by construction: device ``me`` computes destination shards
 ``me+1, me+2, …, me`` so every shard's partial flows leftward and ends
 fully reduced on its owner.
 
-Engines: ``PALLAS_FUSED`` (VMEM-resident, ICI), ``XLA_RING``
-(ppermute+dot loop, any size / DCN), ``XLA_NAIVE`` (dot → psum_scatter
+The fused engine is HBM-streaming: operands and the ring slabs live in
+HBM (ANY memory space); the per-destination matmul and the fold-in add
+are tiled ``emit_pipeline`` loops whose blocks are double-buffered
+HBM→VMEM DMAs. There is no whole-working-set VMEM gate — the engine
+engages at the north-star shapes (the whole point of the reference's
+persistent producer GEMM, gemm_reduce_scatter.py:124-235).
+
+Engines: ``PALLAS_FUSED`` (streaming ring, ICI), ``XLA_RING``
+(ppermute+dot loop, DCN path), ``XLA_NAIVE`` (dot → psum_scatter
 baseline, ≡ the torch reference impl in test_gemm_rs.py).
 """
 
@@ -32,10 +39,20 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import lang
-from triton_distributed_tpu.config import config, fused_vmem_budget, on_tpu
-from triton_distributed_tpu.kernels.reduce_scatter import ring_reduce_core
-from triton_distributed_tpu.runtime import LinkKind, detect_topology, mesh_axes_size
-
+from triton_distributed_tpu.config import config, fused_vmem_budget
+from triton_distributed_tpu.kernels.ag_gemm import (
+    _divisor_block,
+    _warn_once,
+    mm_pipeline,
+    pick_mm_blocks,
+)
+from triton_distributed_tpu.runtime import (
+    LinkKind,
+    detect_topology,
+    mesh_axes_size,
+    ring_neighbors,
+)
+from triton_distributed_tpu.utils.testing import chaos_delay
 
 class GemmRSMethod(enum.Enum):
     PALLAS_FUSED = "pallas_fused"
@@ -43,27 +60,97 @@ class GemmRSMethod(enum.Enum):
     XLA_NAIVE = "xla_naive"
 
 
-def _fused_kernel(
-    n, axis, mesh_axes, a_ref, b_ref, out_ref, acc_ref, recv_ref, send_sem, recv_sem, ack_sem
-):
-    """Compute-into-the-ring GEMM-RS: the shared ring-reduce core
-    (kernels/reduce_scatter.py:ring_reduce_core) with the per-destination
-    contribution produced by the MXU. ``make_partial`` runs between a
-    slot DMA's start and wait, so each destination's matmul overlaps the
-    in-flight accumulator (the producer/consumer stream overlap of the
-    reference, collapsed into one kernel). Destination order me+1…me is
-    the rank-swizzle of gemm_reduce_scatter.py:205-219."""
-    m = out_ref.shape[0]
+def ew_add_pipeline(m, n, itemsize):
+    """Tiled elementwise-add pipeline over HBM refs: dst = a + b.
+    Blocks stream through VMEM double-buffered; used to fold a received
+    ring partial into the locally computed one."""
+    from triton_distributed_tpu.config import on_tpu
 
-    def make_partial(dst):
-        return jnp.dot(
-            a_ref[pl.ds(dst * m, m)], b_ref[:], preferred_element_type=jnp.float32
-        ).astype(acc_ref.dtype)
+    bm = _divisor_block(m, 512, 8 * (4 // itemsize), on_tpu())
+    bn = _divisor_block(n, 2048, 128, on_tpu())
 
-    ring_reduce_core(
-        n, axis, mesh_axes, make_partial,
-        out_ref, acc_ref, recv_ref, send_sem, recv_sem, ack_sem,
+    def inner(a_ref, b_ref, o_ref):
+        o_ref[...] = a_ref[...] + b_ref[...]
+
+    spec = pl.BlockSpec((bm, bn), lambda i, j: (i, j))
+    return pltpu.emit_pipeline(
+        inner, grid=(m // bm, n // bn), in_specs=[spec, spec], out_specs=[spec]
     )
+
+
+def _fused_kernel(
+    n, axis, mesh_axes, blocks,
+    a_hbm, b_hbm, out_hbm, w0, w1, r0, r1, acc_ref, send_sem, recv_sem, ack_sem,
+):
+    """HBM-streaming compute-into-the-ring GEMM-RS.
+
+    Step ``s`` (destination shard ``me+1+s``): the matmul pipeline for the
+    *next* destination runs between a ring DMA's start and its recv wait,
+    so each transfer hides under a full shard matmul. Double-buffered work
+    and recv slabs with the ack-based flow control of
+    kernels/reduce_scatter.py:ring_reduce_core (a sender may not rewrite a
+    slot its receiver hasn't folded in — semaphore credits count arrivals,
+    not consumption)."""
+    me = lang.my_pe(axis)
+    m_local = out_hbm.shape[0]
+    n_out = out_hbm.shape[1]
+    k = a_hbm.shape[1]
+    bm, bk, bn = blocks
+    mb, nb, kb = m_local // bm, n_out // bn, k // bk
+    left, right = ring_neighbors(me, n)
+    left = lang.pe_flat(axis, left, mesh_axes)
+    right = lang.pe_flat(axis, right, mesh_axes)
+    work = (w0, w1)
+    recv = (r0, r1)
+
+    if n == 1:
+        # Degenerate ring (bench/smoke path): out = A @ B, no RDMA.
+        mm_pipeline(mb, nb, kb, bm, bk, bn, acc_ref, m_off=0)(
+            a_hbm, b_hbm, out_hbm
+        )
+        return
+
+    lang.neighbor_barrier(axis, left, right)
+
+    def partial_into(dst, dst_ref):
+        # dst_ref = A[dst·m_local : (dst+1)·m_local, :] @ B   (streamed)
+        mm_pipeline(mb, nb, kb, bm, bk, bn, acc_ref, m_off=dst * mb, out_m_off=0)(
+            a_hbm, b_hbm, dst_ref
+        )
+
+    add = ew_add_pipeline(m_local, n_out, out_hbm.dtype.itemsize)
+
+    def ring_dma(slot):
+        return lang.remote_copy(
+            work[slot], recv[slot], send_sem.at[slot], recv_sem.at[slot], left
+        )
+
+    # my contribution to shard (me+1), the first one I forward
+    partial_into(jax.lax.rem(me + 1, n), work[0])
+
+    for s in range(n - 1):
+        slot = s % 2
+        chaos_delay()
+        if s >= 2:
+            # left must have folded my slot (s-2) before I rewrite it
+            pltpu.semaphore_wait(ack_sem, 1)
+        dma = ring_dma(slot)
+        dma.start()
+        # produce my contribution to the next destination while the
+        # accumulator is in flight
+        nxt = jax.lax.rem(me + 2 + s, n)
+        if s >= 1:
+            ring_dma(1 - slot).wait_send()  # slot reusable
+        partial_into(nxt, work[1 - slot])
+        dma.wait_recv()
+        # received: partial sum of shard (me+2+s) accumulated so far by
+        # the ring to my right; fold in my own contribution.
+        add(work[1 - slot], recv[slot], out_hbm if s == n - 2 else work[1 - slot])
+        lang.signal_op(ack_sem, 1, pe=right)
+
+    ring_dma((n - 2) % 2).wait_send()
+    # drain leftover acks: n-1 received, max(n-3, 0) consumed in-loop
+    pltpu.semaphore_wait(ack_sem, min(2, n - 1))
 
 
 def _specs(axis, batch_axes):
@@ -87,25 +174,46 @@ def _build_fused(
     n = mesh.shape[axis]
     dp = mesh_axes_size(mesh, batch_axes)
     m_local = a_shape[0] // (dp * n)
+    k_local = a_shape[1] // n
     n_out = b_shape[1]
+    blocks = pick_mm_blocks(m_local, k_local, n_out, dtype.itemsize)
+    if blocks is None:
+        raise ValueError(
+            f"gemm_rs PALLAS_FUSED: no divisor blocking for shard "
+            f"({m_local}, {k_local}) @ ({k_local}, {n_out}); use XLA_RING"
+        )
 
+    if n == 1:
+        collective_id = None  # degenerate path uses no barrier semaphore
+    slab = jax.ShapeDtypeStruct((m_local, n_out), out_dtype)
     call = lang.shmem_call(
-        functools.partial(_fused_kernel, n, axis, mesh.axis_names),
-        out_shape=jax.ShapeDtypeStruct((m_local, n_out), out_dtype),
-        in_specs=lang.vmem_specs(2),
+        functools.partial(_fused_kernel, n, axis, mesh.axis_names, blocks),
+        # work/recv ring slabs are HBM workspaces (Mosaic supports scratch
+        # only in vmem/smem/semaphore space, so they ride as extra outputs
+        # — the symmetric-workspace pattern of the reference's ctx).
+        out_shape=[slab, slab, slab, slab, slab],
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 5,
         scratch_shapes=[
-            pltpu.VMEM((m_local, n_out), out_dtype),
-            pltpu.VMEM((2, m_local, n_out), out_dtype),
+            pltpu.VMEM((blocks[0], blocks[2]), jnp.float32),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR,
         ],
         collective_id=collective_id,
+        vmem_limit_bytes=fused_vmem_budget(),
         name="gemm_rs_fused",
     )
     in_specs, out_specs = _specs(axis, batch_axes)
     fn = jax.shard_map(
-        call, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        lambda a, b: call(a, b)[0],
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=False,
     )
     return jax.jit(fn)
 
@@ -165,21 +273,28 @@ def _build_xla_naive(mesh, axis, batch_axes, out_dtype):
     return jax.jit(fn)
 
 
-def _fused_fits(n, m, k_local, n_out, itemsize) -> bool:
-    m_local = m // n
-    work = (m * k_local + k_local * n_out + 4 * m_local * n_out) * itemsize
-    return work <= fused_vmem_budget()
-
-
 def auto_gemm_rs_method(mesh, axis, a, b, dp: int = 1) -> GemmRSMethod:
+    """Topology + shape blockability decide the engine; fallbacks are
+    logged (nobody should benchmark XLA believing it is the fused kernel)."""
     n = mesh.shape[axis]
     topo = detect_topology(mesh, axis)
-    fits = _fused_fits(n, a.shape[0] // dp, a.shape[1] // n, b.shape[1], a.dtype.itemsize)
     if topo.link_kind == LinkKind.DCN:
+        _warn_once(
+            ("gemm_rs", "dcn", axis),
+            f"gemm_rs: axis {axis!r} crosses DCN; using XLA_RING engine",
+        )
         return GemmRSMethod.XLA_RING
-    if fits and (topo.link_kind == LinkKind.ICI or not on_tpu()):
-        return GemmRSMethod.PALLAS_FUSED
-    return GemmRSMethod.XLA_RING
+    m_local = a.shape[0] // (dp * n)
+    blocks = pick_mm_blocks(m_local, a.shape[1] // n, b.shape[1], a.dtype.itemsize)
+    if blocks is None:
+        _warn_once(
+            ("gemm_rs", "blocks", a.shape, b.shape),
+            f"gemm_rs: shard ({m_local}, {a.shape[1] // n}) @ "
+            f"({a.shape[1] // n}, {b.shape[1]}) admits no divisor blocking; "
+            "falling back to XLA_RING",
+        )
+        return GemmRSMethod.XLA_RING
+    return GemmRSMethod.PALLAS_FUSED
 
 
 def gemm_rs(
