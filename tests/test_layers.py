@@ -177,27 +177,54 @@ class TestMoELayers:
 
 
 class TestSpDecodeLayer:
-    def test_vs_xla(self, mesh8):
+    @pytest.mark.parametrize("kv_layout", ["bshd", "bhsd"])
+    def test_vs_xla(self, mesh8, kv_layout):
         b, hq, hkv, d, s = 2, 8, 2, 128, 1024
         layer = layers.SpGQAFlashDecodeAttention(
-            mesh8, "x", q_heads=hq, kv_heads=hkv, head_dim=d, block_k=128
+            mesh8, "x", q_heads=hq, kv_heads=hkv, head_dim=d, block_k=128,
+            kv_layout=kv_layout,
         )
         q = jax.random.normal(jax.random.PRNGKey(0), (b, hq, d), jnp.float32)
         k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, d), jnp.float32)
         v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d), jnp.float32)
         lens = jnp.array([900, 400], jnp.int32)
-        out = layer(q, k, v, lens)
         ref, _ = gqa_fwd_batch_decode_xla(q, k, v, lens)
+        if kv_layout == "bhsd":
+            k = k.transpose(0, 2, 1, 3)
+            v = v.transpose(0, 2, 1, 3)
+        out = layer(q, k, v, lens)
         assert_allclose(out, ref, atol=2e-2, rtol=2e-2)
 
-    def test_append_kv(self):
+    def test_uneven_block_k(self, mesh8):
+        """SP cache slices need not divide block_k: a 384-capacity slice
+        with the default block must round down, not assert (ADVICE r1)."""
+        b, hq, hkv, d, s = 2, 8, 2, 128, 8 * 384
+        layer = layers.SpGQAFlashDecodeAttention(
+            mesh8, "x", q_heads=hq, kv_heads=hkv, head_dim=d,
+            kv_layout="bhsd",
+        )
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, hq, d), jnp.float32)
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, hkv, s, d), jnp.float32)
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, hkv, s, d), jnp.float32)
+        lens = jnp.array([1000, 500], jnp.int32)
+        out = layer(q, k, v, lens)
+        ref, _ = gqa_fwd_batch_decode_xla(q, k, v, lens, kv_layout="bhsd")
+        assert_allclose(out, ref, atol=2e-2, rtol=2e-2)
+
+    @pytest.mark.parametrize("kv_layout", ["bshd", "bhsd"])
+    def test_append_kv(self, kv_layout):
         b, s, hkv, d = 2, 8, 2, 128
-        k = jnp.zeros((b, s, hkv, d))
-        v = jnp.zeros((b, s, hkv, d))
+        shape = (b, s, hkv, d) if kv_layout == "bshd" else (b, hkv, s, d)
+        k = jnp.zeros(shape)
+        v = jnp.zeros(shape)
         lens = jnp.array([3, 5], jnp.int32)
         kn = jnp.ones((b, hkv, d))
-        k2, v2, lens2 = layers.append_kv(k, v, lens, kn, kn * 2)
+        k2, v2, lens2 = layers.append_kv(k, v, lens, kn, kn * 2, kv_layout=kv_layout)
         np.testing.assert_array_equal(np.asarray(lens2), [4, 6])
-        assert float(k2[0, 3].sum()) == hkv * d
-        assert float(v2[1, 5].sum()) == 2 * hkv * d
-        assert float(k2[0, 4].sum()) == 0
+        if kv_layout == "bshd":
+            at = lambda c, bi, si: c[bi, si]
+        else:
+            at = lambda c, bi, si: c[bi, :, si]
+        assert float(at(k2, 0, 3).sum()) == hkv * d
+        assert float(at(v2, 1, 5).sum()) == 2 * hkv * d
+        assert float(at(k2, 0, 4).sum()) == 0

@@ -80,7 +80,11 @@ class TestTraining:
 class TestDecode:
     def test_sp_decode_matches_dense(self, mesh_tp):
         """generate() through the distributed flash-decode layer must
-        equal a dense incremental decode, token for token."""
+        match a dense incremental decode. Tokens are compared only where
+        the dense argmax margin is decisive: the Pallas online-softmax +
+        LSE combine reduces in a different order than dense softmax, so a
+        near-tie may legitimately break the other way on another backend
+        (ADVICE r1)."""
         model = _model(mesh_tp, moe="ep")
         params = _sharded_params(model)
         b, smax, steps = 2, 32, 3
@@ -90,8 +94,14 @@ class TestDecode:
         toks, _, lens2 = model.generate(params, caches, lens, first, steps)
         assert np.asarray(lens2).tolist() == [steps] * b
 
-        ref = self._dense_decode(model.config, params, first, b, smax, steps)
-        np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+        ref, margins = self._dense_decode(
+            model.config, params, first, b, smax, steps
+        )
+        decisive = np.asarray(margins) > 1e-3
+        assert decisive.any(), "degenerate test: every argmax is a near-tie"
+        np.testing.assert_array_equal(
+            np.asarray(toks)[decisive], np.asarray(ref)[decisive]
+        )
 
     @staticmethod
     def _dense_decode(c, params, last, b, smax, steps):
@@ -106,7 +116,7 @@ class TestDecode:
                 xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + c.norm_eps)
             ).astype(x.dtype) * w
 
-        outs = []
+        outs, margins = [], []
         for _ in range(steps):
             x = params["embed"][last]
             for li, blk in enumerate(params["blocks"]):
@@ -145,9 +155,12 @@ class TestDecode:
                     x = x + y
             lens = lens + 1
             x = rms(x, params["norm_f"])
-            last = jnp.argmax(x @ params["lm_head"], -1).astype(jnp.int32)
+            logits = x @ params["lm_head"]
+            last = jnp.argmax(logits, -1).astype(jnp.int32)
+            top2 = jax.lax.top_k(logits, 2)[0]
+            margins.append(top2[:, 0] - top2[:, 1])
             outs.append(last)
-        return jnp.stack(outs, 1)
+        return jnp.stack(outs, 1), jnp.stack(margins, 1)
 
 
 class TestRemat:

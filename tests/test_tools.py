@@ -71,6 +71,31 @@ class TestNative:
         with pytest.raises(IOError):
             artifact_read(path)
 
+    def test_artifact_truncation_detected_python_path(self, tmp_path, monkeypatch):
+        """A framed artifact cut short must raise, not come back as
+        garbage raw bytes misread as a legacy file (ADVICE r1)."""
+        from triton_distributed_tpu.tools import native as nat
+
+        path = str(tmp_path / "a.art")
+        artifact_write(path, b"payload-bytes-here" * 10)
+        raw = pathlib.Path(path).read_bytes()
+        pathlib.Path(path).write_bytes(raw[: len(raw) // 2])
+        monkeypatch.setattr(nat, "_lib_cache", [None])  # pure-python reader
+        with pytest.raises(IOError):
+            artifact_read(path)
+
+    def test_artifact_corruption_detected_python_path(self, tmp_path, monkeypatch):
+        from triton_distributed_tpu.tools import native as nat
+
+        path = str(tmp_path / "a.art")
+        artifact_write(path, b"payload-bytes-here" * 10)
+        raw = bytearray(pathlib.Path(path).read_bytes())
+        raw[20] ^= 0xFF
+        pathlib.Path(path).write_bytes(bytes(raw))
+        monkeypatch.setattr(nat, "_lib_cache", [None])
+        with pytest.raises(IOError):
+            artifact_read(path)
+
     def test_artifact_cross_environment(self, tmp_path, monkeypatch):
         """Native-written artifacts must be readable by the pure-python
         path and vice versa (same framed on-disk format)."""

@@ -334,6 +334,21 @@ def _build_xla_ring(mesh, axis, batch_axes, out_dtype):
 
 
 @functools.lru_cache(maxsize=256)
+def _build_gather(mesh, axis, batch_axes):
+    """Standalone row-gather used when ``return_gathered=True`` rides an
+    XLA engine (the fused engine produces the gathered A for free)."""
+    ba = tuple(batch_axes)
+    fn = jax.shard_map(
+        lambda x: jax.lax.all_gather(x, axis, tiled=True),
+        mesh=mesh,
+        in_specs=_specs(axis, batch_axes)[0][0],
+        out_specs=P(ba if ba else None, None),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=256)
 def _build_xla_naive(mesh, axis, batch_axes, out_dtype):
     def body(a_loc, b_loc):
         a_full = jax.lax.all_gather(a_loc, axis, tiled=True)
@@ -428,14 +443,5 @@ def ag_gemm(
         fn = _build_xla_naive(mesh, axis, batch_axes, out_dtype)
     out = fn(a, b)
     if return_gathered:
-        gathered = jax.jit(
-            jax.shard_map(
-                lambda x: jax.lax.all_gather(x, axis, tiled=True),
-                mesh=mesh,
-                in_specs=_specs(axis, batch_axes)[0][0],
-                out_specs=P(batch_axes if batch_axes else None, None),
-                check_vma=False,
-            )
-        )(a)
-        return out, gathered
+        return out, _build_gather(mesh, axis, batch_axes)(a)
     return out

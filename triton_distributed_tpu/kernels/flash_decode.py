@@ -65,8 +65,10 @@ def _decode_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     q = q_ref[0, 0]                            # (G, D), input dtype
-    k = k_ref[0]                               # (block_k, D)
-    v = v_ref[0]                               # (block_k, D)
+    # KV blocks arrive as (1, block_k, D) [bshd view] or (1, 1, block_k,
+    # D) [bhsd]; flatten the unit block dims either way.
+    k = k_ref[...].reshape(block_k, q.shape[-1])
+    v = v_ref[...].reshape(block_k, q.shape[-1])
 
     # Inputs stay in their native (bf16) dtype so the MXU runs at full
     # rate; accumulation is f32 via preferred_element_type.
@@ -100,46 +102,69 @@ def _decode_kernel(
         )
 
 
+def pick_block_k(s_len: int, requested: int) -> int:
+    """Largest divisor of ``s_len`` ≤ ``requested``, preferring sublane
+    multiples (16). Replaces the old hard divisibility assert: SP cache
+    slices (S/tp) may not divide the caller's block_k (e.g. capacity 384
+    with the default block), and nothing upstream enforces it."""
+    from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
+
+    return _divisor_block(s_len, requested, 16, strict=False) or 1
+
+
 @functools.partial(
-    jax.jit, static_argnames=("scale", "soft_cap", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("scale", "soft_cap", "block_k", "kv_layout", "interpret"),
 )
 def gqa_fwd_batch_decode(
     q, k_cache, v_cache, kv_lens, *,
     scale: float | None = None, soft_cap: float = 0.0,
-    block_k: int = 256, interpret=None,
+    block_k: int = 2048, kv_layout: str = "bshd", interpret=None,
 ):
     """Local GQA decode over a (sharded or whole) KV cache → (out, lse).
 
-    q: (B, Hq, D); k_cache/v_cache: (B, S, Hkv, D); kv_lens: (B,) int32
-    valid lengths. Returns out (B, Hq, D) in q.dtype and lse (B, Hq) f32
-    — the per-shard partials the SP combine consumes. ``lse`` is the
-    natural-log sum-exp of ``scale * q·k`` over valid positions
-    (≡ gqa_fwd_batch_decode, flash_decode.py:763-846, with the intra-rank
-    combine folded into the kernel's sequential KV walk).
+    q: (B, Hq, D); k_cache/v_cache: (B, S, Hkv, D) (``kv_layout="bshd"``,
+    the reference layout) or (B, Hkv, S, D) (``"bhsd"``, the fast decode
+    layout: each KV block is one contiguous DMA run — measured 97% of
+    HBM speed-of-light on a v5e vs 87% for the strided bshd view at the
+    same block size); kv_lens: (B,) int32 valid lengths. Returns out
+    (B, Hq, D) in q.dtype and lse (B, Hq) f32 — the per-shard partials
+    the SP combine consumes. ``lse`` is the natural-log sum-exp of
+    ``scale * q·k`` over valid positions (≡ gqa_fwd_batch_decode,
+    flash_decode.py:763-846, with the intra-rank combine folded into the
+    kernel's sequential KV walk).
     """
     batch, hq, d = q.shape
-    _, s_len, hkv, _ = k_cache.shape
+    if kv_layout == "bshd":
+        _, s_len, hkv, _ = k_cache.shape
+    elif kv_layout == "bhsd":
+        _, hkv, s_len, _ = k_cache.shape
+    else:
+        raise ValueError(f"kv_layout must be 'bshd' or 'bhsd', got {kv_layout!r}")
     assert hq % hkv == 0, f"GQA needs Hq % Hkv == 0, got {hq} % {hkv}"
     g = hq // hkv
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    block_k = min(block_k, s_len)
-    assert s_len % block_k == 0, f"S={s_len} not divisible by block_k={block_k}"
+    block_k = pick_block_k(s_len, block_k)
 
     qg = q.reshape(batch, hkv, g, d)
-    kf = k_cache.reshape(batch, s_len, hkv * d)   # free view, no copy
-    vf = v_cache.reshape(batch, s_len, hkv * d)
-
     grid = (batch, hkv, s_len // block_k)
     kernel = functools.partial(_decode_kernel, scale, soft_cap, block_k)
+    if kv_layout == "bshd":
+        kf = k_cache.reshape(batch, s_len, hkv * d)   # free view, no copy
+        vf = v_cache.reshape(batch, s_len, hkv * d)
+        kv_spec = pl.BlockSpec((1, block_k, d), lambda b, h, k: (b, k, h))
+    else:
+        kf, vf = k_cache, v_cache
+        kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda b, h, k: (b, h, k, 0))
     call = shmem_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # kv_lens, whole (B,)
             pl.BlockSpec((1, 1, g, d), lambda b, h, k: (b, h, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, h, k: (b, k, h)),
-            pl.BlockSpec((1, block_k, d), lambda b, h, k: (b, k, h)),
+            kv_spec,
+            kv_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, g, d), lambda b, h, k: (b, h, 0, 0)),
@@ -164,7 +189,7 @@ def gqa_fwd_batch_decode(
 
 def gqa_fwd_batch_decode_aot(
     *, scale: float | None = None, soft_cap: float = 0.0,
-    block_k: int = 256, cache_dir=".aot_cache",
+    block_k: int = 2048, kv_layout: str = "bshd", cache_dir=".aot_cache",
 ):
     """AOT twin of :func:`gqa_fwd_batch_decode` (≡ the ``*_aot`` entries
     calling pre-compiled kernels, flash_decode.py:1007-1160): returns a
@@ -176,25 +201,35 @@ def gqa_fwd_batch_decode_aot(
         return gqa_fwd_batch_decode(
             q, k_cache, v_cache, kv_lens,
             scale=scale, soft_cap=soft_cap, block_k=block_k,
+            kv_layout=kv_layout,
         )
 
     # hyperparameters are part of the artifact identity — two libraries
     # sharing a cache_dir must never reuse each other's kernels
-    name = f"gqa_decode-bk{block_k}-sc{soft_cap}-s{scale}"
+    name = f"gqa_decode-bk{block_k}-sc{soft_cap}-s{scale}-{kv_layout}"
     return AotLibrary(entry, name=name, cache_dir=cache_dir)
 
 
-def gqa_fwd_batch_decode_xla(q, k_cache, v_cache, kv_lens, *, scale=None, soft_cap=0.0):
+def gqa_fwd_batch_decode_xla(
+    q, k_cache, v_cache, kv_lens, *, scale=None, soft_cap=0.0,
+    kv_layout: str = "bshd",
+):
     """Dense-XLA twin of :func:`gqa_fwd_batch_decode` (correctness
     reference, ≡ the torch baselines in test_decode_attn.py)."""
     batch, hq, d = q.shape
-    _, s_len, hkv, _ = k_cache.shape
+    if kv_layout == "bshd":
+        s_len = k_cache.shape[1]
+        kt = k_cache.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,Hkv,S,D)
+        vt = v_cache.transpose(0, 2, 1, 3).astype(jnp.float32)
+    else:
+        s_len = k_cache.shape[2]
+        kt = k_cache.astype(jnp.float32)
+        vt = v_cache.astype(jnp.float32)
+    hkv = kt.shape[1]
     g = hq // hkv
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     qg = q.reshape(batch, hkv, g, d).astype(jnp.float32)
-    kt = k_cache.transpose(0, 2, 1, 3).astype(jnp.float32)   # (B,Hkv,S,D)
-    vt = v_cache.transpose(0, 2, 1, 3).astype(jnp.float32)
     s = jnp.einsum("bhgd,bhsd->bhgs", qg, kt) * scale
     if soft_cap > 0.0:
         s = soft_cap * jnp.tanh(s / soft_cap)
@@ -229,16 +264,16 @@ def combine_partials(outs, lses, out_dtype=None):
 
 def _local_shard_decode(
     q, k_shard, v_shard, global_kv_lens, axis, *,
-    scale, soft_cap, block_k, use_pallas, interpret=None,
+    scale, soft_cap, block_k, use_pallas, kv_layout="bshd", interpret=None,
 ):
     """Rank-local decode over this rank's contiguous KV slice → (out, lse)."""
     r = jax.lax.axis_index(axis)
-    s_loc = k_shard.shape[1]
+    s_loc = k_shard.shape[1 if kv_layout == "bshd" else 2]
     local_lens = jnp.clip(global_kv_lens - r * s_loc, 0, s_loc).astype(jnp.int32)
     decode = gqa_fwd_batch_decode if use_pallas else gqa_fwd_batch_decode_xla
-    kwargs = dict(scale=scale, soft_cap=soft_cap)
+    kwargs = dict(scale=scale, soft_cap=soft_cap, kv_layout=kv_layout)
     if use_pallas:
-        kwargs.update(block_k=min(block_k, s_loc), interpret=interpret)
+        kwargs.update(block_k=block_k, interpret=interpret)
     return decode(q, k_shard, v_shard, local_lens, **kwargs)
 
 
@@ -257,12 +292,14 @@ def _merge_shard_partials(out, lse, axis):
 
 def sp_gqa_fwd_batch_decode_device(
     q, k_shard, v_shard, global_kv_lens, axis, *,
-    scale=None, soft_cap=0.0, block_k=256, use_pallas=True, interpret=None,
+    scale=None, soft_cap=0.0, block_k=2048, use_pallas=True,
+    kv_layout="bshd", interpret=None,
 ):
     """Per-device SP decode body — callable inside any shard_map.
 
-    q: (B, Hq, D) replicated across ``axis``; k_shard/v_shard:
-    (B, S/R, Hkv, D) — this rank's contiguous slice of the sequence;
+    q: (B, Hq, D) replicated across ``axis``; k_shard/v_shard: this
+    rank's contiguous slice of the sequence — (B, S/R, Hkv, D) for
+    ``kv_layout="bshd"`` or (B, Hkv, S/R, D) for ``"bhsd"``;
     global_kv_lens: (B,) TOTAL valid lengths. ≡ SpGQAFlashDecodeAttention
     .forward (sp_flash_decode_layer.py:78-184): local decode → AG of
     (out, lse) → inter-rank combine.
@@ -270,13 +307,13 @@ def sp_gqa_fwd_batch_decode_device(
     out, lse = _local_shard_decode(
         q, k_shard, v_shard, global_kv_lens, axis,
         scale=scale, soft_cap=soft_cap, block_k=block_k,
-        use_pallas=use_pallas, interpret=interpret,
+        use_pallas=use_pallas, kv_layout=kv_layout, interpret=interpret,
     )
     return _merge_shard_partials(out, lse, axis)
 
 
 @functools.lru_cache(maxsize=64)
-def _sp_decode_fns(mesh, axis, scale, soft_cap, block_k, use_pallas):
+def _sp_decode_fns(mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout):
     """Jitted (local, merge) pair for :func:`sp_gqa_fwd_batch_decode`,
     cached so repeated decode steps don't retrace/recompile."""
     # Two dispatches, not one: on the CPU-interpreter path, mixing the
@@ -287,14 +324,15 @@ def _sp_decode_fns(mesh, axis, scale, soft_cap, block_k, use_pallas):
         return _local_shard_decode(
             q, k_shard, v_shard, lens, axis,
             scale=scale, soft_cap=soft_cap, block_k=block_k,
-            use_pallas=use_pallas,
+            use_pallas=use_pallas, kv_layout=kv_layout,
         )
 
+    kv_spec = P(None, axis) if kv_layout == "bshd" else P(None, None, axis)
     local_fn = jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(), P(None, axis), P(None, axis), P()),
+            in_specs=(P(), kv_spec, kv_spec, P()),
             out_specs=(P(axis), P(axis)),
             check_vma=False,
         )
@@ -313,15 +351,17 @@ def _sp_decode_fns(mesh, axis, scale, soft_cap, block_k, use_pallas):
 
 def sp_gqa_fwd_batch_decode(
     q, k_cache, v_cache, global_kv_lens, mesh, axis="x", *,
-    scale=None, soft_cap=0.0, block_k=256, use_pallas=True,
+    scale=None, soft_cap=0.0, block_k=2048, use_pallas=True,
+    kv_layout="bshd",
 ):
     """Host entry: sequence-parallel GQA decode on ``mesh``.
 
-    k_cache/v_cache: (B, S, Hkv, D) with S sharded over ``axis``; q and
-    global_kv_lens replicated. Returns (B, Hq, D) replicated.
+    k_cache/v_cache: (B, S, Hkv, D) [bshd] or (B, Hkv, S, D) [bhsd] with
+    S sharded over ``axis``; q and global_kv_lens replicated. Returns
+    (B, Hq, D) replicated.
     """
     local_fn, merge_fn = _sp_decode_fns(
-        mesh, axis, scale, soft_cap, block_k, use_pallas
+        mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout
     )
     out, lse = local_fn(q, k_cache, v_cache, global_kv_lens)
     return merge_fn(out, lse)

@@ -380,13 +380,13 @@ class Transformer:
         )
 
     def init_cache(self, batch: int, max_len: int):
-        """Per-layer (k, v) caches, (B, S, Hkv, D) sequence-sharded over
-        tp — the SP decode layout (≡ the KV sharding of
-        sp_flash_decode_layer.py: each rank holds its slice of the
-        sequence)."""
+        """Per-layer (k, v) caches, (B, Hkv, S, D) ["bhsd", the fast
+        decode layout — contiguous KV block DMAs] sequence-sharded over
+        tp (≡ the KV sharding of sp_flash_decode_layer.py: each rank
+        holds its slice of the sequence)."""
         c = self.config
-        spec = NamedSharding(self.mesh, P(None, self.tp_axis))
-        z = jnp.zeros((batch, max_len, c.n_kv_heads, c.head_dim), c.dtype)
+        spec = NamedSharding(self.mesh, P(None, None, self.tp_axis))
+        z = jnp.zeros((batch, c.n_kv_heads, max_len, c.head_dim), c.dtype)
         return [
             (jax.device_put(z, spec), jax.device_put(z, spec))
             for _ in range(c.n_layers)
@@ -415,7 +415,7 @@ class Transformer:
             q = q.reshape(b, c.n_heads, c.head_dim)
             k = k.reshape(b, c.n_kv_heads, c.head_dim)
             v = v.reshape(b, c.n_kv_heads, c.head_dim)
-            ck, cv, _ = append_kv(ck, cv, kv_lens, k, v)
+            ck, cv, _ = append_kv(ck, cv, kv_lens, k, v, kv_layout="bhsd")
             new_caches.append((ck, cv))
             o = self._sp_attn(q, ck, cv, kv_lens + 1)           # (B, Hq, D)
             o = o.reshape(b, c.q_dim) @ blk["wo"].astype(c.dtype)
@@ -447,7 +447,7 @@ class Transformer:
     def generate(self, params, caches, kv_lens, last_tokens, steps: int):
         """Greedy decode ``steps`` tokens. The whole decode step is one
         jitted program (cached across steps and calls by shape)."""
-        cap = caches[0][0].shape[1]
+        cap = caches[0][0].shape[2]  # (B, Hkv, S, D) bhsd layout
         try:
             max_len = int(np.asarray(kv_lens).max()) + steps
             assert max_len <= cap, (

@@ -111,14 +111,24 @@ def artifact_read(path: str) -> bytes:
             if rc == 0:
                 return bytes(out)
     raw = pathlib.Path(path).read_bytes()
-    if len(raw) >= 24:
-        magic, length = struct.unpack_from("<QQ", raw, 0)
-        if magic == _ART_MAGIC and len(raw) == 24 + length:
-            payload = raw[16 : 16 + length]
-            (stored,) = struct.unpack_from("<Q", raw, 16 + length)
-            if _fnv1a(payload) != stored:
-                raise IOError(f"artifact checksum mismatch: {path}")
-            return payload
+    if len(raw) >= 8 and struct.unpack_from("<Q", raw, 0)[0] == _ART_MAGIC:
+        # Magic present → this IS a framed artifact; a bad length or
+        # checksum is corruption/truncation, not a legacy file (returning
+        # the raw bytes would hand garbage to a downstream parser —
+        # mirror the native rc=-3 error path instead; ADVICE r1).
+        if len(raw) < 24:
+            raise IOError(f"artifact truncated: {path}")
+        _, length = struct.unpack_from("<QQ", raw, 0)
+        if len(raw) != 24 + length:
+            raise IOError(
+                f"artifact length mismatch: {path} ({len(raw)} bytes, "
+                f"frame says {24 + length})"
+            )
+        payload = raw[16 : 16 + length]
+        (stored,) = struct.unpack_from("<Q", raw, 16 + length)
+        if _fnv1a(payload) != stored:
+            raise IOError(f"artifact checksum mismatch: {path}")
+        return payload
     return raw                     # pre-framing legacy file: raw payload
 
 
