@@ -19,20 +19,22 @@ from triton_distributed_tpu.kernels.flash_decode import (
 from triton_distributed_tpu.utils import assert_allclose
 
 
-def _setup(batch=2, hq=8, hkv=2, d=128, s=512, seed=0):
+def _setup(batch=2, hq=8, hkv=2, d=128, s=512, seed=0, layout="bshd"):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)
     q = jax.random.normal(ks[0], (batch, hq, d), jnp.float32)
-    k = jax.random.normal(ks[1], (batch, s, hkv, d), jnp.float32)
-    v = jax.random.normal(ks[2], (batch, s, hkv, d), jnp.float32)
+    shape = (batch, s, hkv, d) if layout == "bshd" else (batch, hkv, s, d)
+    k = jax.random.normal(ks[1], shape, jnp.float32)
+    v = jax.random.normal(ks[2], shape, jnp.float32)
     return q, k, v
 
 
+@pytest.mark.parametrize("kv_layout", ["bshd", "bhsd"])
 @pytest.mark.parametrize("kv_lens", [[512, 512], [300, 17], [512, 1]])
-def test_local_decode_matches_xla(kv_lens):
-    q, k, v = _setup()
+def test_local_decode_matches_xla(kv_lens, kv_layout):
+    q, k, v = _setup(layout=kv_layout)
     lens = jnp.asarray(kv_lens, jnp.int32)
-    out, lse = gqa_fwd_batch_decode(q, k, v, lens, block_k=128)
-    out_ref, lse_ref = gqa_fwd_batch_decode_xla(q, k, v, lens)
+    out, lse = gqa_fwd_batch_decode(q, k, v, lens, block_k=128, kv_layout=kv_layout)
+    out_ref, lse_ref = gqa_fwd_batch_decode_xla(q, k, v, lens, kv_layout=kv_layout)
     assert_allclose(np.asarray(out), np.asarray(out_ref), atol=2e-5, rtol=2e-5)
     assert_allclose(np.asarray(lse), np.asarray(lse_ref), atol=2e-5, rtol=2e-5)
 
@@ -40,8 +42,12 @@ def test_local_decode_matches_xla(kv_lens):
 def test_local_decode_soft_cap():
     q, k, v = _setup(seed=3)
     lens = jnp.asarray([512, 211], jnp.int32)
-    out, _ = gqa_fwd_batch_decode(q, k, v, lens, soft_cap=30.0, block_k=128)
-    out_ref, _ = gqa_fwd_batch_decode_xla(q, k, v, lens, soft_cap=30.0)
+    out, _ = gqa_fwd_batch_decode(
+        q, k, v, lens, soft_cap=30.0, block_k=128, kv_layout="bshd"
+    )
+    out_ref, _ = gqa_fwd_batch_decode_xla(
+        q, k, v, lens, soft_cap=30.0, kv_layout="bshd"
+    )
     assert_allclose(np.asarray(out), np.asarray(out_ref), atol=2e-5, rtol=2e-5)
 
 
@@ -50,14 +56,16 @@ def test_combine_partials_is_exact_softmax_merge():
     attention over the whole sequence (the ring-attention invariant)."""
     q, k, v = _setup(batch=1, s=512, seed=1)
     lens = jnp.asarray([512], jnp.int32)
-    whole, whole_lse = gqa_fwd_batch_decode_xla(q, k, v, lens)
+    whole, whole_lse = gqa_fwd_batch_decode_xla(q, k, v, lens, kv_layout="bshd")
 
     outs, lses = [], []
     r = 4
     for i in range(r):
         ks = k[:, i * 128 : (i + 1) * 128]
         vs = v[:, i * 128 : (i + 1) * 128]
-        o, l = gqa_fwd_batch_decode_xla(q, ks, vs, jnp.asarray([128], jnp.int32))
+        o, l = gqa_fwd_batch_decode_xla(
+            q, ks, vs, jnp.asarray([128], jnp.int32), kv_layout="bshd"
+        )
         outs.append(o)
         lses.append(l)
     merged, merged_lse = combine_partials(jnp.stack(outs), jnp.stack(lses))
@@ -68,9 +76,9 @@ def test_combine_partials_is_exact_softmax_merge():
 def test_combine_partials_empty_shard_contributes_zero():
     q, k, v = _setup(batch=1, s=128, seed=2)
     lens = jnp.asarray([128], jnp.int32)
-    out, lse = gqa_fwd_batch_decode_xla(q, k, v, lens)
+    out, lse = gqa_fwd_batch_decode_xla(q, k, v, lens, kv_layout="bshd")
     empty_out, empty_lse = gqa_fwd_batch_decode_xla(
-        q, k, v, jnp.asarray([0], jnp.int32)
+        q, k, v, jnp.asarray([0], jnp.int32), kv_layout="bshd"
     )
     merged, _ = combine_partials(
         jnp.stack([out, empty_out]), jnp.stack([lse, empty_lse])
@@ -87,9 +95,10 @@ def test_sp_decode_matches_dense(mesh8, use_pallas, global_len):
     q, k, v = _setup(batch=2, s=1024, seed=4)
     lens = jnp.asarray([global_len, max(global_len // 2, 1)], jnp.int32)
     out = sp_gqa_fwd_batch_decode(
-        q, k, v, lens, mesh8, "x", use_pallas=use_pallas, block_k=128
+        q, k, v, lens, mesh8, "x", use_pallas=use_pallas, block_k=128,
+        kv_layout="bshd",
     )
-    out_ref, _ = gqa_fwd_batch_decode_xla(q, k, v, lens)
+    out_ref, _ = gqa_fwd_batch_decode_xla(q, k, v, lens, kv_layout="bshd")
     assert_allclose(np.asarray(out), np.asarray(out_ref), atol=3e-5, rtol=3e-5)
 
 
@@ -107,17 +116,19 @@ def test_aot_twin_roundtrip(tmp_path):
     v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d), jnp.float32)
     lens = jnp.array([400, 100], jnp.int32)
 
-    lib = gqa_fwd_batch_decode_aot(block_k=128, cache_dir=tmp_path)
+    lib = gqa_fwd_batch_decode_aot(block_k=128, kv_layout="bshd", cache_dir=tmp_path)
     path = lib.compile(q, k, v, lens)
     assert path.exists()
     # a fresh library finds the artifact on disk — no retrace
-    lib2 = gqa_fwd_batch_decode_aot(block_k=128, cache_dir=tmp_path)
+    lib2 = gqa_fwd_batch_decode_aot(block_k=128, kv_layout="bshd", cache_dir=tmp_path)
     out, lse = lib2(q, k, v, lens)
     assert lib2.stats == {"artifact_loads": 1, "jit_fallbacks": 0}
     # different hyperparameters must NOT reuse the artifact
-    lib3 = gqa_fwd_batch_decode_aot(block_k=128, soft_cap=30.0, cache_dir=tmp_path)
+    lib3 = gqa_fwd_batch_decode_aot(
+        block_k=128, soft_cap=30.0, kv_layout="bshd", cache_dir=tmp_path
+    )
     lib3(q, k, v, lens)
     assert lib3.stats["jit_fallbacks"] == 1
-    ref, ref_lse = gqa_fwd_batch_decode(q, k, v, lens, block_k=128)
+    ref, ref_lse = gqa_fwd_batch_decode(q, k, v, lens, block_k=128, kv_layout="bshd")
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=1e-5)

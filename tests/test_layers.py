@@ -188,7 +188,7 @@ class TestSpDecodeLayer:
         k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, d), jnp.float32)
         v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d), jnp.float32)
         lens = jnp.array([900, 400], jnp.int32)
-        ref, _ = gqa_fwd_batch_decode_xla(q, k, v, lens)
+        ref, _ = gqa_fwd_batch_decode_xla(q, k, v, lens, kv_layout="bshd")
         if kv_layout == "bhsd":
             k = k.transpose(0, 2, 1, 3)
             v = v.transpose(0, 2, 1, 3)

@@ -97,11 +97,20 @@ class TestDecode:
         ref, margins = self._dense_decode(
             model.config, params, first, b, smax, steps
         )
-        decisive = np.asarray(margins) > 1e-3
-        assert decisive.any(), "degenerate test: every argmax is a near-tie"
-        np.testing.assert_array_equal(
-            np.asarray(toks)[decisive], np.asarray(ref)[decisive]
+        # Compare each row only up to its first near-tie: after a
+        # legitimately flipped argmax the two trajectories condition on
+        # different prefixes, so later tokens are incomparable even
+        # where the dense margin is decisive.
+        nondecisive = np.asarray(margins) <= 1e-3
+        first_bad = np.where(
+            nondecisive.any(axis=1), nondecisive.argmax(axis=1), steps
         )
+        assert (first_bad > 0).any(), "degenerate test: immediate near-ties"
+        toks_np, ref_np = np.asarray(toks), np.asarray(ref)
+        for i in range(b):
+            np.testing.assert_array_equal(
+                toks_np[i, : first_bad[i]], ref_np[i, : first_bad[i]]
+            )
 
     @staticmethod
     def _dense_decode(c, params, last, b, smax, steps):

@@ -86,3 +86,91 @@ def test_ag_group_gemm_sorted_layout(mesh8):
         if s < M * TOPK:
             expect = np.asarray(xs[r] @ w_up[flat[s]])
             np.testing.assert_allclose(y_np[r], expect, atol=2e-5, rtol=2e-5)
+
+
+class TestOverlapped:
+    """Single-kernel overlapped engines (kernels/moe_tp_fused.py) vs the
+    dense reference and the composed pipeline (VERDICT r1 #4)."""
+
+    def _ctx(self, mesh8, **kw):
+        from triton_distributed_tpu.ops.moe_tp import (
+            create_ag_group_gemm_context,
+        )
+
+        return create_ag_group_gemm_context(
+            mesh8, "x", num_experts=E, topk=TOPK, block_m=8,
+            dtype=jnp.float32, **kw,
+        )
+
+    def test_overlapped_mlp_vs_dense(self, mesh8):
+        from triton_distributed_tpu.ops.moe_tp import moe_tp_mlp_overlapped
+
+        x, w_up, w_down, weights, ids = _data()
+        ctx = self._ctx(mesh8)
+        xg = jax.device_put(x, NamedSharding(mesh8, P("x")))
+        idsg = jax.device_put(ids, NamedSharding(mesh8, P("x")))
+        wg = jax.device_put(weights, NamedSharding(mesh8, P("x")))
+        wug = jax.device_put(w_up, NamedSharding(mesh8, P(None, None, "x")))
+        wdg = jax.device_put(w_down, NamedSharding(mesh8, P(None, "x")))
+        out = moe_tp_mlp_overlapped(xg, idsg, wg, wug, wdg, ctx)
+        ref = _dense_ref(x, w_up, w_down, weights, ids)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_overlapped_matches_composed(self, mesh8):
+        """Same inputs through both pipelines must agree tightly — the
+        'fused replaces composed' contract."""
+        from triton_distributed_tpu.ops.moe_tp import (
+            ag_group_gemm,
+            align_routing,
+            moe_reduce_rs,
+            moe_tp_mlp_overlapped,
+        )
+
+        x, w_up, w_down, weights, ids = _data()
+        ctx = self._ctx(mesh8)
+        xg = jax.device_put(x, NamedSharding(mesh8, P("x")))
+        wug = jax.device_put(w_up, NamedSharding(mesh8, P(None, None, "x")))
+        wdg = jax.device_put(w_down, NamedSharding(mesh8, P(None, "x")))
+        routing = align_routing(ctx, ids)
+        y = ag_group_gemm(xg, routing, wug, ctx)
+        composed = moe_reduce_rs(jax.nn.silu(y), routing, weights, wdg, ctx)
+
+        idsg = jax.device_put(ids, NamedSharding(mesh8, P("x")))
+        wg = jax.device_put(weights, NamedSharding(mesh8, P("x")))
+        fused = moe_tp_mlp_overlapped(xg, idsg, wg, wug, wdg, ctx)
+        np.testing.assert_allclose(
+            np.asarray(fused), np.asarray(composed), atol=1e-5, rtol=1e-5
+        )
+
+    def test_overlapped_sorted_layout(self, mesh8):
+        """ag_group_gemm_fused returns per-shard sorted slabs: slab s ==
+        grouped GEMM over shard s's locally sorted tokens."""
+        from triton_distributed_tpu.ops.moe_tp import (
+            ag_group_gemm_fused,
+            align_routing_sharded,
+        )
+
+        x, w_up, _, _, ids = _data()
+        ctx = self._ctx(mesh8)
+        xg = jax.device_put(x, NamedSharding(mesh8, P("x")))
+        wug = jax.device_put(w_up, NamedSharding(mesh8, P(None, None, "x")))
+        routing = align_routing_sharded(ctx, ids)
+        y = np.asarray(ag_group_gemm_fused(xg, routing, wug, ctx))
+        tp = ctx.tp
+        m_s = M // tp
+        cap_s = routing.cap_s
+        for s in range(0, tp, 3):
+            ids_s = np.asarray(ids)[s * m_s:(s + 1) * m_s]
+            x_s = np.asarray(x)[s * m_s:(s + 1) * m_s]
+            sti = np.asarray(routing.sti[s])
+            xs = np.asarray(mu.gather_sorted(jnp.asarray(x_s), jnp.asarray(sti), TOPK))
+            flat = ids_s.reshape(-1)
+            slab = y[s * cap_s:(s + 1) * cap_s]
+            for r in range(0, cap_s, 29):
+                if sti[r] < m_s * TOPK:
+                    expect = xs[r] @ w_up[flat[sti[r]]]
+                    np.testing.assert_allclose(
+                        slab[r], expect, atol=2e-5, rtol=2e-5
+                    )

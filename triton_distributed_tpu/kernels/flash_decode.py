@@ -119,15 +119,18 @@ def pick_block_k(s_len: int, requested: int) -> int:
 def gqa_fwd_batch_decode(
     q, k_cache, v_cache, kv_lens, *,
     scale: float | None = None, soft_cap: float = 0.0,
-    block_k: int = 2048, kv_layout: str = "bshd", interpret=None,
+    block_k: int = 2048, kv_layout: str = "bhsd", interpret=None,
 ):
     """Local GQA decode over a (sharded or whole) KV cache → (out, lse).
 
-    q: (B, Hq, D); k_cache/v_cache: (B, S, Hkv, D) (``kv_layout="bshd"``,
-    the reference layout) or (B, Hkv, S, D) (``"bhsd"``, the fast decode
-    layout: each KV block is one contiguous DMA run — measured 97% of
-    HBM speed-of-light on a v5e vs 87% for the strided bshd view at the
-    same block size); kv_lens: (B,) int32 valid lengths. Returns out
+    q: (B, Hq, D); k_cache/v_cache: (B, Hkv, S, D) (``kv_layout="bhsd"``,
+    the framework's native decode layout: each KV block is one contiguous
+    DMA run — measured 97% of HBM speed-of-light on a v5e vs 87% for the
+    strided view at the same block size) or (B, S, Hkv, D) (``"bshd"``,
+    the reference-style layout); kv_lens: (B,) int32 valid lengths.
+    The layout default is "bhsd" EVERYWHERE in this stack (kernel, XLA
+    twin, AOT twin, SP entries, layer, append_kv) — callers holding
+    reference-style caches must pass kv_layout="bshd" explicitly. Returns out
     (B, Hq, D) in q.dtype and lse (B, Hq) f32 — the per-shard partials
     the SP combine consumes. ``lse`` is the natural-log sum-exp of
     ``scale * q·k`` over valid positions (≡ gqa_fwd_batch_decode,
@@ -189,7 +192,7 @@ def gqa_fwd_batch_decode(
 
 def gqa_fwd_batch_decode_aot(
     *, scale: float | None = None, soft_cap: float = 0.0,
-    block_k: int = 2048, kv_layout: str = "bshd", cache_dir=".aot_cache",
+    block_k: int = 2048, kv_layout: str = "bhsd", cache_dir=".aot_cache",
 ):
     """AOT twin of :func:`gqa_fwd_batch_decode` (≡ the ``*_aot`` entries
     calling pre-compiled kernels, flash_decode.py:1007-1160): returns a
@@ -212,7 +215,7 @@ def gqa_fwd_batch_decode_aot(
 
 def gqa_fwd_batch_decode_xla(
     q, k_cache, v_cache, kv_lens, *, scale=None, soft_cap=0.0,
-    kv_layout: str = "bshd",
+    kv_layout: str = "bhsd",
 ):
     """Dense-XLA twin of :func:`gqa_fwd_batch_decode` (correctness
     reference, ≡ the torch baselines in test_decode_attn.py)."""
@@ -264,7 +267,7 @@ def combine_partials(outs, lses, out_dtype=None):
 
 def _local_shard_decode(
     q, k_shard, v_shard, global_kv_lens, axis, *,
-    scale, soft_cap, block_k, use_pallas, kv_layout="bshd", interpret=None,
+    scale, soft_cap, block_k, use_pallas, kv_layout="bhsd", interpret=None,
 ):
     """Rank-local decode over this rank's contiguous KV slice → (out, lse)."""
     r = jax.lax.axis_index(axis)
@@ -293,13 +296,14 @@ def _merge_shard_partials(out, lse, axis):
 def sp_gqa_fwd_batch_decode_device(
     q, k_shard, v_shard, global_kv_lens, axis, *,
     scale=None, soft_cap=0.0, block_k=2048, use_pallas=True,
-    kv_layout="bshd", interpret=None,
+    kv_layout="bhsd", interpret=None,
 ):
     """Per-device SP decode body — callable inside any shard_map.
 
     q: (B, Hq, D) replicated across ``axis``; k_shard/v_shard: this
-    rank's contiguous slice of the sequence — (B, S/R, Hkv, D) for
-    ``kv_layout="bshd"`` or (B, Hkv, S/R, D) for ``"bhsd"``;
+    rank's contiguous slice of the sequence — (B, Hkv, S/R, D) for
+    ``kv_layout="bhsd"`` (native, default) or (B, S/R, Hkv, D) for
+    ``"bshd"``;
     global_kv_lens: (B,) TOTAL valid lengths. ≡ SpGQAFlashDecodeAttention
     .forward (sp_flash_decode_layer.py:78-184): local decode → AG of
     (out, lse) → inter-rank combine.
@@ -352,13 +356,13 @@ def _sp_decode_fns(mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout):
 def sp_gqa_fwd_batch_decode(
     q, k_cache, v_cache, global_kv_lens, mesh, axis="x", *,
     scale=None, soft_cap=0.0, block_k=2048, use_pallas=True,
-    kv_layout="bshd",
+    kv_layout="bhsd",
 ):
     """Host entry: sequence-parallel GQA decode on ``mesh``.
 
-    k_cache/v_cache: (B, S, Hkv, D) [bshd] or (B, Hkv, S, D) [bhsd] with
-    S sharded over ``axis``; q and global_kv_lens replicated. Returns
-    (B, Hq, D) replicated.
+    k_cache/v_cache: (B, Hkv, S, D) [bhsd, native default] or
+    (B, S, Hkv, D) [bshd] with S sharded over ``axis``; q and
+    global_kv_lens replicated. Returns (B, Hq, D) replicated.
     """
     local_fn, merge_fn = _sp_decode_fns(
         mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout

@@ -1,0 +1,285 @@
+"""Single-kernel overlapped MoE-TP engines: AG⊕GroupGEMM and GroupGEMM⊕RS.
+
+Reference: python/triton_dist/kernels/nvidia/allgather_group_gemm.py —
+``kernel_consumer_m_parallel_scatter_group_gemm`` waits per-tile on the
+producer AG barrier before consuming gathered tokens (:420-498) — and
+moe_reduce_rs.py — the producer grouped GEMM signals per-rank tile
+counters into a consumer reduce-scatter pipeline (:362-545).
+
+TPU re-design (the key restructuring): tokens ride the ring **pre-sorted
+per shard**. Each device expert-sorts its own token rows locally (cheap
+XLA gather) and the ring ships those padded sorted slabs, so every
+arriving shard is immediately a contiguous grouped-GEMM operand — no
+in-kernel gather, fully static shapes. Consequences:
+
+* The overlap structure collapses into the ag_gemm/gemm_rs streaming
+  rings: at step ``s`` the grouped-GEMM pipeline for the shard that just
+  arrived runs on the MXU while the next shard's RDMA is in flight. The
+  per-tile ``dl.wait`` of the reference becomes the per-shard recv-DMA
+  semaphore wait, with expert-id block indexing via an SMEM table
+  (the scalar-prefetch idiom of kernels/group_gemm.py).
+* The sorted layout is **per-shard**: outputs are (tp·cap_s, ·) where
+  slab ``s`` holds shard ``s``'s tokens in its own expert-sorted order.
+  The topk combine happens after the reduce ring, on each destination's
+  own rows only — which is exactly the locality that makes the reduce
+  ring a plain ring over sorted slabs.
+* Wire bytes are topk× the raw-token AG (sorted rows duplicate each
+  token topk times). Compute scales by the same topk, so the
+  compute-to-comm ratio — what overlap depends on — is unchanged, and
+  the transfers stay hidden under the MXU at north-star shapes. The
+  trade buys contiguous DMAs and no dynamic in-kernel addressing.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu import lang
+from triton_distributed_tpu.config import fused_vmem_budget
+from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
+from triton_distributed_tpu.kernels.gemm_rs import ew_add_pipeline
+from triton_distributed_tpu.runtime import ring_neighbors
+from triton_distributed_tpu.utils.testing import chaos_delay
+
+
+def pick_gg_blocks(block_m: int, cap: int, k: int, nl: int, itemsize: int):
+    """(bm, bk, bn) for the grouped pipelines. bm is pinned to the routing
+    ``block_m`` (one expert per A-block is the grouped-GEMM contract);
+    bk/bn stream K and the output columns."""
+    from triton_distributed_tpu.config import on_tpu
+
+    strict = on_tpu()
+    if cap % block_m:
+        return None
+    if strict and block_m % (8 * (4 // itemsize)):
+        return None  # sublane-misaligned routing block on real hardware
+    bk = _divisor_block(k, 512, 128, strict)
+    bn = _divisor_block(nl, 1792, 128, strict)
+    if bk is None or bn is None:
+        return None
+    work = 2 * (block_m * bk + bk * bn) * itemsize \
+        + 2 * block_m * bn * itemsize + 4 * block_m * bn
+    if work > fused_vmem_budget():
+        return None
+    return block_m, bk, bn
+
+
+def gmm_pipeline(mb, nb, kb, blocks, acc_ref, expert_of_block, *,
+                 a_m_off=0, out_m_off=0):
+    """Tiled grouped-matmul pipeline over HBM refs: for each A row-block
+    ``i``, C[out_m_off+i, j] = A[a_m_off+i, :] @ W[expert_of_block(i)].
+    ``expert_of_block`` reads the SMEM block→expert table (the
+    scalar-prefetch indexing of kernels/group_gemm.py:74-85, here inside
+    ``emit_pipeline`` index maps)."""
+    bm, bk, bn = blocks
+
+    def inner(a_ref, b_ref, o_ref):
+        @pl.when(pl.program_id(2) == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        acc_ref[...] += jnp.dot(
+            a_ref[...], b_ref[0], preferred_element_type=jnp.float32
+        )
+
+        @pl.when(pl.program_id(2) == kb - 1)
+        def _():
+            o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+    return pltpu.emit_pipeline(
+        inner,
+        grid=(mb, nb, kb),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (a_m_off + i, kk)),
+            pl.BlockSpec(
+                (1, bk, bn), lambda i, j, kk: (expert_of_block(i), kk, j)
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda i, j, kk: (out_m_off + i, j))
+        ],
+    )
+
+
+def ag_group_gemm_kernel(
+    n, axis, mesh_axes, blocks,
+    be_ref, xs_hbm, w_hbm, out_hbm, ag_hbm,
+    acc_ref, send_sem, recv_sem,
+):
+    """Streaming ring AG ⊕ grouped GEMM (≡ the producer AG + per-tile-
+    waiting consumer grouped GEMM of allgather_group_gemm.py:420-498).
+
+    xs_hbm: (cap_s, K) this device's pre-sorted padded token slab;
+    w_hbm: (E, K, NL) expert weight columns; be_ref: (n, cap_s/bm) SMEM
+    block→expert table for every shard; out_hbm: (n·cap_s, NL) per-shard
+    sorted outputs; ag_hbm: (n·cap_s, K) gathered-slab workspace.
+    """
+    me = lang.my_pe(axis)
+    cap = xs_hbm.shape[0]
+    k = xs_hbm.shape[1]
+    nl = w_hbm.shape[2]
+    bm, bk, bn = blocks
+    mb, nb, kb = cap // bm, nl // bn, k // bk
+    left, right = ring_neighbors(me, n)
+    left = lang.pe_flat(axis, left, mesh_axes)
+    right = lang.pe_flat(axis, right, mesh_axes)
+
+    # No local-slab publish (unlike ag_gemm): the gathered workspace is
+    # internal here, the local shard is computed and forwarded straight
+    # from xs_hbm, and slab ``me`` is never read by anyone.
+    lang.neighbor_barrier(axis, left, right)
+
+    def fwd(src, slot, from_x=False):
+        src_ref = xs_hbm if from_x else ag_hbm.at[pl.ds(src * cap, cap)]
+        return lang.remote_copy(
+            src_ref,
+            ag_hbm.at[pl.ds(src * cap, cap)],
+            send_sem.at[slot],
+            recv_sem.at[slot],
+            right,
+        )
+
+    for s in range(n):
+        src = jax.lax.rem(me + n - s, n) if s > 0 else me
+        if s > 0:
+            fwd(src, s - 1, from_x=(s == 1)).wait_recv()
+        if s < n - 1:
+            chaos_delay()
+            fwd(src, s, from_x=(s == 0)).start()
+        pipe = gmm_pipeline(
+            mb, nb, kb, blocks, acc_ref,
+            lambda i, src=src: be_ref[src, i],
+            a_m_off=0 if s == 0 else src * mb,
+            out_m_off=src * mb,
+        )
+        pipe(xs_hbm if s == 0 else ag_hbm, w_hbm, out_hbm)
+    for s in range(n - 1):
+        src = jax.lax.rem(me + n - s, n) if s > 0 else me
+        fwd(src, s, from_x=(s == 0)).wait_send()
+
+
+def moe_reduce_rs_kernel(
+    n, axis, mesh_axes, blocks,
+    be_ref, y_hbm, w_hbm, out_hbm, w0, w1, r0, r1,
+    acc_ref, send_sem, recv_sem, ack_sem,
+):
+    """Grouped GEMM ⊕ reduce ring over per-shard sorted slabs (≡ the
+    producer grouped GEMM signalling the consumer topk-reduce-RS,
+    moe_reduce_rs.py:362-545; flow control from reduce_scatter.py's
+    ring ack protocol).
+
+    y_hbm: (n·cap_s, FL) per-shard sorted up-projection outputs (FL =
+    F/tp columns — each rank's grouped GEMM yields a PARTIAL (cap_s, H)
+    per destination); w_hbm: (E, FL, H); out_hbm: (cap_s, H) — this
+    rank's fully-reduced sorted rows, still awaiting the local topk
+    combine (done in XLA on the destination's own rows).
+    """
+    me = lang.my_pe(axis)
+    cap = out_hbm.shape[0]
+    h = out_hbm.shape[1]
+    fl = y_hbm.shape[1]
+    bm, bk, bn = blocks
+    mb, nb, kb = cap // bm, h // bn, fl // bk
+    left, right = ring_neighbors(me, n)
+    left = lang.pe_flat(axis, left, mesh_axes)
+    right = lang.pe_flat(axis, right, mesh_axes)
+    work = (w0, w1)
+    recv = (r0, r1)
+
+    def partial_into(dst, dst_ref):
+        gmm_pipeline(
+            mb, nb, kb, blocks, acc_ref,
+            lambda i, dst=dst: be_ref[dst, i],
+            a_m_off=dst * mb,
+        )(y_hbm, w_hbm, dst_ref)
+
+    if n == 1:
+        partial_into(0, out_hbm)
+        return
+
+    add = ew_add_pipeline(cap, h, out_hbm.dtype.itemsize)
+
+    def ring_dma(slot):
+        return lang.remote_copy(
+            work[slot], recv[slot], send_sem.at[slot], recv_sem.at[slot], left
+        )
+
+    lang.neighbor_barrier(axis, left, right)
+    partial_into(jax.lax.rem(me + 1, n), work[0])
+
+    for s in range(n - 1):
+        slot = s % 2
+        chaos_delay()
+        if s >= 2:
+            pltpu.semaphore_wait(ack_sem, 1)
+        dma = ring_dma(slot)
+        dma.start()
+        nxt = jax.lax.rem(me + 2 + s, n)
+        if s >= 1:
+            ring_dma(1 - slot).wait_send()
+        partial_into(nxt, work[1 - slot])
+        dma.wait_recv()
+        add(work[1 - slot], recv[slot], out_hbm if s == n - 2 else work[1 - slot])
+        lang.signal_op(ack_sem, 1, pe=right)
+
+    ring_dma((n - 2) % 2).wait_send()
+    pltpu.semaphore_wait(ack_sem, min(2, n - 1))
+
+
+def build_ag_group_gemm_call(
+    n, mesh_axes, axis, cap, k, nl, e, blocks, dtype, collective_id,
+):
+    """pallas_call for :func:`ag_group_gemm_kernel` (per-device, for use
+    inside shard_map)."""
+    return lang.shmem_call(
+        functools.partial(ag_group_gemm_kernel, n, axis, mesh_axes, blocks),
+        out_shape=[
+            jax.ShapeDtypeStruct((n * cap, nl), dtype),
+            jax.ShapeDtypeStruct((n * cap, k), dtype),  # ring workspace
+        ],
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+        scratch_shapes=[
+            pltpu.VMEM((blocks[0], blocks[2]), jnp.float32),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+        ],
+        collective_id=collective_id,
+        vmem_limit_bytes=fused_vmem_budget(),
+        name="ag_group_gemm_fused",
+    )
+
+
+def build_moe_reduce_rs_call(
+    n, mesh_axes, axis, cap, fl, h, e, blocks, dtype, collective_id,
+):
+    """pallas_call for :func:`moe_reduce_rs_kernel` (per-device)."""
+    slab = jax.ShapeDtypeStruct((cap, h), dtype)
+    return lang.shmem_call(
+        functools.partial(moe_reduce_rs_kernel, n, axis, mesh_axes, blocks),
+        out_shape=[slab, slab, slab, slab, slab],
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 5,
+        scratch_shapes=[
+            pltpu.VMEM((blocks[0], blocks[2]), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        collective_id=None if n == 1 else collective_id,
+        vmem_limit_bytes=fused_vmem_budget(),
+        name="moe_reduce_rs_fused",
+    )

@@ -78,11 +78,12 @@ class SpGQAFlashDecodeAttention:
         )
 
 
-def append_kv(k_cache, v_cache, kv_lens, k_new, v_new, kv_layout="bshd"):
+def append_kv(k_cache, v_cache, kv_lens, k_new, v_new, kv_layout="bhsd"):
     """Append one decode step's K/V at each batch row's current length.
 
-    k_cache/v_cache: (B, S, Hkv, D) [``kv_layout="bshd"``] or
-    (B, Hkv, S, D) [``"bhsd"``]; k_new/v_new: (B, Hkv, D); kv_lens: (B,)
+    k_cache/v_cache: (B, Hkv, S, D) [``kv_layout="bhsd"``, native
+    default] or (B, S, Hkv, D) [``"bshd"``]; k_new/v_new: (B, Hkv, D);
+    kv_lens: (B,)
     lengths BEFORE the append. Returns updated caches and lengths.
     (The reference leaves cache management to the serving stack; provided
     here so the models package can run real decode loops.)

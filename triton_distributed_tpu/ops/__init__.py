@@ -15,11 +15,17 @@ from triton_distributed_tpu.ops.moe import (
 )
 from triton_distributed_tpu.ops.moe_tp import (
     MoETPContext,
+    ShardedRouting,
     ag_group_gemm,
+    ag_group_gemm_fused,
     align_routing,
+    align_routing_sharded,
     create_ag_group_gemm_context,
     create_moe_rs_context,
     moe_reduce_rs,
+    moe_reduce_rs_fused,
+    moe_tp_mlp,
+    moe_tp_mlp_overlapped,
 )
 from triton_distributed_tpu.ops.overlap import (
     OverlapContext,
@@ -41,9 +47,15 @@ __all__ = [
     "ep_moe_tuned",
     "create_ep_moe_context",
     "MoETPContext",
+    "ShardedRouting",
     "ag_group_gemm",
+    "ag_group_gemm_fused",
     "align_routing",
+    "align_routing_sharded",
     "moe_reduce_rs",
+    "moe_reduce_rs_fused",
+    "moe_tp_mlp",
+    "moe_tp_mlp_overlapped",
     "create_ag_group_gemm_context",
     "create_moe_rs_context",
 ]

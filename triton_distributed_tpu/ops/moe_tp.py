@@ -7,13 +7,20 @@ moe_reduce_rs.py — producer grouped GEMM scattering weighted expert
 outputs (:362-467) into a consumer topk-reduce + reduce-scatter pipeline
 (:468-622, orchestration :882-1020).
 
-TPU re-design (composed v1): the gather leg rides ``lax.all_gather``
-(XLA's async collective overlaps it with the routing sort that follows)
-and the reduce leg rides the Pallas ring reduce-scatter; the grouped
-GEMM is the scalar-prefetch Mosaic kernel. A single-kernel ring variant
-(grouped-GEMM tiles waiting on per-shard DMA arrival like ag_gemm's
-PALLAS_FUSED) is the planned upgrade once the autotuner can pick
-between them.
+Two pipelines:
+
+* **Overlapped (default inference path)**: the single-kernel streaming
+  engines of kernels/moe_tp_fused.py — tokens expert-sorted per shard
+  ride the ring while arrived shards stream through grouped-GEMM
+  pipelines (grouped-GEMM tiles gated by shard-arrival DMA semaphores,
+  the TPU translation of the reference's per-tile producer barriers).
+  Entry points: :func:`align_routing_sharded`,
+  :func:`ag_group_gemm_fused`, :func:`moe_reduce_rs_fused`,
+  :func:`moe_tp_mlp_overlapped`.
+* **Composed** (v1, kept as the training-capable/differentiable and
+  correctness-reference path): gather leg on ``lax.all_gather``, reduce
+  leg on the Pallas ring reduce-scatter, grouped GEMM via the
+  scalar-prefetch Mosaic kernel.
 
 Layouts (Megatron MoE-TP):
 
@@ -59,6 +66,7 @@ class MoETPContext:
     dtype: jnp.dtype = jnp.bfloat16
     use_pallas_gemm: bool = True
     rs_collective_id: int = 12
+    ag_collective_id: int = 13
     batch_axes: tuple = ()          # extra (DP) axes sharding token rows
 
     @property
@@ -133,6 +141,11 @@ def ag_group_gemm(a, routing, w, ctx: MoETPContext):
     w: (E, K, N) with N sharded. Returns (cap, N) sorted expert rows
     with N sharded.
     """
+    assert ctx.batch_axes == (), (
+        "composed ag_group_gemm reshards tokens tp-only; with DP use "
+        "moe_tp_mlp (which honors batch_axes) or the overlapped entries "
+        "inside your own DP shard_map"
+    )
     sti, be, counts = routing
     return _build_ag_group_gemm(ctx)(a, sti, be, counts, w)
 
@@ -145,6 +158,11 @@ def moe_reduce_rs(y, routing, weights, w, ctx: MoETPContext):
     (M, k) replicated router weights; w: (E, F, H) with F sharded.
     Returns (M, H) token rows sharded over ``ctx.axis``.
     """
+    assert ctx.batch_axes == (), (
+        "composed moe_reduce_rs reshards tokens tp-only; with DP use "
+        "moe_tp_mlp (which honors batch_axes) or the overlapped entries "
+        "inside your own DP shard_map"
+    )
     sti, be, counts = routing
     return _build_moe_reduce_rs(ctx)(y, sti, be, counts, weights, w)
 
@@ -178,6 +196,177 @@ def _build_moe_reduce_rs(ctx: MoETPContext):
         )
 
     return jax.jit(entry)
+
+
+# ------------------------------------------------- overlapped (fused) path
+
+
+@dataclass(frozen=True)
+class ShardedRouting:
+    """Per-shard routing tables for the overlapped pipeline: shard ``s``'s
+    tokens in shard-local expert-sorted order. All replicated."""
+
+    sti: jax.Array      # (tp, cap_s) shard-local sorted token ids
+    be: jax.Array       # (tp, cap_s / block_m) block→expert table
+    splits: jax.Array   # (tp, E) true per-expert counts per shard
+
+    @property
+    def cap_s(self) -> int:
+        return self.sti.shape[1]
+
+
+def align_routing_sharded(ctx: MoETPContext, topk_ids) -> ShardedRouting:
+    """Per-SHARD routing alignment for the overlapped engines.
+
+    ``topk_ids``: (M, k) replicated. Shard ``s`` owns token rows
+    [s·M/tp, (s+1)·M/tp); each shard is aligned independently so its
+    sorted slab is self-contained (the slab IS the ring payload).
+    """
+    m, k = topk_ids.shape
+    assert m % ctx.tp == 0
+    ids_s = jnp.asarray(topk_ids).reshape(ctx.tp, m // ctx.tp, k)
+    sti, be, splits = jax.vmap(
+        lambda i: mu.moe_align_block_size(i, ctx.num_experts, ctx.block_m)
+    )(ids_s)
+    return ShardedRouting(sti=sti, be=be, splits=splits)
+
+
+def _fused_blocks(ctx: MoETPContext, cap_s: int, k: int, nl: int):
+    from triton_distributed_tpu.kernels.moe_tp_fused import pick_gg_blocks
+
+    blocks = pick_gg_blocks(
+        ctx.block_m, cap_s, k, nl, jnp.dtype(ctx.dtype).itemsize
+    )
+    if blocks is None:
+        raise ValueError(
+            f"overlapped MoE-TP: no lowerable blocking for block_m="
+            f"{ctx.block_m}, cap_s={cap_s}, K={k}, N={nl} — adjust "
+            "block_m (TPU needs a sublane multiple) or use the composed path"
+        )
+    return blocks
+
+
+@functools.lru_cache(maxsize=64)
+def _build_gather_sorted(ctx: MoETPContext, m_shard: int):
+    def body(x_loc, sti):
+        me = jax.lax.axis_index(ctx.axis)
+        return mu.gather_sorted(x_loc, sti[me], ctx.topk).astype(ctx.dtype)
+
+    fn = jax.shard_map(
+        body, mesh=ctx.mesh, in_specs=(P(ctx.axis), P()),
+        out_specs=P(ctx.axis), check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_ag_gg_fused(ctx: MoETPContext, cap_s, k, nl_local):
+    from triton_distributed_tpu.kernels.moe_tp_fused import (
+        build_ag_group_gemm_call,
+    )
+
+    blocks = _fused_blocks(ctx, cap_s, k, nl_local)
+    call = build_ag_group_gemm_call(
+        ctx.tp, ctx.mesh.axis_names, ctx.axis, cap_s, k, nl_local,
+        ctx.num_experts, blocks, jnp.dtype(ctx.dtype), ctx.ag_collective_id,
+    )
+    fn = jax.shard_map(
+        lambda be, xs, w: call(be, xs, w)[0],
+        mesh=ctx.mesh,
+        in_specs=(P(), P(ctx.axis), P(None, None, ctx.axis)),
+        out_specs=P(None, ctx.axis),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def ag_group_gemm_fused(x, routing: ShardedRouting, w, ctx: MoETPContext):
+    """Overlapped AG-GroupGEMM (default inference engine; ≡ ag_group_gemm,
+    allgather_group_gemm.py:272-498, with the producer barriers replaced
+    by shard-arrival DMA semaphores — see kernels/moe_tp_fused.py).
+
+    x: (M, K) token rows sharded over ``ctx.axis``; w: (E, K, N) with N
+    sharded. Returns (tp·cap_s, N) per-shard sorted rows, N sharded.
+    """
+    assert ctx.batch_axes == (), (
+        "overlapped MoE-TP runs per DP replica; wrap it in your own "
+        "shard_map over batch axes or use moe_tp_mlp"
+    )
+    m, k = x.shape
+    xs = _build_gather_sorted(ctx, m // ctx.tp)(x, routing.sti)
+    return _build_ag_gg_fused(ctx, routing.cap_s, k, w.shape[2] // ctx.tp)(
+        routing.be, xs, w
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _build_moe_rs_fused(ctx: MoETPContext, cap_s, fl_local, h):
+    from triton_distributed_tpu.kernels.moe_tp_fused import (
+        build_moe_reduce_rs_call,
+    )
+
+    blocks = _fused_blocks(ctx, cap_s, fl_local, h)
+    call = build_moe_reduce_rs_call(
+        ctx.tp, ctx.mesh.axis_names, ctx.axis, cap_s, fl_local, h,
+        ctx.num_experts, blocks, jnp.dtype(ctx.dtype), ctx.rs_collective_id,
+    )
+    fn = jax.shard_map(
+        lambda be, y, w: call(be, y, w)[0],
+        mesh=ctx.mesh,
+        in_specs=(P(), P(None, ctx.axis), P(None, ctx.axis, None)),
+        out_specs=P(ctx.axis),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_combine(ctx: MoETPContext, m_shard: int):
+    def body(red_loc, sti, w_loc):
+        me = jax.lax.axis_index(ctx.axis)
+        out = mu.scatter_combine(red_loc, sti[me], w_loc, m_shard)
+        return out.astype(ctx.dtype)
+
+    fn = jax.shard_map(
+        body, mesh=ctx.mesh, in_specs=(P(ctx.axis), P(), P(ctx.axis)),
+        out_specs=P(ctx.axis), check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def moe_reduce_rs_fused(y, routing: ShardedRouting, weights, w,
+                        ctx: MoETPContext):
+    """Overlapped GroupGEMM-Reduce-RS (default inference engine;
+    ≡ moe_reduce_rs, moe_reduce_rs.py:362-1020: the producer grouped
+    GEMM computes straight into the reduce ring).
+
+    y: (tp·cap_s, F) per-shard sorted rows from
+    :func:`ag_group_gemm_fused` (post-activation), F sharded; weights:
+    (M, k) router weights sharded over ``ctx.axis`` rows; w: (E, F, H)
+    with F sharded. Returns (M, H) token rows sharded over ``ctx.axis``.
+    """
+    assert ctx.batch_axes == (), (
+        "overlapped MoE-TP runs per DP replica; wrap it in your own "
+        "shard_map over batch axes or use moe_tp_mlp"
+    )
+    assert y.shape[0] == ctx.tp * routing.cap_s
+    red = _build_moe_rs_fused(
+        ctx, routing.cap_s, y.shape[1] // ctx.tp, w.shape[2]
+    )(routing.be, y, w)
+    m = weights.shape[0]
+    return _build_combine(ctx, m // ctx.tp)(red, routing.sti, weights)
+
+
+def moe_tp_mlp_overlapped(x, topk_ids, topk_weights, w_up, w_down,
+                          ctx: MoETPContext, activation: str = "silu"):
+    """Full overlapped TP MoE MLP: AG⊕up-GroupGEMM → act → down-GroupGEMM
+    ⊕Reduce-RS. The default inference path; the composed
+    :func:`moe_tp_mlp` remains the differentiable training path."""
+    routing = align_routing_sharded(ctx, topk_ids)
+    h = ag_group_gemm_fused(x, routing, w_up, ctx)
+    act = jax.nn.silu if activation == "silu" else jax.nn.gelu
+    h = act(h.astype(jnp.float32)).astype(ctx.dtype)
+    return moe_reduce_rs_fused(h, routing, topk_weights, w_down, ctx)
 
 
 def moe_tp_mlp_device(
