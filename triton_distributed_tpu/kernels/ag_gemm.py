@@ -48,9 +48,7 @@ from triton_distributed_tpu.runtime import (
     LinkKind,
     detect_topology,
     mesh_axes_size,
-    ring_neighbors,
 )
-from triton_distributed_tpu.utils.testing import chaos_delay
 
 logger = logging.getLogger(__name__)
 _warned = set()
@@ -167,59 +165,34 @@ def _fused_kernel(
     x_hbm, b_hbm, out_hbm, ag_hbm, acc_ref, local_sem, send_sem, recv_sem,
 ):
     """HBM-streaming ring AG-GEMM. Per step: wait shard arrival → start
-    forwarding it → stream it through the MXU while the RDMA is in flight."""
+    forwarding it → stream it through the MXU while the RDMA is in flight
+    (the ring protocol lives in kernels/ring.ag_forward_ring)."""
+    from triton_distributed_tpu.kernels.ring import ag_forward_ring
+
     me = lang.my_pe(axis)
     m = x_hbm.shape[0]  # shard rows
     k = x_hbm.shape[1]
     nl = b_hbm.shape[1]
     bm, bk, bn = blocks
     mb, nb, kb = m // bm, nl // bn, k // bk
-    left, right = ring_neighbors(me, n)
-    left = lang.pe_flat(axis, left, mesh_axes)
-    right = lang.pe_flat(axis, right, mesh_axes)
 
     # Publish the local shard into the gathered workspace (HBM→HBM local
     # DMA ≡ local_copy_and_barrier_all, allgather_gemm.py:100-117). The
-    # copy overlaps step 0 entirely: the first forward and the first
-    # matmul read the local shard straight from x_hbm.
+    # copy overlaps step 0 entirely: the ring forwards and consumes the
+    # local shard straight from x_hbm.
     cp = pltpu.make_async_copy(x_hbm, ag_hbm.at[pl.ds(me * m, m)], local_sem)
     cp.start()
-    lang.neighbor_barrier(axis, left, right)
 
-    def fwd(src, slot, from_x=False):
-        # Descriptor for forwarding shard ``src`` to the right neighbor.
-        # Reconstructed at wait time: the wait is on the slot semaphore and
-        # byte counts are identical for every shard, so the recv wait
-        # releases exactly when the incoming shard's payload is resident
-        # (the dl.wait + consume_token of allgather_gemm.py:224-227, done
-        # by hardware).
-        src_ref = x_hbm if from_x else ag_hbm.at[pl.ds(src * m, m)]
-        return lang.remote_copy(
-            src_ref,
-            ag_hbm.at[pl.ds(src * m, m)],
-            send_sem.at[slot],
-            recv_sem.at[slot],
-            right,
-        )
-
-    for s in range(n):
-        src = jax.lax.rem(me + n - s, n) if s > 0 else me
-        if s > 0:
-            fwd(src, s - 1, from_x=(s == 1)).wait_recv()
-        if s < n - 1:
-            chaos_delay()
-            fwd(src, s, from_x=(s == 0)).start()
+    def consume(s, src, a_hbm, a_row_off):
         # Stream this shard through the MXU while the forward is in flight.
-        if s == 0:
-            mm_pipeline(mb, nb, kb, bm, bk, bn, acc_ref, m_off=0,
-                        out_m_off=src * mb)(x_hbm, b_hbm, out_hbm)
-        else:
-            mm_pipeline(mb, nb, kb, bm, bk, bn, acc_ref, m_off=src * mb)(
-                ag_hbm, b_hbm, out_hbm
-            )
-    for s in range(n - 1):
-        src = jax.lax.rem(me + n - s, n) if s > 0 else me
-        fwd(src, s, from_x=(s == 0)).wait_send()
+        mm_pipeline(
+            mb, nb, kb, bm, bk, bn, acc_ref,
+            m_off=a_row_off // bm, out_m_off=src * mb,
+        )(a_hbm, b_hbm, out_hbm)
+
+    ag_forward_ring(
+        n, axis, mesh_axes, x_hbm, ag_hbm, m, send_sem, recv_sem, consume
+    )
     cp.wait()
 
 

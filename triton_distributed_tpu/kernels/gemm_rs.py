@@ -91,26 +91,13 @@ def _fused_kernel(
     kernels/reduce_scatter.py:ring_reduce_core (a sender may not rewrite a
     slot its receiver hasn't folded in — semaphore credits count arrivals,
     not consumption)."""
-    me = lang.my_pe(axis)
+    from triton_distributed_tpu.kernels.ring import reduce_ring
+
     m_local = out_hbm.shape[0]
     n_out = out_hbm.shape[1]
     k = a_hbm.shape[1]
     bm, bk, bn = blocks
     mb, nb, kb = m_local // bm, n_out // bn, k // bk
-    left, right = ring_neighbors(me, n)
-    left = lang.pe_flat(axis, left, mesh_axes)
-    right = lang.pe_flat(axis, right, mesh_axes)
-    work = (w0, w1)
-    recv = (r0, r1)
-
-    if n == 1:
-        # Degenerate ring (bench/smoke path): out = A @ B, no RDMA.
-        mm_pipeline(mb, nb, kb, bm, bk, bn, acc_ref, m_off=0)(
-            a_hbm, b_hbm, out_hbm
-        )
-        return
-
-    lang.neighbor_barrier(axis, left, right)
 
     def partial_into(dst, dst_ref):
         # dst_ref = A[dst·m_local : (dst+1)·m_local, :] @ B   (streamed)
@@ -118,39 +105,11 @@ def _fused_kernel(
             a_hbm, b_hbm, dst_ref
         )
 
-    add = ew_add_pipeline(m_local, n_out, out_hbm.dtype.itemsize)
-
-    def ring_dma(slot):
-        return lang.remote_copy(
-            work[slot], recv[slot], send_sem.at[slot], recv_sem.at[slot], left
-        )
-
-    # my contribution to shard (me+1), the first one I forward
-    partial_into(jax.lax.rem(me + 1, n), work[0])
-
-    for s in range(n - 1):
-        slot = s % 2
-        chaos_delay()
-        if s >= 2:
-            # left must have folded my slot (s-2) before I rewrite it
-            pltpu.semaphore_wait(ack_sem, 1)
-        dma = ring_dma(slot)
-        dma.start()
-        # produce my contribution to the next destination while the
-        # accumulator is in flight
-        nxt = jax.lax.rem(me + 2 + s, n)
-        if s >= 1:
-            ring_dma(1 - slot).wait_send()  # slot reusable
-        partial_into(nxt, work[1 - slot])
-        dma.wait_recv()
-        # received: partial sum of shard (me+2+s) accumulated so far by
-        # the ring to my right; fold in my own contribution.
-        add(work[1 - slot], recv[slot], out_hbm if s == n - 2 else work[1 - slot])
-        lang.signal_op(ack_sem, 1, pe=right)
-
-    ring_dma((n - 2) % 2).wait_send()
-    # drain leftover acks: n-1 received, max(n-3, 0) consumed in-loop
-    pltpu.semaphore_wait(ack_sem, min(2, n - 1))
+    reduce_ring(
+        n, axis, mesh_axes, out_hbm, (w0, w1), (r0, r1),
+        send_sem, recv_sem, ack_sem, partial_into,
+        ew_add_pipeline(m_local, n_out, out_hbm.dtype.itemsize),
+    )
 
 
 def _specs(axis, batch_axes):

@@ -64,10 +64,14 @@ class MoEAllToAllContext:
     experts_per_rank: int
     dtype: jnp.dtype
     collective_id: int = 10
+    # Total EP ranks when the exchange is hierarchical (DCN×ICI, see
+    # ops/moe.py) — slot geometry then spans all ranks, not just the
+    # ``axis`` line. None → flat exchange over ``axis``.
+    num_ranks: int | None = None
 
     @property
     def n(self) -> int:
-        return self.mesh.shape[self.axis]
+        return self.num_ranks or self.mesh.shape[self.axis]
 
     @property
     def num_experts(self) -> int:
@@ -89,7 +93,7 @@ class MoEAllToAllContext:
 
 def create_all_to_all_context(
     mesh, axis, *, max_m, hidden, experts_per_rank,
-    dtype=jnp.bfloat16, collective_id: int = 10,
+    dtype=jnp.bfloat16, collective_id: int = 10, num_ranks: int | None = None,
 ) -> MoEAllToAllContext:
     """≡ create_all_to_all_context (low_latency_all_to_all.py:168-187)."""
     dtype = jnp.dtype(dtype)
@@ -99,7 +103,7 @@ def create_all_to_all_context(
     return MoEAllToAllContext(
         mesh=mesh, axis=axis, max_m=max_m, hidden=hidden,
         experts_per_rank=experts_per_rank, dtype=dtype,
-        collective_id=collective_id,
+        collective_id=collective_id, num_ranks=num_ranks,
     )
 
 

@@ -43,8 +43,6 @@ from triton_distributed_tpu import lang
 from triton_distributed_tpu.config import fused_vmem_budget
 from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
 from triton_distributed_tpu.kernels.gemm_rs import ew_add_pipeline
-from triton_distributed_tpu.runtime import ring_neighbors
-from triton_distributed_tpu.utils.testing import chaos_delay
 
 
 def pick_gg_blocks(block_m: int, cap: int, k: int, nl: int, itemsize: int):
@@ -119,48 +117,28 @@ def ag_group_gemm_kernel(
     block→expert table for every shard; out_hbm: (n·cap_s, NL) per-shard
     sorted outputs; ag_hbm: (n·cap_s, K) gathered-slab workspace.
     """
-    me = lang.my_pe(axis)
+    from triton_distributed_tpu.kernels.ring import ag_forward_ring
+
     cap = xs_hbm.shape[0]
     k = xs_hbm.shape[1]
     nl = w_hbm.shape[2]
     bm, bk, bn = blocks
     mb, nb, kb = cap // bm, nl // bn, k // bk
-    left, right = ring_neighbors(me, n)
-    left = lang.pe_flat(axis, left, mesh_axes)
-    right = lang.pe_flat(axis, right, mesh_axes)
 
     # No local-slab publish (unlike ag_gemm): the gathered workspace is
     # internal here, the local shard is computed and forwarded straight
     # from xs_hbm, and slab ``me`` is never read by anyone.
-    lang.neighbor_barrier(axis, left, right)
-
-    def fwd(src, slot, from_x=False):
-        src_ref = xs_hbm if from_x else ag_hbm.at[pl.ds(src * cap, cap)]
-        return lang.remote_copy(
-            src_ref,
-            ag_hbm.at[pl.ds(src * cap, cap)],
-            send_sem.at[slot],
-            recv_sem.at[slot],
-            right,
-        )
-
-    for s in range(n):
-        src = jax.lax.rem(me + n - s, n) if s > 0 else me
-        if s > 0:
-            fwd(src, s - 1, from_x=(s == 1)).wait_recv()
-        if s < n - 1:
-            chaos_delay()
-            fwd(src, s, from_x=(s == 0)).start()
-        pipe = gmm_pipeline(
+    def consume(s, src, a_hbm, a_row_off):
+        gmm_pipeline(
             mb, nb, kb, blocks, acc_ref,
             lambda i, src=src: be_ref[src, i],
-            a_m_off=0 if s == 0 else src * mb,
+            a_m_off=a_row_off // bm,
             out_m_off=src * mb,
-        )
-        pipe(xs_hbm if s == 0 else ag_hbm, w_hbm, out_hbm)
-    for s in range(n - 1):
-        src = jax.lax.rem(me + n - s, n) if s > 0 else me
-        fwd(src, s, from_x=(s == 0)).wait_send()
+        )(a_hbm, w_hbm, out_hbm)
+
+    ag_forward_ring(
+        n, axis, mesh_axes, xs_hbm, ag_hbm, cap, send_sem, recv_sem, consume
+    )
 
 
 def moe_reduce_rs_kernel(
@@ -179,17 +157,13 @@ def moe_reduce_rs_kernel(
     rank's fully-reduced sorted rows, still awaiting the local topk
     combine (done in XLA on the destination's own rows).
     """
-    me = lang.my_pe(axis)
+    from triton_distributed_tpu.kernels.ring import reduce_ring
+
     cap = out_hbm.shape[0]
     h = out_hbm.shape[1]
     fl = y_hbm.shape[1]
     bm, bk, bn = blocks
     mb, nb, kb = cap // bm, h // bn, fl // bk
-    left, right = ring_neighbors(me, n)
-    left = lang.pe_flat(axis, left, mesh_axes)
-    right = lang.pe_flat(axis, right, mesh_axes)
-    work = (w0, w1)
-    recv = (r0, r1)
 
     def partial_into(dst, dst_ref):
         gmm_pipeline(
@@ -198,37 +172,11 @@ def moe_reduce_rs_kernel(
             a_m_off=dst * mb,
         )(y_hbm, w_hbm, dst_ref)
 
-    if n == 1:
-        partial_into(0, out_hbm)
-        return
-
-    add = ew_add_pipeline(cap, h, out_hbm.dtype.itemsize)
-
-    def ring_dma(slot):
-        return lang.remote_copy(
-            work[slot], recv[slot], send_sem.at[slot], recv_sem.at[slot], left
-        )
-
-    lang.neighbor_barrier(axis, left, right)
-    partial_into(jax.lax.rem(me + 1, n), work[0])
-
-    for s in range(n - 1):
-        slot = s % 2
-        chaos_delay()
-        if s >= 2:
-            pltpu.semaphore_wait(ack_sem, 1)
-        dma = ring_dma(slot)
-        dma.start()
-        nxt = jax.lax.rem(me + 2 + s, n)
-        if s >= 1:
-            ring_dma(1 - slot).wait_send()
-        partial_into(nxt, work[1 - slot])
-        dma.wait_recv()
-        add(work[1 - slot], recv[slot], out_hbm if s == n - 2 else work[1 - slot])
-        lang.signal_op(ack_sem, 1, pe=right)
-
-    ring_dma((n - 2) % 2).wait_send()
-    pltpu.semaphore_wait(ack_sem, min(2, n - 1))
+    reduce_ring(
+        n, axis, mesh_axes, out_hbm, (w0, w1), (r0, r1),
+        send_sem, recv_sem, ack_sem, partial_into,
+        ew_add_pipeline(cap, h, out_hbm.dtype.itemsize),
+    )
 
 
 def build_ag_group_gemm_call(

@@ -1,0 +1,138 @@
+"""Shared streaming-ring harnesses for the fused overlap kernels.
+
+Two protocols, each used by two kernels (keep ONE implementation of the
+deadlock-prone concurrency logic):
+
+* :func:`ag_forward_ring` — the AllGather forward ring of
+  ag_gemm._fused_kernel and moe_tp_fused.ag_group_gemm_kernel: shard
+  ``(me-s) mod n`` is forwarded to the right neighbor while the caller's
+  ``consume`` streams it through the MXU. Step 0 forwards/consumes the
+  caller's local slab directly (no dependence on the workspace publish).
+* :func:`reduce_ring` — the compute-into-the-ring reduce of
+  gemm_rs._fused_kernel and moe_tp_fused.moe_reduce_rs_kernel:
+  double-buffered work/recv slabs flowing leftward with ack-credit flow
+  control (a sender may not rewrite a slot its receiver hasn't folded —
+  semaphore credits count arrivals, not consumption; see
+  reduce_scatter.ring_reduce_core for the original reasoning).
+
+Both the forward descriptor and the wait-side descriptor are rebuilt
+from identical arguments: DMA waits are on the slot semaphore and byte
+counts match for every shard, so a reconstructed descriptor's
+``wait_recv`` releases exactly when the incoming payload is resident
+(the dl.wait + consume_token of allgather_gemm.py:224-227, done by
+hardware).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu import lang
+from triton_distributed_tpu.runtime import ring_neighbors
+from triton_distributed_tpu.utils.testing import chaos_delay
+
+
+def ag_forward_ring(
+    n, axis, mesh_axes, local_hbm, ag_hbm, slab_rows, send_sem, recv_sem,
+    consume,
+):
+    """Run the AG forward ring; ``consume(s, src, a_hbm, a_row_off)``
+    computes over shard ``src`` (rows ``[a_row_off, a_row_off+slab_rows)``
+    of ``a_hbm``) at step ``s`` while the next transfer is in flight.
+
+    ``local_hbm``: this device's (slab_rows, ·) slab; ``ag_hbm``: the
+    (n·slab_rows, ·) gathered workspace (slab ``me`` is NOT written by
+    this harness — publish it yourself if the gathered result is part of
+    your contract, cf. ag_gemm's ``return_gathered``).
+    """
+    me = lang.my_pe(axis)
+    left, right = ring_neighbors(me, n)
+    left = lang.pe_flat(axis, left, mesh_axes)
+    right = lang.pe_flat(axis, right, mesh_axes)
+
+    lang.neighbor_barrier(axis, left, right)
+
+    def fwd(src, slot, from_local):
+        src_ref = local_hbm if from_local else ag_hbm.at[
+            pl.ds(src * slab_rows, slab_rows)
+        ]
+        return lang.remote_copy(
+            src_ref,
+            ag_hbm.at[pl.ds(src * slab_rows, slab_rows)],
+            send_sem.at[slot],
+            recv_sem.at[slot],
+            right,
+        )
+
+    for s in range(n):
+        src = jax.lax.rem(me + n - s, n) if s > 0 else me
+        if s > 0:
+            fwd(src, s - 1, s == 1).wait_recv()
+        if s < n - 1:
+            chaos_delay()
+            fwd(src, s, s == 0).start()
+        if s == 0:
+            consume(s, src, local_hbm, 0)
+        else:
+            consume(s, src, ag_hbm, src * slab_rows)
+    for s in range(n - 1):
+        src = jax.lax.rem(me + n - s, n) if s > 0 else me
+        fwd(src, s, s == 0).wait_send()
+
+
+def reduce_ring(
+    n, axis, mesh_axes, out_hbm, work, recv, send_sem, recv_sem, ack_sem,
+    partial_into, fold,
+):
+    """Run the compute-into-the-ring reduce.
+
+    ``partial_into(dst, dst_ref)`` produces this device's contribution to
+    destination shard ``dst`` — invoked between a ring DMA's start and
+    its recv wait so the transfer hides under it. ``fold(a, b, dst_ref)``
+    writes ``a + b`` (streamed). ``work``/``recv``: pairs of
+    double-buffered HBM slabs. Destination order me+1…me is the
+    rank-swizzle of gemm_reduce_scatter.py:205-219.
+    """
+    me = lang.my_pe(axis)
+    left, right = ring_neighbors(me, n)
+    left = lang.pe_flat(axis, left, mesh_axes)
+    right = lang.pe_flat(axis, right, mesh_axes)
+
+    if n == 1:
+        partial_into(0, out_hbm)
+        return
+
+    def ring_dma(slot):
+        return lang.remote_copy(
+            work[slot], recv[slot], send_sem.at[slot], recv_sem.at[slot], left
+        )
+
+    lang.neighbor_barrier(axis, left, right)
+    # my contribution to shard (me+1), the first one I forward
+    partial_into(jax.lax.rem(me + 1, n), work[0])
+
+    for s in range(n - 1):
+        slot = s % 2
+        chaos_delay()
+        if s >= 2:
+            # left must have folded my slot (s-2) before I rewrite it
+            pltpu.semaphore_wait(ack_sem, 1)
+        dma = ring_dma(slot)
+        dma.start()
+        # produce my contribution to the next destination while the
+        # accumulator is in flight
+        nxt = jax.lax.rem(me + 2 + s, n)
+        if s >= 1:
+            ring_dma(1 - slot).wait_send()  # slot reusable
+        partial_into(nxt, work[1 - slot])
+        dma.wait_recv()
+        # received: partial sum of shard (me+2+s) accumulated so far by
+        # the ring to my right; fold in my own contribution.
+        fold(work[1 - slot], recv[slot], out_hbm if s == n - 2 else work[1 - slot])
+        lang.signal_op(ack_sem, 1, pe=right)
+
+    ring_dma((n - 2) % 2).wait_send()
+    # drain leftover acks: n-1 received, max(n-3, 0) consumed in-loop
+    pltpu.semaphore_wait(ack_sem, min(2, n - 1))

@@ -54,10 +54,35 @@ class EPMoEContext:
     use_pallas_gemm: bool = True
     collective_id: int = 10
     batch_axes: tuple = ()          # extra (DP) axes sharding token rows
+    # Hierarchical (multi-slice) EP: experts span (dcn_axis × axis) and
+    # the exchange decomposes into a same-local-rank DCN rail leg +
+    # intra-slice ICI leg (≡ ep_a2a.py:36-150's node rotation with
+    # same-local-rank rail puts). None → flat single-slice exchange.
+    dcn_axis: str | None = None
 
     @property
     def n(self) -> int:
+        """Total EP ranks (dcn × local when hierarchical)."""
+        n = self.mesh.shape[self.axis]
+        if self.dcn_axis is not None:
+            n *= self.mesh.shape[self.dcn_axis]
+        return n
+
+    @property
+    def epl(self) -> int:
+        """EP ranks per slice (the ICI leg width)."""
         return self.mesh.shape[self.axis]
+
+    @property
+    def dcn(self) -> int:
+        """Number of slices on the DCN leg (1 when flat)."""
+        return self.mesh.shape[self.dcn_axis] if self.dcn_axis else 1
+
+    @property
+    def ep_axes(self) -> tuple:
+        """Mesh axes the experts are sharded over, DCN-major — global EP
+        rank g = slice·epl + local matches P(ep_axes) dim-0 sharding."""
+        return (self.dcn_axis, self.axis) if self.dcn_axis else (self.axis,)
 
     @property
     def experts_per_rank(self) -> int:
@@ -68,19 +93,35 @@ class EPMoEContext:
         return ma.create_all_to_all_context(
             self.mesh, self.axis, max_m=self.max_m, hidden=self.hidden,
             experts_per_rank=self.experts_per_rank, dtype=self.dtype,
-            collective_id=self.collective_id,
+            collective_id=self.collective_id, num_ranks=self.n,
         )
 
 
 def create_ep_moe_context(
     mesh, axis, *, num_experts, topk, max_m, hidden, **kw
 ) -> EPMoEContext:
-    n = mesh.shape[axis]
-    assert num_experts % n == 0, f"{num_experts} experts over {n} ranks"
-    return EPMoEContext(
+    ctx = EPMoEContext(
         mesh=mesh, axis=axis, num_experts=num_experts, topk=topk,
         max_m=max_m, hidden=hidden, **kw,
     )
+    assert num_experts % ctx.n == 0, f"{num_experts} experts over {ctx.n} ranks"
+    if ctx.transport == "pallas":
+        # Pallas remote DMA cannot cross DCN: a multi-slice EP axis must
+        # be declared as dcn_axis so the exchange takes the hierarchical
+        # rail path (≡ the reference's CommScope INTER_NODE dispatch).
+        from triton_distributed_tpu.runtime import is_dcn_axis
+
+        if ctx.dcn_axis is None and is_dcn_axis(mesh, axis):
+            raise ValueError(
+                f"EP axis {axis!r} crosses DCN; pass dcn_axis= for the "
+                "hierarchical exchange or transport='xla'"
+            )
+        if ctx.dcn_axis is not None and is_dcn_axis(mesh, ctx.axis):
+            raise ValueError(
+                f"intra-slice EP axis {ctx.axis!r} itself crosses DCN — "
+                "swap the axes (dcn_axis must be the cross-slice one)"
+            )
+    return ctx
 
 
 def _act(name: str, x):
@@ -92,15 +133,43 @@ def _act(name: str, x):
 
 
 def _a2a(ctx: EPMoEContext, x):
-    """Transpose leading (n, ...) slot dim across ranks."""
+    """Transpose the leading (n, ...) slot dim across EP ranks.
+
+    Flat: one exchange over ``ctx.axis``. Hierarchical (``dcn_axis``
+    set): a DCN rail leg — ``lax.all_to_all`` over the slice axis, which
+    by mesh construction only connects devices with the SAME local rank
+    (the reference's same-local-rank put, ep_a2a.py:70-78) — followed by
+    an intra-slice ICI leg (Pallas remote-DMA a2a or lax). Both legs are
+    self-inverse, so dispatch and combine use the same function.
+    """
+    if ctx.dcn_axis is None:
+        if ctx.transport == "pallas":
+            flat = x.reshape(ctx.n * x.shape[1], -1)
+            out = all_to_all_device(
+                flat, ctx.n, ctx.axis, ctx.mesh.axis_names,
+                collective_id=ctx.collective_id,
+            )
+            return out.reshape(x.shape)
+        return jax.lax.all_to_all(x, ctx.axis, 0, 0, tiled=False)
+
+    dcn, epl = ctx.dcn, ctx.epl
+    rest = x.shape[1:]
+    y = x.reshape(dcn, epl, *rest)
+    # DCN rail leg: slots for target slice d ride to (d, my_local).
+    y = jax.lax.all_to_all(y, ctx.dcn_axis, 0, 0, tiled=False)
+    y = jnp.swapaxes(y, 0, 1)                       # (local_dst, slice_src, ...)
+    # ICI leg: deliver each slot to its final local rank within my slice.
     if ctx.transport == "pallas":
-        flat = x.reshape(ctx.n * x.shape[1], -1)
+        flat = y.reshape(epl * dcn * rest[0], -1)
         out = all_to_all_device(
-            flat, ctx.n, ctx.axis, ctx.mesh.axis_names,
+            flat, epl, ctx.axis, ctx.mesh.axis_names,
             collective_id=ctx.collective_id,
         )
-        return out.reshape(x.shape)
-    return jax.lax.all_to_all(x, ctx.axis, 0, 0, tiled=False)
+        y = out.reshape(epl, dcn, *rest)            # (local_src, slice_src, ...)
+    else:
+        y = jax.lax.all_to_all(y, ctx.axis, 0, 0, tiled=False)
+    # back to global-rank-major (slice·epl + local)
+    return jnp.swapaxes(y, 0, 1).reshape(ctx.n, *rest)
 
 
 def _dispatch(ctx: EPMoEContext, x_sorted, splits):
@@ -212,11 +281,12 @@ def ep_moe_device(x, logits, w_up, w_down, ctx: EPMoEContext):
 
 @functools.lru_cache(maxsize=64)
 def _build_ep_moe(ctx: EPMoEContext):
-    rows = P(tuple(ctx.batch_axes) + (ctx.axis,))
+    rows = P(tuple(ctx.batch_axes) + ctx.ep_axes)
+    experts = P(ctx.ep_axes)
     fn = jax.shard_map(
         functools.partial(ep_moe_device, ctx=ctx),
         mesh=ctx.mesh,
-        in_specs=(rows, rows, P(ctx.axis), P(ctx.axis)),
+        in_specs=(rows, rows, experts, experts),
         out_specs=rows,
         check_vma=False,
     )

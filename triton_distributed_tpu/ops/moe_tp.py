@@ -362,10 +362,11 @@ def moe_tp_mlp_overlapped(x, topk_ids, topk_weights, w_up, w_down,
     """Full overlapped TP MoE MLP: AG⊕up-GroupGEMM → act → down-GroupGEMM
     ⊕Reduce-RS. The default inference path; the composed
     :func:`moe_tp_mlp` remains the differentiable training path."""
+    from triton_distributed_tpu.ops.moe import _act
+
     routing = align_routing_sharded(ctx, topk_ids)
     h = ag_group_gemm_fused(x, routing, w_up, ctx)
-    act = jax.nn.silu if activation == "silu" else jax.nn.gelu
-    h = act(h.astype(jnp.float32)).astype(ctx.dtype)
+    h = _act(activation, h.astype(jnp.float32)).astype(ctx.dtype)
     return moe_reduce_rs_fused(h, routing, topk_weights, w_down, ctx)
 
 
@@ -390,10 +391,11 @@ def moe_tp_mlp_device(
         ids, ctx.num_experts, ctx.block_m
     )
     cap = sti.shape[0]
+    from triton_distributed_tpu.ops.moe import _act
+
     xs = mu.gather_sorted(x_full, sti, ctx.topk).astype(ctx.dtype)
     h = _ggemm(ctx, xs, w_up_loc.astype(ctx.dtype), be, counts, cap)
-    act = jax.nn.silu if activation == "silu" else jax.nn.gelu
-    h = act(h).astype(ctx.dtype)
+    h = _act(activation, h).astype(ctx.dtype)
     part = _ggemm(ctx, h, w_down_loc.astype(ctx.dtype), be, counts, cap)
     tok = mu.scatter_combine(part, sti, weights, x_full.shape[0])
     return jax.lax.psum_scatter(
