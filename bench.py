@@ -282,9 +282,11 @@ def _bench_moe_a2a(mesh, n, on_tpu, spec):
 
     epr, hidden, tok, topk = (8, 7168, 128, 8) if on_tpu else (2, 256, 16, 2)
     max_m = tok * topk
+    # fp8 wire with in-slot per-token scales — the reference's headline
+    # config is fp8 (README.md:87)
     ctx = ma.create_all_to_all_context(
         mesh, "x", max_m=max_m, hidden=hidden,
-        experts_per_rank=epr, dtype=jnp.bfloat16,
+        experts_per_rank=epr, dtype=jnp.bfloat16, quant="fp8",
     )
     # Force the Pallas transport even at n=1 (all_to_all() itself
     # shortcuts to identity there, which round 1 mis-measured as latency).
@@ -338,7 +340,7 @@ def _bench_moe_a2a(mesh, n, on_tpu, spec):
         "value": round(t * 1e6, 1),
         "unit": "us",
         "config": (
-            f"n={n} tok/rank={tok} topk={topk} hidden={hidden} bf16 "
+            f"n={n} tok/rank={tok} topk={topk} hidden={hidden} fp8+scales "
             + ("self-transport(no wire)" if n == 1 else "ring")
         ),
     }

@@ -44,6 +44,7 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import lang
 from triton_distributed_tpu.config import config, fused_vmem_budget
+from triton_distributed_tpu.kernels.ring import ag_forward_ring
 from triton_distributed_tpu.runtime import (
     LinkKind,
     detect_topology,
@@ -167,8 +168,6 @@ def _fused_kernel(
     """HBM-streaming ring AG-GEMM. Per step: wait shard arrival → start
     forwarding it → stream it through the MXU while the RDMA is in flight
     (the ring protocol lives in kernels/ring.ag_forward_ring)."""
-    from triton_distributed_tpu.kernels.ring import ag_forward_ring
-
     me = lang.my_pe(axis)
     m = x_hbm.shape[0]  # shard rows
     k = x_hbm.shape[1]

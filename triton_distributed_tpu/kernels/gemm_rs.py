@@ -46,13 +46,13 @@ from triton_distributed_tpu.kernels.ag_gemm import (
     mm_pipeline,
     pick_mm_blocks,
 )
+from triton_distributed_tpu.kernels.ring import reduce_ring
 from triton_distributed_tpu.runtime import (
     LinkKind,
     detect_topology,
     mesh_axes_size,
-    ring_neighbors,
 )
-from triton_distributed_tpu.utils.testing import chaos_delay
+
 
 class GemmRSMethod(enum.Enum):
     PALLAS_FUSED = "pallas_fused"
@@ -91,8 +91,6 @@ def _fused_kernel(
     kernels/reduce_scatter.py:ring_reduce_core (a sender may not rewrite a
     slot its receiver hasn't folded in — semaphore credits count arrivals,
     not consumption)."""
-    from triton_distributed_tpu.kernels.ring import reduce_ring
-
     m_local = out_hbm.shape[0]
     n_out = out_hbm.shape[1]
     k = a_hbm.shape[1]

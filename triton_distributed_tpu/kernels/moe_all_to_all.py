@@ -68,6 +68,12 @@ class MoEAllToAllContext:
     # ops/moe.py) — slot geometry then spans all ranks, not just the
     # ``axis`` line. None → flat exchange over ``axis``.
     num_ranks: int | None = None
+    # Quantized wire format: "fp8" (e4m3) or "int8" ships tokens at 1
+    # byte/elem with one f32 scale per token packed IN-SLOT next to the
+    # payload (≡ the WITH_SCALE putmem_signal of the reference's
+    # headline fp8 dispatch, low_latency_all_to_all.py:43-107). None →
+    # tokens ride in ``dtype``.
+    quant: str | None = None
 
     @property
     def n(self) -> int:
@@ -78,8 +84,29 @@ class MoEAllToAllContext:
         return self.n * self.experts_per_rank
 
     @property
+    def wire_dtype(self):
+        if self.quant is None:
+            return jnp.dtype(self.dtype)
+        if self.quant == "fp8":
+            return jnp.dtype(jnp.float8_e4m3fn)
+        if self.quant == "int8":
+            return jnp.dtype(jnp.int8)
+        raise ValueError(f"quant must be None|'fp8'|'int8', got {self.quant!r}")
+
+    @property
+    def quant_max(self) -> float:
+        return 448.0 if self.quant == "fp8" else 127.0
+
+    @property
     def ints_per_row(self) -> int:
-        return self.hidden * jnp.dtype(self.dtype).itemsize // 4
+        return self.hidden * self.wire_dtype.itemsize // 4
+
+    @property
+    def scale_rows(self) -> int:
+        """Rows per slot carrying the bitcast f32 per-token scales."""
+        if self.quant is None:
+            return 0
+        return -(-self.max_m // self.ints_per_row)
 
     @property
     def splits_rows(self) -> int:
@@ -88,23 +115,25 @@ class MoEAllToAllContext:
 
     @property
     def slot_rows(self) -> int:
-        return self.max_m + self.splits_rows
+        return self.max_m + self.scale_rows + self.splits_rows
 
 
 def create_all_to_all_context(
     mesh, axis, *, max_m, hidden, experts_per_rank,
     dtype=jnp.bfloat16, collective_id: int = 10, num_ranks: int | None = None,
+    quant: str | None = None,
 ) -> MoEAllToAllContext:
     """≡ create_all_to_all_context (low_latency_all_to_all.py:168-187)."""
     dtype = jnp.dtype(dtype)
-    assert (hidden * dtype.itemsize) % 4 == 0, (
-        f"hidden={hidden} row of {dtype} not a whole number of int32s"
-    )
-    return MoEAllToAllContext(
+    ctx = MoEAllToAllContext(
         mesh=mesh, axis=axis, max_m=max_m, hidden=hidden,
         experts_per_rank=experts_per_rank, dtype=dtype,
-        collective_id=collective_id, num_ranks=num_ranks,
+        collective_id=collective_id, num_ranks=num_ranks, quant=quant,
     )
+    assert (hidden * ctx.wire_dtype.itemsize) % 4 == 0, (
+        f"hidden={hidden} row of {ctx.wire_dtype} not a whole number of int32s"
+    )
+    return ctx
 
 
 def _pack_splits(ctx: MoEAllToAllContext, spl):
@@ -115,9 +144,9 @@ def _pack_splits(ctx: MoEAllToAllContext, spl):
 
 
 def _toks_to_ints(ctx: MoEAllToAllContext, toks):
-    """(..., H) ctx.dtype → (..., ints_per_row) int32, pure bitcast."""
+    """(..., H) wire dtype → (..., ints_per_row) int32, pure bitcast."""
     lead = toks.shape[:-1]
-    itemsize = jnp.dtype(ctx.dtype).itemsize
+    itemsize = ctx.wire_dtype.itemsize
     if itemsize < 4:
         toks = toks.reshape(*lead, ctx.ints_per_row, 4 // itemsize)
     return jax.lax.bitcast_convert_type(toks, jnp.int32).reshape(
@@ -126,9 +155,45 @@ def _toks_to_ints(ctx: MoEAllToAllContext, toks):
 
 
 def _ints_to_toks(ctx: MoEAllToAllContext, ints):
-    """(..., ints_per_row) int32 → (..., H) ctx.dtype, pure bitcast."""
-    rows = jax.lax.bitcast_convert_type(ints, ctx.dtype)
+    """(..., ints_per_row) int32 → (..., H) wire dtype, pure bitcast."""
+    rows = jax.lax.bitcast_convert_type(ints, ctx.wire_dtype)
     return rows.reshape(*ints.shape[:-1], ctx.hidden)
+
+
+def quantize_rows(ctx: MoEAllToAllContext, toks):
+    """(..., H) → ((..., H) wire dtype, (...,) f32 per-token scales).
+
+    Symmetric per-token quantization: scale = amax/QMAX (≡ the per-token
+    scales the reference ships WITH_SCALE, low_latency_all_to_all.py:43).
+    """
+    f = toks.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=-1)
+    scale = jnp.maximum(amax, 1e-12) / ctx.quant_max
+    q = f / scale[..., None]
+    if ctx.quant == "int8":
+        q = jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8)
+    else:
+        q = q.astype(jnp.float8_e4m3fn)
+    return q, scale
+
+
+def dequantize_rows(ctx: MoEAllToAllContext, q, scale):
+    """Inverse of :func:`quantize_rows`, back to ctx.dtype."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(ctx.dtype)
+
+
+def _pack_scales(ctx: MoEAllToAllContext, scale):
+    """(n, max_m) f32 scales → (n, scale_rows, ints_per_row) int32 rows."""
+    ints = jax.lax.bitcast_convert_type(scale.astype(jnp.float32), jnp.int32)
+    pad = ctx.scale_rows * ctx.ints_per_row - ctx.max_m
+    ints = jnp.pad(ints, ((0, 0), (0, pad)))
+    return ints.reshape(ctx.n, ctx.scale_rows, ctx.ints_per_row)
+
+
+def _unpack_scales(ctx: MoEAllToAllContext, rows):
+    """(n, scale_rows, ints_per_row) int32 → (n, max_m) f32 scales."""
+    flat = rows.reshape(ctx.n, -1)[:, : ctx.max_m]
+    return jax.lax.bitcast_convert_type(flat, jnp.float32)
 
 
 def peer_offsets(ctx: MoEAllToAllContext, splits):
@@ -164,12 +229,20 @@ def dispatch_stage(ctx: MoEAllToAllContext, tokens, splits):
 
 def pack_slots(ctx: MoEAllToAllContext, toks, spl):
     """(toks (n, max_m, H), spl (n, epr)) → one int32 payload
-    (n * slot_rows, ints_per_row) for :func:`fast_all_to_all`. The
-    bitcast is gradient-opaque — inference transport only."""
-    slots = jnp.concatenate(
-        [_toks_to_ints(ctx, toks.astype(ctx.dtype)), _pack_splits(ctx, spl)],
-        axis=1,
-    )
+    (n * slot_rows, ints_per_row) for :func:`fast_all_to_all`. With
+    ``ctx.quant`` set, tokens are quantized and their per-token f32
+    scales ride in-slot between payload and splits (one RDMA still moves
+    data + scales + counts). The bitcast is gradient-opaque — inference
+    transport only."""
+    parts = []
+    if ctx.quant is None:
+        parts.append(_toks_to_ints(ctx, toks.astype(ctx.dtype)))
+    else:
+        q, scale = quantize_rows(ctx, toks)
+        parts.append(_toks_to_ints(ctx, q))
+        parts.append(_pack_scales(ctx, scale))
+    parts.append(_pack_splits(ctx, spl))
+    slots = jnp.concatenate(parts, axis=1)
     return slots.reshape(ctx.n * ctx.slot_rows, ctx.ints_per_row)
 
 
@@ -196,7 +269,9 @@ def fast_all_to_all(ctx: MoEAllToAllContext, send, *, use_xla: bool = False):
 
 
 def recv_tokens_view(ctx: MoEAllToAllContext, recv):
-    """Per-device slice → ((n, max_m, H) tokens, (n, epr) int32 splits).
+    """Per-device slice → ((n, max_m, H) ctx.dtype tokens, (n, epr) int32
+    splits). Quantized transports are dequantized here with the in-slot
+    per-token scales.
 
     Row i of the splits = source rank i's counts for MY experts
     (≡ all_to_all_post_process, low_latency_all_to_all.py:251-269).
@@ -204,7 +279,14 @@ def recv_tokens_view(ctx: MoEAllToAllContext, recv):
     """
     slots = recv.reshape(ctx.n, ctx.slot_rows, ctx.ints_per_row)
     toks = _ints_to_toks(ctx, slots[:, : ctx.max_m])
-    spl = slots[:, ctx.max_m :].reshape(ctx.n, -1)[:, : ctx.experts_per_rank]
+    if ctx.quant is not None:
+        scales = _unpack_scales(
+            ctx, slots[:, ctx.max_m : ctx.max_m + ctx.scale_rows]
+        )
+        toks = dequantize_rows(ctx, toks, scales)
+    spl = slots[:, ctx.max_m + ctx.scale_rows :].reshape(ctx.n, -1)[
+        :, : ctx.experts_per_rank
+    ]
     return toks, clamp_recv_splits(ctx, spl)
 
 
@@ -218,9 +300,16 @@ def combine_stage(ctx: MoEAllToAllContext, toks):
 
 
 def combine_unpack(ctx: MoEAllToAllContext, comb):
-    """Int32 return-leg payload → (n, max_m, H) token slots."""
-    ints = comb.reshape(ctx.n, ctx.slot_rows, ctx.ints_per_row)[:, : ctx.max_m]
-    return _ints_to_toks(ctx, ints)
+    """Int32 return-leg payload → (n, max_m, H) ctx.dtype token slots
+    (dequantized with the in-slot scales when the wire is quantized)."""
+    slots = comb.reshape(ctx.n, ctx.slot_rows, ctx.ints_per_row)
+    toks = _ints_to_toks(ctx, slots[:, : ctx.max_m])
+    if ctx.quant is not None:
+        scales = _unpack_scales(
+            ctx, slots[:, ctx.max_m : ctx.max_m + ctx.scale_rows]
+        )
+        toks = dequantize_rows(ctx, toks, scales)
+    return toks
 
 
 def combine_unstage(ctx: MoEAllToAllContext, toks, splits, m_total: int):

@@ -43,6 +43,7 @@ from triton_distributed_tpu import lang
 from triton_distributed_tpu.config import fused_vmem_budget
 from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
 from triton_distributed_tpu.kernels.gemm_rs import ew_add_pipeline
+from triton_distributed_tpu.kernels.ring import ag_forward_ring, reduce_ring
 
 
 def pick_gg_blocks(block_m: int, cap: int, k: int, nl: int, itemsize: int):
@@ -117,8 +118,6 @@ def ag_group_gemm_kernel(
     block→expert table for every shard; out_hbm: (n·cap_s, NL) per-shard
     sorted outputs; ag_hbm: (n·cap_s, K) gathered-slab workspace.
     """
-    from triton_distributed_tpu.kernels.ring import ag_forward_ring
-
     cap = xs_hbm.shape[0]
     k = xs_hbm.shape[1]
     nl = w_hbm.shape[2]
@@ -157,8 +156,6 @@ def moe_reduce_rs_kernel(
     rank's fully-reduced sorted rows, still awaiting the local topk
     combine (done in XLA on the destination's own rows).
     """
-    from triton_distributed_tpu.kernels.ring import reduce_ring
-
     cap = out_hbm.shape[0]
     h = out_hbm.shape[1]
     fl = y_hbm.shape[1]

@@ -59,6 +59,11 @@ class EPMoEContext:
     # intra-slice ICI leg (≡ ep_a2a.py:36-150's node rotation with
     # same-local-rank rail puts). None → flat single-slice exchange.
     dcn_axis: str | None = None
+    # Quantized token transport ("fp8" | "int8"): tokens ride the a2a at
+    # 1 byte/elem with per-token scales packed in-slot (≡ the reference's
+    # headline fp8 WITH_SCALE dispatch). Pallas transport only — the XLA
+    # transport is the differentiable path and stays full-precision.
+    quant: str | None = None
 
     @property
     def n(self) -> int:
@@ -94,6 +99,7 @@ class EPMoEContext:
             self.mesh, self.axis, max_m=self.max_m, hidden=self.hidden,
             experts_per_rank=self.experts_per_rank, dtype=self.dtype,
             collective_id=self.collective_id, num_ranks=self.n,
+            quant=self.quant,
         )
 
 
@@ -105,6 +111,12 @@ def create_ep_moe_context(
         max_m=max_m, hidden=hidden, **kw,
     )
     assert num_experts % ctx.n == 0, f"{num_experts} experts over {ctx.n} ranks"
+    ctx.a2a  # fail fast on bad quant/hidden geometry, not at trace time
+    if ctx.quant is not None and ctx.transport != "pallas":
+        raise ValueError(
+            "quantized transport rides the Pallas slot payload; the XLA "
+            "transport is the differentiable full-precision path"
+        )
     if ctx.transport == "pallas":
         # Pallas remote DMA cannot cross DCN: a multi-slice EP axis must
         # be declared as dcn_axis so the exchange takes the hierarchical
