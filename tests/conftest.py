@@ -25,6 +25,18 @@ import pytest  # noqa: E402
 from jax.sharding import Mesh  # noqa: E402
 
 
+def pytest_collection_modifyitems(items):
+    """Run the tuned-engine-selection tests LAST. They bench many
+    interpreted kernels in rapid succession, which can leave the TPU
+    interpreter's io_callback worker pool wedged on this 1-core host;
+    an interpreted kernel running after them in the same process then
+    deadlocks in the ordered-effects chain (observed as a hang in
+    Token.block_until_ready). The full suite's alphabetical order
+    already put test_tune last — this makes that load-bearing ordering
+    explicit so subset runs are safe too."""
+    items.sort(key=lambda it: "TestTunedEngineSelection" in it.nodeid)
+
+
 @pytest.fixture(autouse=True)
 def _fresh_interpreter_state():
     """Isolate tests: the TPU interpreter keeps global shared memory /

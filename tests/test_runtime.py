@@ -9,7 +9,6 @@ from triton_distributed_tpu.runtime import (
     AllGatherMethod,
     auto_allgather_method,
     detect_topology,
-    symm_zeros,
 )
 from triton_distributed_tpu.runtime.topology import LinkKind
 
@@ -20,13 +19,6 @@ def test_initialize_distributed_single_host():
     assert ctx.num_devices == 8
     assert ctx.mesh.shape["x"] == 8
 
-
-def test_symm_buffer_shapes(mesh8):
-    buf = symm_zeros(mesh8, "x", (4, 128), jnp.float32)
-    assert buf.array.shape == (32, 128)
-    assert buf.local_shape == (4, 128)
-    # one shard per device
-    assert len(buf.array.sharding.device_set) == 8
 
 
 def test_detect_topology_cpu(mesh8):

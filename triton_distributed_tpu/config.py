@@ -41,16 +41,6 @@ class Config:
     chaos_delay: bool = field(
         default_factory=lambda: os.environ.get("TDTPU_CHAOS_DELAY", "0") == "1"
     )
-    # Default symmetric workspace budget (bytes) for contexts that
-    # pre-allocate communication buffers (reference NVSHMEM_SYMMETRIC_SIZE,
-    # launch.sh:1-41).
-    symmetric_size: int = field(
-        default_factory=lambda: int(
-            float(os.environ.get("TDTPU_SYMMETRIC_SIZE", "1e9"))
-        )
-    )
-
-
     # Per-core VMEM working-set budget (bytes) used to gate fused single
     # -kernel engines (ag_gemm, gemm_rs) vs the streaming XLA ring paths.
     fused_vmem_budget: int = field(
@@ -65,6 +55,18 @@ config = Config()
 
 def fused_vmem_budget() -> int:
     return config.fused_vmem_budget
+
+
+def autotune_enabled() -> bool:
+    """Should ``method=None`` op entries consult the measured autotuner
+    (vs the static heuristics)? Default: on real hardware yes, on the CPU
+    interpreter no (benching simulated kernels is meaningless and slow).
+    Override with TDTPU_AUTOTUNE=1/0.
+    """
+    env = os.environ.get("TDTPU_AUTOTUNE")
+    if env is not None:
+        return env == "1"
+    return on_tpu()
 
 
 def _use_interpret(force: bool | None) -> bool:

@@ -335,6 +335,31 @@ def _build_xla_naive(mesh, axis, batch_axes, out_dtype):
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=64)
+def _engine_tuner(mesh, axis, batch_axes, out_dtype, collective_id):
+    """Measured engine selection for ``method=None`` (≡ wrapping the op
+    in contextual_autotune, reference autotuner.py:97): every engine is
+    benchmarked end to end per input shape, the winner persists on disk,
+    and the MAX consensus keeps multi-process meshes aligned. Engines
+    that cannot build for a shape (e.g. unblockable PALLAS_FUSED) fail
+    to +inf and lose. out_dtype/collective_id are part of the tuner name
+    (and so the cache key): a winner for one out_dtype must not be
+    applied to another it might not even build for."""
+    from triton_distributed_tpu.tune.autotuner import method_tuner
+
+    def run(a, b, *, method):
+        return ag_gemm(
+            a, b, mesh, axis, batch_axes=batch_axes,
+            method=AGGemmMethod(method), out_dtype=out_dtype,
+            collective_id=collective_id,
+        )
+
+    return method_tuner(
+        f"ag_gemm[{dict(mesh.shape)}|{axis}|{batch_axes}|{out_dtype}|{collective_id}]",
+        run, AGGemmMethod,
+    )
+
+
 def auto_ag_gemm_method(mesh, axis, a, b, dp: int = 1) -> AGGemmMethod:
     """≡ reference method auto-selection (allgather.py:54-69): topology +
     shape blockability decide the engine. The streaming fused engine has no
@@ -401,7 +426,17 @@ def ag_gemm(
         out = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
         return (out, a) if return_gathered else out
     if method is None:
-        method = auto_ag_gemm_method(mesh, axis, a, b, dp=dp)
+        from triton_distributed_tpu.tune.autotuner import tuned_method_or_none
+
+        m = tuned_method_or_none(
+            lambda: _engine_tuner(
+                mesh, axis, batch_axes, jnp.dtype(out_dtype), collective_id
+            ),
+            a, a, b,
+        )
+        method = (
+            AGGemmMethod(m) if m else auto_ag_gemm_method(mesh, axis, a, b, dp=dp)
+        )
     if method == AGGemmMethod.PALLAS_FUSED:
         fn = _build_fused(
             mesh, axis, batch_axes, a.shape, b.shape, a.dtype, out_dtype,

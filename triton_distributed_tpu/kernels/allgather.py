@@ -177,6 +177,26 @@ def _build_all_gather(mesh, axis, method, shape, dtype, collective_id, chaos):
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=64)
+def _engine_tuner(mesh, axis, collective_id):
+    """Measured engine selection for ``method=None`` — replaces the
+    static 64 KiB LL threshold with a per-shape measurement (the
+    reference's contextual_autotune wrapping, autotuner.py:97); winners
+    persist on disk and the MAX consensus aligns processes."""
+    from triton_distributed_tpu.tune.autotuner import method_tuner
+
+    def run(x, *, method):
+        return all_gather(
+            x, mesh, axis, method=AllGatherMethod(method),
+            collective_id=collective_id,
+        )
+
+    return method_tuner(
+        f"all_gather[{dict(mesh.shape)}|{axis}|{collective_id}]",
+        run, AllGatherMethod,
+    )
+
+
 def all_gather(
     x,
     mesh,
@@ -191,15 +211,25 @@ def all_gather(
     (low_latency_allgather.py:971) + method auto-selection (allgather.py:54-69).
     """
     n = mesh.shape[axis]
+    if n == 1:
+        return x
     if method is None:
-        shard_bytes = (x.size // n) * x.dtype.itemsize
-        method = auto_allgather_method(detect_topology(mesh, axis), shard_bytes)
+        from triton_distributed_tpu.tune.autotuner import tuned_method_or_none
+
+        m = tuned_method_or_none(
+            lambda: _engine_tuner(mesh, axis, collective_id), x, x
+        )
+        if m is not None:
+            method = AllGatherMethod(m)
+        else:
+            shard_bytes = (x.size // n) * x.dtype.itemsize
+            method = auto_allgather_method(
+                detect_topology(mesh, axis), shard_bytes
+            )
     if method == AllGatherMethod.RING_BIDIR and (x.ndim < 2 or x.shape[1] < 2):
         # bidir splits dim 1 between the two directions — impossible on
         # rank-1 / single-column inputs; fall back to the plain ring.
         method = AllGatherMethod.RING_1D
-    if n == 1:
-        return x
     fn = _build_all_gather(
         mesh, axis, method, x.shape, x.dtype, collective_id, config.chaos_delay
     )

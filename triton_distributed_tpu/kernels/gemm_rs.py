@@ -230,6 +230,26 @@ def _build_xla_naive(mesh, axis, batch_axes, out_dtype):
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=64)
+def _engine_tuner(mesh, axis, batch_axes, out_dtype, collective_id):
+    """Measured engine selection for ``method=None`` (see
+    ag_gemm._engine_tuner for the contract incl. why out_dtype and
+    collective_id belong in the name/key)."""
+    from triton_distributed_tpu.tune.autotuner import method_tuner
+
+    def run(a, b, *, method):
+        return gemm_rs(
+            a, b, mesh, axis, batch_axes=batch_axes,
+            method=GemmRSMethod(method), out_dtype=out_dtype,
+            collective_id=collective_id,
+        )
+
+    return method_tuner(
+        f"gemm_rs[{dict(mesh.shape)}|{axis}|{batch_axes}|{out_dtype}|{collective_id}]",
+        run, GemmRSMethod,
+    )
+
+
 def auto_gemm_rs_method(mesh, axis, a, b, dp: int = 1) -> GemmRSMethod:
     """Topology + shape blockability decide the engine; fallbacks are
     logged (nobody should benchmark XLA believing it is the fused kernel)."""
@@ -284,7 +304,17 @@ def gemm_rs(
     if n == 1:
         return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
     if method is None:
-        method = auto_gemm_rs_method(mesh, axis, a, b, dp=dp)
+        from triton_distributed_tpu.tune.autotuner import tuned_method_or_none
+
+        m = tuned_method_or_none(
+            lambda: _engine_tuner(
+                mesh, axis, batch_axes, jnp.dtype(out_dtype), collective_id
+            ),
+            a, a, b,
+        )
+        method = (
+            GemmRSMethod(m) if m else auto_gemm_rs_method(mesh, axis, a, b, dp=dp)
+        )
     if method == GemmRSMethod.PALLAS_FUSED:
         fn = _build_fused(
             mesh, axis, batch_axes, a.shape, b.shape, a.dtype, out_dtype,

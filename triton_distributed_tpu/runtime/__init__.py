@@ -1,4 +1,4 @@
-"""Runtime: bootstrap, mesh/topology discovery, symmetric buffers.
+"""Runtime: bootstrap, mesh/topology discovery.
 
 TPU-native replacement for the reference's L0+L2 layers: ``pynvshmem``
 symmetric-memory management (reference: shmem/nvshmem_bind/pynvshmem/python/
@@ -17,12 +17,6 @@ from triton_distributed_tpu.runtime.multislice import (
     is_dcn_axis,
     num_slices,
 )
-from triton_distributed_tpu.runtime.symm import (
-    SymmetricBuffer,
-    symm_empty,
-    symm_full,
-    symm_zeros,
-)
 from triton_distributed_tpu.runtime.topology import (
     AllGatherMethod,
     LinkKind,
@@ -39,10 +33,6 @@ __all__ = [
     "initialize_distributed",
     "finalize_distributed",
     "get_context",
-    "SymmetricBuffer",
-    "symm_zeros",
-    "symm_empty",
-    "symm_full",
     "TopologyInfo",
     "AllGatherMethod",
     "LinkKind",

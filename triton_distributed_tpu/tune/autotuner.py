@@ -28,6 +28,7 @@ import os
 import pathlib
 import time
 import traceback
+import zlib
 
 import jax
 import numpy as np
@@ -62,22 +63,90 @@ def _consensus_times(times: np.ndarray) -> np.ndarray:
 
 
 class ContextualAutoTuner:
-    """Tune ``fn(*args, **cfg)`` over ``configs`` (list of kwarg dicts)."""
+    """Tune ``fn(*args, **cfg)`` over ``configs`` (list of kwarg dicts).
 
-    def __init__(self, fn, configs, *, name=None, warmup=1, iters=5, log=True):
+    ``persist=True`` backs the in-memory winner cache with an on-disk
+    JSON store (one per log dir), so a redeploy skips re-benching — the
+    reference keeps the same state in its ``.autotune_logs``. Every
+    process derives the identical winner from the MAX consensus, so
+    concurrent writers race to write identical content (atomic replace).
+    """
+
+    def __init__(self, fn, configs, *, name=None, warmup=1, iters=5,
+                 log=True, persist=True):
         self.fn = fn
         self.configs = list(configs)
         self.name = name or getattr(fn, "__name__", "thunk")
         self.warmup = warmup
         self.iters = iters
         self.log = log
+        self.persist = persist
         self.cache: dict = {}
         functools.update_wrapper(self, fn)
 
-    def _log_path(self):
+    def _log_dir(self):
         d = pathlib.Path(os.environ.get("TDTPU_AUTOTUNE_LOG_DIR", ".autotune_logs"))
         d.mkdir(parents=True, exist_ok=True)
-        return d / f"process-{jax.process_index()}.jsonl"
+        return d
+
+    def _log_path(self):
+        return self._log_dir() / f"process-{jax.process_index()}.jsonl"
+
+    def _store_path(self):
+        return self._log_dir() / "cache.json"
+
+    def _disk_load(self) -> dict:
+        try:
+            return json.loads(self._store_path().read_text())
+        except (OSError, ValueError):
+            return {}
+
+    def _disk_get(self, key):
+        best = self._disk_load().get(repr(key))
+        # stale-cache self-healing: a winner from an older code version
+        # (renamed kwarg, dropped candidate) must re-bench, not be
+        # applied blindly
+        if best is not None and best not in self.configs:
+            return None
+        return best
+
+    def _disk_put(self, key, best):
+        # flock'd read-modify-write: different tuners (ag_gemm/gemm_rs/
+        # all_gather) and processes share one store; without the lock the
+        # second writer's replace would drop the first writer's key
+        path = self._store_path()
+        lock = path.with_suffix(".lock")
+        with open(lock, "w") as lf:
+            try:
+                import fcntl
+
+                fcntl.flock(lf, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass  # best effort on exotic filesystems
+            store = self._disk_load()
+            store[repr(key)] = best
+            tmp = path.with_suffix(".json.tmp")
+            tmp.write_text(json.dumps(store, indent=1, sort_keys=True))
+            os.replace(tmp, path)
+
+    def _consensus_disk_hit(self, best):
+        """A disk hit is usable only if EVERY process has the same one:
+        a process that hit would skip the benching collectives a missing
+        process is blocked in — the exact mismatched-collective deadlock
+        the MAX consensus exists to prevent. Disagreement (including a
+        partial hit) degrades to a miss for everyone."""
+        if jax.process_count() == 1:
+            return best
+        from jax.experimental import multihost_utils
+
+        blob = json.dumps(best, sort_keys=True) if best is not None else ""
+        sig = np.array(
+            [1 if best is not None else 0, zlib.crc32(blob.encode())],
+            np.uint32,
+        )
+        all_sigs = np.asarray(multihost_utils.process_allgather(sig))
+        same = (all_sigs == all_sigs[0]).all() and all_sigs[0, 0] == 1
+        return best if same else None
 
     def _bench(self, args, kwargs):
         times = np.full((len(self.configs),), np.inf)
@@ -100,9 +169,15 @@ class ContextualAutoTuner:
                         }) + "\n")
         return _consensus_times(times)
 
-    def __call__(self, *args, **kwargs):
+    def pick(self, *args, **kwargs) -> dict:
+        """Winning config for these (shapes of) arguments: memory cache →
+        disk cache → measure-with-consensus."""
         key = (self.name, _shape_key(args, kwargs))
         best = self.cache.get(key)
+        if best is None and self.persist:
+            best = self._consensus_disk_hit(self._disk_get(key))
+            if best is not None:
+                self.cache[key] = best
         if best is None:
             times = self._bench(args, kwargs)
             idx = int(np.argmin(times))
@@ -112,6 +187,8 @@ class ContextualAutoTuner:
                 )
             best = self.configs[idx]
             self.cache[key] = best
+            if self.persist:
+                self._disk_put(key, best)
             if self.log:
                 with open(self._log_path(), "a") as f:
                     f.write(json.dumps({
@@ -121,7 +198,33 @@ class ContextualAutoTuner:
                                   for t in times],
                         "ts": time.time(),
                     }) + "\n")
-        return self.fn(*args, **kwargs, **best)
+        return best
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs, **self.pick(*args, **kwargs))
+
+
+def method_tuner(name, run, methods, *, warmup=1, iters=3):
+    """Engine-selection tuner: candidates are ``{"method": m.value}`` for
+    each member of the ``methods`` enum (the shared shape behind the
+    ag_gemm/gemm_rs/all_gather ``method=None`` wiring)."""
+    return ContextualAutoTuner(
+        run, [{"method": m.value} for m in methods],
+        name=name, warmup=warmup, iters=iters,
+    )
+
+
+def tuned_method_or_none(tuner_factory, probe, *args):
+    """The ``method=None`` dispatch shared by the op entries: consult the
+    measured tuner when tuning is enabled AND the call carries concrete
+    arrays (benching needs real execution; inside a larger jit the args
+    are tracers and the caller's static heuristic applies). Returns the
+    winning method string or None."""
+    from triton_distributed_tpu.config import autotune_enabled
+
+    if autotune_enabled() and not isinstance(probe, jax.core.Tracer):
+        return tuner_factory().pick(*args)["method"]
+    return None
 
 
 def contextual_autotune(configs, **opts):

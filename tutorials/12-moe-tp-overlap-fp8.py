@@ -1,0 +1,100 @@
+"""Tutorial 12: overlapped MoE-TP, fp8 EP transport, hierarchical EP.
+
+Round-2 flagships in one walk-through:
+
+* the single-kernel overlapped MoE-TP pipeline (AG⊕GroupGEMM →
+  GroupGEMM⊕Reduce-RS, kernels/moe_tp_fused.py) — per-shard expert-
+  sorted token slabs ride the ring while arrived shards stream through
+  grouped-GEMM pipelines (≡ reference allgather_group_gemm.py:420-498 +
+  moe_reduce_rs.py:362-545);
+* the fp8 wire format for EP dispatch/combine — tokens at 1 byte/elem
+  with per-token scales packed in-slot (≡ the WITH_SCALE fp8 headline
+  config, low_latency_all_to_all.py:43-107);
+* the hierarchical DCN-aware EP exchange — same-local-rank rail leg
+  over the slice axis + intra-slice Pallas leg (≡ ep_a2a.py:36-150).
+"""
+
+from _common import get_mesh
+
+mesh = get_mesh()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.kernels import moe_utils as mu
+from triton_distributed_tpu.ops import (
+    create_ag_group_gemm_context,
+    create_ep_moe_context,
+    ep_moe,
+    moe_tp_mlp_overlapped,
+)
+
+E, TOPK, M, K, F, H = 16, 2, 64, 128, 256, 128
+
+x = jax.random.normal(jax.random.PRNGKey(0), (M, K), jnp.float32)
+logits = jax.random.normal(jax.random.PRNGKey(1), (M, E))
+w_up = jax.random.normal(jax.random.PRNGKey(2), (E, K, F), jnp.float32) * 0.05
+w_down = jax.random.normal(jax.random.PRNGKey(3), (E, F, H), jnp.float32) * 0.05
+weights, ids = mu.select_experts(logits, TOPK)
+
+dense = jnp.zeros((M, H))
+for t in range(TOPK):
+    h = jax.nn.silu(jnp.einsum("mk,mkf->mf", x, w_up[ids[:, t]]))
+    dense += weights[:, t : t + 1] * jnp.einsum("mf,mfh->mh", h, w_down[ids[:, t]])
+
+# ---- 1. overlapped MoE-TP (tokens sharded, experts' columns sharded) ----
+ctx = create_ag_group_gemm_context(
+    mesh, "x", num_experts=E, topk=TOPK, block_m=8, dtype=jnp.float32
+)
+out = moe_tp_mlp_overlapped(
+    jax.device_put(x, NamedSharding(mesh, P("x"))),
+    jax.device_put(ids, NamedSharding(mesh, P("x"))),
+    jax.device_put(weights, NamedSharding(mesh, P("x"))),
+    jax.device_put(w_up, NamedSharding(mesh, P(None, None, "x"))),
+    jax.device_put(w_down, NamedSharding(mesh, P(None, "x"))),
+    ctx,
+)
+np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=2e-5, rtol=2e-5)
+print("overlapped MoE-TP == dense MoE")
+
+# ---- 2. EP with the fp8 wire format (+ per-token scales in-slot) --------
+ep_ctx = create_ep_moe_context(
+    mesh, "x", num_experts=E, topk=TOPK, max_m=(M // 8) * TOPK, hidden=K,
+    dtype=jnp.float32, transport="pallas", block_m=8, quant="fp8",
+)
+w_up_ep = jax.random.normal(jax.random.PRNGKey(4), (E, K, F), jnp.float32) * 0.05
+w_down_ep = jax.random.normal(jax.random.PRNGKey(5), (E, F, K), jnp.float32) * 0.05
+dense_ep = jnp.zeros((M, K))
+for t in range(TOPK):
+    h = jax.nn.silu(jnp.einsum("mk,mkf->mf", x, w_up_ep[ids[:, t]]))
+    dense_ep += weights[:, t : t + 1] * jnp.einsum("mf,mfk->mk", h, w_down_ep[ids[:, t]])
+rows = NamedSharding(mesh, P("x"))
+out_ep = ep_moe(
+    jax.device_put(x, rows), jax.device_put(logits, rows),
+    jax.device_put(w_up_ep, rows), jax.device_put(w_down_ep, rows), ep_ctx,
+)
+err = np.abs(np.asarray(out_ep) - np.asarray(dense_ep)).max()
+scale = np.abs(np.asarray(dense_ep)).max()
+assert err < 0.08 * scale, (err, scale)
+print(f"fp8 EP dispatch/combine within quant tolerance ({err / scale:.1%} of scale)")
+
+# ---- 3. hierarchical EP on a (dcn=2, ep=4) mesh -------------------------
+devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+hmesh = Mesh(devs, ("dcn", "ep"))
+hier_ctx = create_ep_moe_context(
+    hmesh, "ep", dcn_axis="dcn", num_experts=E, topk=TOPK,
+    max_m=(M // 8) * TOPK, hidden=K, dtype=jnp.float32,
+    transport="pallas", block_m=8,
+)
+hrows = NamedSharding(hmesh, P(("dcn", "ep")))
+out_h = ep_moe(
+    jax.device_put(x, hrows), jax.device_put(logits, hrows),
+    jax.device_put(w_up_ep, hrows), jax.device_put(w_down_ep, hrows), hier_ctx,
+)
+np.testing.assert_allclose(
+    np.asarray(out_h), np.asarray(dense_ep), atol=2e-5, rtol=2e-5
+)
+print("hierarchical (rail-leg) EP == dense MoE")
+print("tutorial 12 OK")
