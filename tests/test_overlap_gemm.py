@@ -103,3 +103,16 @@ def test_tp_mlp_roundtrip(mesh8):
     y = gemm_rs(h, w2, mesh8, "x")          # (M, 32) sharded on rows
     ref = _ref_matmul(np.asarray(_ref_matmul(x, w1)), w2)
     assert_allclose(np.asarray(y, np.float32), ref, atol=1e-3, rtol=1e-3)
+
+
+@pytest.mark.parametrize(
+    "method", [AGGemmMethod.PALLAS_FUSED, AGGemmMethod.XLA_RING]
+)
+def test_ag_gemm_return_gathered(mesh8, method):
+    """return_gathered=True hands back the gathered activations (free on
+    the fused engine's workspace; a cached all_gather on XLA engines)."""
+    a = _rand((64, 32), seed=7)
+    b = _rand((32, 128), seed=8)
+    c, gathered = ag_gemm(a, b, mesh8, "x", method=method, return_gathered=True)
+    assert_allclose(np.asarray(c), _ref_matmul(a, b), atol=1e-4, rtol=1e-4)
+    assert_allclose(np.asarray(gathered), np.asarray(a), atol=0, rtol=0)

@@ -57,6 +57,14 @@ def fused_vmem_budget() -> int:
     return config.fused_vmem_budget
 
 
+def interp_key() -> tuple:
+    """Hashable key of the config state captured at pallas BUILD time
+    (chaos delays are traced in; detect_races is baked into the
+    interpreter params) — lru-cached kernel builders must include it so
+    toggling either knob rebuilds instead of reusing a stale build."""
+    return (config.chaos_delay, config.detect_races)
+
+
 def autotune_enabled() -> bool:
     """Should ``method=None`` op entries consult the measured autotuner
     (vs the static heuristics)? Default: on real hardware yes, on the CPU

@@ -43,7 +43,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import lang
-from triton_distributed_tpu.config import config, fused_vmem_budget
+from triton_distributed_tpu.config import fused_vmem_budget, interp_key
 from triton_distributed_tpu.kernels.ring import ag_forward_ring
 from triton_distributed_tpu.runtime import (
     LinkKind,
@@ -162,7 +162,7 @@ def mm_pipeline(mb, nb, kb, bm, bk, bn, acc_ref, *, m_off=0, n_off=0, out_m_off=
 
 
 def _fused_kernel(
-    n, axis, mesh_axes, blocks,
+    n, axis, mesh_axes, blocks, publish_local,
     x_hbm, b_hbm, out_hbm, ag_hbm, acc_ref, local_sem, send_sem, recv_sem,
 ):
     """HBM-streaming ring AG-GEMM. Per step: wait shard arrival → start
@@ -176,11 +176,14 @@ def _fused_kernel(
     mb, nb, kb = m // bm, nl // bn, k // bk
 
     # Publish the local shard into the gathered workspace (HBM→HBM local
-    # DMA ≡ local_copy_and_barrier_all, allgather_gemm.py:100-117). The
-    # copy overlaps step 0 entirely: the ring forwards and consumes the
-    # local shard straight from x_hbm.
-    cp = pltpu.make_async_copy(x_hbm, ag_hbm.at[pl.ds(me * m, m)], local_sem)
-    cp.start()
+    # DMA ≡ local_copy_and_barrier_all, allgather_gemm.py:100-117) — ONLY
+    # when the caller wants the gathered activations back: the ring
+    # forwards and consumes the local shard straight from x_hbm, so slab
+    # ``me`` is otherwise never read and the copy would be dead bandwidth
+    # on the overlap-critical step 0.
+    if publish_local:
+        cp = pltpu.make_async_copy(x_hbm, ag_hbm.at[pl.ds(me * m, m)], local_sem)
+        cp.start()
 
     def consume(s, src, a_hbm, a_row_off):
         # Stream this shard through the MXU while the forward is in flight.
@@ -192,7 +195,8 @@ def _fused_kernel(
     ag_forward_ring(
         n, axis, mesh_axes, x_hbm, ag_hbm, m, send_sem, recv_sem, consume
     )
-    cp.wait()
+    if publish_local:
+        cp.wait()
 
 
 def _specs(axis, batch_axes):
@@ -211,7 +215,8 @@ def _specs(axis, batch_axes):
 
 @functools.lru_cache(maxsize=256)
 def _build_fused(
-    mesh, axis, batch_axes, a_shape, b_shape, dtype, out_dtype, collective_id, chaos
+    mesh, axis, batch_axes, a_shape, b_shape, dtype, out_dtype, collective_id,
+    chaos, return_gathered=True,
 ):
     n = mesh.shape[axis]
     k = a_shape[1]
@@ -227,7 +232,9 @@ def _build_fused(
         )
 
     call = lang.shmem_call(
-        functools.partial(_fused_kernel, n, axis, mesh.axis_names, blocks),
+        functools.partial(
+            _fused_kernel, n, axis, mesh.axis_names, blocks, return_gathered
+        ),
         out_shape=[
             jax.ShapeDtypeStruct((m_gathered, n_local), out_dtype),
             jax.ShapeDtypeStruct((m_gathered, k), dtype),  # gathered A
@@ -336,7 +343,8 @@ def _build_xla_naive(mesh, axis, batch_axes, out_dtype):
 
 
 @functools.lru_cache(maxsize=64)
-def _engine_tuner(mesh, axis, batch_axes, out_dtype, collective_id):
+def _engine_tuner(mesh, axis, batch_axes, out_dtype, collective_id,
+                  return_gathered):
     """Measured engine selection for ``method=None`` (≡ wrapping the op
     in contextual_autotune, reference autotuner.py:97): every engine is
     benchmarked end to end per input shape, the winner persists on disk,
@@ -351,11 +359,12 @@ def _engine_tuner(mesh, axis, batch_axes, out_dtype, collective_id):
         return ag_gemm(
             a, b, mesh, axis, batch_axes=batch_axes,
             method=AGGemmMethod(method), out_dtype=out_dtype,
-            collective_id=collective_id,
+            collective_id=collective_id, return_gathered=return_gathered,
         )
 
     return method_tuner(
-        f"ag_gemm[{dict(mesh.shape)}|{axis}|{batch_axes}|{out_dtype}|{collective_id}]",
+        f"ag_gemm[{dict(mesh.shape)}|{axis}|{batch_axes}|{out_dtype}|"
+        f"{collective_id}|rg{int(return_gathered)}]",
         run, AGGemmMethod,
     )
 
@@ -430,17 +439,26 @@ def ag_gemm(
 
         m = tuned_method_or_none(
             lambda: _engine_tuner(
-                mesh, axis, batch_axes, jnp.dtype(out_dtype), collective_id
+                mesh, axis, batch_axes, jnp.dtype(out_dtype), collective_id,
+                return_gathered,
             ),
-            a, a, b,
+            a, b,
         )
         method = (
             AGGemmMethod(m) if m else auto_ag_gemm_method(mesh, axis, a, b, dp=dp)
         )
+        if (
+            method == AGGemmMethod.PALLAS_FUSED
+            and auto_ag_gemm_method(mesh, axis, a, b, dp=dp) != method
+        ):
+            # a persisted winner from another environment (bigger VMEM
+            # budget, non-DCN mesh) may no longer be buildable here; the
+            # heuristic encodes exactly those safety constraints
+            method = auto_ag_gemm_method(mesh, axis, a, b, dp=dp)
     if method == AGGemmMethod.PALLAS_FUSED:
         fn = _build_fused(
             mesh, axis, batch_axes, a.shape, b.shape, a.dtype, out_dtype,
-            collective_id, config.chaos_delay,
+            collective_id, interp_key(), return_gathered,
         )
         out, gathered = fn(a, b)
         return (out, gathered) if return_gathered else out

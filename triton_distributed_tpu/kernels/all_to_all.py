@@ -23,7 +23,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import lang
-from triton_distributed_tpu.config import config
+from triton_distributed_tpu.config import interp_key
 from triton_distributed_tpu.utils.testing import chaos_delay
 
 
@@ -85,7 +85,7 @@ def all_to_all_device(x_loc, n, axis, mesh_axes, *, collective_id: int = 4):
         return x_loc
     call = _build_a2a_call(
         tuple(mesh_axes), axis, n, tuple(x_loc.shape),
-        jnp.dtype(x_loc.dtype), collective_id, config.chaos_delay,
+        jnp.dtype(x_loc.dtype), collective_id, interp_key(),
     )
     return call(x_loc)
 
@@ -110,7 +110,7 @@ def all_to_all(x, mesh, axis: str = "x", *, collective_id: int = 4):
     if n == 1:
         return x
     fn = _build_all_to_all(
-        mesh, axis, x.shape, x.dtype, collective_id, config.chaos_delay
+        mesh, axis, x.shape, x.dtype, collective_id, interp_key()
     )
     return fn(x)
 

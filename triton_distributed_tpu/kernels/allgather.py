@@ -24,7 +24,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import lang
-from triton_distributed_tpu.config import config
+from triton_distributed_tpu.config import interp_key
 from triton_distributed_tpu.runtime import (
     AllGatherMethod,
     auto_allgather_method,
@@ -217,7 +217,7 @@ def all_gather(
         from triton_distributed_tpu.tune.autotuner import tuned_method_or_none
 
         m = tuned_method_or_none(
-            lambda: _engine_tuner(mesh, axis, collective_id), x, x
+            lambda: _engine_tuner(mesh, axis, collective_id), x
         )
         if m is not None:
             method = AllGatherMethod(m)
@@ -231,6 +231,6 @@ def all_gather(
         # rank-1 / single-column inputs; fall back to the plain ring.
         method = AllGatherMethod.RING_1D
     fn = _build_all_gather(
-        mesh, axis, method, x.shape, x.dtype, collective_id, config.chaos_delay
+        mesh, axis, method, x.shape, x.dtype, collective_id, interp_key()
     )
     return fn(x)

@@ -39,7 +39,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import lang
-from triton_distributed_tpu.config import config, fused_vmem_budget
+from triton_distributed_tpu.config import fused_vmem_budget, interp_key
 from triton_distributed_tpu.kernels.ag_gemm import (
     _divisor_block,
     _warn_once,
@@ -310,15 +310,21 @@ def gemm_rs(
             lambda: _engine_tuner(
                 mesh, axis, batch_axes, jnp.dtype(out_dtype), collective_id
             ),
-            a, a, b,
+            a, b,
         )
         method = (
             GemmRSMethod(m) if m else auto_gemm_rs_method(mesh, axis, a, b, dp=dp)
         )
+        if (
+            method == GemmRSMethod.PALLAS_FUSED
+            and auto_gemm_rs_method(mesh, axis, a, b, dp=dp) != method
+        ):
+            # persisted winner may not be buildable in this environment
+            method = auto_gemm_rs_method(mesh, axis, a, b, dp=dp)
     if method == GemmRSMethod.PALLAS_FUSED:
         fn = _build_fused(
             mesh, axis, batch_axes, a.shape, b.shape, a.dtype, out_dtype,
-            collective_id, config.chaos_delay,
+            collective_id, interp_key(),
         )
     elif method == GemmRSMethod.XLA_RING:
         fn = _build_xla_ring(mesh, axis, batch_axes, out_dtype)

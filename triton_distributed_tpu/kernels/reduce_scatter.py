@@ -22,7 +22,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import lang
-from triton_distributed_tpu.config import config
+from triton_distributed_tpu.config import interp_key
 from triton_distributed_tpu.runtime import ring_neighbors
 from triton_distributed_tpu.utils.testing import chaos_delay
 
@@ -117,7 +117,7 @@ def reduce_scatter(
     assert full_shape[0] % n == 0, f"dim0 {full_shape[0]} not divisible by {n}"
     fn = _build_reduce_scatter(
         mesh, axis, tuple(full_shape), x.dtype, stacked, collective_id,
-        config.chaos_delay,
+        interp_key(),
     )
     return fn(x)
 

@@ -338,8 +338,18 @@ def ep_moe_tuned(x, logits, w_up, w_down, ctx: EPMoEContext,
         def run(x, logits, up, down, *, block_m):
             return ep_moe(x, logits, up, down, replace(ctx, block_m=block_m))
 
+        # ctx is part of the tuner identity: the persistent winner store
+        # keys on (name, arg shapes), and two contexts with identical
+        # token shapes but different transport/quant/geometry must not
+        # share winners
+        ctx_tag = (
+            f"{dict(ctx.mesh.shape)}|{ctx.axis}|{ctx.dcn_axis}|"
+            f"E{ctx.num_experts}k{ctx.topk}m{ctx.max_m}|{ctx.transport}|"
+            f"{ctx.quant}|{jnp.dtype(ctx.dtype).name}"
+        )
         tuner = ContextualAutoTuner(
-            run, [{"block_m": b} for b in candidates], name="ep_moe"
+            run, [{"block_m": b} for b in candidates],
+            name=f"ep_moe[{ctx_tag}]",
         )
         _EP_MOE_TUNERS[key] = tuner
         while len(_EP_MOE_TUNERS) > _EP_MOE_TUNERS_MAX:

@@ -214,15 +214,15 @@ def method_tuner(name, run, methods, *, warmup=1, iters=3):
     )
 
 
-def tuned_method_or_none(tuner_factory, probe, *args):
+def tuned_method_or_none(tuner_factory, *args):
     """The ``method=None`` dispatch shared by the op entries: consult the
     measured tuner when tuning is enabled AND the call carries concrete
-    arrays (benching needs real execution; inside a larger jit the args
-    are tracers and the caller's static heuristic applies). Returns the
-    winning method string or None."""
+    arrays (args[0] is probed: benching needs real execution, and inside
+    a larger jit the args are tracers so the caller's static heuristic
+    applies). Returns the winning method string or None."""
     from triton_distributed_tpu.config import autotune_enabled
 
-    if autotune_enabled() and not isinstance(probe, jax.core.Tracer):
+    if autotune_enabled() and not isinstance(args[0], jax.core.Tracer):
         return tuner_factory().pick(*args)["method"]
     return None
 

@@ -23,9 +23,11 @@ def device_fence(out):
         if shards:
             for sh in shards:
                 if sh.data.size:
-                    np.asarray(jax.device_get(sh.data.ravel()[0]))
+                    # single-element slice, NOT ravel(): ravel would copy
+                    # the whole shard on-device inside the timed window
+                    np.asarray(jax.device_get(sh.data[(0,) * sh.data.ndim]))
         elif hasattr(leaf, "ravel") and getattr(leaf, "size", 0):
-            np.asarray(jax.device_get(leaf.ravel()[0]))
+            np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
 
 
 def perf_func(fn, *args, iters: int = 10, warmup: int = 3):
