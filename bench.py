@@ -245,7 +245,7 @@ def _bench_group_gemm(mesh, n, on_tpu, spec):
     from triton_distributed_tpu.kernels.group_gemm import grouped_matmul
 
     if on_tpu:
-        e, m_per, h, f, block_m = 8, 1024, 4096, 2048, 256
+        e, m_per, h, f, block_m = 8, 1024, 4096, 2048, 512
     else:
         e, m_per, h, f, block_m = 4, 64, 128, 128, 64
     m_total = e * m_per

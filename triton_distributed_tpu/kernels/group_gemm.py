@@ -53,13 +53,17 @@ def _ggemm_kernel(nsteps_k, be_ref, x_ref, w_ref, o_ref, acc_ref):
 )
 def grouped_matmul(
     x_sorted, w, block_expert, *,
-    block_m: int = 256, block_n: int = 512, block_k: int = 512,
+    block_m: int = 512, block_n: int = 2048, block_k: int = 512,
     interpret=None,
 ):
     """x_sorted (cap, K) @ w (E, K, N) → (cap, N), expert per M-block.
 
     ``cap`` must be a multiple of ``block_m`` and ``block_expert`` have
     ``cap // block_m`` entries (from moe_utils.moe_align_block_size).
+    Defaults swept on a real v5e (8 experts, 1024 rows/expert,
+    4096×2048 bf16): (512, 2048, 512) → 168 TFLOP/s (MFU 0.85) vs 121
+    for the old (256, 512, 512). Smaller block_m trades MXU efficiency
+    for less routing padding — contexts keep their own defaults.
     """
     cap, kdim = x_sorted.shape
     e, _, ndim = w.shape
