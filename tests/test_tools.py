@@ -230,3 +230,31 @@ class TestCheckpoint:
             restore_checkpoint(
                 tmp_path / "c", {"a": jnp.zeros((4,)), "c": jnp.ones((4,))}
             )
+
+
+def test_compile_aot_cli_roundtrip(tmp_path):
+    """The AOT CLI (≡ reference compile_aot.py + gen_aot_code.sh) builds
+    artifacts a fresh library with the same hyperparameters loads without
+    a jit fallback."""
+    import jax
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.kernels.flash_decode import (
+        gqa_fwd_batch_decode_aot,
+    )
+    from triton_distributed_tpu.tools.compile_aot import main
+
+    rc = main([
+        "--cache-dir", str(tmp_path), "--batch", "2", "--q-heads", "8",
+        "--kv-heads", "2", "--head-dim", "128", "--seq", "256",
+        "--block-k", "128", "--dtype", "float32",
+    ])
+    assert rc == 0
+    lib = gqa_fwd_batch_decode_aot(
+        block_k=128, kv_layout="bhsd", cache_dir=tmp_path
+    )
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 128), jnp.float32)
+    kv = jax.random.normal(jax.random.PRNGKey(1), (2, 2, 256, 128), jnp.float32)
+    out, _ = lib(q, kv, kv, jnp.array([200, 50], jnp.int32))
+    assert lib.stats == {"artifact_loads": 1, "jit_fallbacks": 0}
+    assert out.shape == (2, 8, 128)

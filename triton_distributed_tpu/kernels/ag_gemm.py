@@ -71,9 +71,11 @@ class AGGemmMethod(enum.Enum):
 
 #: default tile targets for the streaming matmul pipeline (bm, bk, bn).
 #: Swept on a real v5e at the Llama-7B TP8 north-star shard
-#: (8192×8192 @ 8192×3584 bf16): (512, 512, 1792) → 146 TFLOP/s vs 131-141
-#: for the (1024, 1024, ·) / large-bk variants and ~170 for XLA's dot.
-_TILE_TARGETS = (512, 512, 1792)
+#: (8192×8192 @ 8192×3584 bf16) with the paired-median methodology:
+#: (512, 2048, 1792) → 167 TFLOP/s vs 157 for (512, 512, 1792) and 161-162
+#: for the 4096-bk / 1024-bm variants. GEMM-RS carries its own targets
+#: (its north-star shape prefers whole-K tiles — see gemm_rs.py).
+_TILE_TARGETS = (512, 2048, 1792)
 
 
 def _divisor_block(dim: int, target: int, mult: int, strict: bool) -> int | None:
@@ -95,7 +97,8 @@ def _divisor_block(dim: int, target: int, mult: int, strict: bool) -> int | None
     return best
 
 
-def pick_mm_blocks(m: int, k: int, n: int, itemsize: int, budget: int | None = None):
+def pick_mm_blocks(m: int, k: int, n: int, itemsize: int,
+                   budget: int | None = None, targets=None):
     """(bm, bk, bn) for the streaming matmul pipeline, or None if the shape
     admits no (TPU-lowerable) divisor blocking. Shrinks targets until the
     double-buffered tile working set fits the VMEM budget."""
@@ -104,7 +107,7 @@ def pick_mm_blocks(m: int, k: int, n: int, itemsize: int, budget: int | None = N
     budget = budget or fused_vmem_budget()
     strict = on_tpu()
     sublane = 8 * (4 // itemsize)  # (8·packing, 128) native tile
-    tm, tk, tn = _TILE_TARGETS
+    tm, tk, tn = targets or _TILE_TARGETS
     while True:
         bm = _divisor_block(m, tm, sublane, strict)
         # bk is A's lane dim and B's sublane dim; 128 covers both granules

@@ -65,14 +65,17 @@ def grouped_matmul(
     for the old (256, 512, 512). Smaller block_m trades MXU efficiency
     for less routing padding — contexts keep their own defaults.
     """
+    from triton_distributed_tpu.config import on_tpu
+    from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
+
     cap, kdim = x_sorted.shape
     e, _, ndim = w.shape
     assert cap % block_m == 0, f"cap={cap} not divisible by block_m={block_m}"
-    block_n = min(block_n, ndim)
-    block_k = min(block_k, kdim)
-    assert ndim % block_n == 0 and kdim % block_k == 0, (
-        f"(K={kdim}, N={ndim}) not divisible by ({block_k}, {block_n})"
-    )
+    # round the requested blocks DOWN to divisors (TPU-aligned when
+    # possible): the sweep-tuned defaults must not assert on shapes like
+    # N=3584 that 512 divides but 2048 does not
+    block_n = _divisor_block(ndim, min(block_n, ndim), 128, on_tpu()) or ndim
+    block_k = _divisor_block(kdim, min(block_k, kdim), 128, on_tpu()) or kdim
     nsteps_k = kdim // block_k
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

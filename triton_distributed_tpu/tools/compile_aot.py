@@ -1,0 +1,79 @@
+"""AOT precompilation CLI (≡ tools/compile_aot.py + scripts/
+gen_aot_code.sh: the reference drives its AOT generator over the kernel
+list in scripts/aot_kernels.txt — the flash-decode family — producing a
+dispatcher library; deployment then runs with USE_TRITON_DISTRIBUTED_AOT).
+
+Here the same workflow is::
+
+    python -m triton_distributed_tpu.tools.compile_aot \
+        --kernel gqa_decode --cache-dir .aot_cache \
+        --batch 4 --q-heads 32 --kv-heads 8 --head-dim 128 \
+        --seq 4096 --seq 8192 --dtype bfloat16
+
+which serializes one artifact per sequence-length point; serving code
+loads them via ``kernels.flash_decode.gqa_fwd_batch_decode_aot`` with
+the same hyperparameters and never retraces.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def _decode_space(args):
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(args.dtype)
+    pts = []
+    for s in args.seq:
+        q = jax.ShapeDtypeStruct((args.batch, args.q_heads, args.head_dim), dtype)
+        kv = jax.ShapeDtypeStruct(
+            (args.batch, args.kv_heads, s, args.head_dim)
+            if args.kv_layout == "bhsd"
+            else (args.batch, s, args.kv_heads, args.head_dim),
+            dtype,
+        )
+        lens = jax.ShapeDtypeStruct((args.batch,), jnp.int32)
+        pts.append((q, kv, kv, lens))
+    return pts
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--kernel", choices=["gqa_decode"], default="gqa_decode")
+    p.add_argument("--cache-dir", default=".aot_cache")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--q-heads", type=int, default=32)
+    p.add_argument("--kv-heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--seq", type=int, action="append", default=None,
+                   help="KV capacity point; repeatable (default: 4096 8192)")
+    p.add_argument("--block-k", type=int, default=2048)
+    p.add_argument("--kv-layout", choices=["bhsd", "bshd"], default="bhsd")
+    p.add_argument("--soft-cap", type=float, default=0.0)
+    p.add_argument("--scale", type=float, default=None,
+                   help="attention scale; None = 1/sqrt(head_dim) "
+                        "(part of the artifact identity — must match the "
+                        "serving library's value)")
+    p.add_argument("--dtype", default="bfloat16")
+    args = p.parse_args(argv)
+    if args.seq is None:
+        args.seq = [4096, 8192]
+
+    from triton_distributed_tpu.kernels.flash_decode import (
+        gqa_fwd_batch_decode_aot,
+    )
+
+    lib = gqa_fwd_batch_decode_aot(
+        scale=args.scale, block_k=args.block_k, soft_cap=args.soft_cap,
+        kv_layout=args.kv_layout, cache_dir=args.cache_dir,
+    )
+    for pt in _decode_space(args):
+        path = lib.compile(*pt)
+        print(f"compiled {args.kernel} {[tuple(a.shape) for a in pt]} -> {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
