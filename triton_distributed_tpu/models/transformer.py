@@ -242,7 +242,7 @@ class Transformer:
     def _cp_attention(self, blk, x, b, s):
         """Context-parallel attention: sequence sharded over tp, heads
         whole, projection weights replicated (the long-context layout).
-        x: (B·S, H) SP rows → (B·S, H) SP rows."""
+        x: (B·S, H) SP rows → ((B·S, H) SP rows, k, v)."""
         from triton_distributed_tpu.kernels.ring_attention import (
             ring_attention,
             ulysses_attention,
@@ -264,13 +264,17 @@ class Transformer:
         attn = ring_attention if c.attn == "ring" else ulysses_attention
         o = attn(q, k, v, self.mesh, self.tp_axis, batch_axes=ba)
         o = o.reshape(b, s, c.q_dim) @ blk["wo"].astype(c.dtype)
-        return jax.lax.with_sharding_constraint(
+        out = jax.lax.with_sharding_constraint(
             o.reshape(b * s, c.hidden),
             NamedSharding(self.mesh, self.row_spec),
         )
+        return out, k, v
 
-    def _attention(self, blk, x, b, s):
-        """x: (B·S, H) SP rows → (B·S, H) SP rows. Heads sharded tp."""
+    def _attention_kv(self, blk, x, b, s):
+        """Attention returning (out rows, k, v) — the K/V are what
+        :meth:`prefill` writes into the decode caches. Dispatches to the
+        context-parallel path for attn='ring'/'ulysses' (their K/V come
+        back sequence-sharded, matching the seq-sharded caches)."""
         c = self.config
         if c.attn != "tp":
             return self._cp_attention(blk, x, b, s)
@@ -290,9 +294,14 @@ class Transformer:
         probs = jax.nn.softmax(logits, axis=-1).astype(c.dtype)
         o = jnp.einsum("bhgst,bthd->bshgd", probs, v)
         o = o.reshape(b * s, hq * d)
-        return ops.gemm_rs(o, blk["wo"].astype(c.dtype), self._rs_ctx)
+        out = ops.gemm_rs(o, blk["wo"].astype(c.dtype), self._rs_ctx)
+        return out, k, v
 
-    def _mlp_block(self, blk, x):
+    def _attention(self, blk, x, b, s):
+        """x: (B·S, H) SP rows → (B·S, H) SP rows. Heads sharded tp."""
+        return self._attention_kv(blk, x, b, s)[0]
+
+    def _mlp_block(self, blk, x, inference=False):
         c = self.config
         if "up" in blk:
             p = {
@@ -305,6 +314,17 @@ class Transformer:
             "up": blk["moe_up"].astype(c.dtype),
             "down": blk["moe_down"].astype(c.dtype),
         }
+        if c.moe == "tp" and inference and not self.dp_axes:
+            # inference (no grads needed): the single-kernel overlapped
+            # engines replace the composed differentiable pipeline
+            from triton_distributed_tpu.ops import moe_tp_mlp_overlapped
+
+            logits = x.astype(jnp.float32) @ blk["router"]
+            weights, ids = mu.select_experts(logits, c.topk)
+            return moe_tp_mlp_overlapped(
+                x, ids, weights, moe_params["up"], moe_params["down"],
+                self._moe_tp_ctx,
+            ).astype(c.dtype)
         if c.moe == "ep":
             # EP flavour: experts sharded over tp, tokens stay row-sharded;
             # fully differentiable (XLA transport) — the training MoE.
@@ -321,19 +341,42 @@ class Transformer:
         weights, ids = mu.select_experts(logits, c.topk)
         return MoETPMLP(self._moe_tp_ctx)(moe_params, x, ids, weights)
 
+    def _embed_rows(self, params, tokens):
+        """(B, S) int32 → (B·S, H) SP-row-sharded activations."""
+        x = params["embed"][tokens.reshape(-1)].astype(self.config.dtype)
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, self.row_spec)
+        )
+
+    def _block(self, blk, x, b, s, collect_kv=False, inference=False):
+        """One decoder block. The SINGLE definition of the block math —
+        forward and prefill both run exactly this; ``collect_kv`` makes
+        it also return the layer's (k, v) for cache filling, and
+        ``inference`` selects the non-differentiable overlapped engines
+        where they exist (MoE-TP)."""
+        xn = self._rmsnorm(x, blk["norm_attn"])
+        if collect_kv:
+            h, k, v = self._attention_kv(blk, xn, b, s)
+        else:
+            h, k, v = self._attention(blk, xn, b, s), None, None
+        x = x + h
+        x = x + self._mlp_block(
+            blk, self._rmsnorm(x, blk["norm_mlp"]), inference=inference
+        )
+        return x, k, v
+
+    def _head(self, params, x):
+        x = self._rmsnorm(x, params["norm_f"])
+        return x.astype(jnp.float32) @ params["lm_head"]
+
     def forward(self, params, tokens):
         """tokens: (B, S) int32 → logits (B·S, vocab) SP-row-sharded."""
         c = self.config
         b, s = tokens.shape
-        x = params["embed"][tokens.reshape(-1)].astype(c.dtype)  # (B·S, H)
-        x = jax.lax.with_sharding_constraint(
-            x, NamedSharding(self.mesh, self.row_spec)
-        )
+        x = self._embed_rows(params, tokens)
+
         def block(x, blk):
-            h = self._attention(blk, self._rmsnorm(x, blk["norm_attn"]), b, s)
-            x = x + h
-            h = self._mlp_block(blk, self._rmsnorm(x, blk["norm_mlp"]))
-            return x + h
+            return self._block(blk, x, b, s)[0]
 
         if c.remat:
             from triton_distributed_tpu.config import (
@@ -351,8 +394,7 @@ class Transformer:
             block = jax.checkpoint(block)
         for blk in params["blocks"]:
             x = block(x, blk)
-        x = self._rmsnorm(x, params["norm_f"])
-        return x.astype(jnp.float32) @ params["lm_head"]
+        return self._head(params, x)
 
     def loss(self, params, tokens, targets):
         """Causal LM loss; logits stay row-sharded end to end."""
@@ -391,6 +433,45 @@ class Transformer:
             (jax.device_put(z, spec), jax.device_put(z, spec))
             for _ in range(c.n_layers)
         ]
+
+    def prefill(self, params, caches, tokens):
+        """Process a whole prompt in ONE forward pass and fill the decode
+        caches: returns (last-position logits (B, vocab), caches,
+        kv_lens). The serving entry the reference leaves to the serving
+        stack — :meth:`generate` continues from here instead of decoding
+        the prompt token by token.
+
+        tokens: (B, S) int32, S ≤ cache capacity. Attention runs the
+        forward path of the configured mode (TP: AG-GEMM qkv → dense
+        causal softmax → GEMM-RS out; ring/ulysses: the CP kernels,
+        whose K/V come back sequence-sharded like the caches) while the
+        per-layer K/V are captured into the bhsd seq-sharded caches;
+        MoE-TP blocks run the overlapped inference engines.
+        """
+        c = self.config
+        b, s = tokens.shape
+        cap = caches[0][0].shape[2]
+        assert s <= cap, f"prompt length {s} exceeds cache capacity {cap}"
+        x = self._embed_rows(params, tokens)
+        new_caches = []
+        for blk, (ck, cv) in zip(params["blocks"], caches):
+            x, k, v = self._block(
+                blk, x, b, s, collect_kv=True, inference=True
+            )
+            ck = jax.lax.dynamic_update_slice(
+                ck, k.transpose(0, 2, 1, 3).astype(ck.dtype), (0, 0, 0, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cv, v.transpose(0, 2, 1, 3).astype(cv.dtype), (0, 0, 0, 0)
+            )
+            new_caches.append((ck, cv))
+        logits = self._head(params, x)
+        last = logits.reshape(b, s, -1)[:, -1]
+        return last, new_caches, jnp.full((b,), s, jnp.int32)
+
+    @functools.cached_property
+    def _prefill_jit(self):
+        return jax.jit(self.prefill)
 
     def decode_step(self, params, caches, kv_lens, last_tokens):
         """One token of SP decode: replicated (B,) last tokens + seq-
