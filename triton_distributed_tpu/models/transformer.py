@@ -314,17 +314,6 @@ class Transformer:
             "up": blk["moe_up"].astype(c.dtype),
             "down": blk["moe_down"].astype(c.dtype),
         }
-        if c.moe == "tp" and inference and not self.dp_axes:
-            # inference (no grads needed): the single-kernel overlapped
-            # engines replace the composed differentiable pipeline
-            from triton_distributed_tpu.ops import moe_tp_mlp_overlapped
-
-            logits = x.astype(jnp.float32) @ blk["router"]
-            weights, ids = mu.select_experts(logits, c.topk)
-            return moe_tp_mlp_overlapped(
-                x, ids, weights, moe_params["up"], moe_params["down"],
-                self._moe_tp_ctx,
-            ).astype(c.dtype)
         if c.moe == "ep":
             # EP flavour: experts sharded over tp, tokens stay row-sharded;
             # fully differentiable (XLA transport) — the training MoE.
@@ -334,11 +323,20 @@ class Transformer:
                 np.prod([self.mesh.shape[a] for a in self.dp_axes]) or 1
             ))
             return EPMoEMLP(self._moe_ep_ctx(m_local))(moe_params, x)
-        # TP flavour: fused single-body op, per-replica routing
-        from triton_distributed_tpu.layers import MoETPMLP
-
+        # TP flavour — one routing computation feeds either body
         logits = x.astype(jnp.float32) @ blk["router"]
         weights, ids = mu.select_experts(logits, c.topk)
+        if inference and not self.dp_axes:
+            # inference (no grads needed): the single-kernel overlapped
+            # engines replace the composed differentiable pipeline
+            from triton_distributed_tpu.ops import moe_tp_mlp_overlapped
+
+            return moe_tp_mlp_overlapped(
+                x, ids, weights, moe_params["up"], moe_params["down"],
+                self._moe_tp_ctx,
+            ).astype(c.dtype)
+        from triton_distributed_tpu.layers import MoETPMLP
+
         return MoETPMLP(self._moe_tp_ctx)(moe_params, x, ids, weights)
 
     def _embed_rows(self, params, tokens):
@@ -348,17 +346,16 @@ class Transformer:
             x, NamedSharding(self.mesh, self.row_spec)
         )
 
-    def _block(self, blk, x, b, s, collect_kv=False, inference=False):
-        """One decoder block. The SINGLE definition of the block math —
-        forward and prefill both run exactly this; ``collect_kv`` makes
-        it also return the layer's (k, v) for cache filling, and
+    def _block(self, blk, x, b, s, inference=False):
+        """One decoder block → (x, k, v). The SINGLE definition of the
+        block math — forward and prefill both run exactly this (prefill
+        keeps the k/v for cache filling; forward drops them);
         ``inference`` selects the non-differentiable overlapped engines
         where they exist (MoE-TP)."""
         xn = self._rmsnorm(x, blk["norm_attn"])
-        if collect_kv:
-            h, k, v = self._attention_kv(blk, xn, b, s)
-        else:
-            h, k, v = self._attention(blk, xn, b, s), None, None
+        # k/v are always produced; XLA dead-code-eliminates them when the
+        # caller (forward) drops them
+        h, k, v = self._attention_kv(blk, xn, b, s)
         x = x + h
         x = x + self._mlp_block(
             blk, self._rmsnorm(x, blk["norm_mlp"]), inference=inference
@@ -455,9 +452,7 @@ class Transformer:
         x = self._embed_rows(params, tokens)
         new_caches = []
         for blk, (ck, cv) in zip(params["blocks"], caches):
-            x, k, v = self._block(
-                blk, x, b, s, collect_kv=True, inference=True
-            )
+            x, k, v = self._block(blk, x, b, s, inference=True)
             ck = jax.lax.dynamic_update_slice(
                 ck, k.transpose(0, 2, 1, 3).astype(ck.dtype), (0, 0, 0, 0)
             )
