@@ -272,3 +272,25 @@ class TestPrefill:
             lb, caches_b, lens_b = model._decode_jit(
                 params, caches_b, lens_b, tb
             )
+
+    def test_ragged_prefill(self, mesh_tp):
+        """Right-padded ragged prompts: each row's continuation state must
+        equal prefilling that row's unpadded prompt alone."""
+        model = _model(mesh_tp, moe="none")
+        params = _sharded_params(model)
+        b, smax = 2, 32
+        full = jax.random.randint(jax.random.PRNGKey(5), (b, 16), 0, 128)
+        lens = jnp.array([16, 8], jnp.int32)
+
+        caches = model.init_cache(b, smax)
+        last, caches, out_lens = model._prefill_jit(params, caches, full, lens)
+        np.testing.assert_array_equal(np.asarray(out_lens), np.asarray(lens))
+
+        # reference: prefill row 1's true (unpadded) prompt on its own
+        # (length a multiple of tp — prefill shards B·S rows over tp)
+        short = full[1:2, :8]
+        c1 = model.init_cache(1, smax)
+        last1, _, _ = model._prefill_jit(params, c1, short)
+        np.testing.assert_allclose(
+            np.asarray(last)[1], np.asarray(last1)[0], atol=2e-4, rtol=2e-4
+        )

@@ -431,12 +431,18 @@ class Transformer:
             for _ in range(c.n_layers)
         ]
 
-    def prefill(self, params, caches, tokens):
-        """Process a whole prompt in ONE forward pass and fill the decode
-        caches: returns (last-position logits (B, vocab), caches,
-        kv_lens). The serving entry the reference leaves to the serving
-        stack — :meth:`generate` continues from here instead of decoding
-        the prompt token by token.
+    def prefill(self, params, caches, tokens, lens=None):
+        """Process a whole prompt batch in ONE forward pass and fill the
+        decode caches: returns (per-row last-position logits (B, vocab),
+        caches, kv_lens). The serving entry the reference leaves to the
+        serving stack — :meth:`generate` continues from here instead of
+        decoding the prompt token by token.
+
+        ``lens`` (B,) enables RAGGED batches: rows are right-padded to S
+        and ``lens[i]`` names row i's true prompt length. Causality makes
+        the short rows' valid positions independent of their padding, the
+        pad positions' K/V land beyond ``lens`` where decode never reads,
+        and the returned logits are taken at each row's ``lens-1``.
 
         tokens: (B, S) int32, S ≤ cache capacity. Attention runs the
         forward path of the configured mode (TP: AG-GEMM qkv → dense
@@ -461,12 +467,15 @@ class Transformer:
             )
             new_caches.append((ck, cv))
         logits = self._head(params, x)
-        last = logits.reshape(b, s, -1)[:, -1]
-        return last, new_caches, jnp.full((b,), s, jnp.int32)
+        if lens is None:
+            lens = jnp.full((b,), s, jnp.int32)
+        lens = lens.astype(jnp.int32)
+        last = logits.reshape(b, s, -1)[jnp.arange(b), lens - 1]
+        return last, new_caches, lens
 
     @functools.cached_property
     def _prefill_jit(self):
-        return jax.jit(self.prefill)
+        return jax.jit(self.prefill)  # lens=None and lens=(B,) trace separately
 
     def decode_step(self, params, caches, kv_lens, last_tokens):
         """One token of SP decode: replicated (B,) last tokens + seq-
