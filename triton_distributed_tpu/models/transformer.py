@@ -469,7 +469,11 @@ class Transformer:
         logits = self._head(params, x)
         if lens is None:
             lens = jnp.full((b,), s, jnp.int32)
-        lens = lens.astype(jnp.int32)
+        # clamp to the valid range: lens=0 would gather position -1 (the
+        # last PAD) and lens>s would make decode attend over unwritten
+        # cache rows — both silently wrong, neither assertable on traced
+        # values
+        lens = jnp.clip(lens.astype(jnp.int32), 1, s)
         last = logits.reshape(b, s, -1)[jnp.arange(b), lens - 1]
         return last, new_caches, lens
 
