@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_distributed_tpu.kernels import (
     all_gather,
@@ -111,3 +112,26 @@ def test_reduce_scatter_multiaxis_mesh(mesh2x4):
     y = reduce_scatter(x, mesh2x4, "tp", stacked=True)
     expected = np.sum(np.asarray(x), axis=0)
     assert_allclose(y, expected, atol=1e-4, rtol=1e-4)
+
+
+def test_reduce_scatter_streaming_engine(mesh8, monkeypatch):
+    """Payloads over the VMEM budget take the HBM-streaming reduce ring
+    (the VMEM ring would OOM at activation-scale shapes); same numerics."""
+    from triton_distributed_tpu.config import config as cfg
+
+    # force the streaming engine regardless of payload size
+    monkeypatch.setattr(cfg, "fused_vmem_budget", 1)
+    x = jax.random.normal(jax.random.PRNGKey(11), (8, 64, 48), jnp.float32)
+    out = reduce_scatter(
+        jax.device_put(x, NamedSharding(mesh8, P("x"))), mesh8, "x",
+        stacked=True, collective_id=3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(x.sum(0)), atol=1e-5, rtol=1e-5
+    )
+    # non-stacked (replicated contributions)
+    y = jax.random.normal(jax.random.PRNGKey(12), (64, 48), jnp.float32)
+    out2 = reduce_scatter(y, mesh8, "x", collective_id=3)
+    np.testing.assert_allclose(
+        np.asarray(out2), np.asarray(y * 8), atol=1e-4, rtol=1e-5
+    )

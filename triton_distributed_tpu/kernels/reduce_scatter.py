@@ -17,12 +17,13 @@ from __future__ import annotations
 import functools
 
 import jax
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import lang
-from triton_distributed_tpu.config import interp_key
+from triton_distributed_tpu.config import fused_vmem_budget, interp_key
 from triton_distributed_tpu.runtime import ring_neighbors
 from triton_distributed_tpu.utils.testing import chaos_delay
 
@@ -97,6 +98,86 @@ def _ring_rs_kernel(n, axis, mesh_axes, x_ref, out_ref, acc_ref, recv_ref, send_
     )
 
 
+def _rs_stream_kernel(
+    n, axis, mesh_axes, x_hbm, out_hbm, w0, w1, r0, r1,
+    copy_sem, send_sem, recv_sem, ack_sem,
+):
+    """HBM-streaming reduce ring: each destination's contribution is
+    DMA'd straight from the HBM input into the ring slabs (no
+    whole-payload VMEM residency — RS at activation-scale payloads); the
+    fold-in add streams tiles through VMEM. Protocol: kernels/ring.py."""
+    from triton_distributed_tpu.kernels.gemm_rs import ew_add_pipeline
+    from triton_distributed_tpu.kernels.ring import reduce_ring
+
+    m = out_hbm.shape[0]
+
+    def partial_into(dst, dst_ref):
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(dst * m, m)], dst_ref, copy_sem
+        )
+        cp.start()
+        cp.wait()
+
+    reduce_ring(
+        n, axis, mesh_axes, out_hbm, (w0, w1), (r0, r1),
+        send_sem, recv_sem, ack_sem, partial_into,
+        ew_add_pipeline(m, out_hbm.shape[1], out_hbm.dtype.itemsize),
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _build_rs_stream(mesh, axis, rows, cols, dtype, stacked, collective_id, ikey):
+    n = mesh.shape[axis]
+    slab = jax.ShapeDtypeStruct((rows // n, cols), dtype)
+    call = lang.shmem_call(
+        functools.partial(_rs_stream_kernel, n, axis, mesh.axis_names),
+        # ring slabs ride as extra ANY outputs (Mosaic has no HBM scratch)
+        out_shape=[slab, slab, slab, slab, slab],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 5,
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        collective_id=collective_id,
+        name="rs_ring_stream",
+    )
+    body = (lambda s: call(s[0])[0]) if stacked else (lambda s: call(s)[0])
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=P(axis) if stacked else P(None),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def _vmem_ring_fits(n, local_shape, itemsize) -> bool:
+    """The VMEM ring keeps the whole per-device contribution + acc + two
+    recv slots resident; prefer it for small payloads (lower latency),
+    stream through HBM otherwise."""
+    slab = int(np.prod(local_shape)) * itemsize
+    return (n + 3) * slab <= fused_vmem_budget() // 2
+
+
+def _streamable(m_local: int, cols: int, itemsize: int) -> bool:
+    """The streaming engine's fold-in add needs a TPU-lowerable divisor
+    blocking of the (m_local, cols) slab (≡ gemm_rs's pick_mm_blocks
+    guard); shapes without one must stay on the VMEM ring rather than
+    crash at Mosaic trace time."""
+    from triton_distributed_tpu.config import on_tpu
+    from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
+
+    strict = on_tpu()
+    return (
+        _divisor_block(m_local, 512, 8 * (4 // itemsize), strict) is not None
+        and _divisor_block(cols, 2048, 128, strict) is not None
+    )
+
+
 def reduce_scatter(
     x, mesh, axis: str = "x", *, stacked: bool = False, collective_id: int = 3
 ):
@@ -108,6 +189,10 @@ def reduce_scatter(
     sharded on dim 0 — device i contributes slice ``x[i]`` (the normal case,
     e.g. partial GEMM outputs).
 
+    Two engines by payload size: the VMEM-resident ring (low latency) and
+    the HBM-streaming ring (no VMEM cap — activation-scale payloads;
+    trailing dims ride as a free 2D view of the contiguous array).
+
     Host entry ≡ reference ``reduce_scatter_2d_op`` (reduce_scatter.py:863).
     """
     n = mesh.shape[axis]
@@ -115,6 +200,18 @@ def reduce_scatter(
     if n == 1:
         return x[0] if stacked else x
     assert full_shape[0] % n == 0, f"dim0 {full_shape[0]} not divisible by {n}"
+    local_shape = (full_shape[0] // n,) + tuple(full_shape[1:])
+    rows = full_shape[0]
+    cols = int(np.prod(full_shape[1:], dtype=np.int64)) if len(full_shape) > 1 else 1
+    if not _vmem_ring_fits(n, local_shape, x.dtype.itemsize) and _streamable(
+        rows // n, cols, x.dtype.itemsize
+    ):
+        x2d = x.reshape(((n,) if stacked else ()) + (rows, cols))
+        fn = _build_rs_stream(
+            mesh, axis, rows, cols, x.dtype, stacked, collective_id,
+            interp_key(),
+        )
+        return fn(x2d).reshape(full_shape)
     fn = _build_reduce_scatter(
         mesh, axis, tuple(full_shape), x.dtype, stacked, collective_id,
         interp_key(),
