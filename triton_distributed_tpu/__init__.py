@@ -17,8 +17,9 @@ A brand-new framework with the capability surface of Triton-distributed
 - ``layers``   — NN-module-level wrappers (reference:
                  python/triton_dist/layers/nvidia/).
 - ``models``   — flagship model definitions exercising the layers.
-- ``parallel`` — mesh construction and TP/EP/SP/DP sharding plans.
-- ``ops``      — stable functional entry points (ag_gemm, gemm_rs, ...).
+- ``ops``      — stable functional entry points (ag_gemm, gemm_rs, ...);
+                 the TP/EP/SP/DP sharding plans live here and in
+                 ``runtime`` (mesh construction).
 - ``tune``     — distributed-consensus autotuner (reference:
                  python/triton_dist/autotuner.py).
 - ``tools``    — AOT compile and profiling tools.
@@ -35,7 +36,6 @@ __all__ = [
     "kernels",
     "layers",
     "models",
-    "parallel",
     "ops",
     "tune",
     "tools",

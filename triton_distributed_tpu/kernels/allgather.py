@@ -214,18 +214,26 @@ def all_gather(
     if n == 1:
         return x
     if method is None:
+        from triton_distributed_tpu.runtime.topology import LinkKind
         from triton_distributed_tpu.tune.autotuner import tuned_method_or_none
 
-        m = tuned_method_or_none(
-            lambda: _engine_tuner(mesh, axis, collective_id), x
-        )
-        if m is not None:
-            method = AllGatherMethod(m)
+        topo = detect_topology(mesh, axis)
+        if topo.link_kind == LinkKind.DCN:
+            # Pallas remote DMA cannot cross DCN: never bench Pallas
+            # candidates here (a failure may hang, not raise) and never
+            # apply a disk winner persisted on an ICI mesh — the same
+            # environment re-validation ag_gemm/gemm_rs do before using
+            # a tuned method.
+            method = AllGatherMethod.XLA_FALLBACK
         else:
-            shard_bytes = (x.size // n) * x.dtype.itemsize
-            method = auto_allgather_method(
-                detect_topology(mesh, axis), shard_bytes
+            m = tuned_method_or_none(
+                lambda: _engine_tuner(mesh, axis, collective_id), x
             )
+            if m is not None:
+                method = AllGatherMethod(m)
+            else:
+                shard_bytes = (x.size // n) * x.dtype.itemsize
+                method = auto_allgather_method(topo, shard_bytes)
     if method == AllGatherMethod.RING_BIDIR and (x.ndim < 2 or x.shape[1] < 2):
         # bidir splits dim 1 between the two directions — impossible on
         # rank-1 / single-column inputs; fall back to the plain ring.

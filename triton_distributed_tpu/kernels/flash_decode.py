@@ -106,10 +106,17 @@ def pick_block_k(s_len: int, requested: int) -> int:
     """Largest divisor of ``s_len`` ≤ ``requested``, preferring sublane
     multiples (16). Replaces the old hard divisibility assert: SP cache
     slices (S/tp) may not divide the caller's block_k (e.g. capacity 384
-    with the default block), and nothing upstream enforces it."""
+    with the default block), and nothing upstream enforces it.
+
+    On real TPU an unaligned *interior* second-minor block is a Mosaic
+    lowering error (see ``_divisor_block``'s contract), so strict mode
+    applies and a length with no aligned divisor ≤ requested degrades to
+    ONE whole-length block (ragged edges are padded, interiors never
+    misalign) — not to the old pathological block_k=1."""
+    from triton_distributed_tpu.config import compiling_for_tpu
     from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
 
-    return _divisor_block(s_len, requested, 16, strict=False) or 1
+    return _divisor_block(s_len, requested, 16, strict=compiling_for_tpu()) or s_len
 
 
 @functools.partial(

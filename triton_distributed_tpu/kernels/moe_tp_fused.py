@@ -50,9 +50,9 @@ def pick_gg_blocks(block_m: int, cap: int, k: int, nl: int, itemsize: int):
     """(bm, bk, bn) for the grouped pipelines. bm is pinned to the routing
     ``block_m`` (one expert per A-block is the grouped-GEMM contract);
     bk/bn stream K and the output columns."""
-    from triton_distributed_tpu.config import on_tpu
+    from triton_distributed_tpu.config import compiling_for_tpu
 
-    strict = on_tpu()
+    strict = compiling_for_tpu()
     if cap % block_m:
         return None
     if strict and block_m % (8 * (4 // itemsize)):
@@ -198,7 +198,9 @@ def build_ag_group_gemm_call(
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
         ],
-        collective_id=collective_id,
+        # n==1: ag_forward_ring early-returns without touching the
+        # barrier semaphore, and Mosaic rejects an unused collective_id
+        collective_id=None if n == 1 else collective_id,
         vmem_limit_bytes=fused_vmem_budget(),
         name="ag_group_gemm_fused",
     )

@@ -47,6 +47,13 @@ def ag_forward_ring(
     this harness — publish it yourself if the gathered result is part of
     your contract, cf. ag_gemm's ``return_gathered``).
     """
+    if n == 1:
+        # single-rank degenerate ring: no barrier (self-signal semantics
+        # would otherwise be load-bearing — cf. reduce_ring's early
+        # return and gemm_rs nulling collective_id at n==1)
+        consume(0, 0, local_hbm, 0)
+        return
+
     me = lang.my_pe(axis)
     left, right = ring_neighbors(me, n)
     left = lang.pe_flat(axis, left, mesh_axes)

@@ -292,7 +292,11 @@ def ep_moe_device(x, logits, w_up, w_down, ctx: EPMoEContext):
 
 
 @functools.lru_cache(maxsize=64)
-def _build_ep_moe(ctx: EPMoEContext):
+def _build_ep_moe(ctx: EPMoEContext, ikey: tuple = ()):
+    # ikey: config.interp_key() — chaos/race knobs are baked in at trace
+    # time, so they must participate in the cache identity (like every
+    # other kernel builder; del keeps the signature honest about usage).
+    del ikey
     rows = P(tuple(ctx.batch_axes) + ctx.ep_axes)
     experts = P(ctx.ep_axes)
     fn = jax.shard_map(
@@ -312,7 +316,9 @@ def ep_moe(x, logits, w_up, w_down, ctx: EPMoEContext):
     ``ctx.axis``; w_up (E, H, F) / w_down (E, F, H) expert-sharded over
     ``ctx.axis``. Returns (M, H) token-sharded.
     """
-    return _build_ep_moe(ctx)(x, logits, w_up, w_down)
+    from triton_distributed_tpu.config import interp_key
+
+    return _build_ep_moe(ctx, interp_key())(x, logits, w_up, w_down)
 
 
 _EP_MOE_TUNERS: OrderedDict = OrderedDict()
