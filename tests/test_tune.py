@@ -80,6 +80,108 @@ class TestAutotuner:
         assert len([l for l in log if "best" in l]) == 2
 
 
+class TestWinnerValidation:
+    """Persisted winners are TTL'd and re-validated against the recorded
+    runner-up (VERDICT r2 #8): a noise-artifact winner heals instead of
+    persisting forever."""
+
+    @staticmethod
+    def _sleep_op():
+        import time as _t
+
+        def op(x, *, d):
+            _t.sleep(d)
+            return x
+
+        return op
+
+    def test_stale_wrong_winner_recovers(self, tmp_path, monkeypatch):
+        from triton_distributed_tpu.tune.autotuner import (
+            ContextualAutoTuner,
+            _shape_key,
+        )
+
+        monkeypatch.setenv("TDTPU_AUTOTUNE_LOG_DIR", str(tmp_path))
+        fast, slow = {"d": 0.0}, {"d": 0.05}
+        tuner = ContextualAutoTuner(
+            self._sleep_op(), [fast, slow], name="heal", warmup=0, iters=1,
+        )
+        x = jnp.ones((2,))
+        key = ("heal", _shape_key((x,), {}))
+        # inject the SLOW config as the persisted winner (a noisy sweep's
+        # artifact), fast one recorded as runner-up
+        tuner._disk_put(key, slow, fast)
+        assert tuner.pick(x) == fast            # re-validated → re-tuned
+        assert tuner._disk_get(key)["best"] == fast   # store healed
+
+    def test_valid_winner_accepted_without_full_sweep(self, tmp_path, monkeypatch):
+        from triton_distributed_tpu.tune.autotuner import (
+            ContextualAutoTuner,
+            _shape_key,
+        )
+
+        monkeypatch.setenv("TDTPU_AUTOTUNE_LOG_DIR", str(tmp_path))
+        fast, slow = {"d": 0.0}, {"d": 0.05}
+        calls = []
+
+        def op(x, *, d):
+            calls.append(d)
+            import time as _t
+
+            _t.sleep(d)
+            return x
+
+        tuner = ContextualAutoTuner(op, [fast, slow], name="ok",
+                                    warmup=0, iters=1)
+        x = jnp.ones((2,))
+        tuner._disk_put(("ok", _shape_key((x,), {})), fast, slow)
+        assert tuner.pick(x) == fast
+        # validation benched exactly winner+runner once each (no sweep,
+        # which here would be indistinguishable by count — assert order:
+        # best first, runner second, nothing else)
+        assert calls == [0.0, 0.05]
+
+    def test_ttl_expiry_rebenches(self, tmp_path, monkeypatch):
+        from triton_distributed_tpu.tune.autotuner import (
+            ContextualAutoTuner,
+            _shape_key,
+        )
+
+        monkeypatch.setenv("TDTPU_AUTOTUNE_LOG_DIR", str(tmp_path))
+        tuner = ContextualAutoTuner(
+            self._sleep_op(), [{"d": 0.0}, {"d": 0.02}], name="ttl",
+            warmup=0, iters=1, ttl_s=0,
+        )
+        x = jnp.ones((2,))
+        key = ("ttl", _shape_key((x,), {}))
+        tuner._disk_put(key, {"d": 0.02}, {"d": 0.0})
+        assert tuner._disk_get(key) is None     # ttl 0 → instantly stale
+        assert tuner.pick(x) == {"d": 0.0}      # full re-bench found fast
+
+    def test_legacy_v1_entry_rebenches(self, tmp_path, monkeypatch):
+        import json as _json
+
+        from triton_distributed_tpu.tune.autotuner import (
+            ContextualAutoTuner,
+            _shape_key,
+        )
+
+        monkeypatch.setenv("TDTPU_AUTOTUNE_LOG_DIR", str(tmp_path))
+        tuner = ContextualAutoTuner(
+            self._sleep_op(), [{"d": 0.0}, {"d": 0.02}], name="v1",
+            warmup=0, iters=1,
+        )
+        x = jnp.ones((2,))
+        key = ("v1", _shape_key((x,), {}))
+        # hand-write a pre-v2 store entry (bare config dict)
+        (tmp_path / "cache.json").write_text(
+            _json.dumps({repr(key): {"d": 0.02}})
+        )
+        assert tuner._disk_get(key) is None     # schema drift → miss
+        assert tuner.pick(x) == {"d": 0.0}
+        assert tuner._disk_get(key)["v"] == 2   # store upgraded
+
+
 class TestPerfModel:
     def test_specs_and_detection(self):
         assert set(TPU_SPECS) == {"v4", "v5e", "v5p", "v6e"}
@@ -127,14 +229,28 @@ class TestTunedEngineSelection:
         assert any("ag_gemm" in k for k in store)
 
         # fresh tuner (new process simulation): must hit the DISK cache —
-        # benching is forbidden
+        # a full sweep is forbidden. Winner re-validation (the cheap
+        # 2-config re-bench) is pinned to "accept" here: on this noisy
+        # time-shared host a legitimate rejection would trigger a full
+        # sweep and flake the test; the validation logic itself is
+        # covered deterministically by TestWinnerValidation.
         mod._engine_tuner.cache_clear()
+        validated = []
+        monkeypatch.setattr(
+            ContextualAutoTuner, "_validate_entry",
+            lambda self, entry, args, kwargs: (
+                validated.append(entry), entry["best"]
+            )[1],
+        )
         monkeypatch.setattr(
             ContextualAutoTuner, "_bench",
-            lambda self, *a: (_ for _ in ()).throw(AssertionError("benched on a disk hit")),
+            lambda self, *a, **k: (_ for _ in ()).throw(
+                AssertionError("full sweep ran on a disk hit")
+            ),
         )
         out2 = mod.ag_gemm(a, b, mesh8, "x")
         np.testing.assert_allclose(np.asarray(out2), ref, atol=1e-4, rtol=1e-4)
+        assert validated, "disk entry never reached winner re-validation"
 
     def test_gemm_rs_and_all_gather_tuned(self, mesh8, tmp_path, monkeypatch):
         import jax

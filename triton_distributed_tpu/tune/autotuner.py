@@ -73,7 +73,8 @@ class ContextualAutoTuner:
     """
 
     def __init__(self, fn, configs, *, name=None, warmup=1, iters=5,
-                 log=True, persist=True):
+                 log=True, persist=True, rounds=1, revalidate_margin=0.25,
+                 ttl_s=30 * 86400):
         self.fn = fn
         self.configs = list(configs)
         self.name = name or getattr(fn, "__name__", "thunk")
@@ -81,6 +82,19 @@ class ContextualAutoTuner:
         self.iters = iters
         self.log = log
         self.persist = persist
+        # rounds > 1: bench configs round-robin and take per-config
+        # MEDIANS across rounds (the paired methodology bench.py uses) —
+        # slowly-varying interference on a time-shared chip hits every
+        # config in a round about equally, so interleaving + median
+        # de-noises rankings where a single mean window cannot.
+        self.rounds = rounds
+        # A persisted winner is re-validated on the first use per
+        # process: winner and recorded runner-up are re-benched, and a
+        # winner slower than (1+margin)·runner_up triggers a full
+        # re-tune (a sticky wrong winner from a noisy sweep heals).
+        self.revalidate_margin = revalidate_margin
+        # Entries older than ttl_s re-bench outright (None disables).
+        self.ttl_s = ttl_s
         self.cache: dict = {}
         functools.update_wrapper(self, fn)
 
@@ -102,15 +116,30 @@ class ContextualAutoTuner:
             return {}
 
     def _disk_get(self, key):
-        best = self._disk_load().get(repr(key))
+        """Disk entry for ``key`` as a v2 record
+        ``{"v": 2, "best": cfg, "runner_up": cfg|None, "ts": float}``, or
+        None (miss / stale / schema drift → re-bench)."""
+        entry = self._disk_load().get(repr(key))
+        if entry is None:
+            return None
+        if not (isinstance(entry, dict) and entry.get("v") == 2):
+            # pre-v2 store (a bare config dict): re-bench once and
+            # rewrite in the validated schema
+            return None
+        best = entry.get("best")
+        runner = entry.get("runner_up")
         # stale-cache self-healing: a winner from an older code version
         # (renamed kwarg, dropped candidate) must re-bench, not be
         # applied blindly
-        if best is not None and best not in self.configs:
+        if best not in self.configs:
             return None
-        return best
+        if runner is not None and runner not in self.configs:
+            entry = dict(entry, runner_up=None)
+        if self.ttl_s is not None and time.time() - entry.get("ts", 0) > self.ttl_s:
+            return None
+        return entry
 
-    def _disk_put(self, key, best):
+    def _disk_put(self, key, best, runner_up=None):
         # flock'd read-modify-write: different tuners (ag_gemm/gemm_rs/
         # all_gather) and processes share one store; without the lock the
         # second writer's replace would drop the first writer's key
@@ -124,7 +153,10 @@ class ContextualAutoTuner:
             except (ImportError, OSError):
                 pass  # best effort on exotic filesystems
             store = self._disk_load()
-            store[repr(key)] = best
+            store[repr(key)] = {
+                "v": 2, "best": best, "runner_up": runner_up,
+                "ts": time.time(),
+            }
             tmp = path.with_suffix(".json.tmp")
             tmp.write_text(json.dumps(store, indent=1, sort_keys=True))
             os.replace(tmp, path)
@@ -134,12 +166,18 @@ class ContextualAutoTuner:
         a process that hit would skip the benching collectives a missing
         process is blocked in — the exact mismatched-collective deadlock
         the MAX consensus exists to prevent. Disagreement (including a
-        partial hit) degrades to a miss for everyone."""
+        partial hit) degrades to a miss for everyone. Only the
+        decision-relevant fields are compared — per-host stores record
+        their own ``ts``, which must not defeat agreement."""
         if jax.process_count() == 1:
             return best
         from jax.experimental import multihost_utils
 
-        blob = json.dumps(best, sort_keys=True) if best is not None else ""
+        decision = (
+            {"best": best.get("best"), "runner_up": best.get("runner_up")}
+            if isinstance(best, dict) else best
+        )
+        blob = json.dumps(decision, sort_keys=True) if best is not None else ""
         sig = np.array(
             [1 if best is not None else 0, zlib.crc32(blob.encode())],
             np.uint32,
@@ -148,47 +186,88 @@ class ContextualAutoTuner:
         same = (all_sigs == all_sigs[0]).all() and all_sigs[0, 0] == 1
         return best if same else None
 
-    def _bench(self, args, kwargs):
-        times = np.full((len(self.configs),), np.inf)
-        for i, cfg in enumerate(self.configs):
-            try:
-                _, ms = perf_func(
-                    lambda: self.fn(*args, **kwargs, **cfg),
-                    warmup=self.warmup, iters=self.iters,
-                )
-                times[i] = ms
-            except Exception:
-                # a config that fails anywhere must fail everywhere —
-                # +inf survives the MAX consensus (≡ KernelError skip,
-                # autotuner.py:78-94)
-                if self.log:
-                    with open(self._log_path(), "a") as f:
-                        f.write(json.dumps({
-                            "name": self.name, "config": self.configs[i],
-                            "error": traceback.format_exc(limit=1),
-                        }) + "\n")
+    def _bench(self, args, kwargs, configs=None):
+        configs = self.configs if configs is None else configs
+        per_round = np.full((self.rounds, len(configs)), np.inf)
+        dead = [False] * len(configs)
+        for r in range(self.rounds):
+            for i, cfg in enumerate(configs):
+                if dead[i]:
+                    continue
+                try:
+                    _, ms = perf_func(
+                        lambda: self.fn(*args, **kwargs, **cfg),
+                        # warmup only needs to happen once per config
+                        warmup=self.warmup if r == 0 else 0,
+                        iters=self.iters,
+                    )
+                    per_round[r, i] = ms
+                except Exception:
+                    # a config that fails anywhere must fail everywhere —
+                    # +inf survives the MAX consensus (≡ KernelError
+                    # skip, autotuner.py:78-94)
+                    dead[i] = True
+                    if self.log:
+                        with open(self._log_path(), "a") as f:
+                            f.write(json.dumps({
+                                "name": self.name, "config": cfg,
+                                "error": traceback.format_exc(limit=1),
+                            }) + "\n")
+        times = np.median(per_round, axis=0)
+        times[dead] = np.inf
         return _consensus_times(times)
+
+    def _validate_entry(self, entry, args, kwargs):
+        """Re-validate a persisted winner against its recorded runner-up
+        on a fresh (cheap, 2-config) bench: a winner that measures
+        > (1+margin)× the runner-up was a noise artifact — discard so
+        the caller re-tunes from scratch. Runs under the same MAX
+        consensus, so every process reaches the same verdict."""
+        best, runner = entry["best"], entry.get("runner_up")
+        if runner is None or not self.revalidate_margin:
+            return best
+        times = self._bench(args, kwargs, configs=[best, runner])
+        if not np.isfinite(times[0]):
+            return None  # persisted winner no longer even runs
+        if np.isfinite(times[1]) and (
+            times[0] > (1 + self.revalidate_margin) * times[1]
+        ):
+            if self.log:
+                with open(self._log_path(), "a") as f:
+                    f.write(json.dumps({
+                        "name": self.name, "stale_winner": best,
+                        "runner_up": runner,
+                        "ms": [float(times[0]), float(times[1])],
+                    }) + "\n")
+            return None
+        return best
 
     def pick(self, *args, **kwargs) -> dict:
         """Winning config for these (shapes of) arguments: memory cache →
-        disk cache → measure-with-consensus."""
+        disk cache (TTL'd + re-validated) → measure-with-consensus."""
         key = (self.name, _shape_key(args, kwargs))
         best = self.cache.get(key)
         if best is None and self.persist:
-            best = self._consensus_disk_hit(self._disk_get(key))
+            entry = self._consensus_disk_hit(self._disk_get(key))
+            if entry is not None:
+                best = self._validate_entry(entry, args, kwargs)
             if best is not None:
                 self.cache[key] = best
         if best is None:
             times = self._bench(args, kwargs)
-            idx = int(np.argmin(times))
+            order = np.argsort(times, kind="stable")
+            idx = int(order[0])
             if not np.isfinite(times[idx]):
                 raise RuntimeError(
                     f"autotune({self.name}): every config failed"
                 )
             best = self.configs[idx]
+            runner = None
+            if len(order) > 1 and np.isfinite(times[order[1]]):
+                runner = self.configs[int(order[1])]
             self.cache[key] = best
             if self.persist:
-                self._disk_put(key, best)
+                self._disk_put(key, best, runner)
             if self.log:
                 with open(self._log_path(), "a") as f:
                     f.write(json.dumps({
@@ -204,13 +283,19 @@ class ContextualAutoTuner:
         return self.fn(*args, **kwargs, **self.pick(*args, **kwargs))
 
 
-def method_tuner(name, run, methods, *, warmup=1, iters=3):
+def method_tuner(name, run, methods, *, warmup=1, iters=3, rounds=3):
     """Engine-selection tuner: candidates are ``{"method": m.value}`` for
     each member of the ``methods`` enum (the shared shape behind the
-    ag_gemm/gemm_rs/all_gather ``method=None`` wiring)."""
+    ag_gemm/gemm_rs/all_gather ``method=None`` wiring).
+
+    Engine gaps are a few percent — the same order as the time-shared
+    chip's run-to-run spread — so selection benches ``rounds``
+    round-robin passes and ranks per-config medians (and persisted
+    winners are re-validated against the recorded runner-up on first
+    use, healing noise-artifact winners)."""
     return ContextualAutoTuner(
         run, [{"method": m.value} for m in methods],
-        name=name, warmup=warmup, iters=iters,
+        name=name, warmup=warmup, iters=iters, rounds=rounds,
     )
 
 
