@@ -116,6 +116,15 @@ class Transformer:
         """Sequence-parallel activation sharding: rows over (dp..., tp)."""
         return P(tuple(self.dp_axes) + (self.tp_axis,))
 
+    @property
+    def token_shards(self) -> int:
+        """Number of row shards of the SP activation layout (tp × dp) —
+        the single definition of the padding/shard-count arithmetic used
+        by both prefill (EPMoEMLP) and decode (_decode_moe_ep)."""
+        return self.tp * int(
+            np.prod([self.mesh.shape[a] for a in self.dp_axes]) or 1
+        )
+
     @functools.cached_property
     def _ag_ctx(self):
         return ops.create_ag_gemm_context(
@@ -319,10 +328,9 @@ class Transformer:
             # fully differentiable (XLA transport) — the training MoE.
             from triton_distributed_tpu.layers import EPMoEMLP
 
-            m_local = x.shape[0] // (self.tp * int(
-                np.prod([self.mesh.shape[a] for a in self.dp_axes]) or 1
-            ))
-            return EPMoEMLP(self._moe_ep_ctx(m_local))(moe_params, x)
+            return EPMoEMLP(
+                self._moe_ep_ctx(x.shape[0] // self.token_shards)
+            )(moe_params, x)
         # TP flavour — one routing computation feeds either body
         logits = x.astype(jnp.float32) @ blk["router"]
         weights, ids = mu.select_experts(logits, c.topk)
@@ -513,7 +521,12 @@ class Transformer:
             if "up" in blk:
                 h = jax.nn.silu(xn @ blk["up"].astype(c.dtype))
                 x = x + h @ blk["down"].astype(c.dtype)
+            elif c.moe == "ep":
+                x = x + self._decode_moe_ep(blk, xn).astype(x.dtype)
             else:
+                # TP flavour: experts replicated on the expert dim (only
+                # F is sharded), so the per-topk gather stays shard-local
+                # — (B, H, F/tp) per device, no cross-shard weight moves
                 logits_r = xn.astype(jnp.float32) @ blk["router"]
                 w, ids = mu.select_experts(logits_r, c.topk)
                 y = jnp.zeros_like(xn, dtype=jnp.float32)
@@ -528,6 +541,27 @@ class Transformer:
         x = self._rmsnorm(x, params["norm_f"])
         logits = x.astype(jnp.float32) @ params["lm_head"]
         return logits, new_caches, kv_lens + 1
+
+    def _decode_moe_ep(self, blk, xn):
+        """Decode-step EP MoE: the B last-token activations ride the EP
+        dispatch → sharded grouped expert MLP → combine machinery, so
+        expert weights STAY sharded — no gathered (B, H, F) weight
+        tensor ever materializes (the reference's EP-MoE inference
+        headline: test_ep_moe_inference.py, decode-sized batches through
+        low_latency_all_to_all.py:36-118). B is padded up to the token
+        -shard count; pad rows are discarded after the combine."""
+        c = self.config
+        b = xn.shape[0]
+        shards = self.token_shards
+        pad = (-b) % shards
+        xp = jnp.pad(xn, ((0, pad), (0, 0)))
+        logits = xp.astype(jnp.float32) @ blk["router"]
+        ctx = self._moe_ep_ctx((b + pad) // shards)
+        y = ops.ep_moe(
+            xp, logits, blk["moe_up"].astype(c.dtype),
+            blk["moe_down"].astype(c.dtype), ctx,
+        )
+        return y[:b]
 
     @functools.cached_property
     def _decode_jit(self):

@@ -55,4 +55,29 @@ np.testing.assert_allclose(
     np.asarray(last_logits), np.asarray(logits_b), atol=2e-3, rtol=2e-3
 )
 print(f"prefill({PROMPT} tokens) + {STEPS}-token completion == stepwise decode")
+
+# ---- the same serving loop with an EP-MoE model: decode routes every
+# MoE block through the EP dispatch → sharded grouped expert MLP →
+# combine machinery (expert weights stay sharded; the reference's
+# EP-MoE inference headline, test_ep_moe_inference.py)
+moe_cfg = TransformerConfig(
+    vocab=128, n_layers=2, hidden=128, ffn=256,
+    n_heads=8, n_kv_heads=4, head_dim=16,
+    moe="ep", moe_layers=(0, 1), num_experts=8, topk=2,
+    dtype=jnp.float32, param_dtype=jnp.float32,
+)
+moe_model = Transformer(moe_cfg, mesh, "x", ())
+moe_params = jax.tree.map(
+    lambda p, s: jax.device_put(p, s),
+    moe_model.init(jax.random.PRNGKey(2)), moe_model.shardings(),
+)
+caches_m = moe_model.init_cache(B, CAP)
+last_m, caches_m, lens_m = moe_model._prefill_jit(moe_params, caches_m, prompt)
+first_m = jnp.argmax(last_m, axis=-1).astype(jnp.int32)
+toks_m, caches_m, lens_m = moe_model.generate(
+    moe_params, caches_m, lens_m, first_m, STEPS - 1
+)
+assert np.asarray(toks_m).shape == (B, STEPS - 1)
+assert np.asarray(lens_m).tolist() == [PROMPT + STEPS - 1] * B
+print(f"EP-MoE serving loop: prefill + {STEPS}-token completion through ep_moe")
 print("tutorial 13 OK")
