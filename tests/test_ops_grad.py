@@ -76,6 +76,23 @@ def test_tp_mlp_grad(mesh8):
     _check_grads(fused, dense, (w1, w2))
 
 
+def test_ag_gemm_grad_no_saved_gather(mesh8):
+    """save_gathered=False: dB re-gathers A in backward (the lower-memory
+    residual mode) — must match the gather-free default numerically."""
+    ctx = ops.create_ag_gemm_context(mesh8, "x", save_gathered=False)
+    a = _rand((64, 32), seed=21)
+    b = _rand((32, 128), seed=22)
+    w = _rand((64, 128), seed=23)
+
+    def fused(a, b):
+        return jnp.sum(ops.ag_gemm(a, b, ctx) * w)
+
+    def dense(a, b):
+        return jnp.sum(jnp.dot(a, b) * w)
+
+    _check_grads(fused, dense, (a, b))
+
+
 def test_ag_gemm_dp_batch_axes(mesh2x4):
     """DP×TP: rows sharded (dp, tp) — sequence-parallel within each DP
     group; weight grads must psum over dp."""

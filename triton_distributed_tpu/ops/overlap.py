@@ -14,9 +14,18 @@ differentiable, which is what makes the flagship *training* path possible:
 i.e. the backward of each overlap op's *activation gradient* is the dual
 overlap op, so dA gets the same compute/communication overlap as the
 forward — a property the stream-based reference design cannot express.
-The weight gradients run as plain all_gather + matmul (XLA overlaps the
-gather with neighbouring ops where it can, but there is no fused engine
-for them yet).
+
+The weight gradients overlap too:
+
+* gemm_rs: the dual ag_gemm that computes dA produces the gathered dC
+  as a free by-product of its ring (``return_gathered=True``), so dB is
+  a plain local matmul — its AllGather rode the fused dA engine.
+* ag_gemm: with ``ctx.save_gathered`` (default) the FORWARD fused
+  engine's gathered-A output is kept as the residual, so dB needs no
+  gather at all — the AG cost sits in the forward where the engine
+  hides it under the GEMM. Costs tp× more residual memory for that
+  tensor; set ``save_gathered=False`` to re-gather in backward instead
+  (plain all_gather + matmul).
 """
 
 from __future__ import annotations
@@ -53,6 +62,12 @@ class OverlapContext:
     method: object = None          # AGGemmMethod / GemmRSMethod / None=auto
     out_dtype: object = None
     collective_id: int = 8
+    # ag_gemm training: keep the forward engine's gathered-A output as
+    # the VJP residual so the weight gradient is gather-free (see module
+    # docstring). tp× residual memory for A; disable to re-gather in bwd.
+    # Only engages when the FUSED engine resolves (an XLA engine would
+    # pay a second standalone all_gather just to produce the residual).
+    save_gathered: bool = True
 
     @property
     def tp(self) -> int:
@@ -76,8 +91,9 @@ def _psum_if(x, axes):
 
 @functools.lru_cache(maxsize=256)
 def _build_ag_wgrad(mesh, axis, batch_axes):
-    """dB for ag_gemm: psum_dp( AG(A)ᵀ @ dC ) — weight grads reduce over
-    the data-parallel axes, activations gather over the TP axis."""
+    """dB for ag_gemm when the gathered A was NOT saved:
+    psum_dp( AG(A)ᵀ @ dC ) — weight grads reduce over the data-parallel
+    axes, activations gather over the TP axis."""
     ba = tuple(batch_axes)
 
     def body(a_loc, g_loc):
@@ -97,23 +113,39 @@ def _build_ag_wgrad(mesh, axis, batch_axes):
     return jax.jit(fn)
 
 
+# (the former _build_rs_wgrad — gather-in-backward dB for gemm_rs — is
+# subsumed by _build_gathered_wgrad: the dual dA op now supplies AG(dC))
 @functools.lru_cache(maxsize=256)
-def _build_rs_wgrad(mesh, axis, batch_axes):
-    """dB for gemm_rs: psum_dp( Aᵀ @ AG(dC) )."""
+def _build_gathered_wgrad(mesh, axis, batch_axes, transpose_out):
+    """Gather-free dB from an already-gathered operand:
+    psum_dp( fullᵀ @ loc ) with out cols sharded (``transpose_out=False``
+    — ag_gemm's dB (K, N/tp)) or psum_dp( locᵀ @ full ) with out rows
+    sharded (``True`` — gemm_rs's dB (K/tp, N)). The AllGather that fed
+    ``full`` rode a fused engine (forward's return_gathered, or the dual
+    dA op's ring), so this is pure local compute."""
     ba = tuple(batch_axes)
+    full_spec = P(ba if ba else None, None)
+    loc_spec = P(ba if ba else None, axis)
 
-    def body(a_loc, g_loc):
-        g_full = jax.lax.all_gather(g_loc, axis, tiled=True)
-        db = jnp.dot(
-            a_loc.T.astype(jnp.float32), g_full.astype(jnp.float32)
-        )
-        return _psum_if(db, ba)
+    if transpose_out:
+        def body(a_loc, g_full):
+            return _psum_if(
+                jnp.dot(a_loc.T.astype(jnp.float32), g_full.astype(jnp.float32)),
+                ba,
+            )
+
+        in_specs, out_specs = (loc_spec, full_spec), P(axis, None)
+    else:
+        def body(a_full, g_loc):
+            return _psum_if(
+                jnp.dot(a_full.T.astype(jnp.float32), g_loc.astype(jnp.float32)),
+                ba,
+            )
+
+        in_specs, out_specs = (full_spec, loc_spec), P(None, axis)
 
     fn = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(ba if ba else None, axis), P(ba + (axis,) if ba else axis, None)),
-        out_specs=P(axis, None),
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(fn)
@@ -133,19 +165,65 @@ def ag_gemm(a, b, ctx: OverlapContext):
     )
 
 
+def _fused_forward(ctx, a, b) -> bool:
+    """Gate for saving the gathered A: only the fused engine emits it
+    for free (an XLA engine would pay a SECOND standalone all_gather for
+    the residual, plus tp× residual memory, while saving nothing).
+
+    Deliberately a PURE function of (ctx, global shapes, dtype): the
+    explicit ctx.method, else the topology/blockability heuristic —
+    never the tuner, whose answer differs between traced and concrete
+    calls and would let fwd and bwd disagree about what the residual is.
+    When the gate passes, the forward PINS method=PALLAS_FUSED so the
+    engine that runs is exactly the one the gate promised."""
+    from triton_distributed_tpu.kernels.ag_gemm import auto_ag_gemm_method
+    from triton_distributed_tpu.runtime import mesh_axes_size
+
+    method = ctx.method
+    if method is None:
+        method = auto_ag_gemm_method(
+            ctx.mesh, ctx.axis, a, b,
+            dp=mesh_axes_size(ctx.mesh, tuple(ctx.batch_axes)),
+        )
+    return method == AGGemmMethod.PALLAS_FUSED
+
+
 def _ag_gemm_fwd(a, b, ctx):
+    # NOTE: the save/no-save decision is a pure function of (ctx, global
+    # shapes, dtype) — the backward recomputes it from the residuals
+    # (same global shapes) instead of carrying a flag, which would turn
+    # into a tracer across the fwd/bwd boundary under jit.
+    if ctx.save_gathered and _fused_forward(ctx, a, b):
+        # the fused engine emits the gathered A as a by-product of its
+        # ring; saving it makes the backward dB gather-free (the AG cost
+        # lives in the forward, hidden under the forward GEMM)
+        out, a_full = _ag_gemm_raw(
+            a, b, ctx.mesh, ctx.axis,
+            batch_axes=ctx.batch_axes,
+            # pinned: the engine must be the one the gate promised (see
+            # _fused_forward) — a tuner pick here could silently be XLA
+            method=AGGemmMethod.PALLAS_FUSED,
+            out_dtype=ctx.out_dtype, collective_id=ctx.collective_id,
+            return_gathered=True,
+        )
+        return out, (a_full, b)
     return ag_gemm(a, b, ctx), (a, b)
 
 
 def _ag_gemm_bwd(ctx, res, g):
-    a, b = res
+    a_res, b = res
     # dA: the dual overlap op — GEMM(dC, Bᵀ) fused with ReduceScatter.
     da = _gemm_rs_raw(
         g, b.T, ctx.mesh, ctx.axis,
         batch_axes=ctx.batch_axes, method=_dual_method(ctx.method, GemmRSMethod),
-        out_dtype=a.dtype, collective_id=ctx.collective_id + 1,
+        out_dtype=a_res.dtype, collective_id=ctx.collective_id + 1,
     )
-    db = _build_ag_wgrad(ctx.mesh, ctx.axis, tuple(ctx.batch_axes))(a, g)
+    ba = tuple(ctx.batch_axes)
+    if ctx.save_gathered and _fused_forward(ctx, a_res, b):
+        # a_res is the forward-saved gathered A (same global shape as a)
+        db = _build_gathered_wgrad(ctx.mesh, ctx.axis, ba, False)(a_res, g)
+    else:
+        db = _build_ag_wgrad(ctx.mesh, ctx.axis, ba)(a_res, g)
     return da, db.astype(b.dtype)
 
 
@@ -173,12 +251,18 @@ def _gemm_rs_fwd(a, b, ctx):
 def _gemm_rs_bwd(ctx, res, g):
     a, b = res
     # dA: the dual overlap op — AllGather(dC) fused with GEMM(·, Bᵀ).
-    da = _ag_gemm_raw(
+    # Its ring gathers dC as a free by-product (return_gathered), which
+    # is exactly the AG(dC) the weight gradient needs: dB becomes a
+    # local matmul with no collective of its own.
+    da, g_full = _ag_gemm_raw(
         g, b.T, ctx.mesh, ctx.axis,
         batch_axes=ctx.batch_axes, method=_dual_method(ctx.method, AGGemmMethod),
         out_dtype=a.dtype, collective_id=ctx.collective_id + 1,
+        return_gathered=True,
     )
-    db = _build_rs_wgrad(ctx.mesh, ctx.axis, tuple(ctx.batch_axes))(a, g)
+    db = _build_gathered_wgrad(
+        ctx.mesh, ctx.axis, tuple(ctx.batch_axes), True
+    )(a, g_full)
     return da, db.astype(b.dtype)
 
 
