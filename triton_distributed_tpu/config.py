@@ -24,11 +24,26 @@ def on_tpu() -> bool:
     return backend() == "tpu"
 
 
+def compiling_for_tpu() -> bool:
+    """Will Pallas kernels built now lower through Mosaic? True on real
+    TPU and under ``force_compile`` (AOT lowering for an unattached TPU
+    topology from a CPU-backed process). Strict Mosaic constraints
+    (block alignment) key on this, not on :func:`on_tpu`."""
+    return config.force_compile or on_tpu()
+
+
 @dataclass
 class Config:
     # Force Pallas interpreter mode even on TPU (debugging).
     force_interpret: bool = field(
         default_factory=lambda: os.environ.get("TDTPU_FORCE_INTERPRET", "0") == "1"
+    )
+    # Force real Mosaic compilation even off-TPU — the AOT-lowering path:
+    # building kernels against an unattached multi-chip TPU *topology*
+    # (jax.experimental.topologies) from a CPU-backed process must lower
+    # through Mosaic, not the interpreter (tests/test_aot_topology.py).
+    force_compile: bool = field(
+        default_factory=lambda: os.environ.get("TDTPU_FORCE_COMPILE", "0") == "1"
     )
     # Enable the interpreter's DMA race detector (CPU test runs only).
     # TPU-native answer to the reference's chaos-delay substitute for a race
@@ -60,9 +75,10 @@ def fused_vmem_budget() -> int:
 def interp_key() -> tuple:
     """Hashable key of the config state captured at pallas BUILD time
     (chaos delays are traced in; detect_races is baked into the
-    interpreter params) — lru-cached kernel builders must include it so
-    toggling either knob rebuilds instead of reusing a stale build."""
-    return (config.chaos_delay, config.detect_races)
+    interpreter params; force_compile flips interpret→Mosaic) —
+    lru-cached kernel builders must include it so toggling any knob
+    rebuilds instead of reusing a stale build."""
+    return (config.chaos_delay, config.detect_races, config.force_compile)
 
 
 def autotune_enabled() -> bool:
@@ -78,10 +94,18 @@ def autotune_enabled() -> bool:
 
 
 def _use_interpret(force: bool | None) -> bool:
-    """Shared should-we-interpret policy: forced, or running off-TPU."""
+    """Shared should-we-interpret policy: forced, or running off-TPU.
+    ``config.force_compile`` overrides the off-TPU default (AOT lowering
+    against an unattached TPU topology needs real Mosaic)."""
     if force is not None:
         return bool(force)
-    return config.force_interpret or not on_tpu()
+    if config.force_interpret:
+        # the explicit debugging knob wins over force_compile: someone
+        # asking for the interpreter (race detector, chaos) must get it
+        return True
+    if config.force_compile:
+        return False
+    return not on_tpu()
 
 
 def local_interpret(force: bool | None = None):
@@ -214,6 +238,11 @@ def interpret_params(force: bool | None = None):
     from jax.experimental.pallas import tpu as pltpu
 
     if not _use_interpret(force):
+        if not on_tpu():
+            # force_compile from a CPU-backed process (AOT lowering for a
+            # TPU topology): emit_pipeline still asks the *runtime* for
+            # the TPU generation at trace time — answer for the target
+            ensure_pipeline_shim()
         return False
     ensure_interpreter_unblocked()
     ensure_pipeline_shim()

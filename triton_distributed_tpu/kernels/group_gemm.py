@@ -65,7 +65,7 @@ def grouped_matmul(
     for the old (256, 512, 512). Smaller block_m trades MXU efficiency
     for less routing padding — contexts keep their own defaults.
     """
-    from triton_distributed_tpu.config import on_tpu
+    from triton_distributed_tpu.config import compiling_for_tpu
     from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
 
     cap, kdim = x_sorted.shape
@@ -74,8 +74,8 @@ def grouped_matmul(
     # round the requested blocks DOWN to divisors (TPU-aligned when
     # possible): the sweep-tuned defaults must not assert on shapes like
     # N=3584 that 512 divides but 2048 does not
-    block_n = _divisor_block(ndim, min(block_n, ndim), 128, on_tpu()) or ndim
-    block_k = _divisor_block(kdim, min(block_k, kdim), 128, on_tpu()) or kdim
+    block_n = _divisor_block(ndim, min(block_n, ndim), 128, compiling_for_tpu()) or ndim
+    block_k = _divisor_block(kdim, min(block_k, kdim), 128, compiling_for_tpu()) or kdim
     nsteps_k = kdim // block_k
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -168,10 +168,10 @@ def _streamable(m_local: int, cols: int, itemsize: int) -> bool:
     blocking of the (m_local, cols) slab (≡ gemm_rs's pick_mm_blocks
     guard); shapes without one must stay on the VMEM ring rather than
     crash at Mosaic trace time."""
-    from triton_distributed_tpu.config import on_tpu
+    from triton_distributed_tpu.config import compiling_for_tpu
     from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
 
-    strict = on_tpu()
+    strict = compiling_for_tpu()
     return (
         _divisor_block(m_local, 512, 8 * (4 // itemsize), strict) is not None
         and _divisor_block(cols, 2048, 128, strict) is not None
