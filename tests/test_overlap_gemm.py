@@ -105,6 +105,78 @@ def test_tp_mlp_roundtrip(mesh8):
     assert_allclose(np.asarray(y, np.float32), ref, atol=1e-3, rtol=1e-3)
 
 
+class TestHierarchical:
+    """Hierarchical (multi-slice) fused engines (VERDICT r2 #4): the TP
+    factor spans (axis, dcn_axis) axis-major; the fused Pallas ring runs
+    intra-slice, the lax leg crosses the slice axis. ≡ the reference's
+    inter-node AG-GEMM (allgather.py:291-375) and GEMM-RS
+    (reduce_scatter.py:524-545)."""
+
+    @pytest.fixture(scope="class")
+    def mesh_tp_dcn(self):
+        devs = np.asarray(jax.devices()).reshape(4, 2)
+        return jax.sharding.Mesh(devs, ("tp", "dcn"))
+
+    @pytest.mark.parametrize(
+        "method",
+        [AGGemmMethod.PALLAS_FUSED, AGGemmMethod.XLA_RING,
+         AGGemmMethod.XLA_NAIVE, None],
+    )
+    def test_ag_gemm_hier(self, mesh_tp_dcn, method):
+        a = _rand((64, 32), seed=11)
+        b = _rand((32, 128), seed=12)
+        c = ag_gemm(a, b, mesh_tp_dcn, "tp", method=method, dcn_axis="dcn")
+        assert c.shape == (64, 128)
+        assert_allclose(
+            np.asarray(c, np.float32), _ref_matmul(a, b), atol=1e-4, rtol=1e-4
+        )
+
+    @pytest.mark.parametrize(
+        "method",
+        [GemmRSMethod.PALLAS_FUSED, GemmRSMethod.XLA_RING,
+         GemmRSMethod.XLA_NAIVE, None],
+    )
+    def test_gemm_rs_hier(self, mesh_tp_dcn, method):
+        a = _rand((64, 32), seed=13)
+        b = _rand((32, 48), seed=14)
+        c = gemm_rs(a, b, mesh_tp_dcn, "tp", method=method, dcn_axis="dcn")
+        assert c.shape == (64, 48)
+        assert_allclose(
+            np.asarray(c, np.float32), _ref_matmul(a, b), atol=1e-4, rtol=1e-4
+        )
+
+    def test_ag_gemm_hier_return_gathered(self, mesh_tp_dcn):
+        a = _rand((64, 32), seed=15)
+        b = _rand((32, 128), seed=16)
+        c, gathered = ag_gemm(
+            a, b, mesh_tp_dcn, "tp", method=AGGemmMethod.PALLAS_FUSED,
+            dcn_axis="dcn", return_gathered=True,
+        )
+        assert_allclose(np.asarray(c), _ref_matmul(a, b), atol=1e-4, rtol=1e-4)
+        assert_allclose(np.asarray(gathered), np.asarray(a), atol=0, rtol=0)
+
+    def test_hier_sharded_inputs_land_fused(self, mesh_tp_dcn):
+        """Explicitly axis-major-sharded device inputs round-trip through
+        the hierarchical fused engines (the realistic serving layout)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        a = jax.device_put(
+            _rand((64, 32), seed=17),
+            NamedSharding(mesh_tp_dcn, P(("tp", "dcn"), None)),
+        )
+        b = jax.device_put(
+            _rand((32, 128), seed=18),
+            NamedSharding(mesh_tp_dcn, P(None, ("tp", "dcn"))),
+        )
+        c = ag_gemm(
+            a, b, mesh_tp_dcn, "tp", method=AGGemmMethod.PALLAS_FUSED,
+            dcn_axis="dcn",
+        )
+        assert_allclose(
+            np.asarray(c, np.float32), _ref_matmul(a, b), atol=1e-4, rtol=1e-4
+        )
+
+
 @pytest.mark.parametrize(
     "method", [AGGemmMethod.PALLAS_FUSED, AGGemmMethod.XLA_RING]
 )

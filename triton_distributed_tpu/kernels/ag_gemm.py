@@ -102,10 +102,10 @@ def pick_mm_blocks(m: int, k: int, n: int, itemsize: int,
     """(bm, bk, bn) for the streaming matmul pipeline, or None if the shape
     admits no (TPU-lowerable) divisor blocking. Shrinks targets until the
     double-buffered tile working set fits the VMEM budget."""
-    from triton_distributed_tpu.config import on_tpu
+    from triton_distributed_tpu.config import compiling_for_tpu
 
     budget = budget or fused_vmem_budget()
-    strict = on_tpu()
+    strict = compiling_for_tpu()
     sublane = 8 * (4 // itemsize)  # (8·packing, 128) native tile
     tm, tk, tn = targets or _TILE_TARGETS
     while True:
@@ -202,37 +202,54 @@ def _fused_kernel(
         cp.wait()
 
 
-def _specs(axis, batch_axes):
+def _specs(axis, batch_axes, dcn_axis=None):
     """(in_specs, out_specs) for AG-GEMM under shard_map over the full mesh.
 
     Activation rows may additionally be sharded over ``batch_axes`` (data
     parallelism): the kernel then gathers only the ``axis`` (sequence/TP)
-    factor of the rows inside each DP group."""
+    factor of the rows inside each DP group. Hierarchical (``dcn_axis``):
+    the TP factor spans (axis, dcn_axis) with axis-MAJOR row order, so
+    the rail-gathered rows per ring slab are contiguous."""
     ba = tuple(batch_axes)
-    row = ba + (axis,) if ba else axis
-    a_spec = P(row, None)
-    b_spec = P(None, axis)
-    out_spec = P(ba if ba else None, axis)
+    # a 1-tuple of axis names is equivalent to the bare name for both
+    # PartitionSpec and lax collectives, so no flat/hier branching
+    tp_axes = (axis,) if dcn_axis is None else (axis, dcn_axis)
+    a_spec = P(ba + tp_axes, None)
+    b_spec = P(None, tp_axes)
+    out_spec = P(ba if ba else None, tp_axes)
     return (a_spec, b_spec), out_spec
 
 
 @functools.lru_cache(maxsize=256)
 def _build_fused(
     mesh, axis, batch_axes, a_shape, b_shape, dtype, out_dtype, collective_id,
-    chaos, return_gathered=True,
+    chaos, return_gathered=True, dcn_axis=None,
 ):
+    """Fused engine. ``dcn_axis`` set = the hierarchical decomposition
+    (≡ the reference's inter-node AG-GEMM, allgather.py:291-375): a
+    ``lax.all_gather`` rail leg over the DCN axis feeds the SAME fused
+    Pallas ring, which runs intra-slice over ``axis`` with nd× larger
+    slabs. Row layout is axis-major — rows sharded P((axis, dcn_axis)) —
+    so the railed rows stay contiguous per ring slab and the kernel is
+    unchanged."""
     n = mesh.shape[axis]
+    nd = mesh.shape[dcn_axis] if dcn_axis else 1
     k = a_shape[1]
-    n_local = b_shape[1] // n
+    n_local = b_shape[1] // (n * nd)
     dp = mesh_axes_size(mesh, batch_axes)
-    m_gathered = a_shape[0] // dp  # rows per device after the AG over `axis`
-    m_shard = m_gathered // n
-    blocks = pick_mm_blocks(m_shard, k, n_local, dtype.itemsize)
+    m_gathered = a_shape[0] // dp  # rows per device after the full AG
+    slab_rows = m_gathered // n    # rows per ring step (nd shards railed)
+    blocks = pick_mm_blocks(slab_rows, k, n_local, dtype.itemsize)
     if blocks is None:
         raise ValueError(
             f"ag_gemm PALLAS_FUSED: no divisor blocking for shard "
-            f"({m_shard}, {k}) @ ({k}, {n_local}); use XLA_RING"
+            f"({slab_rows}, {k}) @ ({k}, {n_local}); use XLA_RING"
         )
+    if n == 1:
+        # degenerate ring: ag_forward_ring early-returns without touching
+        # the barrier semaphore, and Mosaic rejects a collective_id on a
+        # kernel that never does (same convention as gemm_rs)
+        collective_id = None
 
     call = lang.shmem_call(
         functools.partial(
@@ -260,11 +277,18 @@ def _build_fused(
         vmem_limit_bytes=fused_vmem_budget(),
         name="ag_gemm_fused",
     )
-    in_specs, out_specs = _specs(axis, batch_axes)
+    in_specs, out_specs = _specs(axis, batch_axes, dcn_axis)
     ba = tuple(batch_axes)
     ag_spec = P(ba if ba else None, None)
+    if dcn_axis is None:
+        body = call
+    else:
+        def body(a_loc, b_loc):
+            # DCN rail leg: gather my axis-position's rows across slices
+            # (axis-major rows → the railed slab is contiguous)
+            return call(jax.lax.all_gather(a_loc, dcn_axis, tiled=True), b_loc)
     fn = jax.shard_map(
-        call,
+        body,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=(out_specs, ag_spec),
@@ -303,27 +327,33 @@ def ag_gemm_device(a_loc, b_loc, axis, *, out_dtype=None):
 
 
 @functools.lru_cache(maxsize=256)
-def _build_xla_ring(mesh, axis, batch_axes, out_dtype):
-    in_specs, out_specs = _specs(axis, batch_axes)
+def _build_xla_ring(mesh, axis, batch_axes, out_dtype, dcn_axis=None):
+    in_specs, out_specs = _specs(axis, batch_axes, dcn_axis)
+
+    def body(a_loc, b_loc):
+        if dcn_axis is not None:
+            # same rail/ring split as the fused engine: DCN leg via
+            # lax, ppermute ring intra-slice over nd× slabs
+            a_loc = jax.lax.all_gather(a_loc, dcn_axis, tiled=True)
+        return ag_gemm_device(a_loc, b_loc, axis, out_dtype=out_dtype)
+
     fn = jax.shard_map(
-        functools.partial(ag_gemm_device, axis=axis, out_dtype=out_dtype),
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=out_specs,
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=256)
-def _build_gather(mesh, axis, batch_axes):
+def _build_gather(mesh, axis, batch_axes, dcn_axis=None):
     """Standalone row-gather used when ``return_gathered=True`` rides an
     XLA engine (the fused engine produces the gathered A for free)."""
     ba = tuple(batch_axes)
+    tp_axes = (axis,) if dcn_axis is None else (axis, dcn_axis)
     fn = jax.shard_map(
-        lambda x: jax.lax.all_gather(x, axis, tiled=True),
+        lambda x: jax.lax.all_gather(x, tp_axes, tiled=True),
         mesh=mesh,
-        in_specs=_specs(axis, batch_axes)[0][0],
+        in_specs=_specs(axis, batch_axes, dcn_axis)[0][0],
         out_specs=P(ba if ba else None, None),
         check_vma=False,
     )
@@ -331,14 +361,16 @@ def _build_gather(mesh, axis, batch_axes):
 
 
 @functools.lru_cache(maxsize=256)
-def _build_xla_naive(mesh, axis, batch_axes, out_dtype):
+def _build_xla_naive(mesh, axis, batch_axes, out_dtype, dcn_axis=None):
+    tp_axes = (axis,) if dcn_axis is None else (axis, dcn_axis)
+
     def body(a_loc, b_loc):
-        a_full = jax.lax.all_gather(a_loc, axis, tiled=True)
+        a_full = jax.lax.all_gather(a_loc, tp_axes, tiled=True)
         return jnp.dot(a_full, b_loc, preferred_element_type=jnp.float32).astype(
             out_dtype
         )
 
-    in_specs, out_specs = _specs(axis, batch_axes)
+    in_specs, out_specs = _specs(axis, batch_axes, dcn_axis)
     fn = jax.shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
     )
@@ -347,7 +379,7 @@ def _build_xla_naive(mesh, axis, batch_axes, out_dtype):
 
 @functools.lru_cache(maxsize=64)
 def _engine_tuner(mesh, axis, batch_axes, out_dtype, collective_id,
-                  return_gathered):
+                  return_gathered, dcn_axis=None):
     """Measured engine selection for ``method=None`` (≡ wrapping the op
     in contextual_autotune, reference autotuner.py:97): every engine is
     benchmarked end to end per input shape, the winner persists on disk,
@@ -363,41 +395,87 @@ def _engine_tuner(mesh, axis, batch_axes, out_dtype, collective_id,
             a, b, mesh, axis, batch_axes=batch_axes,
             method=AGGemmMethod(method), out_dtype=out_dtype,
             collective_id=collective_id, return_gathered=return_gathered,
+            dcn_axis=dcn_axis,
         )
 
     return method_tuner(
         f"ag_gemm[{dict(mesh.shape)}|{axis}|{batch_axes}|{out_dtype}|"
-        f"{collective_id}|rg{int(return_gathered)}]",
+        f"{collective_id}|rg{int(return_gathered)}|{dcn_axis}]",
         run, AGGemmMethod,
     )
 
 
-def auto_ag_gemm_method(mesh, axis, a, b, dp: int = 1) -> AGGemmMethod:
+def auto_ag_gemm_method(mesh, axis, a, b, dp: int = 1,
+                        dcn_axis: str | None = None) -> AGGemmMethod:
     """≡ reference method auto-selection (allgather.py:54-69): topology +
     shape blockability decide the engine. The streaming fused engine has no
-    working-set VMEM gate; it is skipped only on DCN meshes (no Pallas
-    remote DMA across slices) or shapes with no divisor blocking — and the
-    fallback is *logged* so nobody silently benchmarks XLA believing it is
-    the fused kernel."""
+    working-set VMEM gate; it is skipped only when the intra-slice ``axis``
+    itself crosses DCN (no Pallas remote DMA across slices — declare the
+    cross-slice factor as ``dcn_axis`` for the hierarchical engine) or on
+    shapes with no divisor blocking — and the fallback is *logged* so
+    nobody silently benchmarks XLA believing it is the fused kernel."""
     n = mesh.shape[axis]
+    nd = mesh.shape[dcn_axis] if dcn_axis else 1
     topo = detect_topology(mesh, axis)
     if topo.link_kind == LinkKind.DCN:
         _warn_once(
             ("ag_gemm", "dcn", axis),
-            f"ag_gemm: axis {axis!r} crosses DCN; using XLA_RING engine",
+            f"ag_gemm: axis {axis!r} crosses DCN; using XLA_RING engine "
+            "(pass the cross-slice factor as dcn_axis= to keep the fused "
+            "engine intra-slice)",
         )
         return AGGemmMethod.XLA_RING
-    m_shard = a.shape[0] // (dp * n)
-    blocks = pick_mm_blocks(m_shard, a.shape[1], b.shape[1] // n, a.dtype.itemsize)
+    slab_rows = a.shape[0] // (dp * n)
+    blocks = pick_mm_blocks(
+        slab_rows, a.shape[1], b.shape[1] // (n * nd), a.dtype.itemsize
+    )
     if blocks is None:
         _warn_once(
             ("ag_gemm", "blocks", a.shape, b.shape),
-            f"ag_gemm: shard ({m_shard}, {a.shape[1]}) @ "
-            f"({a.shape[1]}, {b.shape[1] // n}) admits no divisor blocking; "
-            "falling back to XLA_RING",
+            f"ag_gemm: shard ({slab_rows}, {a.shape[1]}) @ "
+            f"({a.shape[1]}, {b.shape[1] // (n * nd)}) admits no divisor "
+            "blocking; falling back to XLA_RING",
         )
         return AGGemmMethod.XLA_RING
     return AGGemmMethod.PALLAS_FUSED
+
+
+def resolve_ag_gemm_method(
+    a_mesh, axis, a, b, *, batch_axes=(), method=None, out_dtype=None,
+    collective_id: int = 5, return_gathered: bool = False,
+    dcn_axis: str | None = None,
+) -> AGGemmMethod:
+    """The engine :func:`ag_gemm` will ACTUALLY run for these arguments:
+    the explicit ``method``, else the tuned winner (when tuning is
+    enabled and the args are concrete), else the topology/blockability
+    heuristic — with the safety recheck demoting a fused winner that is
+    not buildable in this environment. Exposed so callers that must act
+    on the resolved engine (ops.overlap's save_gathered residual gate)
+    agree with the entry instead of re-guessing."""
+    if method is not None:
+        return method
+    from triton_distributed_tpu.tune.autotuner import tuned_method_or_none
+
+    batch_axes = tuple(batch_axes)
+    dp = mesh_axes_size(a_mesh, batch_axes)
+    out_dtype = out_dtype or a.dtype
+    m = tuned_method_or_none(
+        lambda: _engine_tuner(
+            a_mesh, axis, batch_axes, jnp.dtype(out_dtype), collective_id,
+            return_gathered, dcn_axis,
+        ),
+        a, b,
+    )
+    auto = functools.partial(
+        auto_ag_gemm_method, a_mesh, axis, a, b, dp=dp, dcn_axis=dcn_axis
+    )
+    method = AGGemmMethod(m) if m else auto()
+    if method == AGGemmMethod.PALLAS_FUSED and auto() != method:
+        # a persisted winner from another environment (bigger VMEM
+        # budget, non-DCN mesh) may no longer be buildable here; the
+        # heuristic encodes exactly those safety constraints
+        method = auto()
+    return method
 
 
 def ag_gemm(
@@ -411,6 +489,7 @@ def ag_gemm(
     out_dtype=None,
     collective_id: int = 5,
     return_gathered: bool = False,
+    dcn_axis: str | None = None,
 ):
     """Fused AllGather(A) @ B for column-parallel TP.
 
@@ -419,6 +498,12 @@ def ag_gemm(
     factor within each DP group (Megatron sequence-parallel layout).
     ``b``: (K, N) sharded P(None, axis) — column-parallel weight.
     Returns (M, N) with rows sharded over ``batch_axes``, cols over ``axis``.
+
+    ``dcn_axis``: hierarchical TP spanning slices (≡ the reference's
+    inter-node AG-GEMM, allgather.py:291-375). The TP factor is
+    (axis, dcn_axis) with AXIS-MAJOR ordering — rows P((axis, dcn_axis)),
+    weight cols likewise: a ``lax.all_gather`` rail leg crosses DCN, the
+    fused Pallas ring stays intra-slice with nd× larger slabs.
 
     ``return_gathered=True`` additionally returns the gathered activations
     (the reference exposes them in its symmetric workspace; callers reuse
@@ -429,47 +514,32 @@ def ag_gemm(
     ``rowise_ag_gemm_dispatcher`` (:586-661).
     """
     n = mesh.shape[axis]
+    nd = mesh.shape[dcn_axis] if dcn_axis else 1
     batch_axes = tuple(batch_axes)
     dp = mesh_axes_size(mesh, batch_axes)
     out_dtype = out_dtype or a.dtype
-    assert a.shape[0] % (n * dp) == 0 and b.shape[1] % n == 0
+    assert a.shape[0] % (n * nd * dp) == 0 and b.shape[1] % (n * nd) == 0
     assert a.shape[1] == b.shape[0], f"contract dim mismatch {a.shape} @ {b.shape}"
-    if n == 1:
+    if n * nd == 1:
         out = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
         return (out, a) if return_gathered else out
-    if method is None:
-        from triton_distributed_tpu.tune.autotuner import tuned_method_or_none
-
-        m = tuned_method_or_none(
-            lambda: _engine_tuner(
-                mesh, axis, batch_axes, jnp.dtype(out_dtype), collective_id,
-                return_gathered,
-            ),
-            a, b,
-        )
-        method = (
-            AGGemmMethod(m) if m else auto_ag_gemm_method(mesh, axis, a, b, dp=dp)
-        )
-        if (
-            method == AGGemmMethod.PALLAS_FUSED
-            and auto_ag_gemm_method(mesh, axis, a, b, dp=dp) != method
-        ):
-            # a persisted winner from another environment (bigger VMEM
-            # budget, non-DCN mesh) may no longer be buildable here; the
-            # heuristic encodes exactly those safety constraints
-            method = auto_ag_gemm_method(mesh, axis, a, b, dp=dp)
+    method = resolve_ag_gemm_method(
+        mesh, axis, a, b, batch_axes=batch_axes, method=method,
+        out_dtype=out_dtype, collective_id=collective_id,
+        return_gathered=return_gathered, dcn_axis=dcn_axis,
+    )
     if method == AGGemmMethod.PALLAS_FUSED:
         fn = _build_fused(
             mesh, axis, batch_axes, a.shape, b.shape, a.dtype, out_dtype,
-            collective_id, interp_key(), return_gathered,
+            collective_id, interp_key(), return_gathered, dcn_axis,
         )
         out, gathered = fn(a, b)
         return (out, gathered) if return_gathered else out
     if method == AGGemmMethod.XLA_RING:
-        fn = _build_xla_ring(mesh, axis, batch_axes, out_dtype)
+        fn = _build_xla_ring(mesh, axis, batch_axes, out_dtype, dcn_axis)
     else:
-        fn = _build_xla_naive(mesh, axis, batch_axes, out_dtype)
+        fn = _build_xla_naive(mesh, axis, batch_axes, out_dtype, dcn_axis)
     out = fn(a, b)
     if return_gathered:
-        return out, _build_gather(mesh, axis, batch_axes)(a)
+        return out, _build_gather(mesh, axis, batch_axes, dcn_axis)(a)
     return out

@@ -72,10 +72,10 @@ def ew_add_pipeline(m, n, itemsize):
     """Tiled elementwise-add pipeline over HBM refs: dst = a + b.
     Blocks stream through VMEM double-buffered; used to fold a received
     ring partial into the locally computed one."""
-    from triton_distributed_tpu.config import on_tpu
+    from triton_distributed_tpu.config import compiling_for_tpu
 
-    bm = _divisor_block(m, 512, 8 * (4 // itemsize), on_tpu())
-    bn = _divisor_block(n, 2048, 128, on_tpu())
+    bm = _divisor_block(m, 512, 8 * (4 // itemsize), compiling_for_tpu())
+    bn = _divisor_block(n, 2048, 128, compiling_for_tpu())
 
     def inner(a_ref, b_ref, o_ref):
         o_ref[...] = a_ref[...] + b_ref[...]
@@ -118,28 +118,41 @@ def _fused_kernel(
     )
 
 
-def _specs(axis, batch_axes):
+def _specs(axis, batch_axes, dcn_axis=None):
     """(in_specs, out_specs) for GEMM-RS under shard_map over the full mesh.
 
     Activation rows may additionally be sharded over ``batch_axes`` (DP);
     the reduce-scatter then runs over ``axis`` within each DP group and the
     output rows end up sharded over (*batch_axes, axis) — the Megatron
-    sequence-parallel layout, the exact inverse of ag_gemm's."""
+    sequence-parallel layout, the exact inverse of ag_gemm's.
+    Hierarchical (``dcn_axis``): the TP factor spans (axis, dcn_axis)
+    axis-MAJOR (matching ag_gemm's hierarchical layout): K cols and
+    output rows sharded P((axis, dcn_axis))."""
     ba = tuple(batch_axes)
-    a_spec = P(ba if ba else None, axis)
-    b_spec = P(axis, None)
-    out_spec = P(ba + (axis,) if ba else axis, None)
+    # a 1-tuple of axis names is equivalent to the bare name for both
+    # PartitionSpec and lax collectives, so no flat/hier branching
+    tp_axes = (axis,) if dcn_axis is None else (axis, dcn_axis)
+    a_spec = P(ba if ba else None, tp_axes)
+    b_spec = P(tp_axes, None)
+    out_spec = P(ba + tp_axes, None)
     return (a_spec, b_spec), out_spec
 
 
 @functools.lru_cache(maxsize=256)
 def _build_fused(
-    mesh, axis, batch_axes, a_shape, b_shape, dtype, out_dtype, collective_id, chaos
+    mesh, axis, batch_axes, a_shape, b_shape, dtype, out_dtype, collective_id,
+    chaos, dcn_axis=None,
 ):
+    """Fused engine. ``dcn_axis`` set = hierarchical (≡ the reference's
+    inter-node GEMM-RS, reduce_scatter.py:524-545): the fused ring
+    reduces intra-slice over ``axis`` (each slice sums its own K
+    stripe), then a ``lax.psum_scatter`` leg crosses DCN — adding the
+    other slices' stripes and scattering rows axis-major."""
     n = mesh.shape[axis]
+    nd = mesh.shape[dcn_axis] if dcn_axis else 1
     dp = mesh_axes_size(mesh, batch_axes)
     m_local = a_shape[0] // (dp * n)
-    k_local = a_shape[1] // n
+    k_local = a_shape[1] // (n * nd)
     n_out = b_shape[1]
     blocks = pick_mm_blocks(
         m_local, k_local, n_out, dtype.itemsize, targets=_RS_TILE_TARGETS
@@ -174,9 +187,19 @@ def _build_fused(
         vmem_limit_bytes=fused_vmem_budget(),
         name="gemm_rs_fused",
     )
-    in_specs, out_specs = _specs(axis, batch_axes)
+    in_specs, out_specs = _specs(axis, batch_axes, dcn_axis)
+
+    def body(a, b):
+        part = call(a, b)[0]
+        if dcn_axis is not None:
+            # DCN leg: sum the per-slice stripes and scatter rows
+            part = jax.lax.psum_scatter(
+                part, dcn_axis, scatter_dimension=0, tiled=True
+            )
+        return part
+
     fn = jax.shard_map(
-        lambda a, b: call(a, b)[0],
+        body,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=out_specs,
@@ -213,27 +236,35 @@ def gemm_rs_device(a_loc, b_loc, axis, *, out_dtype=None):
 
 
 @functools.lru_cache(maxsize=256)
-def _build_xla_ring(mesh, axis, batch_axes, out_dtype):
-    in_specs, out_specs = _specs(axis, batch_axes)
+def _build_xla_ring(mesh, axis, batch_axes, out_dtype, dcn_axis=None):
+    in_specs, out_specs = _specs(axis, batch_axes, dcn_axis)
+
+    def body(a_loc, b_loc):
+        part = gemm_rs_device(a_loc, b_loc, axis, out_dtype=out_dtype)
+        if dcn_axis is not None:
+            part = jax.lax.psum_scatter(
+                part, dcn_axis, scatter_dimension=0, tiled=True
+            )
+        return part
+
     fn = jax.shard_map(
-        functools.partial(gemm_rs_device, axis=axis, out_dtype=out_dtype),
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=out_specs,
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=256)
-def _build_xla_naive(mesh, axis, batch_axes, out_dtype):
+def _build_xla_naive(mesh, axis, batch_axes, out_dtype, dcn_axis=None):
+    tp_axes = (axis,) if dcn_axis is None else (axis, dcn_axis)
+
     def body(a_loc, b_loc):
         full = jnp.dot(a_loc, b_loc, preferred_element_type=jnp.float32).astype(
             out_dtype
         )
-        return jax.lax.psum_scatter(full, axis, scatter_dimension=0, tiled=True)
+        return jax.lax.psum_scatter(full, tp_axes, scatter_dimension=0, tiled=True)
 
-    in_specs, out_specs = _specs(axis, batch_axes)
+    in_specs, out_specs = _specs(axis, batch_axes, dcn_axis)
     fn = jax.shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
     )
@@ -241,7 +272,8 @@ def _build_xla_naive(mesh, axis, batch_axes, out_dtype):
 
 
 @functools.lru_cache(maxsize=64)
-def _engine_tuner(mesh, axis, batch_axes, out_dtype, collective_id):
+def _engine_tuner(mesh, axis, batch_axes, out_dtype, collective_id,
+                  dcn_axis=None):
     """Measured engine selection for ``method=None`` (see
     ag_gemm._engine_tuner for the contract incl. why out_dtype and
     collective_id belong in the name/key)."""
@@ -251,40 +283,79 @@ def _engine_tuner(mesh, axis, batch_axes, out_dtype, collective_id):
         return gemm_rs(
             a, b, mesh, axis, batch_axes=batch_axes,
             method=GemmRSMethod(method), out_dtype=out_dtype,
-            collective_id=collective_id,
+            collective_id=collective_id, dcn_axis=dcn_axis,
         )
 
     return method_tuner(
-        f"gemm_rs[{dict(mesh.shape)}|{axis}|{batch_axes}|{out_dtype}|{collective_id}]",
+        f"gemm_rs[{dict(mesh.shape)}|{axis}|{batch_axes}|{out_dtype}|"
+        f"{collective_id}|{dcn_axis}]",
         run, GemmRSMethod,
     )
 
 
-def auto_gemm_rs_method(mesh, axis, a, b, dp: int = 1) -> GemmRSMethod:
+def auto_gemm_rs_method(mesh, axis, a, b, dp: int = 1,
+                        dcn_axis: str | None = None) -> GemmRSMethod:
     """Topology + shape blockability decide the engine; fallbacks are
-    logged (nobody should benchmark XLA believing it is the fused kernel)."""
+    logged (nobody should benchmark XLA believing it is the fused kernel).
+    A cross-slice TP factor declared as ``dcn_axis`` keeps the fused
+    engine intra-slice; only ``axis`` itself crossing DCN forces XLA."""
     n = mesh.shape[axis]
+    nd = mesh.shape[dcn_axis] if dcn_axis else 1
     topo = detect_topology(mesh, axis)
     if topo.link_kind == LinkKind.DCN:
         _warn_once(
             ("gemm_rs", "dcn", axis),
-            f"gemm_rs: axis {axis!r} crosses DCN; using XLA_RING engine",
+            f"gemm_rs: axis {axis!r} crosses DCN; using XLA_RING engine "
+            "(pass the cross-slice factor as dcn_axis= to keep the fused "
+            "engine intra-slice)",
         )
         return GemmRSMethod.XLA_RING
     m_local = a.shape[0] // (dp * n)
     blocks = pick_mm_blocks(
-        m_local, a.shape[1] // n, b.shape[1], a.dtype.itemsize,
+        m_local, a.shape[1] // (n * nd), b.shape[1], a.dtype.itemsize,
         targets=_RS_TILE_TARGETS,
     )
     if blocks is None:
         _warn_once(
             ("gemm_rs", "blocks", a.shape, b.shape),
-            f"gemm_rs: shard ({m_local}, {a.shape[1] // n}) @ "
-            f"({a.shape[1] // n}, {b.shape[1]}) admits no divisor blocking; "
-            "falling back to XLA_RING",
+            f"gemm_rs: shard ({m_local}, {a.shape[1] // (n * nd)}) @ "
+            f"({a.shape[1] // (n * nd)}, {b.shape[1]}) admits no divisor "
+            "blocking; falling back to XLA_RING",
         )
         return GemmRSMethod.XLA_RING
     return GemmRSMethod.PALLAS_FUSED
+
+
+def resolve_gemm_rs_method(
+    a_mesh, axis, a, b, *, batch_axes=(), method=None, out_dtype=None,
+    collective_id: int = 6, dcn_axis: str | None = None,
+) -> GemmRSMethod:
+    """The engine :func:`gemm_rs` will ACTUALLY run for these arguments
+    (mirror of ag_gemm.resolve_ag_gemm_method): explicit ``method``,
+    else the tuned winner, else the heuristic — with the safety recheck
+    demoting a fused winner that is not buildable in this environment."""
+    if method is not None:
+        return method
+    from triton_distributed_tpu.tune.autotuner import tuned_method_or_none
+
+    batch_axes = tuple(batch_axes)
+    dp = mesh_axes_size(a_mesh, batch_axes)
+    out_dtype = out_dtype or a.dtype
+    m = tuned_method_or_none(
+        lambda: _engine_tuner(
+            a_mesh, axis, batch_axes, jnp.dtype(out_dtype), collective_id,
+            dcn_axis,
+        ),
+        a, b,
+    )
+    auto = functools.partial(
+        auto_gemm_rs_method, a_mesh, axis, a, b, dp=dp, dcn_axis=dcn_axis
+    )
+    method = GemmRSMethod(m) if m else auto()
+    if method == GemmRSMethod.PALLAS_FUSED and auto() != method:
+        # persisted winner may not be buildable in this environment
+        method = auto()
+    return method
 
 
 def gemm_rs(
@@ -297,6 +368,7 @@ def gemm_rs(
     method: GemmRSMethod | None = None,
     out_dtype=None,
     collective_id: int = 6,
+    dcn_axis: str | None = None,
 ):
     """Fused (A @ B) → ReduceScatter for row-parallel TP.
 
@@ -306,41 +378,37 @@ def gemm_rs(
     over ``(*batch_axes, axis)``: within each DP group device i owns
     fully-reduced row shard i (sequence-parallel layout).
 
+    ``dcn_axis``: hierarchical TP spanning slices (≡ the reference's
+    inter-node GEMM-RS, reduce_scatter.py:524-545): K cols and output
+    rows sharded P((axis, dcn_axis)) axis-major; the fused Pallas ring
+    reduces intra-slice, a psum_scatter leg crosses DCN.
+
     Host entry ≡ reference ``gemm_rs`` (gemm_reduce_scatter.py:547).
     """
     n = mesh.shape[axis]
+    nd = mesh.shape[dcn_axis] if dcn_axis else 1
     batch_axes = tuple(batch_axes)
     dp = mesh_axes_size(mesh, batch_axes)
     out_dtype = out_dtype or a.dtype
-    assert a.shape[0] % (dp * n) == 0 and a.shape[1] % n == 0 and b.shape[0] % n == 0
+    assert (
+        a.shape[0] % (dp * n * nd) == 0
+        and a.shape[1] % (n * nd) == 0
+        and b.shape[0] % (n * nd) == 0
+    )
     assert a.shape[1] == b.shape[0], f"contract dim mismatch {a.shape} @ {b.shape}"
-    if n == 1:
+    if n * nd == 1:
         return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
-    if method is None:
-        from triton_distributed_tpu.tune.autotuner import tuned_method_or_none
-
-        m = tuned_method_or_none(
-            lambda: _engine_tuner(
-                mesh, axis, batch_axes, jnp.dtype(out_dtype), collective_id
-            ),
-            a, b,
-        )
-        method = (
-            GemmRSMethod(m) if m else auto_gemm_rs_method(mesh, axis, a, b, dp=dp)
-        )
-        if (
-            method == GemmRSMethod.PALLAS_FUSED
-            and auto_gemm_rs_method(mesh, axis, a, b, dp=dp) != method
-        ):
-            # persisted winner may not be buildable in this environment
-            method = auto_gemm_rs_method(mesh, axis, a, b, dp=dp)
+    method = resolve_gemm_rs_method(
+        mesh, axis, a, b, batch_axes=batch_axes, method=method,
+        out_dtype=out_dtype, collective_id=collective_id, dcn_axis=dcn_axis,
+    )
     if method == GemmRSMethod.PALLAS_FUSED:
         fn = _build_fused(
             mesh, axis, batch_axes, a.shape, b.shape, a.dtype, out_dtype,
-            collective_id, interp_key(),
+            collective_id, interp_key(), dcn_axis,
         )
     elif method == GemmRSMethod.XLA_RING:
-        fn = _build_xla_ring(mesh, axis, batch_axes, out_dtype)
+        fn = _build_xla_ring(mesh, axis, batch_axes, out_dtype, dcn_axis)
     else:
-        fn = _build_xla_naive(mesh, axis, batch_axes, out_dtype)
+        fn = _build_xla_naive(mesh, axis, batch_axes, out_dtype, dcn_axis)
     return fn(a, b)
