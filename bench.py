@@ -156,16 +156,62 @@ def main() -> None:
     tflops_per_chip = flops / t_fused / n / 1e12
     tflops_naive = flops / t_naive / n / 1e12
     mfu = tflops_per_chip / spec.bf16_tflops
-    # Overlap: per ring step the fused kernel hides ONE shard transfer
-    # (m/tp·k bytes, unidirectional, one ICI link) under ONE shard matmul
-    # (1/tp of the whole per-chip job). Measured job time / ring length
-    # gives the per-step compute; n=1 projects the TP8 ring from the same
-    # per-chip work.
-    ring = n if n > 1 else tp
-    compute_step_ms = t_fused / ring * 1e3
-    shard_bytes = (m // ring) * k * jnp.dtype(dtype).itemsize
-    comm_step_ms = shard_bytes / (spec.ici_gbps * 1e9) * 1e3
-    overlap = overlap_efficiency(compute_step_ms, comm_step_ms)
+    if n > 1:
+        # MEASURED overlap (VERDICT r2 #7): fused vs compute-only vs
+        # comm-only on the same shapes, same methodology —
+        # (t_comm + t_compute - t_fused) / t_comm is the fraction of the
+        # comm time the fused engine actually hid.
+        compute_only = jax.jit(
+            jax.shard_map(
+                lambda af, bl: jnp.dot(af, bl, preferred_element_type=jnp.float32).astype(dtype),
+                mesh=mesh, in_specs=(P(None, None), P(None, "x")),
+                out_specs=P(None, "x"), check_vma=False,
+            )
+        )
+        comm_only = jax.jit(
+            jax.shard_map(
+                lambda al: jax.lax.all_gather(al, "x", tiled=True),
+                mesh=mesh, in_specs=P("x", None), out_specs=P(None, None),
+                check_vma=False,
+            )
+        )
+        a_rep = jax.device_put(
+            jax.random.normal(key, (m, k), dtype), NamedSharding(mesh, P(None, None))
+        )
+
+        def compute_step(state, s):
+            af, bl = state
+            out = compute_only(af, bl)
+            s = s + jnp.sum(out.astype(jnp.float32))
+            return (perturb(af, s), bl), s
+
+        def comm_step(state, s):
+            al = state
+            out = comm_only(al)
+            s = s + jnp.sum(out.astype(jnp.float32))
+            return perturb(al, s), s
+
+        t_compute = bench_loop(compute_step, (a_rep, b), lo=lo, hi=hi)
+        t_comm = bench_loop(comm_step, a, lo=lo, hi=hi)
+        # a comm leg within noise of zero cannot anchor the ratio — say
+        # so instead of reporting a clamped artifact as "measured"
+        if t_comm > 0.05 * t_fused:
+            overlap = max(0.0, min(1.0, (t_comm + t_compute - t_fused) / t_comm))
+            overlap_kind = "measured"
+        else:
+            overlap = 0.0
+            overlap_kind = "comm_below_noise_floor"
+    else:
+        # n=1: no comm exists to measure — project the TP8 ring
+        # analytically from the measured per-chip compute. Per ring step
+        # the fused kernel hides ONE shard transfer (m/tp·k bytes,
+        # unidirectional, one ICI link) under ONE shard matmul (1/tp of
+        # the whole per-chip job).
+        compute_step_ms = t_fused / tp * 1e3
+        shard_bytes = (m // tp) * k * jnp.dtype(dtype).itemsize
+        comm_step_ms = shard_bytes / (spec.ici_gbps * 1e9) * 1e3
+        overlap = overlap_efficiency(compute_step_ms, comm_step_ms)
+        overlap_kind = "projected_tp8"
 
     print(
         json.dumps(
@@ -183,7 +229,7 @@ def main() -> None:
                 "n_chips": n,
                 "mfu": round(mfu, 4),
                 "overlap_pct": round(100 * overlap, 1),
-                "overlap_kind": "measured" if n > 1 else "projected_tp8",
+                "overlap_kind": overlap_kind,
                 "config": f"M={m} K={k} N={nn} bf16 fused-streaming",
             }
         ),
