@@ -319,74 +319,73 @@ def _bench_group_gemm(mesh, n, on_tpu, spec):
 
 def _bench_moe_a2a(mesh, n, on_tpu, spec):
     """MoE dispatch leg on the reference's headline config (128 tok/rank,
-    topk 8, hidden 7168 — README.md:87). With one chip the ring has no
-    wire to cross; what is measured (and labeled) is the full dispatch
-    machinery — expert-sort staging, slot packing, the compiled transport
-    kernel, unpacking — i.e. the non-wire part of the latency."""
+    topk 8, hidden 7168 — README.md:87), through the FUSED window-DMA
+    transport (kernels/moe_dispatch): one aligned staging pass over the
+    true M·topk rows + per-peer window DMAs, replacing the padded-slot
+    machinery whose n·max_m-row staging dominated BENCH_r02's 199 µs.
+    With one chip there is no wire to cross; what is measured (and
+    labeled) is the full dispatch machinery — aligned staging, quantize/
+    bitcast, the compiled window-DMA kernel, receive unpack."""
     from triton_distributed_tpu.kernels import moe_all_to_all as ma
-    from triton_distributed_tpu.kernels.all_to_all import _build_a2a_call
+    from triton_distributed_tpu.kernels import moe_dispatch as md
 
     epr, hidden, tok, topk = (8, 7168, 128, 8) if on_tpu else (2, 256, 16, 2)
     max_m = tok * topk
-    # fp8 wire with in-slot per-token scales — the reference's headline
-    # config is fp8 (README.md:87)
+    # fp8 wire with in-row per-token scales — the reference's headline
+    # config is fp8 WITH_SCALE (README.md:87)
     ctx = ma.create_all_to_all_context(
         mesh, "x", max_m=max_m, hidden=hidden,
         experts_per_rank=epr, dtype=jnp.bfloat16, quant="fp8",
     )
-    # Force the Pallas transport even at n=1 (all_to_all() itself
-    # shortcuts to identity there, which round 1 mis-measured as latency).
-    call = _build_a2a_call(
-        mesh.axis_names, "x", n,
-        (n * ctx.slot_rows, ctx.ints_per_row), jnp.dtype(jnp.int32), 10,
-    )
-    transport = jax.jit(
-        jax.shard_map(call, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
-                      check_vma=False)
-    )
-    toks = jax.device_put(
-        jax.random.normal(
-            jax.random.PRNGKey(5), (n * max_m, hidden), jnp.bfloat16
-        ),
+    rng = np.random.default_rng(5)
+    sorted_e = np.sort(
+        rng.integers(0, ctx.num_experts, (n, max_m)), axis=1
+    ).astype(np.int32)
+    splits_np = np.stack(
+        [np.bincount(a, minlength=ctx.num_experts) for a in sorted_e]
+    ).astype(np.int32)
+    x = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(5), (n * max_m, hidden), jnp.bfloat16),
         NamedSharding(mesh, P("x")),
     )
-    splits = jax.device_put(
-        jnp.tile(
-            jnp.full((ctx.num_experts,), max_m // ctx.num_experts, jnp.int32),
-            (n, 1),
-        ).reshape(n, ctx.num_experts),
-        NamedSharding(mesh, P("x")),
-    )
+    se = jax.device_put(jnp.asarray(sorted_e).reshape(-1), NamedSharding(mesh, P("x")))
+    splits = jax.device_put(jnp.asarray(splits_np), NamedSharding(mesh, P("x")))
 
-    stage = jax.jit(
-        jax.shard_map(
-            lambda t, sp: ma.pack_slots(ctx, *ma.dispatch_stage(ctx, t, sp[0])),
-            mesh=mesh, in_specs=(P("x"), P("x")), out_specs=P("x"),
-            check_vma=False,
+    def device_leg(x_loc, se_loc, spl_loc):
+        spl_loc = spl_loc.reshape(-1)
+        counts, offs, offs_al, offs_w = md.aligned_offsets(ctx, spl_loc)
+        peer, dest = md.assignment_dest(ctx, se_loc, offs, offs_al)
+        payload, scales = md.stage_aligned(
+            ctx, x_loc, jnp.arange(x_loc.shape[0], dtype=jnp.int32), dest,
+            x_loc.shape[0],
         )
-    )
-    unview = jax.jit(
+        meta = md.meta_payload(ctx, spl_loc, scales, offs_al, offs_w)
+        recv_tok, recv_meta = md.dispatch_device(ctx, payload, offs_w, meta)
+        toks, rspl, shift = md.recv_view(ctx, recv_tok, recv_meta)
+        return toks.reshape(n * md.max_pad(ctx), hidden)
+
+    leg = jax.jit(
         jax.shard_map(
-            lambda r: ma.recv_tokens_view(ctx, r)[0],
-            mesh=mesh, in_specs=P("x"), out_specs=P("x"), check_vma=False,
+            device_leg, mesh=mesh, in_specs=(P("x"), P("x"), P("x")),
+            out_specs=P("x"), check_vma=False,
         )
     )
 
     def step(state, s):
-        toks = state
-        recv = transport(stage(toks, splits))
-        out = unview(recv)
+        x = state
+        out = leg(x, se, splits)
         s = s + jnp.sum(out.astype(jnp.float32))
-        return perturb(toks, s), s
+        return perturb(x, s), s
 
     lo, hi = (16, 400) if on_tpu else (1, 3)
-    t = bench_loop(step, toks, lo=lo, hi=hi)
+    t = bench_loop(step, x, lo=lo, hi=hi)
     return {
         "metric": "moe_a2a_dispatch_latency",
         "value": round(t * 1e6, 1),
         "unit": "us",
         "config": (
             f"n={n} tok/rank={tok} topk={topk} hidden={hidden} fp8+scales "
+            "fused-window-dma "
             + ("self-transport(no wire)" if n == 1 else "ring")
         ),
     }

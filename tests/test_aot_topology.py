@@ -209,6 +209,38 @@ class TestCollectiveFamilies:
             _sds(tmesh, (e, fl_local * n, h), jnp.bfloat16, None, "x", None),
         )
 
+    def test_fused_moe_dispatch(self, tmesh):
+        from triton_distributed_tpu.kernels import moe_all_to_all as ma
+        from triton_distributed_tpu.kernels import moe_dispatch as md
+
+        ctx = ma.create_all_to_all_context(
+            tmesh, "x", max_m=256, hidden=512, experts_per_rank=2,
+            dtype=jnp.bfloat16, quant="fp8",
+        )
+        call = md._build_window_a2a_call(
+            tmesh.axis_names, "x", 8, md.align(ctx), md.max_pad(ctx),
+            md.meta_rows(ctx), md.m_cap(ctx), ctx.hidden, ctx.wire_dtype,
+            10, interp_key(),
+        )
+        fn = jax.jit(
+            jax.shard_map(
+                call, mesh=tmesh,
+                in_specs=(P("x"), P("x"), P("x")),
+                out_specs=(P("x"), P("x")),
+                check_vma=False,
+            )
+        )
+        _assert_compiles(
+            fn,
+            _sds(tmesh, (8 * 8,), jnp.int32, "x"),
+            _sds(tmesh, (8 * md.m_cap(ctx), ctx.hidden), ctx.wire_dtype, "x"),
+            _sds(
+                tmesh,
+                (8 * 8 * md.meta_rows(ctx), md.META_W),
+                jnp.int32, "x",
+            ),
+        )
+
     def test_flash_decode_sp(self, tmesh):
         """SP decode: the per-device split-kv kernel + combine compiled
         over the sequence-sharded mesh (the serving hot path)."""

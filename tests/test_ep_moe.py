@@ -38,7 +38,7 @@ def _put(mesh, *arrays):
     return tuple(jax.device_put(a, sh) for a in arrays)
 
 
-@pytest.mark.parametrize("transport", ["xla", "pallas"])
+@pytest.mark.parametrize("transport", ["xla", "pallas", "fused"])
 @pytest.mark.parametrize("use_pallas_gemm", [True, False])
 def test_forward_vs_dense(mesh8, transport, use_pallas_gemm):
     x, logits, w_up, w_down = _data()
@@ -52,6 +52,32 @@ def test_forward_vs_dense(mesh8, transport, use_pallas_gemm):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5
     )
+
+
+def test_fused_quant_vs_dense(mesh8):
+    """Fused window-DMA transport with the fp8 in-row scale lane (the
+    reference's headline WITH_SCALE dispatch) vs the dense reference."""
+    x, logits, w_up, w_down = _data()
+    ref = _dense_ref(x, logits, w_up, w_down)
+    ctx = create_ep_moe_context(
+        mesh8, "x", num_experts=E, topk=TOPK, max_m=MTOK * TOPK, hidden=H,
+        dtype=jnp.float32, transport="fused", quant="fp8", block_m=8,
+        use_pallas_gemm=False,
+    )
+    out = ep_moe(*_put(mesh8, x, logits, w_up, w_down), ctx)
+    err = np.abs(np.asarray(out) - np.asarray(ref))
+    assert np.max(err) < 0.08 * np.abs(np.asarray(ref)).max()
+
+
+def test_fused_rejects_hierarchical():
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("dcn", "ep"))
+    with pytest.raises(ValueError, match="flat"):
+        create_ep_moe_context(
+            mesh, "ep", dcn_axis="dcn", num_experts=E, topk=TOPK,
+            max_m=MTOK * TOPK, hidden=H, transport="fused",
+        )
 
 
 def test_grads_match_dense(mesh8):
@@ -203,6 +229,69 @@ class TestHierarchical:
             max_m=MTOK * TOPK, hidden=H, transport="pallas",
         )
         assert ctx.dcn == 2
+
+
+class TestRailDedup:
+    """The DCN rail ships each token ONCE per target slice (VERDICT r2
+    #5; ≡ the reference's once-per-node put + intra-node scatter,
+    ep_a2a.py:74-80): DCN payload scales with unique (token, slice)
+    pairs, never with topk duplicates."""
+
+    def test_rail_bytes_scale_with_unique_tokens(self, mesh8):
+        """All topk experts of every token on ONE remote slice: the rail
+        must carry exactly M unique rows for that slice — not M·topk —
+        and the rail slot capacity itself is M rows per slice."""
+        from triton_distributed_tpu.ops.moe import _rail_stage
+
+        mesh_dcn = jax.sharding.Mesh(
+            np.asarray(jax.devices()).reshape(2, 4), ("dcn", "ep")
+        )
+        ctx = create_ep_moe_context(
+            mesh_dcn, "ep", dcn_axis="dcn", num_experts=E, topk=TOPK,
+            max_m=MTOK * TOPK, hidden=H, dtype=jnp.float32,
+        )
+        m = MTOK
+        x = jax.random.normal(jax.random.PRNGKey(0), (m, H))
+        slice1 = E // 2  # experts [E/2, E) live on slice 1
+        ids = jnp.stack(
+            [jnp.full((m,), slice1, jnp.int32),
+             jnp.full((m,), slice1 + 1, jnp.int32)], axis=1,
+        )
+        weights = jnp.full((m, TOPK), 0.5, jnp.float32)
+        tok, ids_s, w_s, hit, u_counts = _rail_stage(ctx, x, ids, weights)
+        # capacity: M rows per slice — independent of topk
+        assert tok.shape == (2, m, H)
+        # every token hits slice 1 exactly once despite topk=2 experts
+        np.testing.assert_array_equal(np.asarray(u_counts), [0, m])
+        np.testing.assert_array_equal(
+            np.asarray(hit).sum(), m  # M unique pairs, not M·topk
+        )
+
+    def test_hier_dedup_matches_flat(self, mesh8):
+        """The dedup'd hierarchical exchange must still equal the flat
+        8-rank exchange on identical data (all transports)."""
+        mesh_dcn = jax.sharding.Mesh(
+            np.asarray(jax.devices()).reshape(2, 4), ("dcn", "ep")
+        )
+        x, logits, w_up, w_down = _data()
+        flat_ctx = create_ep_moe_context(
+            mesh8, "x", num_experts=E, topk=TOPK, max_m=MTOK * TOPK,
+            hidden=H, dtype=jnp.float32, transport="xla", block_m=8,
+            use_pallas_gemm=False,
+        )
+        flat = ep_moe(*_put(mesh8, x, logits, w_up, w_down), flat_ctx)
+        ctx = create_ep_moe_context(
+            mesh_dcn, "ep", dcn_axis="dcn", num_experts=E, topk=TOPK,
+            max_m=MTOK * TOPK, hidden=H, dtype=jnp.float32,
+            transport="xla", block_m=8, use_pallas_gemm=False,
+        )
+        sh = NamedSharding(mesh_dcn, P(("dcn", "ep")))
+        hier = ep_moe(
+            *(jax.device_put(a, sh) for a in (x, logits, w_up, w_down)), ctx
+        )
+        np.testing.assert_allclose(
+            np.asarray(hier), np.asarray(flat), atol=1e-5, rtol=1e-5
+        )
 
 
 class TestQuantizedTransport:

@@ -8,12 +8,15 @@ allgather_group_gemm.py:420 / moe_reduce_rs.py:362; routing ≡
 select_experts (moe_reduce_rs.py:180).
 
 TPU re-design: one ``shard_map`` body does route → expert-sort →
-dispatch (padded-slot a2a) → local grouped GEMM MLP over the owned
-experts → return a2a → weighted combine. Two transports:
+dispatch → local grouped GEMM MLP over the owned experts → return a2a →
+weighted combine. Three transports:
 
-* ``transport="pallas"``: the in-kernel remote-DMA a2a
-  (kernels/all_to_all.all_to_all_device) — the low-latency inference
-  path.
+* ``transport="fused"`` (flat-mesh default): in-kernel per-peer window
+  DMAs straight from the aligned expert-sorted payload
+  (kernels/moe_dispatch) — the low-latency inference path.
+* ``transport="pallas"``: staged padded-slot in-kernel a2a
+  (kernels/all_to_all.all_to_all_device) — the hierarchical-capable
+  transport (default when ``dcn_axis`` is set).
 * ``transport="xla"``: ``lax.all_to_all`` — differentiable end-to-end
   (sort/gather/scatter/topk-softmax all have transpose rules), which is
   what makes EP *training* possible; the reference is inference-only.
@@ -49,7 +52,17 @@ class EPMoEContext:
     hidden: int
     dtype: jnp.dtype = jnp.bfloat16
     activation: str = "silu"        # silu | gelu | none
-    transport: str = "pallas"       # pallas | xla
+    # "fused": in-kernel per-peer window DMAs straight from the aligned
+    #   expert-sorted payload — the low-latency inference path
+    #   (kernels/moe_dispatch, ≡ the reference's on-device range
+    #   computation, low_latency_all_to_all.py:36-80). Flat meshes only;
+    #   requires max_m ≥ M·topk (the worst-case total, the standard
+    #   sizing).
+    # "pallas": staged padded-slot a2a (kernels/moe_all_to_all) — the
+    #   hierarchical-capable in-kernel transport.
+    # "xla": lax.all_to_all — differentiable end to end (training).
+    # None (default): "fused" on flat meshes, "pallas" hierarchical.
+    transport: str | None = None    # fused | pallas | xla
     block_m: int = 128
     use_pallas_gemm: bool = True
     collective_id: int = 10
@@ -60,9 +73,10 @@ class EPMoEContext:
     # same-local-rank rail puts). None → flat single-slice exchange.
     dcn_axis: str | None = None
     # Quantized token transport ("fp8" | "int8"): tokens ride the a2a at
-    # 1 byte/elem with per-token scales packed in-slot (≡ the reference's
-    # headline fp8 WITH_SCALE dispatch). Pallas transport only — the XLA
-    # transport is the differentiable path and stays full-precision.
+    # 1 byte/elem with per-token scales in the wire metadata (≡ the
+    # reference's headline fp8 WITH_SCALE dispatch). Carried by the
+    # "fused" and "pallas" transports; the XLA transport is the
+    # differentiable path and stays full-precision.
     quant: str | None = None
 
     @property
@@ -110,14 +124,23 @@ def create_ep_moe_context(
         mesh=mesh, axis=axis, num_experts=num_experts, topk=topk,
         max_m=max_m, hidden=hidden, **kw,
     )
+    if ctx.transport is None:
+        ctx = replace(
+            ctx, transport="pallas" if ctx.dcn_axis is not None else "fused"
+        )
     assert num_experts % ctx.n == 0, f"{num_experts} experts over {ctx.n} ranks"
     ctx.a2a  # fail fast on bad quant/hidden geometry, not at trace time
-    if ctx.quant is not None and ctx.transport != "pallas":
+    if ctx.quant is not None and ctx.transport == "xla":
         raise ValueError(
             "quantized transport rides the Pallas slot payload; the XLA "
             "transport is the differentiable full-precision path"
         )
-    if ctx.transport == "pallas":
+    if ctx.transport == "fused" and ctx.dcn_axis is not None:
+        raise ValueError(
+            "the fused window-DMA transport is flat (single-slice) only; "
+            "use transport='pallas' for the hierarchical exchange"
+        )
+    if ctx.transport in ("pallas", "fused"):
         # Pallas remote DMA cannot cross DCN: a multi-slice EP axis must
         # be declared as dcn_axis so the exchange takes the hierarchical
         # rail path (≡ the reference's CommScope INTER_NODE dispatch).
@@ -145,43 +168,18 @@ def _act(name: str, x):
 
 
 def _a2a(ctx: EPMoEContext, x):
-    """Transpose the leading (n, ...) slot dim across EP ranks.
-
-    Flat: one exchange over ``ctx.axis``. Hierarchical (``dcn_axis``
-    set): a DCN rail leg — ``lax.all_to_all`` over the slice axis, which
-    by mesh construction only connects devices with the SAME local rank
-    (the reference's same-local-rank put, ep_a2a.py:70-78) — followed by
-    an intra-slice ICI leg (Pallas remote-DMA a2a or lax). Both legs are
-    self-inverse, so dispatch and combine use the same function.
-    """
-    if ctx.dcn_axis is None:
-        if ctx.transport == "pallas":
-            flat = x.reshape(ctx.n * x.shape[1], -1)
-            out = all_to_all_device(
-                flat, ctx.n, ctx.axis, ctx.mesh.axis_names,
-                collective_id=ctx.collective_id,
-            )
-            return out.reshape(x.shape)
-        return jax.lax.all_to_all(x, ctx.axis, 0, 0, tiled=False)
-
-    dcn, epl = ctx.dcn, ctx.epl
-    rest = x.shape[1:]
-    y = x.reshape(dcn, epl, *rest)
-    # DCN rail leg: slots for target slice d ride to (d, my_local).
-    y = jax.lax.all_to_all(y, ctx.dcn_axis, 0, 0, tiled=False)
-    y = jnp.swapaxes(y, 0, 1)                       # (local_dst, slice_src, ...)
-    # ICI leg: deliver each slot to its final local rank within my slice.
+    """Transpose the leading (n, ...) slot dim across EP ranks — the
+    FLAT exchange over ``ctx.axis`` (hierarchical meshes never reach
+    here: ``_ep_moe_hier_device`` decomposes into a dedup'd DCN rail +
+    a flat intra-slice exchange before any slot staging happens)."""
     if ctx.transport == "pallas":
-        flat = y.reshape(epl * dcn * rest[0], -1)
+        flat = x.reshape(ctx.n * x.shape[1], -1)
         out = all_to_all_device(
-            flat, epl, ctx.axis, ctx.mesh.axis_names,
+            flat, ctx.n, ctx.axis, ctx.mesh.axis_names,
             collective_id=ctx.collective_id,
         )
-        y = out.reshape(epl, dcn, *rest)            # (local_src, slice_src, ...)
-    else:
-        y = jax.lax.all_to_all(y, ctx.axis, 0, 0, tiled=False)
-    # back to global-rank-major (slice·epl + local)
-    return jnp.swapaxes(y, 0, 1).reshape(ctx.n, *rest)
+        return out.reshape(x.shape)
+    return jax.lax.all_to_all(x, ctx.axis, 0, 0, tiled=False)
 
 
 def _dispatch(ctx: EPMoEContext, x_sorted, splits):
@@ -254,39 +252,207 @@ def _expert_mlp(ctx: EPMoEContext, rows, eid, valid, w_up, w_down):
     return out.at[dest].set(y)[:r]
 
 
+def _slot_tables(ctx: EPMoEContext, rspl, slot_m: int, shift=None):
+    """(eid, valid) for (n, slot_m) receive slots from clamped counts.
+    ``shift`` (n,): per-slot row offset of the segment inside the window
+    (fused transport under extreme skew; None → 0)."""
+    pos = jnp.arange(slot_m, dtype=jnp.int32)
+    cum = jnp.cumsum(rspl, axis=1)                     # (n, epr)
+    rel = pos[None, :] - (
+        jnp.zeros((rspl.shape[0], 1), jnp.int32) if shift is None
+        else shift[:, None]
+    )
+    eid = jax.vmap(
+        lambda c, r: jnp.searchsorted(c, r, side="right")
+    )(cum, rel)
+    eid = jnp.clip(eid, 0, ctx.experts_per_rank - 1).reshape(-1)
+    valid = ((rel >= 0) & (rel < cum[:, -1][:, None])).reshape(-1)
+    return eid, valid
+
+
+def _ep_assignments_device(ctx: EPMoEContext, x, flat_e, w_flat, out_rows,
+                           w_up, w_down):
+    """Dispatch pre-routed assignments → grouped MLP → combine →
+    weighted scatter, on a FLAT exchange over ``ctx.axis``.
+
+    x: (R, H) token rows; flat_e: (T,) exchange-local expert id per
+    assignment (T = R·topk; the SENTINEL ``ctx.num_experts`` marks a
+    masked assignment — sorted to the tail, never shipped); w_flat:
+    (T,) f32 combine weights, exactly 0 for masked assignments.
+    Returns (out_rows, H) f32 weighted sums (out_rows == R).
+    """
+    total = flat_e.shape[0]
+    order = jnp.argsort(flat_e, stable=True).astype(jnp.int32)
+    valid_a = flat_e < ctx.num_experts
+    n_valid = jnp.sum(valid_a.astype(jnp.int32))
+    splits = jnp.zeros((ctx.num_experts,), jnp.int32).at[
+        jnp.clip(flat_e, 0, ctx.num_experts - 1)
+    ].add(valid_a.astype(jnp.int32))
+
+    if ctx.transport == "fused":
+        from triton_distributed_tpu.kernels import moe_dispatch as md
+
+        a2a = ctx.a2a
+        assert a2a.max_m >= total, (
+            f"fused transport: max_m={a2a.max_m} < T={total} — the "
+            "aligned payload must hold every assignment"
+        )
+        # single staging pass: gather straight from x into the aligned
+        # per-peer segments (no x_sorted materialization, no slot
+        # inflation — the reference's on-device range computation)
+        counts, offs, offs_al, offs_w = md.aligned_offsets(a2a, splits)
+        peer, dest = md.assignment_dest(a2a, flat_e[order], offs, offs_al)
+        payload, scales = md.stage_aligned(
+            a2a, x, order // ctx.topk, dest, n_valid
+        )
+        meta = md.meta_payload(a2a, splits, scales, offs_al, offs_w)
+        recv_tok, recv_meta = md.dispatch_device(a2a, payload, offs_w, meta)
+        toks, rspl, shift = md.recv_view(a2a, recv_tok, recv_meta)
+
+        slot_m = md.max_pad(a2a)
+        eid, valid = _slot_tables(ctx, rspl, slot_m, shift)
+        y = _expert_mlp(
+            ctx, toks.reshape(ctx.n * slot_m, ctx.hidden), eid, valid,
+            w_up, w_down,
+        )
+        # return leg: slot-regular — the same window kernel with static
+        # slot offsets carries it back
+        y_tok, y_meta = md.stage_return(
+            a2a, y.reshape(ctx.n, slot_m, ctx.hidden)
+        )
+        comb_tok, comb_meta = md.combine_device(a2a, y_tok, y_meta)
+        y_sorted = md.combine_view(
+            a2a, comb_tok, comb_meta, peer, dest, offs_w, n_valid
+        )
+    else:
+        x_sorted = x[order // ctx.topk].astype(ctx.dtype)
+        # dispatch: tokens to the ranks owning their experts
+        toks, rspl = _dispatch(ctx, x_sorted, splits)  # (n,max_m,H),(n,epr)
+        eid, valid = _slot_tables(ctx, rspl, ctx.max_m)
+        y = _expert_mlp(
+            ctx, toks.reshape(ctx.n * ctx.max_m, ctx.hidden), eid, valid,
+            w_up, w_down,
+        )
+        # combine: processed tokens back to their owners
+        y_sorted = _combine(
+            ctx, y.reshape(ctx.n, ctx.max_m, ctx.hidden), splits, total
+        )
+
+    w_sorted = w_flat[order]
+    # masked assignments carry weight exactly 0, but their y rows may be
+    # garbage (untransported window slack) — zero them before the MAC so
+    # a stray inf/nan cannot poison the sum
+    y_use = jnp.where(
+        (w_sorted != 0)[:, None], y_sorted.astype(jnp.float32), 0.0
+    )
+    out = jnp.zeros((out_rows, ctx.hidden), jnp.float32)
+    return out.at[order // ctx.topk].add(y_use * w_sorted[:, None])
+
+
+def _rail_stage(ctx: EPMoEContext, x, ids, weights):
+    """Dedup rail staging: ONE row per unique (token, target-slice) pair.
+
+    Returns (tok_slot (dcn, M, H), ids_slot (dcn, M, topk) [-1 pad],
+    w_slot (dcn, M, topk) [0 pad], hit (M, dcn), u_counts (dcn,)).
+    Capacity is M rows per slice — DCN payload scales with unique
+    tokens, never with topk duplicates (≡ the reference's once-per-node
+    put + local scatter, ep_a2a.py:74-80, :120-150)."""
+    m = x.shape[0]
+    slice_experts = ctx.epl * ctx.experts_per_rank
+    e_slice = ids // slice_experts                       # (m, topk)
+    d_idx = jnp.arange(ctx.dcn, dtype=jnp.int32)
+    hit = (e_slice[:, :, None] == d_idx[None, None, :]).any(axis=1)  # (m,dcn)
+    u_counts = hit.sum(axis=0).astype(jnp.int32)
+    tok_of_slot = jnp.argsort(
+        jnp.where(hit.T, jnp.arange(m, dtype=jnp.int32)[None, :], m),
+        axis=1, stable=True,
+    ).astype(jnp.int32)                                  # (dcn, m)
+    valid_u = jnp.arange(m, dtype=jnp.int32)[None, :] < u_counts[:, None]
+    safe = jnp.clip(tok_of_slot, 0, m - 1)
+    tok_slot = jnp.where(valid_u[..., None], x[safe], 0).astype(ctx.dtype)
+    ids_slot = jnp.where(valid_u[..., None], ids[safe], -1).astype(jnp.int32)
+    w_slot = jnp.where(
+        valid_u[..., None], weights[safe].astype(jnp.float32), 0.0
+    )
+    return tok_slot, ids_slot, w_slot, hit, u_counts
+
+
+def _ep_moe_hier_device(x, logits, w_up, w_down, ctx: EPMoEContext):
+    """Hierarchical EP with RAIL DEDUP: each token crosses DCN at most
+    ONCE per target slice (not once per assignment), is expanded to its
+    per-expert assignments INSIDE the slice, and its per-slice weighted
+    partial crosses back as ONE row (≡ the reference's once-per-node
+    put + intra-node scatter, ep_a2a.py:36-150; DCN is exactly the link
+    where duplicate bytes hurt most)."""
+    m = x.shape[0]
+    dcn, epl, epr = ctx.dcn, ctx.epl, ctx.experts_per_rank
+    weights, ids = mu.select_experts(logits, ctx.topk)
+    ids = ids.astype(jnp.int32)
+
+    tok_slot, ids_slot, w_slot, hit, _ = _rail_stage(ctx, x, ids, weights)
+
+    # DCN rail (same-local-rank by mesh construction): unique tokens out
+    rtok = jax.lax.all_to_all(tok_slot, ctx.dcn_axis, 0, 0, tiled=False)
+    rids = jax.lax.all_to_all(ids_slot, ctx.dcn_axis, 0, 0, tiled=False)
+    rw = jax.lax.all_to_all(w_slot, ctx.dcn_axis, 0, 0, tiled=False)
+
+    # intra-slice flat EP over the railed set: keep only assignments
+    # whose expert lives in MY slice, sentinel the rest
+    my_slice = jax.lax.axis_index(ctx.dcn_axis)
+    slice_experts = epl * epr
+    rows = rtok.reshape(dcn * m, ctx.hidden)
+    aids = rids.reshape(dcn * m, ctx.topk)
+    local_e = aids - my_slice * slice_experts
+    amask = (aids >= 0) & (local_e >= 0) & (local_e < slice_experts)
+    flat_e = jnp.where(amask, local_e, slice_experts).reshape(-1)
+    w_flat = jnp.where(amask, rw.reshape(dcn * m, ctx.topk), 0.0).reshape(-1)
+
+    sub = replace(
+        ctx,
+        num_experts=slice_experts,
+        max_m=ctx.max_m * dcn,
+        dcn_axis=None,
+        transport="xla" if ctx.transport == "xla" else "fused",
+    )
+    part = _ep_assignments_device(
+        sub, rows, flat_e, w_flat, dcn * m, w_up, w_down
+    )                                                    # (dcn·m, H) f32
+
+    # rail back: ONE weighted partial row per unique (token, slice) pair
+    # — in ctx.dtype, not the f32 accumulator (DCN is exactly the link
+    # where bytes hurt; the cross-slice sum still runs in f32 below)
+    back = jax.lax.all_to_all(
+        part.astype(ctx.dtype).reshape(dcn, m, ctx.hidden),
+        ctx.dcn_axis, 0, 0, tiled=False,
+    )
+    # source side: sum each token's per-slice partials
+    pos = jnp.cumsum(hit, axis=0) - 1                    # (m, dcn)
+    safe_pos = jnp.clip(pos, 0, m - 1)
+    d_idx = jnp.arange(dcn)
+    gathered = back[d_idx[None, :], safe_pos]            # (m, dcn, H)
+    out = jnp.sum(
+        jnp.where(hit[..., None], gathered.astype(jnp.float32), 0.0),
+        axis=1,
+    )
+    return out.astype(x.dtype)
+
+
 def ep_moe_device(x, logits, w_up, w_down, ctx: EPMoEContext):
     """Per-device EP MoE body — callable inside any shard_map.
 
     x: (M, H) this rank's tokens; logits: (M, E); w_up: (epr, H, F),
     w_down: (epr, F, H) — this rank's experts. Returns (M, H).
     """
-    m = x.shape[0]
-    total = m * ctx.topk
-    weights, ids = mu.select_experts(logits, ctx.topk)
-    flat = ids.reshape(-1)
-    order = jnp.argsort(flat, stable=True).astype(jnp.int32)
-    splits = jnp.zeros((ctx.num_experts,), jnp.int32).at[flat].add(1)
-    x_sorted = x[order // ctx.topk].astype(ctx.dtype)
-
-    # dispatch: tokens to the ranks owning their experts
-    toks, rspl = _dispatch(ctx, x_sorted, splits)      # (n,max_m,H),(n,epr)
-    rows = toks.reshape(ctx.n * ctx.max_m, ctx.hidden)
-    pos = jnp.arange(ctx.max_m, dtype=jnp.int32)
-    cum = jnp.cumsum(rspl, axis=1)                     # (n, epr)
-    eid = jax.vmap(lambda c: jnp.searchsorted(c, pos, side="right"))(cum)
-    eid = jnp.clip(eid, 0, ctx.experts_per_rank - 1).reshape(-1)
-    valid = (pos[None, :] < cum[:, -1][:, None]).reshape(-1)
-
-    y = _expert_mlp(ctx, rows, eid, valid, w_up, w_down)
-
-    # combine: processed tokens back to their owners
-    y_sorted = _combine(
-        ctx, y.reshape(ctx.n, ctx.max_m, ctx.hidden), splits, total
+    assert ctx.transport in ("fused", "pallas", "xla"), (
+        f"unresolved transport {ctx.transport!r} — build contexts via "
+        "create_ep_moe_context"
     )
-    w_flat = weights.reshape(-1)[order].astype(jnp.float32)
-    out = jnp.zeros((m, ctx.hidden), jnp.float32)
-    out = out.at[order // ctx.topk].add(
-        y_sorted.astype(jnp.float32) * w_flat[:, None]
+    if ctx.dcn_axis is not None:
+        return _ep_moe_hier_device(x, logits, w_up, w_down, ctx)
+    weights, ids = mu.select_experts(logits, ctx.topk)
+    out = _ep_assignments_device(
+        ctx, x, ids.reshape(-1).astype(jnp.int32),
+        weights.reshape(-1).astype(jnp.float32), x.shape[0], w_up, w_down,
     )
     return out.astype(x.dtype)
 
