@@ -110,6 +110,19 @@ class TestCollectiveFamilies:
         )
         _assert_compiles(fn, _sds(tmesh, (1024, 256), jnp.bfloat16, "x"))
 
+    def test_ll_persist_allgather(self, tmesh):
+        from triton_distributed_tpu.kernels.allgather import _build_ll_persist
+
+        fn = _build_ll_persist(
+            tmesh, "x", 128, 256, jnp.dtype(jnp.bfloat16), 12, interp_key()
+        )
+        _assert_compiles(
+            fn,
+            _sds(tmesh, (1,), jnp.int32),
+            _sds(tmesh, (1024, 256), jnp.bfloat16, "x"),
+            _sds(tmesh, (8 * 2 * 1024, 256), jnp.bfloat16, "x"),
+        )
+
     def test_dense_all_to_all(self, tmesh):
         from triton_distributed_tpu.kernels.all_to_all import _build_all_to_all
 

@@ -5,7 +5,10 @@ Reference: python/triton_dist/kernels/nvidia/ (see SURVEY.md §2.3).
 
 from triton_distributed_tpu.kernels.ag_gemm import AGGemmMethod, ag_gemm
 from triton_distributed_tpu.kernels.all_to_all import all_to_all, all_to_all_xla
-from triton_distributed_tpu.kernels.allgather import all_gather
+from triton_distributed_tpu.kernels.allgather import (
+    PersistentLLAllGather,
+    all_gather,
+)
 from triton_distributed_tpu.kernels.flash_decode import (
     combine_partials,
     gqa_fwd_batch_decode,
@@ -37,6 +40,7 @@ from triton_distributed_tpu.kernels.reduce_scatter import (
 )
 
 __all__ = [
+    "PersistentLLAllGather",
     "all_gather",
     "reduce_scatter",
     "reduce_scatter_xla",

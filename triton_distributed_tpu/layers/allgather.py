@@ -51,5 +51,13 @@ class AllGatherLayer:
         low_latency_allgather.py:532-624)."""
         return self(x, AllGatherMethod.LL_SMALL)
 
+    def forward_ll_persist(self, x):
+        """Barrier-free LL over the persistent double-buffered
+        workspace (≡ the reference's no-barrier LL protocol,
+        low_latency_allgather.py:532-569): the entry barrier the
+        stateless path pays IS the latency at small sizes. Eager calls
+        only (the workspace is layer-owned state)."""
+        return self(x, AllGatherMethod.LL_PERSIST)
+
     def forward_xla(self, x):
         return self(x, AllGatherMethod.XLA_FALLBACK)

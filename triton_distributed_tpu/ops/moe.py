@@ -48,7 +48,12 @@ class EPMoEContext:
     axis: str
     num_experts: int
     topk: int
-    max_m: int                      # per-peer token-slot capacity
+    # Transport capacity. Staged ("pallas"/"xla") transports read it as
+    # PER-PEER slot capacity (overflow beyond it is clamped); the fused
+    # transport needs TOTAL-assignment capacity (max_m ≥ M·topk, the
+    # standard worst-case sizing) and degrades to the staged path with a
+    # warning when sized smaller.
+    max_m: int
     hidden: int
     dtype: jnp.dtype = jnp.bfloat16
     activation: str = "silu"        # silu | gelu | none
@@ -289,14 +294,27 @@ def _ep_assignments_device(ctx: EPMoEContext, x, flat_e, w_flat, out_rows,
         jnp.clip(flat_e, 0, ctx.num_experts - 1)
     ].add(valid_a.astype(jnp.int32))
 
-    if ctx.transport == "fused":
+    transport = ctx.transport
+    if transport == "fused" and ctx.max_m < total:
+        # the fused aligned payload must hold EVERY assignment; a
+        # per-peer-capacity max_m (< M·topk — the documented sizing the
+        # staged transport clamps against) degrades to the padded-slot
+        # path instead of failing, preserving the old overflow semantics
+        from triton_distributed_tpu.kernels.ag_gemm import _warn_once
+
+        _warn_once(
+            ("ep_moe", "fused_cap", ctx.max_m, total),
+            f"ep_moe: max_m={ctx.max_m} < M·topk={total}; the fused "
+            "window transport needs full-assignment capacity — using "
+            "the padded-slot transport (overflow-clamping) instead",
+        )
+        transport = "pallas"
+        ctx = replace(ctx, transport="pallas")
+
+    if transport == "fused":
         from triton_distributed_tpu.kernels import moe_dispatch as md
 
         a2a = ctx.a2a
-        assert a2a.max_m >= total, (
-            f"fused transport: max_m={a2a.max_m} < T={total} — the "
-            "aligned payload must hold every assignment"
-        )
         # single staging pass: gather straight from x into the aligned
         # per-peer segments (no x_sorted materialization, no slot
         # inflation — the reference's on-device range computation)
@@ -412,7 +430,10 @@ def _ep_moe_hier_device(x, logits, w_up, w_down, ctx: EPMoEContext):
         num_experts=slice_experts,
         max_m=ctx.max_m * dcn,
         dcn_axis=None,
-        transport="xla" if ctx.transport == "xla" else "fused",
+        # honor the caller's transport on the intra-slice leg: "pallas"
+        # keeps the padded-slot semantics (per-peer capacity with
+        # overflow clamping); "fused"/"xla" pass through
+        transport=ctx.transport,
     )
     part = _ep_assignments_device(
         sub, rows, flat_e, w_flat, dcn * m, w_up, w_down

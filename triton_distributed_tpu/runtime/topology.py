@@ -28,7 +28,10 @@ class AllGatherMethod(enum.Enum):
 
     RING_1D = "ring_1d"
     RING_BIDIR = "ring_bidir"
-    LL_SMALL = "ll_small"          # low-latency packed, small messages
+    LL_SMALL = "ll_small"          # low-latency push, small messages
+    # barrier-free LL over a persistent double-buffered workspace
+    # (stateful: eager calls only — falls back to LL_SMALL in a trace)
+    LL_PERSIST = "ll_persist"
     XLA_FALLBACK = "xla"           # lax.all_gather (DCN or no-pallas path)
 
 
