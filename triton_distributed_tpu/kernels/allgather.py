@@ -246,9 +246,16 @@ def _build_all_gather(mesh, axis, method, shape, dtype, collective_id, chaos):
 
 
 @functools.lru_cache(maxsize=64)
-def _build_ll_persist(mesh, axis, m_local, k, dtype, collective_id, chaos):
+def _build_ll_persist(mesh, axis, m_local, k, dtype, collective_id, chaos,
+                      instance=0):
     """Jitted barrier-free LL AG: (parity, x, ws) → (gathered, ws') with
-    the workspace donated/aliased straight through."""
+    the workspace donated/aliased straight through.
+
+    ``instance`` keys the build per PersistentLLAllGather INSTANCE: two
+    live contexts with identical configs must not share one compiled
+    kernel — its physical per-parity DMA semaphores would be shared too,
+    and interleaved calls could satisfy each other's waits while the
+    data sits in the *other* instance's workspace."""
     n = mesh.shape[axis]
     call = lang.shmem_call(
         functools.partial(_ll_persist_kernel, n, axis, mesh.axis_names),
@@ -299,6 +306,8 @@ class PersistentLLAllGather:
     loops), not inside a larger jit trace.
     """
 
+    _next_instance = [0]
+
     def __init__(self, mesh, axis, shard_shape, dtype=jnp.bfloat16,
                  collective_id: int = 12):
         from jax.sharding import NamedSharding
@@ -310,6 +319,9 @@ class PersistentLLAllGather:
         self.dtype = jnp.dtype(dtype)
         self.collective_id = collective_id
         self.call_idx = 0
+        # per-instance kernel identity — see _build_ll_persist
+        self.instance = PersistentLLAllGather._next_instance[0]
+        PersistentLLAllGather._next_instance[0] += 1
         self.ws = jax.device_put(
             jnp.zeros((self.n * 2 * self.n * m, k), self.dtype),
             NamedSharding(mesh, P(axis)),
@@ -319,7 +331,7 @@ class PersistentLLAllGather:
         """x: (n·m, k) sharded P(axis) → (n·m, k) replicated gathered."""
         fn = _build_ll_persist(
             self.mesh, self.axis, self.m, self.k, self.dtype,
-            self.collective_id, interp_key(),
+            self.collective_id, interp_key(), self.instance,
         )
         parity = jnp.full((1,), self.call_idx % 2, jnp.int32)
         out, self.ws = fn(parity, x, self.ws)

@@ -154,12 +154,30 @@ class Transformer:
             batch_axes=tuple(self.dp_axes),
         )
 
-    def _moe_ep_ctx(self, m_local: int):
+    def _moe_ep_ctx(self, m_local: int, inference: bool = False):
         c = self.config
+        # training must stay on the differentiable XLA transport;
+        # inference (decode) rides the fused window-DMA dispatch — the
+        # low-latency path the reference's EP-MoE serving scenario is
+        # built around (test_ep_moe_inference.py). Two fallbacks to the
+        # XLA transport: a tp axis that crosses DCN (no Pallas remote
+        # DMA there — fall back like every other op entry, don't raise),
+        # and off-TPU runs (per-step interpreted dispatch kernels are
+        # 100× slower and can wedge the interpreter's worker pool — the
+        # fused decode path's compile/correctness coverage lives in
+        # tests/test_ep_moe.py, test_races.py and test_aot_topology.py).
+        from triton_distributed_tpu.config import compiling_for_tpu
+        from triton_distributed_tpu.runtime import is_dcn_axis
+
+        fused_ok = (
+            inference
+            and compiling_for_tpu()
+            and not is_dcn_axis(self.mesh, self.tp_axis)
+        )
         return ops.create_ep_moe_context(
             self.mesh, self.tp_axis, num_experts=c.num_experts, topk=c.topk,
             max_m=m_local * c.topk, hidden=c.hidden, dtype=c.dtype,
-            transport="xla", use_pallas_gemm=False,
+            transport="fused" if fused_ok else "xla", use_pallas_gemm=False,
             batch_axes=tuple(self.dp_axes),
         )
 
@@ -556,7 +574,7 @@ class Transformer:
         pad = (-b) % shards
         xp = jnp.pad(xn, ((0, pad), (0, 0)))
         logits = xp.astype(jnp.float32) @ blk["router"]
-        ctx = self._moe_ep_ctx((b + pad) // shards)
+        ctx = self._moe_ep_ctx((b + pad) // shards, inference=True)
         y = ops.ep_moe(
             xp, logits, blk["moe_up"].astype(c.dtype),
             blk["moe_down"].astype(c.dtype), ctx,
