@@ -258,3 +258,23 @@ def test_compile_aot_cli_roundtrip(tmp_path):
     out, _ = lib(q, kv, kv, jnp.array([200, 50], jnp.int32))
     assert lib.stats == {"artifact_loads": 1, "jit_fallbacks": 0}
     assert out.shape == (2, 8, 128)
+
+
+def test_generate_cli(capsys):
+    """The serving CLI: prefill + SP decode generate on a tiny preset
+    (the L7 surface a user drives; tutorial 13 is the library version)."""
+    from triton_distributed_tpu.tools.generate import main
+
+    main(["--preset", "tiny", "--batch", "2", "--prompt-len", "8",
+          "--steps", "2"])
+    out = capsys.readouterr().out
+    assert "decode:" in out and "sample completion ids:" in out
+
+
+def test_generate_cli_unknown_preset():
+    import pytest
+
+    from triton_distributed_tpu.tools.generate import main
+
+    with pytest.raises(SystemExit, match="unknown preset"):
+        main(["--preset", "nope"])
