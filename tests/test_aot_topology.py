@@ -254,6 +254,31 @@ class TestCollectiveFamilies:
             ),
         )
 
+    def test_paged_flash_decode(self, tmesh):
+        """Scalar-prefetch page-table index maps through real Mosaic."""
+        import functools as ft
+
+        from triton_distributed_tpu.kernels.flash_decode import (
+            paged_gqa_fwd_batch_decode,
+        )
+
+        b, hq, hkv, d, page, pps, npages = 2, 16, 4, 128, 64, 4, 16
+        fn = jax.jit(
+            jax.shard_map(
+                ft.partial(paged_gqa_fwd_batch_decode, interpret=False),
+                mesh=tmesh, in_specs=(P(),) * 5, out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+        _assert_compiles(
+            fn,
+            _sds(tmesh, (b, hq, d), jnp.bfloat16),
+            _sds(tmesh, (npages, hkv, page, d), jnp.bfloat16),
+            _sds(tmesh, (npages, hkv, page, d), jnp.bfloat16),
+            _sds(tmesh, (b,), jnp.int32),
+            _sds(tmesh, (b, pps), jnp.int32),
+        )
+
     def test_flash_decode_sp(self, tmesh):
         """SP decode: the per-device split-kv kernel + combine compiled
         over the sequence-sharded mesh (the serving hot path)."""

@@ -13,8 +13,12 @@ from triton_distributed_tpu.kernels.flash_decode import (
     combine_partials,
     gqa_fwd_batch_decode,
     gqa_fwd_batch_decode_xla,
+    paged_gqa_fwd_batch_decode,
+    paged_gqa_fwd_batch_decode_xla,
     sp_gqa_fwd_batch_decode,
     sp_gqa_fwd_batch_decode_device,
+    sp_paged_gqa_fwd_batch_decode,
+    sp_paged_gqa_fwd_batch_decode_device,
 )
 from triton_distributed_tpu.kernels.gemm_rs import GemmRSMethod, gemm_rs
 from triton_distributed_tpu.kernels.group_gemm import (
@@ -52,8 +56,12 @@ __all__ = [
     "GemmRSMethod",
     "gqa_fwd_batch_decode",
     "gqa_fwd_batch_decode_xla",
+    "paged_gqa_fwd_batch_decode",
+    "paged_gqa_fwd_batch_decode_xla",
     "sp_gqa_fwd_batch_decode",
     "sp_gqa_fwd_batch_decode_device",
+    "sp_paged_gqa_fwd_batch_decode",
+    "sp_paged_gqa_fwd_batch_decode_device",
     "combine_partials",
     "select_experts",
     "moe_align_block_size",

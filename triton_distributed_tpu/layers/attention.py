@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from triton_distributed_tpu.kernels.flash_decode import (
+    sp_paged_gqa_fwd_batch_decode,
     sp_gqa_fwd_batch_decode,
     sp_gqa_fwd_batch_decode_device,
 )
@@ -56,11 +57,23 @@ class SpGQAFlashDecodeAttention:
     # sp_flash_decode_layer.py:32-39); this layer always dispatches the
     # jit-cached SP pipeline.
 
-    def __call__(self, q, k_cache, v_cache, global_kv_lens):
+    def __call__(self, q, k_cache, v_cache, global_kv_lens,
+                 block_table=None):
         """q: (B, Hq, D) replicated; k/v_cache: (B, S, Hkv, D) [bshd] or
         (B, Hkv, S, D) [bhsd] with S sharded over ``axis``;
         global_kv_lens: (B,) total lengths. Returns (B, Hq, D) replicated
-        (≡ forward, sp_flash_decode_layer.py:78-184)."""
+        (≡ forward, sp_flash_decode_layer.py:78-184).
+
+        PAGED mode (``block_table`` given, ≡ the reference layer's
+        block_table arg + page_size ctor knob): k/v_cache are page POOLS
+        (R·npages_local, Hkv, page, D) sharded over ``axis`` and
+        block_table is (R, B, pages_per_slice) of local page ids."""
+        if block_table is not None:
+            return sp_paged_gqa_fwd_batch_decode(
+                q, k_cache, v_cache, global_kv_lens, block_table,
+                self.mesh, self.axis, scale=self.scale,
+                soft_cap=self.soft_cap, use_pallas=self.use_pallas,
+            )
         return sp_gqa_fwd_batch_decode(
             q, k_cache, v_cache, global_kv_lens, self.mesh, self.axis,
             scale=self.scale, soft_cap=self.soft_cap,
