@@ -35,3 +35,35 @@ out = layer(q, k, v, lens)
 ref, _ = gqa_fwd_batch_decode_xla(q, k, v, lens, kv_layout="bhsd")
 np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2, rtol=2e-2)
 print("tutorial 05 OK: SP decode == dense attention over the full cache")
+
+# ---- PAGED mode (the reference layer's block_table surface): each rank
+# owns a page POOL of its sequence slice plus the table addressing it.
+# TPU guidance: pages should be >=1024 rows at scale (docs/PERF.md);
+# tiny here for the demo mesh.
+R, PAGE, PPS = mesh.shape["x"], 16, S // (mesh.shape["x"] * 16)
+npl = B * PPS                                  # pages per rank's pool
+rng = np.random.default_rng(3)
+perm = np.stack([rng.permutation(npl).reshape(B, PPS) for _ in range(R)])
+table = jnp.asarray(perm.astype(np.int32))     # (R, B, pages_per_slice)
+
+# scatter the contiguous caches into the per-rank pools (serving stacks
+# write pages directly; here we derive them so the answers must match)
+k_np = np.asarray(k).reshape(B, Hkv, R, PPS, PAGE, D)
+v_np = np.asarray(v).reshape(B, Hkv, R, PPS, PAGE, D)
+k_pool = np.zeros((R * npl, Hkv, PAGE, D), np.float32)
+v_pool = np.zeros((R * npl, Hkv, PAGE, D), np.float32)
+for r in range(R):
+    for b in range(B):
+        for j in range(PPS):
+            pid = r * npl + perm[r, b, j]
+            k_pool[pid] = k_np[b, :, r, j]
+            v_pool[pid] = v_np[b, :, r, j]
+
+out_paged = layer(
+    q, jnp.asarray(k_pool), jnp.asarray(v_pool), lens,
+    block_table=table,
+)
+np.testing.assert_allclose(
+    np.asarray(out_paged), np.asarray(ref), atol=2e-2, rtol=2e-2
+)
+print("tutorial 05 OK: paged (block-table) SP decode == dense attention")
