@@ -223,6 +223,8 @@ class TestCollectiveFamilies:
         )
 
     def test_fused_moe_dispatch(self, tmesh):
+        """Count-bounded chunked a2a, barrier mode (dispatch leg:
+        in-kernel meta-count discovery drives traced recv loops)."""
         from triton_distributed_tpu.kernels import moe_all_to_all as ma
         from triton_distributed_tpu.kernels import moe_dispatch as md
 
@@ -230,28 +232,102 @@ class TestCollectiveFamilies:
             tmesh, "x", max_m=256, hidden=512, experts_per_rank=2,
             dtype=jnp.bfloat16, quant="fp8",
         )
-        call = md._build_window_a2a_call(
-            tmesh.axis_names, "x", 8, md.align(ctx), md.max_pad(ctx),
-            md.meta_rows(ctx), md.m_cap(ctx), ctx.hidden, ctx.wire_dtype,
-            10, interp_key(),
+        call = md._build_chunked_a2a(
+            *md._geom_args(ctx), False, 10, interp_key()
         )
         fn = jax.jit(
             jax.shard_map(
                 call, mesh=tmesh,
-                in_specs=(P("x"), P("x"), P("x")),
+                in_specs=(P("x"),) * 4 + (P("x"), P("x")),
                 out_specs=(P("x"), P("x")),
                 check_vma=False,
             )
         )
+        mr = md.meta_rows(ctx)
         _assert_compiles(
             fn,
+            _sds(tmesh, (8 * 1,), jnp.int32, "x"),
+            _sds(tmesh, (8 * 8,), jnp.int32, "x"),
+            _sds(tmesh, (8 * 8,), jnp.int32, "x"),
             _sds(tmesh, (8 * 8,), jnp.int32, "x"),
             _sds(tmesh, (8 * md.m_cap(ctx), ctx.hidden), ctx.wire_dtype, "x"),
-            _sds(
-                tmesh,
-                (8 * 8 * md.meta_rows(ctx), md.META_W),
-                jnp.int32, "x",
-            ),
+            _sds(tmesh, (8 * 8 * mr, md.META_W), jnp.int32, "x"),
+        )
+
+    def test_fused_moe_dispatch_ll(self, tmesh):
+        """Barrier-free LL variant: persistent aliased workspaces +
+        per-parity semaphore rows through the Mosaic backend."""
+        from triton_distributed_tpu.kernels import moe_all_to_all as ma
+        from triton_distributed_tpu.kernels import moe_dispatch as md
+
+        ctx = ma.create_all_to_all_context(
+            tmesh, "x", max_m=256, hidden=512, experts_per_rank=2,
+            dtype=jnp.bfloat16, quant="fp8",
+        )
+        call = md._build_chunked_a2a_ll(
+            *md._geom_args(ctx), False, 7001, interp_key()
+        )
+        fn = jax.jit(
+            jax.shard_map(
+                call, mesh=tmesh,
+                in_specs=(P("x"),) * 4 + (P("x"),) * 4,
+                out_specs=(P("x"), P("x")),
+                check_vma=False,
+            )
+        )
+        mr = md.meta_rows(ctx)
+        sp = md.slot_pad(ctx)
+        _assert_compiles(
+            fn,
+            _sds(tmesh, (8 * 1,), jnp.int32, "x"),
+            _sds(tmesh, (8 * 8,), jnp.int32, "x"),
+            _sds(tmesh, (8 * 8,), jnp.int32, "x"),
+            _sds(tmesh, (8 * 8,), jnp.int32, "x"),
+            _sds(tmesh, (8 * md.m_cap(ctx), ctx.hidden), ctx.wire_dtype, "x"),
+            _sds(tmesh, (8 * 8 * mr, md.META_W), jnp.int32, "x"),
+            _sds(tmesh, (8 * 2 * 8 * sp, ctx.hidden), ctx.wire_dtype, "x"),
+            _sds(tmesh, (8 * 2 * 8 * mr, md.META_W), jnp.int32, "x"),
+        )
+
+    def test_ep_moe_decode_step_fused(self, tmesh):
+        """The COMPOSED serving path (VERDICT r3 #4): a full
+        Transformer.decode_step — SP flash-decode attention + EP-MoE
+        block on the barrier-free fused transport with its LL state —
+        lowered and compiled over the 8-chip topology. Closes the gap
+        where the fused decode transport had only kernel-level compile
+        coverage."""
+        from triton_distributed_tpu.models import Transformer, TransformerConfig
+
+        cfg = TransformerConfig(
+            vocab=512, n_layers=1, hidden=256, ffn=256, n_heads=8,
+            n_kv_heads=4, head_dim=32, moe="ep", moe_layers=(0,),
+            num_experts=8, topk=2,
+        )
+        model = Transformer(cfg, tmesh, tp_axis="x")
+        b, cap = 16, 256
+        params_sds = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+        params_sds = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            params_sds, model.shardings(),
+        )
+        cache_sh = NamedSharding(tmesh, P(None, None, "x"))
+        kv = jax.ShapeDtypeStruct(
+            (b, cfg.n_kv_heads, cap, cfg.head_dim), jnp.bfloat16,
+            sharding=cache_sh,
+        )
+        caches = [(kv, kv)]
+        state_sds = model.init_decode_state(b, abstract=True)
+        assert state_sds is not None and state_sds[0] is not None, (
+            "force_compile must route decode onto the fused transport"
+        )
+        fn = jax.jit(model.decode_step)
+        _assert_compiles(
+            fn,
+            params_sds,
+            caches,
+            _sds(tmesh, (b,), jnp.int32),
+            _sds(tmesh, (b,), jnp.int32),
+            state_sds,
         )
 
     def test_paged_flash_decode(self, tmesh):

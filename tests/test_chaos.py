@@ -96,3 +96,40 @@ def test_ep_moe_under_chaos(mesh8, chaos):
         jax.device_put(w_up, sh), jax.device_put(w_down, sh), ctx,
     )
     assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_ep_moe_ll_under_chaos(mesh8, chaos):
+    """Barrier-free fused transport under randomized comm delays: the
+    widened race windows must not let a parity window be read early."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from conftest import dense_moe_ref
+
+    from triton_distributed_tpu.ops import (
+        create_ep_moe_context,
+        create_ep_moe_state,
+        ep_moe,
+    )
+
+    n, E, topk, H, F, Mtok = 8, 16, 2, 128, 256, 7
+    sh = NamedSharding(mesh8, P("x"))
+    ctx = create_ep_moe_context(
+        mesh8, "x", num_experts=E, topk=topk, max_m=Mtok * topk, hidden=H,
+        dtype=jnp.float32, transport="fused", block_m=8,
+        use_pallas_gemm=False,
+    )
+    state = create_ep_moe_state(ctx)
+    w_up = jax.random.normal(jax.random.PRNGKey(2), (E, H, F), jnp.float32) * 0.05
+    w_down = jax.random.normal(jax.random.PRNGKey(3), (E, F, H), jnp.float32) * 0.05
+    for i in range(2):
+        x = jax.random.normal(jax.random.PRNGKey(50 + i), (n * Mtok, H),
+                              jnp.float32)
+        logits = jax.random.normal(jax.random.PRNGKey(60 + i), (n * Mtok, E))
+        ref = dense_moe_ref(x, logits, w_up, w_down, topk)
+        out, state = ep_moe(
+            jax.device_put(x, sh), jax.device_put(logits, sh),
+            jax.device_put(w_up, sh), jax.device_put(w_down, sh), ctx,
+            state=state,
+        )
+        assert_allclose(out, ref, atol=1e-4, rtol=1e-4)

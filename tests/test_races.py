@@ -108,6 +108,44 @@ def test_ll_persist_race_free(mesh8):
     _PERSIST_STATES.clear()  # race-detector builds must not leak
 
 
+def test_fused_moe_ll_race_free(mesh8):
+    """Barrier-free chunked a2a: consecutive parities over the
+    persistent workspaces under the race detector — the protocol's
+    whole safety story is the parity-window/semaphore ordering."""
+    from triton_distributed_tpu.ops import (
+        create_ep_moe_context,
+        create_ep_moe_state,
+        ep_moe,
+    )
+
+    e, topk, m_per, h = 16, 2, 9, 128
+    ctx = create_ep_moe_context(
+        mesh8, "x", num_experts=e, topk=topk, max_m=m_per * topk, hidden=h,
+        dtype=jnp.float32, transport="fused", block_m=8,
+        use_pallas_gemm=False,
+    )
+    state = create_ep_moe_state(ctx)
+    from conftest import dense_moe_ref
+
+    for i in range(3):
+        x = jax.random.normal(jax.random.PRNGKey(30 + i), (8 * m_per, h),
+                              jnp.float32)
+        logits = jax.random.normal(jax.random.PRNGKey(40 + i), (8 * m_per, e))
+        w_up = jax.random.normal(jax.random.PRNGKey(24), (e, h, 64),
+                                 jnp.float32) * 0.05
+        w_down = jax.random.normal(jax.random.PRNGKey(25), (e, 64, h),
+                                   jnp.float32) * 0.05
+        out, state = ep_moe(
+            _put(mesh8, x, P("x")), _put(mesh8, logits, P("x")),
+            _put(mesh8, w_up, P("x")), _put(mesh8, w_down, P("x")), ctx,
+            state=state,
+        )
+        ref = dense_moe_ref(x, logits, w_up, w_down, topk)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5
+        )
+
+
 def test_fused_moe_dispatch_race_free(mesh8):
     """Fused window-DMA dispatch + slot-regular combine under the race
     detector (the dynamic-offset windows are the risky part)."""

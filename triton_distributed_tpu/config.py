@@ -56,6 +56,14 @@ class Config:
     chaos_delay: bool = field(
         default_factory=lambda: os.environ.get("TDTPU_CHAOS_DELAY", "0") == "1"
     )
+    # Debug-mode integrity verification of the fused MoE transport's
+    # wire metadata (kernels/moe_dispatch): senders always stamp a
+    # checksum word into the meta head; with this flag on, receivers
+    # re-verify it and POISON failing slots with NaN instead of
+    # silently masking tokens by (possibly corrupted) counts.
+    debug_checksum: bool = field(
+        default_factory=lambda: os.environ.get("TDTPU_DEBUG_CHECKSUM", "0") == "1"
+    )
     # Per-core VMEM working-set budget (bytes) used to gate fused single
     # -kernel engines (ag_gemm, gemm_rs) vs the streaming XLA ring paths.
     fused_vmem_budget: int = field(
@@ -78,7 +86,10 @@ def interp_key() -> tuple:
     interpreter params; force_compile flips interpret→Mosaic) —
     lru-cached kernel builders must include it so toggling any knob
     rebuilds instead of reusing a stale build."""
-    return (config.chaos_delay, config.detect_races, config.force_compile)
+    return (
+        config.chaos_delay, config.detect_races, config.force_compile,
+        config.debug_checksum,
+    )
 
 
 def autotune_enabled() -> bool:

@@ -353,9 +353,19 @@ def _engine_tuner(mesh, axis, collective_id):
             collective_id=collective_id,
         )
 
+    # LL_PERSIST is excluded: inside jit traces all_gather silently
+    # demotes it to LL_SMALL (the persistent workspace is module state),
+    # so a persisted 'll_persist' winner would not be the engine that
+    # actually runs at traced call sites — the measured winner must
+    # always match the executed engine (ADVICE r3). Callers wanting the
+    # barrier-free protocol opt in explicitly (method=LL_PERSIST eager,
+    # or PersistentLLAllGather / the MoE LL transport in jitted loops).
+    candidates = [
+        m for m in AllGatherMethod if m != AllGatherMethod.LL_PERSIST
+    ]
     return method_tuner(
         f"all_gather[{dict(mesh.shape)}|{axis}|{collective_id}]",
-        run, AllGatherMethod,
+        run, candidates,
     )
 
 

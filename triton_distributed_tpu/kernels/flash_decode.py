@@ -102,7 +102,8 @@ def _decode_kernel(
         )
 
 
-def pick_block_k(s_len: int, requested: int) -> int:
+def pick_block_k(s_len: int, requested: int, *, head_dim: int = 128,
+                 itemsize: int = 2) -> int:
     """Largest divisor of ``s_len`` ≤ ``requested``, preferring sublane
     multiples (16). Replaces the old hard divisibility assert: SP cache
     slices (S/tp) may not divide the caller's block_k (e.g. capacity 384
@@ -112,11 +113,30 @@ def pick_block_k(s_len: int, requested: int) -> int:
     lowering error (see ``_divisor_block``'s contract), so strict mode
     applies and a length with no aligned divisor ≤ requested degrades to
     ONE whole-length block (ragged edges are padded, interiors never
-    misalign) — not to the old pathological block_k=1."""
+    misalign) — not to the old pathological block_k=1. That whole-length
+    fallback is CAPPED (ADVICE r3): a long prime-ish cache slice would
+    otherwise materialize an (s_len, D) K and V block in VMEM and fail
+    at Mosaic compile/run far less legibly — raise here with the fix
+    (pad the cache to an aligned capacity) instead."""
     from triton_distributed_tpu.config import compiling_for_tpu
     from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
 
-    return _divisor_block(s_len, requested, 16, strict=compiling_for_tpu()) or s_len
+    b = _divisor_block(s_len, requested, 16, strict=compiling_for_tpu())
+    if b:
+        return b
+    # whole-length fallback: 2 KV blocks (K and V) double-buffered by
+    # the pipeline ≈ 4·s_len·D·itemsize of VMEM
+    est = 4 * s_len * head_dim * itemsize
+    budget = 64 * 1024 * 1024   # leave headroom under the 128 MB v5e VMEM
+    if compiling_for_tpu() and est > budget:
+        raise ValueError(
+            f"flash_decode: cache slice length {s_len} has no 16-aligned "
+            f"divisor <= block_k={requested}, and a whole-length KV block "
+            f"(~{est >> 20} MB VMEM) exceeds the safe budget — pad the KV "
+            "cache capacity to a multiple of 16 (init_cache already does; "
+            "custom cache layouts must follow suit)"
+        )
+    return s_len
 
 
 @functools.partial(
@@ -155,7 +175,9 @@ def gqa_fwd_batch_decode(
     g = hq // hkv
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    block_k = pick_block_k(s_len, block_k)
+    block_k = pick_block_k(
+        s_len, block_k, head_dim=d, itemsize=k_cache.dtype.itemsize
+    )
 
     qg = q.reshape(batch, hkv, g, d)
     grid = (batch, hkv, s_len // block_k)

@@ -74,6 +74,14 @@ class MoEAllToAllContext:
     # headline fp8 dispatch, low_latency_all_to_all.py:43-107). None →
     # tokens ride in ``dtype``.
     quant: str | None = None
+    # Chunk granule (rows) of the fused count-bounded transport
+    # (kernels/moe_dispatch): wire bytes per peer are
+    # ceil(count/chunk)·chunk rows, so the chunk bounds the per-peer
+    # slack (≡ the reference shipping exact per-expert ranges,
+    # low_latency_all_to_all.py:62-90 — here rounded up to one DMA
+    # granule). Must be a multiple of the wire dtype's sublane tile;
+    # None → max(tile, 64) (≈0.5 MB DMAs at hidden 7168).
+    chunk_m: int | None = None
 
     @property
     def n(self) -> int:
@@ -121,7 +129,7 @@ class MoEAllToAllContext:
 def create_all_to_all_context(
     mesh, axis, *, max_m, hidden, experts_per_rank,
     dtype=jnp.bfloat16, collective_id: int = 10, num_ranks: int | None = None,
-    quant: str | None = None,
+    quant: str | None = None, chunk_m: int | None = None,
 ) -> MoEAllToAllContext:
     """≡ create_all_to_all_context (low_latency_all_to_all.py:168-187)."""
     dtype = jnp.dtype(dtype)
@@ -129,6 +137,7 @@ def create_all_to_all_context(
         mesh=mesh, axis=axis, max_m=max_m, hidden=hidden,
         experts_per_rank=experts_per_rank, dtype=dtype,
         collective_id=collective_id, num_ranks=num_ranks, quant=quant,
+        chunk_m=chunk_m,
     )
     assert (hidden * ctx.wire_dtype.itemsize) % 4 == 0, (
         f"hidden={hidden} row of {ctx.wire_dtype} not a whole number of int32s"

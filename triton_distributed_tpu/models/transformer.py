@@ -507,7 +507,31 @@ class Transformer:
     def _prefill_jit(self):
         return jax.jit(self.prefill)  # lens=None and lens=(B,) trace separately
 
-    def decode_step(self, params, caches, kv_lens, last_tokens):
+    def init_decode_state(self, batch: int, abstract: bool = False):
+        """Per-layer persistent workspaces for the BARRIER-FREE fused
+        EP-MoE decode transport (ops.EPMoEState): one state per MoE
+        layer, None elsewhere. Returns None when the model has no EP
+        layers or decode would ride the XLA transport (off-TPU / DCN tp
+        axis) — :meth:`decode_step` then needs no state at all.
+        ``abstract=True`` yields ShapeDtypeStruct leaves (topology
+        compiles)."""
+        c = self.config
+        if c.moe != "ep" or not c.moe_layers:
+            return None
+        m_local = -(-batch // self.token_shards)
+        ctx = self._moe_ep_ctx(m_local, inference=True)
+        if ctx.transport != "fused":
+            return None
+        from triton_distributed_tpu.ops import create_ep_moe_state
+
+        return [
+            create_ep_moe_state(ctx, abstract=abstract)
+            if i in c.moe_layers else None
+            for i in range(c.n_layers)
+        ]
+
+    def decode_step(self, params, caches, kv_lens, last_tokens,
+                    moe_state=None):
         """One token of SP decode: replicated (B,) last tokens + seq-
         sharded caches → (B, vocab) logits, updated caches/lens.
 
@@ -516,6 +540,12 @@ class Transformer:
         plain matmuls — at decode the M dim is B, far too small for the
         overlap engines (matching the reference, whose decode path is
         the SP attention kernel, not AG-GEMM).
+
+        ``moe_state`` (from :meth:`init_decode_state`): per-layer LL
+        workspaces — EP-MoE blocks then run the fused transport
+        BARRIER-FREE (≡ the reference's call_count protocol) and the
+        step returns a 4th element, the updated state to thread into
+        the next step.
         """
         c = self.config
         from triton_distributed_tpu.layers import append_kv
@@ -523,7 +553,8 @@ class Transformer:
         x = params["embed"][last_tokens].astype(c.dtype)        # (B, H)
         b = x.shape[0]
         new_caches = []
-        for blk, (ck, cv) in zip(params["blocks"], caches):
+        new_states = None if moe_state is None else list(moe_state)
+        for li, (blk, (ck, cv)) in enumerate(zip(params["blocks"], caches)):
             xn = self._rmsnorm(x, blk["norm_attn"])
             qkv = xn @ blk["wqkv"].astype(c.dtype)              # (B, qkv)
             q, k, v = jnp.split(qkv, [c.q_dim, c.q_dim + c.kv_dim], axis=-1)
@@ -540,7 +571,11 @@ class Transformer:
                 h = jax.nn.silu(xn @ blk["up"].astype(c.dtype))
                 x = x + h @ blk["down"].astype(c.dtype)
             elif c.moe == "ep":
-                x = x + self._decode_moe_ep(blk, xn).astype(x.dtype)
+                st = None if moe_state is None else moe_state[li]
+                y, st = self._decode_moe_ep(blk, xn, st)
+                x = x + y.astype(x.dtype)
+                if new_states is not None:
+                    new_states[li] = st
             else:
                 # TP flavour: experts replicated on the expert dim (only
                 # F is sharded), so the per-topk gather stays shard-local
@@ -558,16 +593,20 @@ class Transformer:
                 x = x + y.astype(x.dtype)
         x = self._rmsnorm(x, params["norm_f"])
         logits = x.astype(jnp.float32) @ params["lm_head"]
-        return logits, new_caches, kv_lens + 1
+        if moe_state is None:
+            return logits, new_caches, kv_lens + 1
+        return logits, new_caches, kv_lens + 1, new_states
 
-    def _decode_moe_ep(self, blk, xn):
+    def _decode_moe_ep(self, blk, xn, state=None):
         """Decode-step EP MoE: the B last-token activations ride the EP
         dispatch → sharded grouped expert MLP → combine machinery, so
         expert weights STAY sharded — no gathered (B, H, F) weight
         tensor ever materializes (the reference's EP-MoE inference
         headline: test_ep_moe_inference.py, decode-sized batches through
         low_latency_all_to_all.py:36-118). B is padded up to the token
-        -shard count; pad rows are discarded after the combine."""
+        -shard count; pad rows are discarded after the combine. With
+        ``state``, the transport runs barrier-free over the persistent
+        workspaces; returns (y, state')."""
         c = self.config
         b = xn.shape[0]
         shards = self.token_shards
@@ -575,19 +614,36 @@ class Transformer:
         xp = jnp.pad(xn, ((0, pad), (0, 0)))
         logits = xp.astype(jnp.float32) @ blk["router"]
         ctx = self._moe_ep_ctx((b + pad) // shards, inference=True)
-        y = ops.ep_moe(
-            xp, logits, blk["moe_up"].astype(c.dtype),
-            blk["moe_down"].astype(c.dtype), ctx,
-        )
-        return y[:b]
+        w_up = blk["moe_up"].astype(c.dtype)
+        w_down = blk["moe_down"].astype(c.dtype)
+        if state is not None and ctx.transport == "fused":
+            y, state = ops.ep_moe(xp, logits, w_up, w_down, ctx, state=state)
+        else:
+            y = ops.ep_moe(xp, logits, w_up, w_down, ctx)
+        return y[:b], state
 
     @functools.cached_property
     def _decode_jit(self):
         return jax.jit(self.decode_step)
 
-    def generate(self, params, caches, kv_lens, last_tokens, steps: int):
+    @functools.cached_property
+    def _decode_jit_state(self):
+        def step(params, caches, kv_lens, last_tokens, moe_state):
+            return self.decode_step(params, caches, kv_lens, last_tokens,
+                                    moe_state)
+
+        # donate the LL workspaces: the barrier-free protocol requires
+        # the SAME physical buffers across steps (skewed peers' in-
+        # flight DMAs target the persistent addresses)
+        return jax.jit(step, donate_argnums=(4,))
+
+    def generate(self, params, caches, kv_lens, last_tokens, steps: int,
+                 moe_state=None):
         """Greedy decode ``steps`` tokens. The whole decode step is one
-        jitted program (cached across steps and calls by shape)."""
+        jitted program (cached across steps and calls by shape). With
+        ``moe_state`` (init_decode_state), EP-MoE blocks run the
+        barrier-free fused transport and the state comes back as a 4th
+        result for continuation."""
         cap = caches[0][0].shape[2]  # (B, Hkv, S, D) bhsd layout
         try:
             max_len = int(np.asarray(kv_lens).max()) + steps
@@ -599,9 +655,17 @@ class Transformer:
             pass  # traced lens: caller owns the capacity contract
         out = []
         for _ in range(steps):
-            logits, caches, kv_lens = self._decode_jit(
-                params, caches, kv_lens, last_tokens
-            )
+            if moe_state is None:
+                logits, caches, kv_lens = self._decode_jit(
+                    params, caches, kv_lens, last_tokens
+                )
+            else:
+                logits, caches, kv_lens, moe_state = self._decode_jit_state(
+                    params, caches, kv_lens, last_tokens, moe_state
+                )
             last_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             out.append(last_tokens)
-        return jnp.stack(out, axis=1), caches, kv_lens
+        toks = jnp.stack(out, axis=1)
+        if moe_state is None:
+            return toks, caches, kv_lens
+        return toks, caches, kv_lens, moe_state

@@ -8,7 +8,9 @@ training, not just inference.
 
 from triton_distributed_tpu.ops.moe import (
     EPMoEContext,
+    EPMoEState,
     create_ep_moe_context,
+    create_ep_moe_state,
     ep_moe,
     ep_moe_device,
     ep_moe_tuned,
@@ -42,6 +44,8 @@ __all__ = [
     "create_ag_gemm_context",
     "create_gemm_rs_context",
     "EPMoEContext",
+    "EPMoEState",
+    "create_ep_moe_state",
     "ep_moe",
     "ep_moe_device",
     "ep_moe_tuned",

@@ -164,6 +164,100 @@ def create_ep_moe_context(
     return ctx
 
 
+@dataclass
+class EPMoEState:
+    """Persistent workspaces of the BARRIER-FREE fused transport (≡ the
+    reference AllToAllContext's symmetric buffers + call_count,
+    low_latency_all_to_all.py:125-187). Owns the double-buffered
+    receive windows for both legs and the parity counter; thread the
+    returned state through successive ``ep_moe(..., state=)`` calls
+    (the arrays are donated — always use the returned state).
+
+    ``instance`` keys the compiled kernels per live state so two states
+    never share physical per-parity semaphores (see
+    moe_dispatch._build_chunked_a2a_ll)."""
+
+    parity: jax.Array       # (1,) int32, replicated
+    disp_tok: jax.Array     # dispatch windows, P(batch+ep) sharded
+    disp_meta: jax.Array
+    comb_tok: jax.Array     # combine windows
+    comb_meta: jax.Array
+    instance: int = 0       # static (pytree aux data)
+
+    def as_dict(self):
+        return {
+            "parity": self.parity,
+            "disp_tok": self.disp_tok, "disp_meta": self.disp_meta,
+            "comb_tok": self.comb_tok, "comb_meta": self.comb_meta,
+        }
+
+
+jax.tree_util.register_dataclass(
+    EPMoEState,
+    data_fields=["parity", "disp_tok", "disp_meta", "comb_tok", "comb_meta"],
+    meta_fields=["instance"],
+)
+
+_NEXT_LL_INSTANCE = [0]
+
+
+def create_ep_moe_state(ctx: EPMoEContext, abstract: bool = False) -> EPMoEState:
+    """Allocate zeroed persistent LL workspaces for ``ctx`` (fused flat
+    transport only). Each call consumes TWO kernel instances (dispatch,
+    combine). ``abstract=True`` returns ShapeDtypeStruct leaves instead
+    of device arrays — for lowering/compiling against an unattached
+    topology mesh (tests/test_aot_topology.py)."""
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from triton_distributed_tpu.kernels import moe_dispatch as md
+
+    if ctx.transport != "fused" or ctx.dcn_axis is not None:
+        raise ValueError(
+            "EPMoEState rides the flat fused transport "
+            f"(got transport={ctx.transport!r}, dcn_axis={ctx.dcn_axis!r})"
+        )
+    a2a = ctx.a2a
+    (tok_shape, tok_dt), (meta_shape, meta_dt) = md.ll_workspace_shapes(a2a)
+    row_axes = tuple(ctx.batch_axes) + ctx.ep_axes
+    shards = int(np.prod([ctx.mesh.shape[ax] for ax in row_axes]))
+    sh = NamedSharding(ctx.mesh, P(row_axes))
+    rep = NamedSharding(ctx.mesh, P())
+
+    if abstract:
+        def ws(shape, dt, sharding=sh):
+            return jax.ShapeDtypeStruct(shape, dt, sharding=sharding)
+
+        tok_shape = (shards * tok_shape[0],) + tok_shape[1:]
+        meta_shape = (shards * meta_shape[0],) + meta_shape[1:]
+        inst = _NEXT_LL_INSTANCE[0]
+        _NEXT_LL_INSTANCE[0] += 2
+        return EPMoEState(
+            parity=ws((1,), jnp.int32, rep),
+            disp_tok=ws(tok_shape, tok_dt),
+            disp_meta=ws(meta_shape, meta_dt),
+            comb_tok=ws(tok_shape, tok_dt),
+            comb_meta=ws(meta_shape, meta_dt),
+            instance=inst,
+        )
+
+    def ws(shape, dt):
+        return jax.device_put(
+            jnp.zeros((shards * shape[0],) + shape[1:], dt), sh
+        )
+
+    inst = _NEXT_LL_INSTANCE[0]
+    _NEXT_LL_INSTANCE[0] += 2
+    return EPMoEState(
+        parity=jax.device_put(jnp.zeros((1,), jnp.int32), rep),
+        disp_tok=ws(tok_shape, tok_dt),
+        disp_meta=ws(meta_shape, meta_dt),
+        comb_tok=ws(tok_shape, tok_dt),
+        comb_meta=ws(meta_shape, meta_dt),
+        instance=inst,
+    )
+
+
 def _act(name: str, x):
     if name == "silu":
         return jax.nn.silu(x)
@@ -276,7 +370,7 @@ def _slot_tables(ctx: EPMoEContext, rspl, slot_m: int, shift=None):
 
 
 def _ep_assignments_device(ctx: EPMoEContext, x, flat_e, w_flat, out_rows,
-                           w_up, w_down):
+                           w_up, w_down, state=None, instance=0):
     """Dispatch pre-routed assignments → grouped MLP → combine →
     weighted scatter, on a FLAT exchange over ``ctx.axis``.
 
@@ -284,9 +378,12 @@ def _ep_assignments_device(ctx: EPMoEContext, x, flat_e, w_flat, out_rows,
     assignment (T = R·topk; the SENTINEL ``ctx.num_experts`` marks a
     masked assignment — sorted to the tail, never shipped); w_flat:
     (T,) f32 combine weights, exactly 0 for masked assignments.
-    Returns (out_rows, H) f32 weighted sums (out_rows == R).
+    Returns (out_rows, H) f32 weighted sums (out_rows == R) — plus the
+    updated workspace dict when ``state`` is given (the barrier-free LL
+    transport; fused only).
     """
     total = flat_e.shape[0]
+    new_state = None
     order = jnp.argsort(flat_e, stable=True).astype(jnp.int32)
     valid_a = flat_e < ctx.num_experts
     n_valid = jnp.sum(valid_a.astype(jnp.int32))
@@ -296,6 +393,12 @@ def _ep_assignments_device(ctx: EPMoEContext, x, flat_e, w_flat, out_rows,
 
     transport = ctx.transport
     if transport == "fused" and ctx.max_m < total:
+        if state is not None:
+            raise ValueError(
+                f"ep_moe LL state: max_m={ctx.max_m} < M·topk={total} — "
+                "the fused transport needs full-assignment capacity and "
+                "the persistent workspaces are sized by it"
+            )
         # the fused aligned payload must hold EVERY assignment; a
         # per-peer-capacity max_m (< M·topk — the documented sizing the
         # staged transport clamps against) degrades to the padded-slot
@@ -318,29 +421,57 @@ def _ep_assignments_device(ctx: EPMoEContext, x, flat_e, w_flat, out_rows,
         # single staging pass: gather straight from x into the aligned
         # per-peer segments (no x_sorted materialization, no slot
         # inflation — the reference's on-device range computation)
-        counts, offs, offs_al, offs_w = md.aligned_offsets(a2a, splits)
+        counts, offs, offs_al, sendk = md.send_plan(a2a, splits)
         peer, dest = md.assignment_dest(a2a, flat_e[order], offs, offs_al)
         payload, scales = md.stage_aligned(
             a2a, x, order // ctx.topk, dest, n_valid
         )
-        meta = md.meta_payload(a2a, splits, scales, offs_al, offs_w)
-        recv_tok, recv_meta = md.dispatch_device(a2a, payload, offs_w, meta)
-        toks, rspl, shift = md.recv_view(a2a, recv_tok, recv_meta)
+        meta = md.meta_payload(a2a, splits, scales, offs_al, sendk)
+        if state is None:
+            recv_tok, recv_meta = md.dispatch_device(
+                a2a, payload, offs_al, sendk, meta
+            )
+        else:
+            dtok, dmeta = md.dispatch_ll_device(
+                a2a, payload, offs_al, sendk, meta,
+                state["parity"], state["disp_tok"], state["disp_meta"],
+                instance,
+            )
+            recv_tok, recv_meta = md.ll_window(a2a, dtok, dmeta,
+                                               state["parity"])
+        toks, rspl = md.recv_view(a2a, recv_tok, recv_meta)
 
-        slot_m = md.max_pad(a2a)
-        eid, valid = _slot_tables(ctx, rspl, slot_m, shift)
+        slot_m = md.slot_pad(a2a)
+        eid, valid = _slot_tables(ctx, rspl, slot_m)
         y = _expert_mlp(
             ctx, toks.reshape(ctx.n * slot_m, ctx.hidden), eid, valid,
             w_up, w_down,
         )
-        # return leg: slot-regular — the same window kernel with static
-        # slot offsets carries it back
+        # return leg: slot-regular — the same chunked kernel with static
+        # slot offsets carries back exactly the received row ranges
         y_tok, y_meta = md.stage_return(
             a2a, y.reshape(ctx.n, slot_m, ctx.hidden)
         )
-        comb_tok, comb_meta = md.combine_device(a2a, y_tok, y_meta)
+        retk = -(-jnp.sum(rspl, axis=1) // md.chunk_rows(a2a))
+        if state is None:
+            comb_tok, comb_meta = md.combine_device(
+                a2a, y_tok, y_meta, retk, sendk
+            )
+        else:
+            ctok, cmeta = md.combine_ll_device(
+                a2a, y_tok, y_meta, retk, sendk,
+                state["parity"], state["comb_tok"], state["comb_meta"],
+                instance + 1,
+            )
+            comb_tok, comb_meta = md.ll_window(a2a, ctok, cmeta,
+                                               state["parity"])
+            new_state = {
+                "parity": (state["parity"] + 1) % 2,
+                "disp_tok": dtok, "disp_meta": dmeta,
+                "comb_tok": ctok, "comb_meta": cmeta,
+            }
         y_sorted = md.combine_view(
-            a2a, comb_tok, comb_meta, peer, dest, offs_w, n_valid
+            a2a, comb_tok, comb_meta, peer, dest, offs_al, n_valid
         )
     else:
         x_sorted = x[order // ctx.topk].astype(ctx.dtype)
@@ -359,12 +490,14 @@ def _ep_assignments_device(ctx: EPMoEContext, x, flat_e, w_flat, out_rows,
     w_sorted = w_flat[order]
     # masked assignments carry weight exactly 0, but their y rows may be
     # garbage (untransported window slack) — zero them before the MAC so
-    # a stray inf/nan cannot poison the sum
+    # a stray inf/nan cannot poison the sum. Under debug_checksum the
+    # poison NaNs ride rows with nonzero weight, so they stay loud.
     y_use = jnp.where(
         (w_sorted != 0)[:, None], y_sorted.astype(jnp.float32), 0.0
     )
     out = jnp.zeros((out_rows, ctx.hidden), jnp.float32)
-    return out.at[order // ctx.topk].add(y_use * w_sorted[:, None])
+    out = out.at[order // ctx.topk].add(y_use * w_sorted[:, None])
+    return (out, new_state) if state is not None else out
 
 
 def _rail_stage(ctx: EPMoEContext, x, ids, weights):
@@ -458,54 +591,97 @@ def _ep_moe_hier_device(x, logits, w_up, w_down, ctx: EPMoEContext):
     return out.astype(x.dtype)
 
 
-def ep_moe_device(x, logits, w_up, w_down, ctx: EPMoEContext):
+def ep_moe_device(x, logits, w_up, w_down, ctx: EPMoEContext, state=None,
+                  instance=0):
     """Per-device EP MoE body — callable inside any shard_map.
 
     x: (M, H) this rank's tokens; logits: (M, E); w_up: (epr, H, F),
-    w_down: (epr, F, H) — this rank's experts. Returns (M, H).
+    w_down: (epr, F, H) — this rank's experts. Returns (M, H), plus the
+    updated LL workspace dict when ``state`` is given.
     """
     assert ctx.transport in ("fused", "pallas", "xla"), (
         f"unresolved transport {ctx.transport!r} — build contexts via "
         "create_ep_moe_context"
     )
     if ctx.dcn_axis is not None:
+        assert state is None, "LL state rides the flat fused transport only"
         return _ep_moe_hier_device(x, logits, w_up, w_down, ctx)
     weights, ids = mu.select_experts(logits, ctx.topk)
-    out = _ep_assignments_device(
+    res = _ep_assignments_device(
         ctx, x, ids.reshape(-1).astype(jnp.int32),
         weights.reshape(-1).astype(jnp.float32), x.shape[0], w_up, w_down,
+        state=state, instance=instance,
     )
-    return out.astype(x.dtype)
+    if state is not None:
+        out, new_state = res
+        return out.astype(x.dtype), new_state
+    return res.astype(x.dtype)
 
 
 @functools.lru_cache(maxsize=64)
-def _build_ep_moe(ctx: EPMoEContext, ikey: tuple = ()):
+def _build_ep_moe(ctx: EPMoEContext, ikey: tuple = (), instance=None):
     # ikey: config.interp_key() — chaos/race knobs are baked in at trace
     # time, so they must participate in the cache identity (like every
     # other kernel builder; del keeps the signature honest about usage).
+    # instance: the EPMoEState identity (None → stateless barrier mode).
     del ikey
     rows = P(tuple(ctx.batch_axes) + ctx.ep_axes)
     experts = P(ctx.ep_axes)
+    if instance is None:
+        fn = jax.shard_map(
+            functools.partial(ep_moe_device, ctx=ctx),
+            mesh=ctx.mesh,
+            in_specs=(rows, rows, experts, experts),
+            out_specs=rows,
+            check_vma=False,
+        )
+        return jax.jit(fn)
+    ws_specs = {
+        "parity": P(),
+        "disp_tok": rows, "disp_meta": rows,
+        "comb_tok": rows, "comb_meta": rows,
+    }
+    def body(x, logits, w_up, w_down, ws):
+        return ep_moe_device(
+            x, logits, w_up, w_down, ctx, state=ws, instance=instance
+        )
+
     fn = jax.shard_map(
-        functools.partial(ep_moe_device, ctx=ctx),
+        body,
         mesh=ctx.mesh,
-        in_specs=(rows, rows, experts, experts),
-        out_specs=rows,
+        in_specs=(rows, rows, experts, experts, ws_specs),
+        out_specs=(rows, ws_specs),
         check_vma=False,
     )
-    return jax.jit(fn)
+    # donate the workspaces: the LL protocol REQUIRES the same physical
+    # buffers to carry every call (skewed peers' in-flight DMAs target
+    # the persistent addresses)
+    return jax.jit(fn, donate_argnums=(4,))
 
 
-def ep_moe(x, logits, w_up, w_down, ctx: EPMoEContext):
+def ep_moe(x, logits, w_up, w_down, ctx: EPMoEContext, state=None):
     """Host entry: EP MoE MLP on ``ctx.mesh``.
 
     Global shapes: x (M, H) and logits (M, E) token-sharded over
     ``ctx.axis``; w_up (E, H, F) / w_down (E, F, H) expert-sharded over
     ``ctx.axis``. Returns (M, H) token-sharded.
+
+    With ``state`` (an :class:`EPMoEState` from
+    :func:`create_ep_moe_state`): the fused transport runs BARRIER-FREE
+    over the state's persistent double-buffered workspaces and the call
+    returns ``(out, state')`` — thread ``state'`` into the next call
+    (the reference's call_count protocol, low_latency_all_to_all.py:
+    97-118, as a functional carry usable inside jitted decode loops).
     """
     from triton_distributed_tpu.config import interp_key
 
-    return _build_ep_moe(ctx, interp_key())(x, logits, w_up, w_down)
+    if state is None:
+        return _build_ep_moe(ctx, interp_key())(x, logits, w_up, w_down)
+    if ctx.transport != "fused":
+        raise ValueError("ep_moe state= requires transport='fused'")
+    fn = _build_ep_moe(ctx, interp_key(), state.instance)
+    out, ws = fn(x, logits, w_up, w_down, state.as_dict())
+    return out, EPMoEState(instance=state.instance, **ws)
 
 
 _EP_MOE_TUNERS: OrderedDict = OrderedDict()

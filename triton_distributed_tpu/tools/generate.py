@@ -91,12 +91,23 @@ def main(argv=None) -> None:
     t_prefill = time.perf_counter() - t0
 
     first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+    # LL workspaces for EP-MoE decode (None for dense presets / off-TPU)
+    moe_state = model.init_decode_state(args.batch)
     # one warm step to exclude decode compile from the timing
-    _, caches_w, lens_w = model._decode_jit(params, caches, lens, first)
+    if moe_state is None:
+        _, caches_w, lens_w = model._decode_jit(params, caches, lens, first)
+    else:
+        # the state is donated per step — keep threading the returned one
+        _, caches_w, lens_w, moe_state = model._decode_jit_state(
+            params, caches, lens, first, moe_state
+        )
     jax.block_until_ready(lens_w)
 
     t0 = time.perf_counter()
-    toks, caches, lens = model.generate(params, caches, lens, first, args.steps)
+    res = model.generate(
+        params, caches, lens, first, args.steps, moe_state=moe_state
+    )
+    toks, caches, lens = res[:3]
     toks = np.asarray(toks)  # host fetch = the reliable fence
     t_decode = time.perf_counter() - t0
 

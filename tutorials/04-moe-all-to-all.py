@@ -46,26 +46,29 @@ out = jax.jit(jax.shard_map(
 np.testing.assert_allclose(np.asarray(out).reshape(n, M, H), toks, rtol=1e-6)
 print("tutorial 04 OK: dispatch/combine round-trip is exact")
 
-# ---- the FUSED window-DMA dispatch (kernels/moe_dispatch): the
-# transport kernel ships each peer's aligned payload window directly —
-# no padded-slot staging; this is the 80 µs headline path (docs/PERF.md)
+# ---- the FUSED count-bounded dispatch (kernels/moe_dispatch): the
+# transport kernel ships each peer ceil(count/chunk) chunk DMAs straight
+# from the aligned expert-sorted payload — wire bytes track the true
+# counts (≡ the reference's exact per-expert ranges,
+# low_latency_all_to_all.py:62-90); this is the headline path (docs/PERF.md)
 from triton_distributed_tpu.kernels import moe_dispatch as mdk
 
 def fused_roundtrip(t_loc, se_loc, spl_loc):
     spl_loc = spl_loc.reshape(-1)
     T = t_loc.shape[0]
-    counts, offs, offs_al, offs_w = mdk.aligned_offsets(ctx, spl_loc)
+    counts, offs, offs_al, sendk = mdk.send_plan(ctx, spl_loc)
     peer, dest = mdk.assignment_dest(ctx, se_loc, offs, offs_al)
     payload, scales = mdk.stage_aligned(
         ctx, t_loc, jnp.arange(T, dtype=jnp.int32), dest, T
     )
-    meta = mdk.meta_payload(ctx, spl_loc, scales, offs_al, offs_w)
-    rtok, rmeta = mdk.dispatch_device(ctx, payload, offs_w, meta)
-    toks_in, rspl, shift = mdk.recv_view(ctx, rtok, rmeta)
+    meta = mdk.meta_payload(ctx, spl_loc, scales, offs_al, sendk)
+    rtok, rmeta = mdk.dispatch_device(ctx, payload, offs_al, sendk, meta)
+    toks_in, rspl = mdk.recv_view(ctx, rtok, rmeta)
     # identity "expert compute", then the slot-regular return leg
     y_tok, y_meta = mdk.stage_return(ctx, toks_in)
-    c_tok, c_meta = mdk.combine_device(ctx, y_tok, y_meta)
-    return mdk.combine_view(ctx, c_tok, c_meta, peer, dest, offs_w, T)
+    retk = -(-jnp.sum(rspl, axis=1) // mdk.chunk_rows(ctx))
+    c_tok, c_meta = mdk.combine_device(ctx, y_tok, y_meta, retk, sendk)
+    return mdk.combine_view(ctx, c_tok, c_meta, peer, dest, offs_al, T)
 
 rt = jax.jit(jax.shard_map(
     fused_roundtrip, mesh=mesh, in_specs=(P("x"), P("x"), P("x")),
@@ -74,4 +77,4 @@ rt = jax.jit(jax.shard_map(
         jax.device_put(jnp.asarray(assign.astype(np.int32)).reshape(-1), sh),
         jax.device_put(jnp.asarray(splits).reshape(n * E), sh))
 np.testing.assert_allclose(np.asarray(rt).reshape(n, M, H), toks, rtol=1e-5)
-print("tutorial 04 OK: fused window-DMA dispatch round-trip is exact")
+print("tutorial 04 OK: fused chunked-DMA dispatch round-trip is exact")
