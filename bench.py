@@ -473,20 +473,27 @@ def _bench_serving_moe_decode(mesh, n, on_tpu, spec):
     # (production decode donates the state per step — _decode_jit_state)
     moe_state = model.init_decode_state(b) if n == 1 else None
 
+    # params ride the CARRY, not the closure: closed-over device arrays
+    # are embedded in the lowered module as literal constants, and ~1 GB
+    # of weights blows the axon relay's compile-request size limit
+    # (observed HTTP 413); as loop-invariant carry entries they lower as
+    # parameters and XLA hoists them.
     def step(state, s):
-        caches, lens, toks, mst = state
+        prm, caches, lens, toks, mst = state
         if mst is None:
-            logits, caches, lens = model.decode_step(params, caches, lens, toks)
+            logits, caches, lens = model.decode_step(prm, caches, lens, toks)
         else:
             logits, caches, lens, mst = model.decode_step(
-                params, caches, lens, toks, mst
+                prm, caches, lens, toks, mst
             )
         toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         s = s + jnp.sum(toks.astype(jnp.float32))
-        return (caches, lens, toks, mst), s
+        return (prm, caches, lens, toks, mst), s
 
     lo, hi = (8, 64) if on_tpu else (1, 3)
-    t_step = bench_loop(step, (caches, lens, toks0, moe_state), lo=lo, hi=hi)
+    t_step = bench_loop(
+        step, (params, caches, lens, toks0, moe_state), lo=lo, hi=hi
+    )
 
     # MoE block alone at the same shapes (own LL state)
     blk = params["blocks"][0]
@@ -500,17 +507,19 @@ def _bench_serving_moe_decode(mesh, n, on_tpu, spec):
     w_down = blk["moe_down"].astype(cfg.dtype)
 
     def moe_step(state, s):
-        x, mst = state
-        logits_r = x.astype(jnp.float32) @ blk["router"]
+        x, router, up, down, mst = state
+        logits_r = x.astype(jnp.float32) @ router
         if mst is None:
-            y = ep_moe(x, logits_r, w_up, w_down, ctx)
+            y = ep_moe(x, logits_r, up, down, ctx)
         else:
-            y, mst = ep_moe(x, logits_r, w_up, w_down, ctx, state=mst)
+            y, mst = ep_moe(x, logits_r, up, down, ctx, state=mst)
         s = s + jnp.sum(y.astype(jnp.float32))
-        return (perturb(x, s), mst), s
+        return (perturb(x, s), router, up, down, mst), s
 
     lo2, hi2 = (16, 128) if on_tpu else (1, 3)
-    t_moe = bench_loop(moe_step, (x0, mst2), lo=lo2, hi=hi2)
+    t_moe = bench_loop(
+        moe_step, (x0, blk["router"], w_up, w_down, mst2), lo=lo2, hi=hi2
+    )
 
     return {
         "metric": "serving_moe_decode_step",

@@ -289,6 +289,43 @@ class TestCollectiveFamilies:
             _sds(tmesh, (8 * 2 * 8 * mr, md.META_W), jnp.int32, "x"),
         )
 
+    def test_hier_ag_gemm_dcn_overlap(self, tmesh):
+        """VERDICT r3 #5: the chunked hierarchical AG-GEMM's compiled
+        schedule must fly a rail fetch (collective-permute) UNDER a
+        Mosaic ring call — assert a custom-call sits between an async
+        permute's start and done in the optimized module."""
+        from triton_distributed_tpu.kernels.ag_gemm import _build_fused, _specs
+        from jax.experimental import topologies
+
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:2x4"
+        )
+        hmesh = topologies.make_mesh(topo, (4, 2), ("tp", "dcn"))
+        m, k, nn = 1024, 256, 2048
+        fn = _build_fused(
+            hmesh, "tp", (), (m, k), (k, nn), jnp.dtype(jnp.bfloat16),
+            jnp.dtype(jnp.bfloat16), 5, interp_key(), True, "dcn",
+        )
+        (a_spec, b_spec), _ = _specs("tp", (), "dcn")
+        low = fn.lower(
+            _sds(hmesh, (m, k), jnp.bfloat16, *a_spec),
+            _sds(hmesh, (k, nn), jnp.bfloat16, *b_spec),
+        )
+        txt = low.compile().as_text()
+        in_flight = False
+        straddle = False
+        for line in txt.splitlines():
+            if "collective-permute-start" in line:
+                in_flight = True
+            elif "collective-permute-done" in line:
+                in_flight = False
+            elif "custom-call" in line and in_flight:
+                straddle = True
+        assert straddle, (
+            "no Mosaic call scheduled inside a collective-permute "
+            "start/done window — the DCN rail is not overlapping"
+        )
+
     def test_ep_moe_decode_step_fused(self, tmesh):
         """The COMPOSED serving path (VERDICT r3 #4): a full
         Transformer.decode_step — SP flash-decode attention + EP-MoE

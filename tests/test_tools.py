@@ -159,6 +159,25 @@ class TestProfile:
     def test_merge_empty_returns_none(self, tmp_path):
         assert merge_chrome_traces(tmp_path) is None
 
+    def test_merge_refuses_partial_multiprocess(self, tmp_path, monkeypatch):
+        """On a multi-process run, a merge that can only see the local
+        host's traces must refuse loudly, not silently produce a
+        partial timeline (VERDICT r3 weak #6)."""
+        import jax
+
+        sub = tmp_path / "process-0" / "plugins" / "profile"
+        sub.mkdir(parents=True)
+        with gzip.open(sub / "host.trace.json.gz", "wt") as f:
+            json.dump({"traceEvents": [{"pid": 1, "name": "op"}]}, f)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        with pytest.raises(RuntimeError, match="gather_traces"):
+            merge_chrome_traces(tmp_path)
+
+    def test_gather_traces_single_process_noop(self, tmp_path):
+        from triton_distributed_tpu.tools import gather_traces
+
+        assert gather_traces(tmp_path) == pathlib.Path(tmp_path)
+
     def test_group_profile_writes(self, tmp_path):
         with group_profile(tmp_path):
             jnp.dot(jnp.ones((32, 32)), jnp.ones((32, 32))).block_until_ready()

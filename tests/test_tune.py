@@ -80,6 +80,54 @@ class TestAutotuner:
         assert len([l for l in log if "best" in l]) == 2
 
 
+class TestPairedBench:
+    """VERDICT r3 #8: the paired (snake-order + within-round
+    normalization) ranking must stay stable under a monotonic
+    interference ramp that flips the naive independent ranking."""
+
+    def test_paired_ranking_survives_drift(self, tmp_path, monkeypatch):
+        import triton_distributed_tpu.tune.autotuner as at
+
+        monkeypatch.setenv("TDTPU_AUTOTUNE_LOG_DIR", str(tmp_path))
+        # true costs: config B is 2% FASTER; background interference
+        # ramps +5% per measurement window — larger than the real gap
+        true_ms = {1: 1.00, 2: 0.98}
+        step = [0]
+        schedule = []
+
+        def fake_perf(fn, warmup=0, iters=1):
+            out = fn()       # the thunk returns its config's `a`
+            a = int(out)
+            ms = true_ms[a] * (1.0 + 0.05 * step[0])
+            schedule.append((a, ms))
+            step[0] += 1
+            return out, ms
+
+        monkeypatch.setattr(at, "perf_func", fake_perf)
+
+        tuner = at.ContextualAutoTuner(
+            lambda *, a: a, [{"a": 1}, {"a": 2}],
+            name="paired", rounds=2, warmup=0, iters=1, log=False,
+            persist=False,
+        )
+        best = tuner.pick()
+        assert best == {"a": 2}, f"paired ranking picked {best}"
+
+        # the same scripted measurements mislead the INDEPENDENT
+        # (forward-order, median-of-absolute) ranking: A is measured
+        # first in every round, so the ramp penalizes B systematically
+        fwd = {1: [], 2: []}
+        t = 0
+        for _ in range(2):
+            for a in (1, 2):
+                fwd[a].append(true_ms[a] * (1.0 + 0.05 * t))
+                t += 1
+        assert np.median(fwd[1]) < np.median(fwd[2]), (
+            "drift scenario no longer flips the independent ranking — "
+            "strengthen the ramp"
+        )
+
+
 class TestWinnerValidation:
     """Persisted winners are TTL'd and re-validated against the recorded
     runner-up (VERDICT r2 #8): a noise-artifact winner heals instead of

@@ -226,12 +226,21 @@ def _build_fused(
     chaos, return_gathered=True, dcn_axis=None,
 ):
     """Fused engine. ``dcn_axis`` set = the hierarchical decomposition
-    (≡ the reference's inter-node AG-GEMM, allgather.py:291-375): a
-    ``lax.all_gather`` rail leg over the DCN axis feeds the SAME fused
-    Pallas ring, which runs intra-slice over ``axis`` with nd× larger
-    slabs. Row layout is axis-major — rows sharded P((axis, dcn_axis)) —
-    so the railed rows stay contiguous per ring slab and the kernel is
-    unchanged."""
+    (≡ the reference's inter-node AG-GEMM, allgather.py:291-375): the
+    DCN rail leg feeds the SAME fused Pallas ring, which runs
+    intra-slice over ``axis``. Row layout is axis-major — rows sharded
+    P((axis, dcn_axis)) — so railed rows stay slab-contiguous.
+
+    Round 4 (VERDICT r3 #5): the rail is CHUNKED for overlap — instead
+    of one serial ``all_gather`` completing before the ring starts, the
+    other slices' rows arrive as nd−1 INDEPENDENT ``ppermute`` fetches
+    issued up front, and the fused ring runs once per slice chunk
+    (local slice first, railed chunks as they land). Nothing in the
+    chunk-s ring depends on chunk s+1's fetch, so XLA's async collective
+    machinery can fly the DCN legs under the Mosaic calls (≡ the
+    reference running inter-node puts concurrently with intra-node
+    copies and the consumer GEMM, allgather.py:291-375). Falls back to
+    the serial rail when the per-slice slab admits no blocking."""
     n = mesh.shape[axis]
     nd = mesh.shape[dcn_axis] if dcn_axis else 1
     k = a_shape[1]
@@ -251,42 +260,97 @@ def _build_fused(
         # kernel that never does (same convention as gemm_rs)
         collective_id = None
 
-    call = lang.shmem_call(
-        functools.partial(
-            _fused_kernel, n, axis, mesh.axis_names, blocks, return_gathered
-        ),
-        out_shape=[
-            jax.ShapeDtypeStruct((m_gathered, n_local), out_dtype),
-            jax.ShapeDtypeStruct((m_gathered, k), dtype),  # gathered A
-        ],
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((blocks[0], blocks[2]), jnp.float32),
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
-            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
-        ],
-        collective_id=collective_id,
-        vmem_limit_bytes=fused_vmem_budget(),
-        name="ag_gemm_fused",
-    )
+    def mk_call(m_g, blk, cid):
+        return lang.shmem_call(
+            functools.partial(
+                _fused_kernel, n, axis, mesh.axis_names, blk, return_gathered
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((m_g, n_local), out_dtype),
+                jax.ShapeDtypeStruct((m_g, k), dtype),  # gathered A
+            ],
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((blk[0], blk[2]), jnp.float32),
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+                pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            ],
+            collective_id=cid,
+            vmem_limit_bytes=fused_vmem_budget(),
+            name="ag_gemm_fused",
+        )
+
     in_specs, out_specs = _specs(axis, batch_axes, dcn_axis)
     ba = tuple(batch_axes)
     ag_spec = P(ba if ba else None, None)
+    m_dev = m_gathered // (n * nd)
+    chunk_blocks = (
+        pick_mm_blocks(m_dev, k, n_local, dtype.itemsize)
+        if dcn_axis is not None and nd > 1 else None
+    )
     if dcn_axis is None:
-        body = call
-    else:
+        body = mk_call(m_gathered, blocks, collective_id)
+    elif chunk_blocks is None:
+        call = mk_call(m_gathered, blocks, collective_id)
+
         def body(a_loc, b_loc):
-            # DCN rail leg: gather my axis-position's rows across slices
-            # (axis-major rows → the railed slab is contiguous)
+            # serial rail fallback: gather my axis-position's rows across
+            # slices (axis-major rows → the railed slab is contiguous)
             return call(jax.lax.all_gather(a_loc, dcn_axis, tiled=True), b_loc)
+    else:
+        # distinct collective_ids per chunk ring: strict per-chunk
+        # rendezvous on the barrier semaphore (a skewed neighbor's
+        # chunk-s+1 signal must not satisfy a chunk-s wait); offset into
+        # a high id range so no other kernel family collides
+        chunk_calls = [
+            mk_call(
+                n * m_dev, chunk_blocks,
+                None if collective_id is None else collective_id + 64 + s,
+            )
+            for s in range(nd)
+        ]
+
+        def body(a_loc, b_loc):
+            my = jax.lax.axis_index(dcn_axis)
+            # nd−1 independent rail fetches, all issued before any ring:
+            # chunk s holds slice (my − s)'s rows
+            chunks = [a_loc] + [
+                jax.lax.ppermute(
+                    a_loc, dcn_axis,
+                    [(i, (i + s) % nd) for i in range(nd)],
+                )
+                for s in range(1, nd)
+            ]
+            pieces = [
+                chunk_calls[s](chunks[s], b_loc) for s in range(nd)
+            ]
+            o = jnp.stack([p[0] for p in pieces])   # (nd, n·m_dev, n_local)
+            g = jnp.stack([p[1] for p in pieces])   # (nd, n·m_dev, k)
+            order = jnp.mod(my - jnp.arange(nd), nd)  # chunk idx per slice
+
+            def reorder(x):
+                # chunk-major → the axis-major global row order the
+                # out_specs promise: [axis pos][slice][m_dev]
+                x = jnp.take(x, order, axis=0)
+                x = x.reshape(nd, n, m_dev, x.shape[-1])
+                return jnp.transpose(x, (1, 0, 2, 3)).reshape(
+                    n * nd * m_dev, x.shape[-1]
+                )
+
+            if not return_gathered:
+                # the gathered-A output is dead to the caller — a flat
+                # reshape satisfies the shape without paying a ~full-A
+                # gather+transpose copy per step
+                return reorder(o), g.reshape(n * nd * m_dev, k)
+            return reorder(o), reorder(g)
     fn = jax.shard_map(
         body,
         mesh=mesh,

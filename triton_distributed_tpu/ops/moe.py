@@ -603,8 +603,15 @@ def ep_moe_device(x, logits, w_up, w_down, ctx: EPMoEContext, state=None,
         f"unresolved transport {ctx.transport!r} — build contexts via "
         "create_ep_moe_context"
     )
+    if state is not None and (ctx.transport != "fused" or ctx.dcn_axis):
+        # reject here (not just in the ep_moe host entry): a state
+        # silently dropped on a downgraded transport would surface as
+        # None['parity'] a step later, far from the cause
+        raise ValueError(
+            "ep_moe_device state= rides the flat fused transport only "
+            f"(got transport={ctx.transport!r}, dcn_axis={ctx.dcn_axis!r})"
+        )
     if ctx.dcn_axis is not None:
-        assert state is None, "LL state rides the flat fused transport only"
         return _ep_moe_hier_device(x, logits, w_up, w_down, ctx)
     weights, ids = mu.select_experts(logits, ctx.topk)
     res = _ep_assignments_device(

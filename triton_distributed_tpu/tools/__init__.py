@@ -24,6 +24,7 @@ from triton_distributed_tpu.tools.native import (
 )
 from triton_distributed_tpu.tools.profile import (
     group_profile,
+    gather_traces,
     merge_chrome_traces,
 )
 
@@ -33,6 +34,7 @@ __all__ = [
     "aot_compile_spaces",
     "AotLibrary",
     "group_profile",
+    "gather_traces",
     "merge_chrome_traces",
     "native_lib",
     "artifact_write",

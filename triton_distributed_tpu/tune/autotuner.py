@@ -187,11 +187,26 @@ class ContextualAutoTuner:
         return best if same else None
 
     def _bench(self, args, kwargs, configs=None):
+        """PAIRED benching (the bench.py methodology, applied to config
+        ranking): configs run round-robin in SNAKE order (forward, then
+        reversed — a config measured late in one round is measured early
+        in the next, so a monotonic drift in background interference
+        biases each config symmetrically), and ranking uses the mean of
+        WITHIN-ROUND-normalized times (each round's vector divided by
+        its own finite mean) — slowly-varying common-mode interference
+        cancels inside each round's comparison instead of shifting the
+        per-config medians independently. Returned magnitudes are
+        rescaled by the median round level so logged ms stay physical;
+        ratios (all any caller compares) are the normalized ones."""
         configs = self.configs if configs is None else configs
         per_round = np.full((self.rounds, len(configs)), np.inf)
         dead = [False] * len(configs)
         for r in range(self.rounds):
-            for i, cfg in enumerate(configs):
+            idx_order = range(len(configs))
+            if r % 2:
+                idx_order = reversed(list(idx_order))
+            for i in idx_order:
+                cfg = configs[i]
                 if dead[i]:
                     continue
                 try:
@@ -213,7 +228,24 @@ class ContextualAutoTuner:
                                 "name": self.name, "config": cfg,
                                 "error": traceback.format_exc(limit=1),
                             }) + "\n")
-        times = np.median(per_round, axis=0)
+        finite = np.isfinite(per_round)
+        scales = np.array([
+            row[ok].mean() if ok.any() else np.nan
+            for row, ok in zip(per_round, finite)
+        ])
+        ok_rows = np.isfinite(scales) & (scales > 0)
+        if ok_rows.any():
+            norm = per_round[ok_rows] / scales[ok_rows, None]
+            with np.errstate(invalid="ignore"):
+                # mean over rounds of within-round relative time; inf
+                # rows (config died mid-sweep) stay inf via the mask
+                times = np.where(
+                    np.isfinite(norm).all(axis=0),
+                    np.where(np.isfinite(norm), norm, 0).mean(axis=0),
+                    np.inf,
+                ) * float(np.median(scales[ok_rows]))
+        else:
+            times = np.full(len(configs), np.inf)
         times[dead] = np.inf
         return _consensus_times(times)
 
