@@ -566,8 +566,12 @@ def ag_gemm(
     ``dcn_axis``: hierarchical TP spanning slices (≡ the reference's
     inter-node AG-GEMM, allgather.py:291-375). The TP factor is
     (axis, dcn_axis) with AXIS-MAJOR ordering — rows P((axis, dcn_axis)),
-    weight cols likewise: a ``lax.all_gather`` rail leg crosses DCN, the
-    fused Pallas ring stays intra-slice with nd× larger slabs.
+    weight cols likewise: the other slices' rows cross DCN as nd−1
+    independent ``ppermute`` fetches feeding per-slice fused rings
+    (local slice first), so the DCN legs fly under the Mosaic calls;
+    a serial ``lax.all_gather`` rail feeding one nd×-slab ring is the
+    fallback when the per-slice slab admits no blocking (see
+    _build_fused and docs/PERF.md's DCN-overlap section).
 
     ``return_gathered=True`` additionally returns the gathered activations
     (the reference exposes them in its symmetric workspace; callers reuse

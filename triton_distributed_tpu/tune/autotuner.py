@@ -82,11 +82,11 @@ class ContextualAutoTuner:
         self.iters = iters
         self.log = log
         self.persist = persist
-        # rounds > 1: bench configs round-robin and take per-config
-        # MEDIANS across rounds (the paired methodology bench.py uses) —
-        # slowly-varying interference on a time-shared chip hits every
-        # config in a round about equally, so interleaving + median
-        # de-noises rankings where a single mean window cannot.
+        # rounds > 1: bench configs round-robin in SNAKE order and rank
+        # by the mean of within-round-normalized times (see _bench) —
+        # slowly-varying interference on a time-shared chip cancels
+        # inside each round's comparison, and the alternating order
+        # symmetrizes any monotonic drift across a round.
         self.rounds = rounds
         # A persisted winner is re-validated on the first use per
         # process: winner and recorded runner-up are re-benched, and a
