@@ -78,6 +78,54 @@ class TestTraining:
 
 
 class TestDecode:
+    def test_decode_ll_state_matches_stateless(self, mesh_tp, monkeypatch):
+        """decode_step with the barrier-free LL MoE state EXECUTES (not
+        just compiles) and matches the stateless step bit-for-bit over
+        consecutive parities. Off-TPU the model normally demotes decode
+        to the XLA transport, so the fused context is forced here (tiny
+        shapes, interpreter-safe)."""
+        from triton_distributed_tpu import ops
+
+        model = _model(mesh_tp, moe="ep")
+
+        def fused_ctx(self, m_local, inference=False):
+            return ops.create_ep_moe_context(
+                self.mesh, self.tp_axis,
+                num_experts=self.config.num_experts, topk=self.config.topk,
+                max_m=m_local * self.config.topk, hidden=self.config.hidden,
+                dtype=self.config.dtype,
+                transport="fused" if inference else "xla",
+                use_pallas_gemm=False, block_m=8,
+                batch_axes=tuple(self.dp_axes),
+            )
+
+        monkeypatch.setattr(Transformer, "_moe_ep_ctx", fused_ctx)
+        params = _sharded_params(model)
+        b, smax = 8, 32
+        caches = model.init_cache(b, smax)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (b, 8), 0, 128)
+        last, caches, lens = model.prefill(params, caches, prompt)
+        first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+        state = model.init_decode_state(b)
+        assert state is not None and state[1] is not None  # MoE layer 1
+        ref_caches, ref_lens, ref_tok = caches, lens, first
+        ll_caches, ll_lens, ll_tok = caches, lens, first
+        for step in range(2):
+            ref_logits, ref_caches, ref_lens = model.decode_step(
+                params, ref_caches, ref_lens, ref_tok
+            )
+            ll_logits, ll_caches, ll_lens, state = model.decode_step(
+                params, ll_caches, ll_lens, ll_tok, state
+            )
+            np.testing.assert_allclose(
+                np.asarray(ll_logits), np.asarray(ref_logits),
+                atol=1e-5, rtol=1e-5,
+            )
+            ref_tok = jnp.argmax(ref_logits, axis=-1).astype(jnp.int32)
+            ll_tok = jnp.argmax(ll_logits, axis=-1).astype(jnp.int32)
+            assert int(np.asarray(state[1].parity)[0]) == (step + 1) % 2
+
     def test_sp_decode_matches_dense(self, mesh_tp):
         """generate() through the distributed flash-decode layer must
         match a dense incremental decode. Tokens are compared only where

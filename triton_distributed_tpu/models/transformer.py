@@ -174,10 +174,15 @@ class Transformer:
             and compiling_for_tpu()
             and not is_dcn_axis(self.mesh, self.tp_axis)
         )
+        # the scalar-prefetch grouped-GEMM kernel wins the decode-size
+        # expert MLP on hardware (measured 2602 → 2197 µs/block at the
+        # serving headline, block_m 256); off-TPU / training keep the
+        # differentiable ragged_dot path
         return ops.create_ep_moe_context(
             self.mesh, self.tp_axis, num_experts=c.num_experts, topk=c.topk,
             max_m=m_local * c.topk, hidden=c.hidden, dtype=c.dtype,
-            transport="fused" if fused_ok else "xla", use_pallas_gemm=False,
+            transport="fused" if fused_ok else "xla",
+            use_pallas_gemm=fused_ok, block_m=256 if fused_ok else 128,
             batch_axes=tuple(self.dp_axes),
         )
 
