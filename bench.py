@@ -131,11 +131,27 @@ def bench_paired(step_a, step_b, state, *, lo=8, hi=40, reps=11):
             tas.append(ta)
             tbs.append(tb)
     if not ratios:
-        # every rep lost a side to noise (µs-scale CPU deltas): a
-        # last-resort unpaired fallback beats aborting the whole bench
-        ta = max(delta(a_lo, a_hi), 1e-9)
-        tb = max(delta(b_lo, b_hi), 1e-9)
-        return ta, tb, tb / ta, (tb / ta, tb / ta)
+        # every rep lost a side to noise (µs-scale CPU deltas): one
+        # last-resort UNPAIRED attempt, reported as untrusted (NaN IQR
+        # + stderr warning) — fabricated confidence would be worse than
+        # aborting, and a still-negative delta does abort
+        ta = delta(a_lo, a_hi)
+        tb = delta(b_lo, b_hi)
+        if ta <= 0 or tb <= 0:
+            raise RuntimeError(
+                "bench_paired: no positive paired deltas and the "
+                "unpaired fallback is non-positive too — noise swamped "
+                "the measurement; raise lo/hi"
+            )
+        print(
+            json.dumps({
+                "warning": "bench_paired fell back to a single UNPAIRED "
+                "comparison (all paired reps lost a side to noise); "
+                "ratio is order-biased and IQR is undefined",
+            }),
+            file=sys.stderr, flush=True,
+        )
+        return ta, tb, tb / ta, (float("nan"), float("nan"))
     tas, tbs, ratios = map(np.asarray, (tas, tbs, ratios))
     # outlier rejection: an interference burst on one side of a pair
     # collapses (or inflates) that delta and its ratio explodes — keep

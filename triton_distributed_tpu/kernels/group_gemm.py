@@ -49,11 +49,13 @@ def _ggemm_kernel(nsteps_k, be_ref, x_ref, w_ref, o_ref, acc_ref):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("block_m", "block_n", "block_k", "interpret"),
+    static_argnames=("block_m", "block_n", "block_k", "vmem_limit_bytes",
+                     "interpret"),
 )
 def grouped_matmul(
     x_sorted, w, block_expert, *,
     block_m: int = 512, block_n: int = 2048, block_k: int = 512,
+    vmem_limit_bytes: int | None = None,
     interpret=None,
 ):
     """x_sorted (cap, K) @ w (E, K, N) → (cap, N), expert per M-block.
@@ -64,6 +66,19 @@ def grouped_matmul(
     4096×2048 bf16): (512, 2048, 512) → 168 TFLOP/s (MFU 0.85) vs 121
     for the old (256, 512, 512). Smaller block_m trades MXU efficiency
     for less routing padding — contexts keep their own defaults.
+
+    WEIGHT-RESIDENT mode (decode sizes): ``block_n``/``block_k`` ≥ the
+    whole N/K dims (pass e.g. 1<<30; rounded down to the dims) keep an
+    expert's ENTIRE weight matrix in VMEM — the W BlockSpec index
+    (be[m], 0, 0) is unchanged across that expert's consecutive sorted
+    M-blocks, so Mosaic's pipeline skips the re-fetch and weight
+    traffic drops from #blocks× to #expert-runs× the matrix. That lets
+    ``block_m`` shrink (less alignment padding → fewer padded-row
+    FLOPs) without the weight re-streaming penalty that otherwise
+    punishes small blocks — measured 1235 → 1130 µs on the serving
+    decode pair at (64, whole, whole) vs (256, 2048, 512), docs/PERF.md.
+    Whole-dim tiles exceed Mosaic's 16 MB default scoped VMEM — pass
+    ``vmem_limit_bytes`` (the contexts use config.fused_vmem_budget()).
     """
     from triton_distributed_tpu.config import compiling_for_tpu
     from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
@@ -94,6 +109,9 @@ def grouped_matmul(
         functools.partial(_ggemm_kernel, nsteps_k),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((cap, ndim), x_sorted.dtype),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_limit_bytes
+        ),
         interpret=local_interpret() if interpret is None else interpret,
     )
     return call(block_expert, x_sorted, w)

@@ -65,6 +65,14 @@ class TransformerConfig:
     num_experts: int = 8
     topk: int = 2
     norm_eps: float = 1e-5
+    # Quantized wire for the fused EP-MoE DECODE transport ("fp8" |
+    # "int8" | None): tokens cross the a2a at 1 byte/elem with
+    # per-token scales in the metadata (≡ the reference's headline fp8
+    # WITH_SCALE dispatch). Halves the decode wire bytes at n>1;
+    # measured neutral at n=1 self-transport (docs/PERF.md). Training
+    # and prefill paths are unaffected (they ride the differentiable
+    # full-precision transport).
+    moe_wire_quant: str | None = None
     # rematerialize each block in backward (jax.checkpoint): trades one
     # extra forward per block for O(n_layers) less activation memory —
     # the standard long-context / large-model training knob. Off-TPU the
@@ -83,6 +91,11 @@ class TransformerConfig:
         if self.moe not in ("none", "tp", "ep"):
             raise ValueError(
                 f"moe must be 'none', 'tp' or 'ep', got {self.moe!r}"
+            )
+        if self.moe_wire_quant not in (None, "fp8", "int8"):
+            raise ValueError(
+                "moe_wire_quant must be None, 'fp8' or 'int8', got "
+                f"{self.moe_wire_quant!r}"
             )
 
     @property
@@ -174,15 +187,21 @@ class Transformer:
             and compiling_for_tpu()
             and not is_dcn_axis(self.mesh, self.tp_axis)
         )
-        # the scalar-prefetch grouped-GEMM kernel wins the decode-size
-        # expert MLP on hardware (measured 2602 → 2197 µs/block at the
-        # serving headline, block_m 256); off-TPU / training keep the
-        # differentiable ragged_dot path
+        # the scalar-prefetch grouped-GEMM kernel in WEIGHT-RESIDENT
+        # mode (whole-N/K tiles, block_m 64) wins the decode-size expert
+        # MLP on hardware: less alignment padding without per-block
+        # weight re-streaming (measured 2.60 → 1.83 ms/block at the
+        # serving headline vs ragged_dot — see group_gemm.grouped_matmul
+        # and docs/PERF.md's serving section); off-TPU / training keep
+        # the differentiable ragged_dot path
         return ops.create_ep_moe_context(
             self.mesh, self.tp_axis, num_experts=c.num_experts, topk=c.topk,
             max_m=m_local * c.topk, hidden=c.hidden, dtype=c.dtype,
             transport="fused" if fused_ok else "xla",
-            use_pallas_gemm=fused_ok, block_m=256 if fused_ok else 128,
+            use_pallas_gemm=fused_ok, block_m=64 if fused_ok else 128,
+            gg_block_n=1 << 30 if fused_ok else None,
+            gg_block_k=1 << 30 if fused_ok else None,
+            quant=c.moe_wire_quant if fused_ok else None,
             batch_axes=tuple(self.dp_axes),
         )
 

@@ -70,6 +70,14 @@ class EPMoEContext:
     transport: str | None = None    # fused | pallas | xla
     block_m: int = 128
     use_pallas_gemm: bool = True
+    # Grouped-GEMM N/K tiles (None → kernel defaults). Setting both to
+    # a huge value (whole-dim) enables the WEIGHT-RESIDENT schedule:
+    # each expert's full weight matrix stays in VMEM across its
+    # consecutive sorted blocks, so block_m can shrink (less alignment
+    # padding) without re-streaming weights per block — the decode-size
+    # optimum (group_gemm.grouped_matmul docstring).
+    gg_block_n: int | None = None
+    gg_block_k: int | None = None
     collective_id: int = 10
     batch_axes: tuple = ()          # extra (DP) axes sharding token rows
     # Hierarchical (multi-slice) EP: experts span (dcn_axis × axis) and
@@ -333,9 +341,18 @@ def _expert_mlp(ctx: EPMoEContext, rows, eid, valid, w_up, w_down):
     be_w = jnp.clip(be, 0, epr - 1)
 
     if ctx.use_pallas_gemm:
-        h = grouped_matmul(xs, w_up, be_w, block_m=ctx.block_m)
+        gg_kw = {}
+        if ctx.gg_block_n is not None:
+            gg_kw["block_n"] = ctx.gg_block_n
+        if ctx.gg_block_k is not None:
+            gg_kw["block_k"] = ctx.gg_block_k
+        if gg_kw:
+            from triton_distributed_tpu.config import fused_vmem_budget
+
+            gg_kw["vmem_limit_bytes"] = fused_vmem_budget()
+        h = grouped_matmul(xs, w_up, be_w, block_m=ctx.block_m, **gg_kw)
         h = _act(ctx.activation, h).astype(ctx.dtype)
-        y = grouped_matmul(h, w_down, be_w, block_m=ctx.block_m)
+        y = grouped_matmul(h, w_down, be_w, block_m=ctx.block_m, **gg_kw)
     else:
         # aligned group sizes; the dummy group and tail slack are zero
         # rows — fold them into the last real expert
