@@ -313,8 +313,11 @@ def main() -> None:
                 # XLA engine, so users never pay it); the overlap
                 # advantage appears where there is comm to hide (n>1).
                 "vs_baseline": round(ratio_med, 4),
-                "vs_baseline_iqr": [round(ratio_iqr[0], 4),
-                                    round(ratio_iqr[1], 4)],
+                # NaN (the unpaired-fallback sentinel) is not valid
+                # JSON — emit null so the headline line stays parseable
+                "vs_baseline_iqr": [
+                    None if np.isnan(v) else round(v, 4) for v in ratio_iqr
+                ],
                 "baseline_tflops_per_chip": round(tflops_naive, 2),
                 "device_kind": device_kind,
                 "n_chips": n,

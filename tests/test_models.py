@@ -77,6 +77,27 @@ class TestTraining:
         assert np.isfinite(float(l1)) and float(l2) < float(l1)
 
 
+def _force_fused_ctx():
+    """Monkeypatch body for Transformer._moe_ep_ctx: decode rides the
+    fused transport even off-TPU (tiny interpreter-safe geometry),
+    honoring the config's moe_wire_quant — shared by the LL-state and
+    wire-quant decode tests."""
+    from triton_distributed_tpu import ops
+
+    def fused_ctx(self, m_local, inference=False):
+        c = self.config
+        return ops.create_ep_moe_context(
+            self.mesh, self.tp_axis, num_experts=c.num_experts,
+            topk=c.topk, max_m=m_local * c.topk, hidden=c.hidden,
+            dtype=c.dtype, transport="fused" if inference else "xla",
+            use_pallas_gemm=False, block_m=8,
+            quant=c.moe_wire_quant if inference else None,
+            batch_axes=tuple(self.dp_axes),
+        )
+
+    return fused_ctx
+
+
 class TestDecode:
     def test_decode_ll_state_matches_stateless(self, mesh_tp, monkeypatch):
         """decode_step with the barrier-free LL MoE state EXECUTES (not
@@ -84,22 +105,8 @@ class TestDecode:
         consecutive parities. Off-TPU the model normally demotes decode
         to the XLA transport, so the fused context is forced here (tiny
         shapes, interpreter-safe)."""
-        from triton_distributed_tpu import ops
-
         model = _model(mesh_tp, moe="ep")
-
-        def fused_ctx(self, m_local, inference=False):
-            return ops.create_ep_moe_context(
-                self.mesh, self.tp_axis,
-                num_experts=self.config.num_experts, topk=self.config.topk,
-                max_m=m_local * self.config.topk, hidden=self.config.hidden,
-                dtype=self.config.dtype,
-                transport="fused" if inference else "xla",
-                use_pallas_gemm=False, block_m=8,
-                batch_axes=tuple(self.dp_axes),
-            )
-
-        monkeypatch.setattr(Transformer, "_moe_ep_ctx", fused_ctx)
+        monkeypatch.setattr(Transformer, "_moe_ep_ctx", _force_fused_ctx())
         params = _sharded_params(model)
         b, smax = 8, 32
         caches = model.init_cache(b, smax)
@@ -131,26 +138,12 @@ class TestDecode:
         """moe_wire_quant='fp8': the decode MoE transport ships 1-byte
         tokens + per-token scales; logits must stay within quantization
         tolerance of the full-precision step."""
-        from triton_distributed_tpu import ops
-
         cfg = TransformerConfig(
             **CFG, moe="ep", moe_layers=(1,), num_experts=8, topk=2,
             moe_wire_quant="fp8",
         )
         model = Transformer(cfg, mesh_tp, "tp", ())
-
-        def fused_ctx(self, m_local, inference=False):
-            c = self.config
-            return ops.create_ep_moe_context(
-                self.mesh, self.tp_axis, num_experts=c.num_experts,
-                topk=c.topk, max_m=m_local * c.topk, hidden=c.hidden,
-                dtype=c.dtype, transport="fused" if inference else "xla",
-                use_pallas_gemm=False, block_m=8,
-                quant=c.moe_wire_quant if inference else None,
-                batch_axes=tuple(self.dp_axes),
-            )
-
-        monkeypatch.setattr(Transformer, "_moe_ep_ctx", fused_ctx)
+        monkeypatch.setattr(Transformer, "_moe_ep_ctx", _force_fused_ctx())
         params = _sharded_params(model)
         b, smax = 8, 32
         caches = model.init_cache(b, smax)
@@ -159,12 +152,14 @@ class TestDecode:
         first = jnp.argmax(last, axis=-1).astype(jnp.int32)
         logits_q, _, _ = model.decode_step(params, caches, lens, first)
 
+        # full-precision twin: same params/caches, no wire quant (the
+        # class-level _moe_ep_ctx patch is already in effect and honors
+        # each model's own moe_wire_quant)
         full = Transformer(
             TransformerConfig(**CFG, moe="ep", moe_layers=(1,),
                               num_experts=8, topk=2),
             mesh_tp, "tp", (),
         )
-        monkeypatch.setattr(Transformer, "_moe_ep_ctx", fused_ctx)
         logits_f, _, _ = full.decode_step(params, caches, lens, first)
         err = np.abs(np.asarray(logits_q) - np.asarray(logits_f))
         assert err.max() < 0.05 * np.abs(np.asarray(logits_f)).max()

@@ -59,6 +59,10 @@ def deepseek_moe_16b(**overrides) -> TransformerConfig:
         n_heads=16, n_kv_heads=16, head_dim=128,
         moe="ep", moe_layers=tuple(range(1, 28)), num_experts=64, topk=6,
         dtype=jnp.bfloat16,
+        # the reference's headline dispatch for this family is fp8
+        # WITH_SCALE (README.md:87) — decode tokens cross the EP a2a at
+        # 1 byte/elem with per-token scales (models/transformer.py)
+        moe_wire_quant="fp8",
     )
     cfg.update(overrides)
     return TransformerConfig(**cfg)

@@ -194,13 +194,24 @@ class Transformer:
         # serving headline vs ragged_dot — see group_gemm.grouped_matmul
         # and docs/PERF.md's serving section); off-TPU / training keep
         # the differentiable ragged_dot path
+        # weight residency needs one expert's FULL (hidden, ffn) matrix
+        # double-buffered in VMEM — gate on the budget (e.g. Mixtral's
+        # 117 MB expert exceeds a v5e's VMEM; fall back to the tiled
+        # schedule at block_m 256, the tiled-sweep optimum)
+        from triton_distributed_tpu.config import fused_vmem_budget
+
+        wr_ok = fused_ok and (
+            2 * c.hidden * c.ffn * jnp.dtype(c.dtype).itemsize
+            <= int(0.7 * fused_vmem_budget())
+        )
         return ops.create_ep_moe_context(
             self.mesh, self.tp_axis, num_experts=c.num_experts, topk=c.topk,
             max_m=m_local * c.topk, hidden=c.hidden, dtype=c.dtype,
             transport="fused" if fused_ok else "xla",
-            use_pallas_gemm=fused_ok, block_m=64 if fused_ok else 128,
-            gg_block_n=1 << 30 if fused_ok else None,
-            gg_block_k=1 << 30 if fused_ok else None,
+            use_pallas_gemm=fused_ok,
+            block_m=64 if wr_ok else (256 if fused_ok else 128),
+            gg_block_n=1 << 30 if wr_ok else None,
+            gg_block_k=1 << 30 if wr_ok else None,
             quant=c.moe_wire_quant if fused_ok else None,
             batch_axes=tuple(self.dp_axes),
         )
