@@ -544,6 +544,12 @@ def _bench_serving_moe_decode(mesh, n, on_tpu, spec):
             vocab=4096, n_layers=1, hidden=7168, ffn=2048, n_heads=56,
             n_kv_heads=8, head_dim=128, moe="ep", moe_layers=(0,),
             num_experts=8, topk=8, param_dtype=jnp.bfloat16,
+            # serving weight path: int8 expert matrices (per-out-channel
+            # scales, grouped-GEMM epilogue dequant) — the decode GEMMs
+            # are weight-HBM-bound, so this is the production default
+            # (presets.deepseek_moe_16b); measured 1.88 -> 1.55 ms on
+            # the MoE block (docs/PERF.md)
+            moe_weight_quant="int8",
         )
     else:
         b, s_cap = 8, 256
@@ -557,6 +563,7 @@ def _bench_serving_moe_decode(mesh, n, on_tpu, spec):
         lambda x, s: jax.device_put(x, s),
         model.init(jax.random.PRNGKey(7)), model.shardings(),
     )
+    params = model.quantize_moe_weights(params)
     caches = model.init_cache(b, s_cap)
     lens = jnp.full((b,), s_cap // 2, jnp.int32)
     toks0 = jnp.zeros((b,), jnp.int32)
@@ -597,8 +604,11 @@ def _bench_serving_moe_decode(mesh, n, on_tpu, spec):
         if ctx.transport == "fused" and n == 1 else None
     )
     x0 = jax.random.normal(jax.random.PRNGKey(8), (b, cfg.hidden), cfg.dtype)
-    w_up = blk["moe_up"].astype(cfg.dtype)
-    w_down = blk["moe_down"].astype(cfg.dtype)
+    # quantized expert dicts pass through; plain arrays cast
+    w_up, w_down = (
+        w if isinstance(w, dict) else w.astype(cfg.dtype)
+        for w in (blk["moe_up"], blk["moe_down"])
+    )
 
     def moe_step(state, s):
         x, router, up, down, mst = state
@@ -626,7 +636,7 @@ def _bench_serving_moe_decode(mesh, n, on_tpu, spec):
         "config": (
             f"n={n} B={b} hidden={cfg.hidden} topk={cfg.topk} "
             f"experts/chip={cfg.num_experts} ffn={cfg.ffn} S={s_cap} "
-            "1-layer EP-MoE decode "
+            f"wq={cfg.moe_weight_quant} 1-layer EP-MoE decode "
             + ("self-transport(no wire)" if n == 1 else "multi-chip")
         ),
     }

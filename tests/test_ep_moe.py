@@ -69,6 +69,34 @@ def test_fused_quant_vs_dense(mesh8):
     assert np.max(err) < 0.08 * np.abs(np.asarray(ref)).max()
 
 
+@pytest.mark.parametrize("use_pallas_gemm", [True, False])
+def test_weight_quantized_experts_vs_dense(mesh8, use_pallas_gemm):
+    """Weight-only-quantized expert dicts through ep_moe (the serving
+    decode weight path): Pallas consumes them in the grouped-GEMM
+    epilogue, the XLA twin widens — both must track the full-precision
+    dense reference within int8 per-channel error."""
+    x, logits, w_up, w_down = _data()
+    ref = _dense_ref(x, logits, w_up, w_down)
+    from triton_distributed_tpu.kernels.group_gemm import (
+        quantize_grouped_weights,
+    )
+
+    qu, su = quantize_grouped_weights(w_up, "int8")
+    qd, sd = quantize_grouped_weights(w_down, "int8")
+    ctx = create_ep_moe_context(
+        mesh8, "x", num_experts=E, topk=TOPK, max_m=MTOK * TOPK, hidden=H,
+        dtype=jnp.float32, transport="fused", block_m=8,
+        use_pallas_gemm=use_pallas_gemm,
+    )
+    xs, logitss = _put(mesh8, x, logits)
+    esh = NamedSharding(mesh8, P("x"))
+    wq_up = {"q": jax.device_put(qu, esh), "scale": jax.device_put(su, esh)}
+    wq_down = {"q": jax.device_put(qd, esh), "scale": jax.device_put(sd, esh)}
+    out = ep_moe(xs, logitss, wq_up, wq_down, ctx)
+    err = np.abs(np.asarray(out) - np.asarray(ref))
+    assert np.max(err) < 0.05 * np.abs(np.asarray(ref)).max()
+
+
 class TestChunkedWire:
     """The r4 transport contract: wire bytes scale with TRUE counts
     (+ ≤1 chunk slack/peer), not with the worst-case window (≡ the

@@ -84,7 +84,7 @@ def _force_fused_ctx():
     wire-quant decode tests."""
     from triton_distributed_tpu import ops
 
-    def fused_ctx(self, m_local, inference=False):
+    def fused_ctx(self, m_local, inference=False, weights_quantized=None):
         c = self.config
         return ops.create_ep_moe_context(
             self.mesh, self.tp_axis, num_experts=c.num_experts,
@@ -188,6 +188,74 @@ class TestDecode:
             )
             ll_tok = jnp.argmax(ll_logits, axis=-1).astype(jnp.int32)
             q_tok = jnp.argmax(q_logits, axis=-1).astype(jnp.int32)
+
+    def test_decode_weight_quant_close_to_full_precision(self, mesh_tp,
+                                                         monkeypatch):
+        """moe_weight_quant='int8': quantize_moe_weights replaces the EP
+        expert matrices with {"q","scale"} dicts; decode (fused
+        transport), prefill, and the training forward must all consume
+        them, staying within per-channel-int8 tolerance of the
+        full-precision model."""
+        cfg = TransformerConfig(
+            **CFG, moe="ep", moe_layers=(1,), num_experts=8, topk=2,
+            moe_weight_quant="int8",
+        )
+        model = Transformer(cfg, mesh_tp, "tp", ())
+        monkeypatch.setattr(Transformer, "_moe_ep_ctx", _force_fused_ctx())
+        params = _sharded_params(model)
+        b, smax = 8, 32
+        caches = model.init_cache(b, smax)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (b, 8), 0, 128)
+        last, caches, lens = model.prefill(params, caches, prompt)
+        first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        logits_f, _, _ = model.decode_step(params, caches, lens, first)
+
+        qparams = model.quantize_moe_weights(params)
+        blk = qparams["blocks"][1]
+        assert isinstance(blk["moe_up"], dict)
+        assert blk["moe_up"]["q"].dtype == jnp.int8
+        # prefill with quantized weights (widens transparently)
+        last_q, caches_q, lens_q = model.prefill(
+            qparams, model.init_cache(b, smax), prompt
+        )
+        logits_q, _, _ = model.decode_step(qparams, caches_q, lens_q, first)
+        err = np.abs(np.asarray(logits_q) - np.asarray(logits_f))
+        assert err.max() < 0.05 * np.abs(np.asarray(logits_f)).max()
+        assert err.max() > 0, "weight quant did not engage"
+        # idempotent: already-quantized params pass through
+        q2 = model.quantize_moe_weights(qparams)
+        assert q2["blocks"][1]["moe_up"]["q"] is qparams["blocks"][1][
+            "moe_up"]["q"]
+
+    def test_residency_gate_keys_on_actual_weights(self, mesh_tp):
+        """A preset can default moe_weight_quant while the caller never
+        ran quantize_moe_weights: the weight-residency VMEM gate must
+        size from the REAL leaves (bf16), not the config's intent —
+        sizing bf16 tiles at 1 B/elem would blow scoped VMEM at the
+        first decode compile."""
+        from triton_distributed_tpu.config import config, fused_vmem_budget
+
+        cfg = TransformerConfig(
+            vocab=128, n_layers=1, hidden=7168, ffn=2560, n_heads=8,
+            n_kv_heads=4, head_dim=16, moe="ep", moe_layers=(0,),
+            num_experts=8, topk=2, moe_weight_quant="int8",
+        )
+        budget = int(0.7 * fused_vmem_budget())
+        if not (2 * cfg.hidden * cfg.ffn <= budget
+                < 2 * cfg.hidden * cfg.ffn * 2):
+            pytest.skip("vmem budget does not straddle this geometry")
+        model = Transformer(cfg, mesh_tp, "tp", ())
+        old = config.force_compile
+        config.force_compile = True    # compiling_for_tpu() → True
+        try:
+            ctx_q = model._moe_ep_ctx(16, inference=True)
+            ctx_raw = model._moe_ep_ctx(
+                16, inference=True, weights_quantized=False
+            )
+        finally:
+            config.force_compile = old
+        assert ctx_q.gg_block_n is not None and ctx_q.block_m == 64
+        assert ctx_raw.gg_block_n is None and ctx_raw.block_m == 256
 
     def test_sp_decode_matches_dense(self, mesh_tp):
         """generate() through the distributed flash-decode layer must

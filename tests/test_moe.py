@@ -72,6 +72,42 @@ class TestGroupedGemm:
         tol = 1e-5 if dtype == jnp.float32 else 3e-2
         assert_allclose(y, y_ref, atol=tol, rtol=tol)
 
+    @pytest.mark.parametrize("mode", ["int8", "fp8"])
+    def test_weight_quantized_vs_dequantized(self, mode):
+        """In-kernel epilogue dequant == widen-then-matmul: the scale is
+        per out-channel, so folding it after the K reduction is exact —
+        the two paths must agree to accumulation noise."""
+        m, k, n, e, topk, bm = 64, 128, 256, 8, 2, 16
+        _, ids = _routing(m, e, topk)
+        sti, be, _ = mu.moe_align_block_size(ids, e, bm)
+        x = jax.random.normal(jax.random.PRNGKey(1), (m, k), jnp.bfloat16)
+        w = jax.random.normal(jax.random.PRNGKey(2), (e, k, n)) * 0.05
+        q, scale = gg.quantize_grouped_weights(w, mode)
+        assert q.dtype.itemsize == 1 and scale.shape == (e, n)
+        xs = mu.gather_sorted(x, sti, topk)
+        y = gg.grouped_matmul(xs, q, be, w_scale=scale, block_m=bm)
+        y_ref = gg.grouped_matmul(
+            xs, gg.dequantize_grouped_weights(q, scale), be, block_m=bm
+        )
+        assert_allclose(y, y_ref, atol=3e-2, rtol=3e-2)
+
+    def test_weight_quant_error_bounded(self):
+        """int8 per-channel weight quant stays close to the full-
+        precision product (the serving-accuracy contract)."""
+        m, k, n, e, topk, bm = 64, 128, 128, 4, 2, 16
+        _, ids = _routing(m, e, topk)
+        sti, be, _ = mu.moe_align_block_size(ids, e, bm)
+        x = jax.random.normal(jax.random.PRNGKey(1), (m, k), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(2), (e, k, n)) * 0.05
+        q, scale = gg.quantize_grouped_weights(w, "int8")
+        xs = mu.gather_sorted(x, sti, topk)
+        y = gg.grouped_matmul(xs.astype(jnp.float32), q, be,
+                              w_scale=scale, block_m=bm)
+        y_full = gg.grouped_matmul(xs.astype(jnp.float32), w, be, block_m=bm)
+        # per-channel int8: ~0.5% relative error on a K=128 reduction
+        err = jnp.abs(y - y_full).max() / (jnp.abs(y_full).max() + 1e-9)
+        assert float(err) < 0.02, float(err)
+
     def test_full_local_moe_vs_dense(self):
         """sorted grouped-GEMM MoE == dense per-expert einsum reference."""
         m, k, n, e, topk, bm = 32, 128, 128, 4, 2, 8

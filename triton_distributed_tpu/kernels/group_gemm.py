@@ -47,6 +47,30 @@ def _ggemm_kernel(nsteps_k, be_ref, x_ref, w_ref, o_ref, acc_ref):
         o_ref[:] = acc_ref[:].astype(o_ref.dtype)
 
 
+def _ggemm_q_kernel(nsteps_k, xdt, be_ref, x_ref, w_ref, s_ref, o_ref,
+                    acc_ref):
+    """Weight-only-quantized variant: W rides HBM in its 1-byte wire
+    dtype (int8 / fp8) and is widened tile-by-tile in VMEM; the
+    per-(expert, out-channel) scale multiplies the f32 accumulator once
+    at the final K step (dequantization is linear over the K reduction,
+    so folding it into the epilogue is exact)."""
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jax.lax.dot_general(
+        x_ref[:], w_ref[0].astype(xdt),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kk == nsteps_k - 1)
+    def _store():
+        o_ref[:] = (acc_ref[:] * s_ref[0, 0][None, :]).astype(o_ref.dtype)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("block_m", "block_n", "block_k", "vmem_limit_bytes",
@@ -54,6 +78,7 @@ def _ggemm_kernel(nsteps_k, be_ref, x_ref, w_ref, o_ref, acc_ref):
 )
 def grouped_matmul(
     x_sorted, w, block_expert, *,
+    w_scale=None,
     block_m: int = 512, block_n: int = 2048, block_k: int = 512,
     vmem_limit_bytes: int | None = None,
     interpret=None,
@@ -79,6 +104,14 @@ def grouped_matmul(
     decode pair at (64, whole, whole) vs (256, 2048, 512), docs/PERF.md.
     Whole-dim tiles exceed Mosaic's 16 MB default scoped VMEM — pass
     ``vmem_limit_bytes`` (the contexts use config.fused_vmem_budget()).
+
+    WEIGHT-ONLY QUANTIZATION (serving decode, where weight HBM reads
+    dominate): pass ``w`` in a 1-byte dtype (int8 / float8_e4m3fn) plus
+    ``w_scale`` (E, N) f32 per-(expert, out-channel) scales (from
+    :func:`quantize_grouped_weights`). The kernel widens W tiles in
+    VMEM and folds the scale into the f32 accumulator at the last K
+    step — HBM weight traffic halves vs bf16 while the MXU still runs
+    the bf16 pipeline. Composes with the weight-resident schedule.
     """
     from triton_distributed_tpu.config import compiling_for_tpu
     from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
@@ -93,20 +126,40 @@ def grouped_matmul(
     block_k = _divisor_block(kdim, min(block_k, kdim), 128, compiling_for_tpu()) or kdim
     nsteps_k = kdim // block_k
 
+    in_specs = [
+        pl.BlockSpec((block_m, block_k), lambda m, n, k, be: (m, k)),
+        pl.BlockSpec(
+            (1, block_k, block_n), lambda m, n, k, be: (be[m], k, n)
+        ),
+    ]
+    if w_scale is None:
+        kernel = functools.partial(_ggemm_kernel, nsteps_k)
+        args = (block_expert, x_sorted, w)
+    else:
+        assert w.dtype.itemsize == 1, (
+            f"w_scale given but w dtype {w.dtype} is not a 1-byte wire "
+            "dtype (int8 / float8_e4m3fn)"
+        )
+        assert w_scale.shape == (e, ndim), (w_scale.shape, (e, ndim))
+        # (E, 1, N): the unit sublane dim equals the array dim, which
+        # Mosaic accepts where a (1, block_n) slice of (E, N) is rejected
+        in_specs.append(
+            pl.BlockSpec((1, 1, block_n), lambda m, n, k, be: (be[m], 0, n))
+        )
+        kernel = functools.partial(_ggemm_q_kernel, nsteps_k, x_sorted.dtype)
+        args = (
+            block_expert, x_sorted, w,
+            w_scale.astype(jnp.float32)[:, None, :],
+        )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(cap // block_m, ndim // block_n, nsteps_k),
-        in_specs=[
-            pl.BlockSpec((block_m, block_k), lambda m, n, k, be: (m, k)),
-            pl.BlockSpec(
-                (1, block_k, block_n), lambda m, n, k, be: (be[m], k, n)
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((block_m, block_n), lambda m, n, k, be: (m, n)),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
     )
     call = pl.pallas_call(
-        functools.partial(_ggemm_kernel, nsteps_k),
+        kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((cap, ndim), x_sorted.dtype),
         compiler_params=pltpu.CompilerParams(
@@ -114,7 +167,7 @@ def grouped_matmul(
         ),
         interpret=local_interpret() if interpret is None else interpret,
     )
-    return call(block_expert, x_sorted, w)
+    return call(*args)
 
 
 def grouped_matmul_xla(x_sorted, w, splits_padded):
@@ -123,6 +176,55 @@ def grouped_matmul_xla(x_sorted, w, splits_padded):
     return jax.lax.ragged_dot(
         x_sorted, w, splits_padded.astype(jnp.int32)
     ).astype(x_sorted.dtype)
+
+
+def quantize_grouped_weights(w, mode: str = "int8"):
+    """(E, K, N) weights → ((E, K, N) wire-dtype, (E, N) f32 scales).
+
+    Symmetric per-(expert, out-channel) weight-only quantization for the
+    serving decode path (the grouped GEMM there is weight-HBM-bound, so
+    1-byte weights halve its floor). Same scale convention as the token
+    wire quant (kernels/moe_all_to_all.quantize_rows — ≡ the reference's
+    WITH_SCALE fp8 transport, low_latency_all_to_all.py:82-90), applied
+    to the stationary operand instead of the moving one.
+    """
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=1)        # (E, N)
+    if mode == "int8":
+        scale = jnp.maximum(amax, 1e-30) / 127.0
+        q = jnp.round(w.astype(jnp.float32) / scale[:, None, :])
+        return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+    if mode == "fp8":
+        scale = jnp.maximum(amax, 1e-30) / 448.0                  # e4m3 max
+        return (
+            (w.astype(jnp.float32) / scale[:, None, :]).astype(
+                jnp.float8_e4m3fn
+            ),
+            scale,
+        )
+    raise ValueError(f"weight quant mode must be int8|fp8, got {mode!r}")
+
+
+def resident_weight_itemsize(mode: str | None, dtype) -> int:
+    """VMEM bytes/elem a weight-resident ``grouped_matmul`` schedule
+    must budget per weight element — the kernel-lowering cost model the
+    model layer's residency gate consumes (kept HERE so it tracks this
+    kernel). int8 tiles are consumed at wire width; fp8 has no native
+    v5e MXU form, so Mosaic materializes the widened copy (budget wire
+    + f32 temp — measured: whole-dim fp8 tiles blow scoped VMEM where
+    int8 fits, docs/PERF.md); None = the unquantized compute dtype."""
+    if mode == "int8":
+        return 1
+    if mode == "fp8":
+        return 5
+    assert mode is None, f"unknown weight-quant mode {mode!r}"
+    return jnp.dtype(dtype).itemsize
+
+
+def dequantize_grouped_weights(q, scale, dtype=jnp.bfloat16):
+    """Widen (E, K, N) wire-dtype weights back with their (E, N) scales
+    — the XLA-twin path (ragged_dot has no quantized form) and the
+    correctness reference for the in-kernel epilogue dequant."""
+    return (q.astype(jnp.float32) * scale[:, None, :]).astype(dtype)
 
 
 def padded_splits(splits, block_m: int, cap: int):

@@ -63,6 +63,12 @@ def deepseek_moe_16b(**overrides) -> TransformerConfig:
         # WITH_SCALE (README.md:87) — decode tokens cross the EP a2a at
         # 1 byte/elem with per-token scales (models/transformer.py)
         moe_wire_quant="fp8",
+        # decode grouped GEMMs are weight-HBM-bound — serve the expert
+        # matrices int8 (per-out-channel scales, epilogue dequant;
+        # run params through Transformer.quantize_moe_weights). int8,
+        # not fp8: v5e has no native fp8 MXU path and the widening
+        # lowers poorly (docs/PERF.md dead-end record)
+        moe_weight_quant="int8",
     )
     cfg.update(overrides)
     return TransformerConfig(**cfg)
@@ -83,6 +89,8 @@ def tiny(preset=None, **overrides) -> TransformerConfig:
             num_experts=min(preset.num_experts, 8),
             topk=min(preset.topk, 2),
             attn=preset.attn,
+            moe_wire_quant=preset.moe_wire_quant,
+            moe_weight_quant=preset.moe_weight_quant,
         )
     cfg.update(overrides)
     return TransformerConfig(**cfg)

@@ -73,6 +73,15 @@ class TransformerConfig:
     # and prefill paths are unaffected (they ride the differentiable
     # full-precision transport).
     moe_wire_quant: str | None = None
+    # Weight-only quantization of the EP expert matrices ("int8" |
+    # "fp8" | None): serving-decode grouped GEMMs are weight-HBM-bound
+    # (B·topk rows vs MB-scale matrices), so 1-byte weights halve the
+    # dominant read. Takes effect when the caller runs params through
+    # :meth:`Transformer.quantize_moe_weights` (after init/load);
+    # training/prefill paths widen transparently. TPU-first extension —
+    # the reference quantizes only the moving tokens (WITH_SCALE fp8,
+    # low_latency_all_to_all.py:82-90), not the stationary weights.
+    moe_weight_quant: str | None = None
     # rematerialize each block in backward (jax.checkpoint): trades one
     # extra forward per block for O(n_layers) less activation memory —
     # the standard long-context / large-model training knob. Off-TPU the
@@ -96,6 +105,16 @@ class TransformerConfig:
             raise ValueError(
                 "moe_wire_quant must be None, 'fp8' or 'int8', got "
                 f"{self.moe_wire_quant!r}"
+            )
+        if self.moe_weight_quant not in (None, "fp8", "int8"):
+            raise ValueError(
+                "moe_weight_quant must be None, 'fp8' or 'int8', got "
+                f"{self.moe_weight_quant!r}"
+            )
+        if self.moe_weight_quant is not None and self.moe != "ep":
+            raise ValueError(
+                "moe_weight_quant targets the EP expert matrices — set "
+                f"moe='ep' (got moe={self.moe!r})"
             )
 
     @property
@@ -167,7 +186,17 @@ class Transformer:
             batch_axes=tuple(self.dp_axes),
         )
 
-    def _moe_ep_ctx(self, m_local: int, inference: bool = False):
+    def _moe_ep_ctx(self, m_local: int, inference: bool = False,
+                    weights_quantized: bool | None = None):
+        """``weights_quantized``: whether the expert-weight leaves this
+        context will consume are ACTUALLY quantized dicts — the
+        residency gate must size VMEM from the real storage, not from
+        the config's intent (a preset may default moe_weight_quant
+        while the caller never ran quantize_moe_weights; sizing bf16
+        tiles at 1 B/elem would blow scoped VMEM at compile). None →
+        trust the config (callers without params in hand, e.g.
+        init_decode_state — residency affects only GEMM tiling, not
+        state geometry)."""
         c = self.config
         # training must stay on the differentiable XLA transport;
         # inference (decode) rides the fused window-DMA dispatch — the
@@ -199,9 +228,16 @@ class Transformer:
         # 117 MB expert exceeds a v5e's VMEM; fall back to the tiled
         # schedule at block_m 256, the tiled-sweep optimum)
         from triton_distributed_tpu.config import fused_vmem_budget
+        from triton_distributed_tpu.kernels.group_gemm import (
+            resident_weight_itemsize,
+        )
 
+        wq_mode = c.moe_weight_quant
+        if weights_quantized is False:
+            wq_mode = None               # raw bf16 leaves despite the config
+        w_itemsize = resident_weight_itemsize(wq_mode, c.dtype)
         wr_ok = fused_ok and (
-            2 * c.hidden * c.ffn * jnp.dtype(c.dtype).itemsize
+            2 * c.hidden * c.ffn * w_itemsize
             <= int(0.7 * fused_vmem_budget())
         )
         return ops.create_ep_moe_context(
@@ -254,6 +290,48 @@ class Transformer:
                 )
             params["blocks"].append(blk)
         return params
+
+    def quantize_moe_weights(self, params, mode: str | None = None):
+        """Replace every EP block's expert matrices with weight-only-
+        quantized ``{"q": 1-byte, "scale": (E, N) f32}`` dicts (see
+        group_gemm.quantize_grouped_weights). Run AFTER init/load and
+        device placement — the quantized leaves inherit the expert
+        sharding from the source arrays. ``mode`` defaults to
+        ``config.moe_weight_quant``; returns ``params`` unchanged when
+        both are None. Decode consumes the dicts in the grouped-GEMM
+        epilogue; prefill/training widen transparently."""
+        mode = mode or self.config.moe_weight_quant
+        if mode is None:
+            return params
+        if self.config.moe != "ep":
+            raise ValueError("quantize_moe_weights targets EP expert weights")
+        from triton_distributed_tpu.kernels.group_gemm import (
+            quantize_grouped_weights,
+        )
+
+        out = dict(params)
+        out["blocks"] = []
+        for blk in params["blocks"]:
+            blk = dict(blk)
+            for name in ("moe_up", "moe_down"):
+                if name in blk and not isinstance(blk[name], dict):
+                    q, scale = quantize_grouped_weights(blk[name], mode)
+                    blk[name] = {"q": q, "scale": scale}
+            out["blocks"].append(blk)
+        return out
+
+    def _expert_w(self, w):
+        """Expert weights for a dense consumer: widen a quantized dict,
+        cast a plain array."""
+        if isinstance(w, dict):
+            from triton_distributed_tpu.kernels.group_gemm import (
+                dequantize_grouped_weights,
+            )
+
+            return dequantize_grouped_weights(
+                w["q"], w["scale"], self.config.dtype
+            )
+        return w.astype(self.config.dtype)
 
     def shardings(self):
         """NamedSharding pytree matching :meth:`init` — TP dims sharded,
@@ -373,8 +451,8 @@ class Transformer:
             return self._mlp(p, x)
         moe_params = {
             "router": blk["router"],
-            "up": blk["moe_up"].astype(c.dtype),
-            "down": blk["moe_down"].astype(c.dtype),
+            "up": self._expert_w(blk["moe_up"]),
+            "down": self._expert_w(blk["moe_down"]),
         }
         if c.moe == "ep":
             # EP flavour: experts sharded over tp, tokens stay row-sharded;
@@ -648,9 +726,17 @@ class Transformer:
         pad = (-b) % shards
         xp = jnp.pad(xn, ((0, pad), (0, 0)))
         logits = xp.astype(jnp.float32) @ blk["router"]
-        ctx = self._moe_ep_ctx((b + pad) // shards, inference=True)
-        w_up = blk["moe_up"].astype(c.dtype)
-        w_down = blk["moe_down"].astype(c.dtype)
+        wq = isinstance(blk["moe_up"], dict)
+        ctx = self._moe_ep_ctx(
+            (b + pad) // shards, inference=True, weights_quantized=wq
+        )
+        # quantized dicts pass straight through — the ops layer consumes
+        # them on both the grouped-GEMM (epilogue dequant) and XLA
+        # (widen) paths; only plain arrays need the compute-dtype cast
+        w_up, w_down = (
+            w if isinstance(w, dict) else w.astype(c.dtype)
+            for w in (blk["moe_up"], blk["moe_down"])
+        )
         if state is not None and ctx.transport == "fused":
             y, state = ops.ep_moe(xp, logits, w_up, w_down, ctx, state=state)
         else:

@@ -325,6 +325,12 @@ def _expert_mlp(ctx: EPMoEContext, rows, eid, valid, w_up, w_down):
     rows: (R, H) received tokens; eid: (R,) local expert ids; valid: (R,)
     bool. w_up: (epr, H, F); w_down: (epr, F, H). Invalid rows are zero
     and sorted into a trailing dummy group, so they contribute zeros.
+
+    Either weight may instead be a WEIGHT-QUANTIZED dict
+    ``{"q": (epr, K, N) int8/fp8, "scale": (epr, N) f32}`` (from
+    group_gemm.quantize_grouped_weights): the Pallas path folds the
+    scale into the GEMM epilogue, halving the weight HBM reads that
+    dominate decode-size grouped GEMMs; the XLA twin widens first.
     """
     epr = ctx.experts_per_rank
     r = rows.shape[0]
@@ -350,10 +356,31 @@ def _expert_mlp(ctx: EPMoEContext, rows, eid, valid, w_up, w_down):
             from triton_distributed_tpu.config import fused_vmem_budget
 
             gg_kw["vmem_limit_bytes"] = fused_vmem_budget()
-        h = grouped_matmul(xs, w_up, be_w, block_m=ctx.block_m, **gg_kw)
+
+        def gg(inp, w):
+            if isinstance(w, dict):
+                return grouped_matmul(
+                    inp, w["q"], be_w, w_scale=w["scale"],
+                    block_m=ctx.block_m, **gg_kw,
+                )
+            return grouped_matmul(inp, w, be_w, block_m=ctx.block_m, **gg_kw)
+
+        h = gg(xs, w_up)
         h = _act(ctx.activation, h).astype(ctx.dtype)
-        y = grouped_matmul(h, w_down, be_w, block_m=ctx.block_m, **gg_kw)
+        y = gg(h, w_down)
     else:
+        from triton_distributed_tpu.kernels.group_gemm import (
+            dequantize_grouped_weights,
+        )
+
+        if isinstance(w_up, dict):
+            w_up = dequantize_grouped_weights(
+                w_up["q"], w_up["scale"], ctx.dtype
+            )
+        if isinstance(w_down, dict):
+            w_down = dequantize_grouped_weights(
+                w_down["q"], w_down["scale"], ctx.dtype
+            )
         # aligned group sizes; the dummy group and tail slack are zero
         # rows — fold them into the last real expert
         gs_all = padded_splits(counts, ctx.block_m, cap)

@@ -72,6 +72,9 @@ def main(argv=None) -> None:
         model.init(jax.random.PRNGKey(args.seed)),
         model.shardings(),
     )
+    # serving weight quantization (preset-gated): expert matrices to
+    # int8 + per-channel scales, consumed in the grouped-GEMM epilogue
+    params = model.quantize_moe_weights(params)
 
     cap = args.capacity or -(-(args.prompt_len + args.steps) // 128) * 128
     prompt = jax.random.randint(
