@@ -565,7 +565,16 @@ def _bench_serving_moe_decode(mesh, n, on_tpu, spec):
     )
     params = model.quantize_moe_weights(params)
     caches = model.init_cache(b, s_cap)
-    lens = jnp.full((b,), s_cap // 2, jnp.int32)
+    # MIXED conversation lengths (a serving batch, not a lockstep one):
+    # uniform [S/8, 3S/4] so the longest row + the timing loop's appends
+    # stay inside capacity. The decode attention kernel walks
+    # ceil(len/block_k) blocks PER ROW (dynamic trip counts), so KV
+    # reads track the true lengths — a capacity-walk kernel would read
+    # S for every row.
+    lens = jnp.asarray(
+        np.random.default_rng(11).integers(s_cap // 8, 3 * s_cap // 4, (b,)),
+        jnp.int32,
+    )
     toks0 = jnp.zeros((b,), jnp.int32)
     # LL state only at n=1: bench_loop re-invokes its jitted programs
     # with NON-donated inputs, so workspace placement is per-invocation
@@ -636,7 +645,8 @@ def _bench_serving_moe_decode(mesh, n, on_tpu, spec):
         "config": (
             f"n={n} B={b} hidden={cfg.hidden} topk={cfg.topk} "
             f"experts/chip={cfg.num_experts} ffn={cfg.ffn} S={s_cap} "
-            f"wq={cfg.moe_weight_quant} 1-layer EP-MoE decode "
+            f"lens~U[S/8,3S/4] wq={cfg.moe_weight_quant} "
+            "1-layer EP-MoE decode "
             + ("self-transport(no wire)" if n == 1 else "multi-chip")
         ),
     }

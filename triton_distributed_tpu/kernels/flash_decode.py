@@ -41,6 +41,12 @@ from triton_distributed_tpu.lang.launch import shmem_call
 NEG_INF = -1.0e30  # finite -inf stand-in: exp(NEG_INF - m) == 0 without NaNs
 
 
+def _n_valid_blocks(kv_len, block_k):
+    """ceil(kv_len / block_k), floored at 1 — even an empty row walks one
+    block (its scores are fully masked; lse comes back NEG_INF)."""
+    return jnp.maximum(jax.lax.div(kv_len + block_k - 1, block_k), 1)
+
+
 def _decode_kernel(
     scale, soft_cap, block_k, kv_lens_ref, q_ref, k_ref, v_ref,
     out_ref, lse_ref, m_ref, l_ref, acc_ref,
@@ -54,6 +60,16 @@ def _decode_kernel(
     D-column window).
     Carries (m, l, acc) in f32 scratch across the KV walk (the online
     softmax of the reference's split_kv kernel, :207-258).
+
+    This STATIC grid walks the cache CAPACITY: blocks past
+    ceil(kv_lens[b]/block_k) skip their COMPUTE (the ``pl.when``
+    below) but their DMA still lands — Mosaic's pipeline fetches every
+    BlockSpec window, and index-map clamping does not reliably elide
+    the copies (measured). Length-proportional HBM traffic lives in
+    :func:`_decode_kernel_dyn` (the native-layout default); this
+    kernel serves the reference-style bshd view and unaligned
+    geometries, where capacity-proportional reads are the price of the
+    strided window.
     """
     b = pl.program_id(0)
     ki = pl.program_id(2)
@@ -64,33 +80,41 @@ def _decode_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0, 0]                            # (G, D), input dtype
-    # KV blocks arrive as (1, block_k, D) [bshd view] or (1, 1, block_k,
-    # D) [bhsd]; flatten the unit block dims either way.
-    k = k_ref[...].reshape(block_k, q.shape[-1])
-    v = v_ref[...].reshape(block_k, q.shape[-1])
-
-    # Inputs stay in their native (bf16) dtype so the MXU runs at full
-    # rate; accumulation is f32 via preferred_element_type.
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale                                  # (G, block_k) f32
-    if soft_cap > 0.0:
-        s = soft_cap * jnp.tanh(s / soft_cap)
-
     kv_len = kv_lens_ref[b]
-    pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    s = jnp.where(pos < kv_len, s, NEG_INF)
 
-    m_prev = m_ref[:]                          # (G, 1)
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)                     # (G, block_k)
-    l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
-    acc_ref[:] = alpha * acc_ref[:] + jnp.dot(
-        p.astype(v.dtype), v, preferred_element_type=jnp.float32
-    )
-    m_ref[:] = m_new
+    @pl.when(ki < _n_valid_blocks(kv_len, block_k))
+    def _compute():
+        q = q_ref[0, 0]                        # (G, D), input dtype
+        # KV blocks arrive as (1, block_k, D) [bshd view] or (1, 1,
+        # block_k, D) [bhsd]; flatten the unit block dims either way.
+        k = k_ref[...].reshape(block_k, q.shape[-1])
+        v = v_ref[...].reshape(block_k, q.shape[-1])
+
+        # Inputs stay in their native (bf16) dtype so the MXU runs at
+        # full rate; accumulation is f32 via preferred_element_type.
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                              # (G, block_k) f32
+        if soft_cap > 0.0:
+            s = soft_cap * jnp.tanh(s / soft_cap)
+
+        pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = pos < kv_len
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[:]                      # (G, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        # mask p explicitly: in an ALL-masked block m_new == NEG_INF and
+        # exp(s − m_new) degenerates to 1, which would make an empty
+        # row's output depend on how many blocks were walked — with the
+        # mask, l stays 0 and _finish emits exact zeros + NEG_INF lse
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)   # (G, block_k)
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = alpha * acc_ref[:] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        m_ref[:] = m_new
 
     @pl.when(ki == pl.num_programs(2) - 1)
     def _finish():
@@ -100,6 +124,157 @@ def _decode_kernel(
         lse_ref[0, 0] = jnp.where(
             l > 0.0, m_ref[:] + jnp.log(safe_l), jnp.full_like(l, NEG_INF)
         )
+
+
+def _decode_kernel_dyn(
+    scale, soft_cap, block_k, n_bufs, g, d,
+    kv_lens_ref, q_ref, k_hbm, v_hbm, out_ref, lse_ref,
+    kbuf, vbuf, sem_k, sem_v, slot_ref, m_ref, l_ref, acc_ref,
+):
+    """Dynamic-trip-count decode: grid is (B, Hkv) ONLY; the KV walk is
+    an in-kernel ``fori_loop`` over ceil(kv_lens[b]/block_k) blocks with
+    manually double-buffered HBM→VMEM DMAs.
+
+    Why not a (B, Hkv, S/block_k) grid with index-map clamping: a grid
+    walks the cache CAPACITY — every invalid tail block still costs a
+    grid step (measured 0.6–1.4 µs each at serving shapes), and Mosaic's
+    revisit-skip does not reliably elide the clamped copies. A dynamic
+    loop bound issues exactly ceil(len/block_k) DMAs and zero extra
+    steps — HBM reads and overhead both scale with the TRUE lengths
+    (≡ the reference kernel's dynamic ``for`` over kv chunks,
+    flash_decode.py:207-216; same discipline as the count-bounded MoE
+    chunk transport, moe_dispatch.py).
+
+    k_hbm/v_hbm: full (B, Hkv, S, D) refs in ANY space — one (block_k,
+    D) contiguous run is DMA'd per loop step into the rotating VMEM
+    slots. The pipeline runs ACROSS grid steps: each iteration issues
+    the NEXT block's copy — the last iteration of a (b, h) group
+    prefetches the next group's block 0 — and ``slot_ref`` (persistent
+    SMEM) carries the slot rotation over the group boundary, so the DMA
+    engine never drains between groups (without this, a one-block group
+    exposes its full copy latency every grid step: measured 2.4 ms vs
+    1.5 ms for the whole walk at B=128, Hkv=8, S=2048).
+    """
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    nb_total = pl.num_programs(0)
+    nh = pl.num_programs(1)
+    kv_len = kv_lens_ref[b]
+    # clamp at capacity: a caller whose lens overran the cache (e.g.
+    # append_kv increments past a full cache) must not DMA past the end
+    nb = jnp.minimum(
+        _n_valid_blocks(kv_len, block_k),
+        k_hbm.shape[2] // block_k,
+    )
+    q = q_ref[0, 0]                            # (G, D)
+
+    def dma(bb, hh, j, slot):
+        src_k = k_hbm.at[bb, hh, pl.ds(j * block_k, block_k)]
+        src_v = v_hbm.at[bb, hh, pl.ds(j * block_k, block_k)]
+        return (
+            pltpu.make_async_copy(src_k, kbuf.at[slot], sem_k.at[slot]),
+            pltpu.make_async_copy(src_v, vbuf.at[slot], sem_v.at[slot]),
+        )
+
+    @pl.when(jnp.logical_and(b == 0, h == 0))
+    def _warmup():                             # first block of the run
+        slot_ref[0] = 0
+        ck, cv = dma(0, 0, 0, 0)
+        ck.start()
+        cv.start()
+
+    s0 = slot_ref[0]                           # this group's start slot
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def body(j, _):
+        slot = jax.lax.rem(s0 + j, n_bufs)
+        nxt = jax.lax.rem(s0 + j + 1, n_bufs)
+
+        # issue the NEXT block's copy BEFORE waiting on this one: the
+        # engine queues it behind the in-flight copy and rolls straight
+        # into it when that completes — i.e. during this block's
+        # compute. Starting after the wait leaves the engine idle for
+        # the whole compute phase (measured: per-iter time = DMA +
+        # compute instead of max(DMA, compute)).
+        @pl.when(j + 1 < nb)
+        def _prefetch_in_group():
+            nk, nv = dma(b, h, j + 1, nxt)
+            nk.start()
+            nv.start()
+
+        # group's last block: prefetch the NEXT group's first block so
+        # the copy flies while out/lse spill and the grid advances
+        @pl.when(
+            jnp.logical_and(
+                j + 1 == nb,
+                jnp.logical_or(h + 1 < nh, b + 1 < nb_total),
+            )
+        )
+        def _prefetch_next_group():
+            nb_ = jnp.where(h + 1 < nh, b, b + 1)
+            nh_ = jnp.where(h + 1 < nh, h + 1, 0)
+            nk, nv = dma(nb_, nh_, 0, nxt)
+            nk.start()
+            nv.start()
+
+        ck, cv = dma(b, h, j, slot)
+        ck.wait()
+        cv.wait()
+
+        k = kbuf[slot]                         # (block_k, D)
+        v = vbuf[slot]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                              # (G, block_k)
+        if soft_cap > 0.0:
+            s = soft_cap * jnp.tanh(s / soft_cap)
+
+        def update(s, p_mask):
+            m = m_ref[:]
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)             # (G, block_k)
+            if p_mask is not None:
+                # an all-masked block degenerates exp(s − m) to 1
+                p = jnp.where(p_mask, p, 0.0)
+            l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[:] = alpha * acc_ref[:] + jnp.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32
+            )
+            m_ref[:] = m_new
+
+        # interior blocks (every position valid) skip the mask chain —
+        # the iota/compare/select passes over (G, block_k) f32 cost as
+        # much VPU time as the whole softmax update (the kernel is
+        # compute-bound at bf16 blocks); only the ragged tail pays them
+        is_tail = jnp.logical_and(
+            j + 1 == nb, (j + 1) * block_k > kv_len
+        )
+
+        @pl.when(is_tail)
+        def _masked():
+            pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+            valid = pos < kv_len
+            update(jnp.where(valid, s, NEG_INF), valid)
+
+        @pl.when(jnp.logical_not(is_tail))
+        def _plain():
+            update(s, None)
+
+        return 0
+
+    jax.lax.fori_loop(0, nb, body, 0)
+    slot_ref[0] = jax.lax.rem(s0 + nb, n_bufs)  # hand the rotation on
+    l = l_ref[:]
+    safe_l = jnp.where(l > 0.0, l, 1.0)
+    out_ref[0, 0] = (acc_ref[:] / safe_l).astype(out_ref.dtype)
+    lse_ref[0, 0] = jnp.where(
+        l > 0.0, m_ref[:] + jnp.log(safe_l), jnp.full_like(l, NEG_INF)
+    )
 
 
 def pick_block_k(s_len: int, requested: int, *, head_dim: int = 128,
@@ -146,7 +321,7 @@ def pick_block_k(s_len: int, requested: int, *, head_dim: int = 128,
 def gqa_fwd_batch_decode(
     q, k_cache, v_cache, kv_lens, *,
     scale: float | None = None, soft_cap: float = 0.0,
-    block_k: int = 2048, kv_layout: str = "bhsd", interpret=None,
+    block_k: int | None = 2048, kv_layout: str = "bhsd", interpret=None,
 ):
     """Local GQA decode over a (sharded or whole) KV cache → (out, lse).
 
@@ -175,23 +350,81 @@ def gqa_fwd_batch_decode(
     g = hq // hkv
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    if block_k is None:
+        # auto: half the capacity, clamped to the measured sweet band
+        # (v5e sweeps, docs/PERF.md — smaller blocks lose DMA depth,
+        # larger ones lose length granularity against partial fills)
+        block_k = min(max(s_len // 2, 1024), 4096)
     block_k = pick_block_k(
         s_len, block_k, head_dim=d, itemsize=k_cache.dtype.itemsize
     )
 
     qg = q.reshape(batch, hkv, g, d)
-    grid = (batch, hkv, s_len // block_k)
-    kernel = functools.partial(_decode_kernel, scale, soft_cap, block_k)
+    # the manual-DMA path slices (block_k, d) runs out of the raw cache,
+    # which needs native tile alignment (lane dim d ≡ 0 mod 128, sublane
+    # offset ≡ 0 mod 8); unaligned geometries (tiny test heads) take the
+    # static BlockSpec grid below, whose pipeline pads transparently
+    if kv_layout == "bhsd" and d % 128 == 0 and block_k % 8 == 0:
+        # native layout: dynamic-trip-count kernel — grid (B, Hkv),
+        # in-kernel double-buffered KV DMAs, ceil(len/block_k) blocks
+        # per row (HBM reads scale with TRUE lengths, not capacity)
+        n_bufs = 2
+        kernel = functools.partial(
+            _decode_kernel_dyn, scale, soft_cap, block_k, n_bufs, g, d
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,              # kv_lens → trip counts
+            grid=(batch, hkv),
+            in_specs=[
+                pl.BlockSpec((1, 1, g, d), lambda b, h, lens: (b, h, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, g, d), lambda b, h, lens: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, g, 1), lambda b, h, lens: (b, h, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((n_bufs, block_k, d), k_cache.dtype),
+                pltpu.VMEM((n_bufs, block_k, d), v_cache.dtype),
+                pltpu.SemaphoreType.DMA((n_bufs,)),
+                pltpu.SemaphoreType.DMA((n_bufs,)),
+                pltpu.SMEM((1,), jnp.int32),    # slot rotation carry
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, d), jnp.float32),
+            ],
+        )
+        call = shmem_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((batch, hkv, g, d), q.dtype),
+                jax.ShapeDtypeStruct((batch, hkv, g, 1), jnp.float32),
+            ],
+            collective_id=None,
+            interpret=local_interpret() if interpret is None else interpret,
+            name="gqa_decode_split_kv_dyn",
+        )
+        out, lse = call(kv_lens.astype(jnp.int32), qg, k_cache, v_cache)
+        return out.reshape(batch, hq, d), lse.reshape(batch, hq)
+
+    # static (B, Hkv, S/block_k) grid: the reference-style bshd layout
+    # (whose strided head window precludes the manual contiguous-run
+    # DMA above) and unaligned-geometry bhsd fallbacks
     if kv_layout == "bshd":
         kf = k_cache.reshape(batch, s_len, hkv * d)   # free view, no copy
         vf = v_cache.reshape(batch, s_len, hkv * d)
         kv_spec = pl.BlockSpec((1, block_k, d), lambda b, h, k: (b, k, h))
     else:
         kf, vf = k_cache, v_cache
-        kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda b, h, k: (b, h, k, 0))
+        kv_spec = pl.BlockSpec(
+            (1, 1, block_k, d), lambda b, h, k: (b, h, k, 0)
+        )
+    kernel = functools.partial(_decode_kernel, scale, soft_cap, block_k)
     call = shmem_call(
         kernel,
-        grid=grid,
+        grid=(batch, hkv, s_len // block_k),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # kv_lens, whole (B,)
             pl.BlockSpec((1, 1, g, d), lambda b, h, k: (b, h, 0, 0)),
@@ -277,9 +510,15 @@ def paged_gqa_fwd_batch_decode(
     grid = (batch, hkv, pages_per_seq)
 
     def kv_map(b, h, j, table_ref, lens_ref):
+        # length-aware page skipping (same trick as the dense kernel's
+        # block clamp): steps past row b's last valid page revisit it,
+        # so Mosaic skips their DMA — reads scale with true lengths.
+        # Also doubles as the -1-padding guard: clamped steps never
+        # consult the (possibly -1) padded table entries.
+        jc = jnp.minimum(j, _n_valid_blocks(lens_ref[b], page) - 1)
         # clamp BOTH ways: padded table entries (-1 padding included)
         # must never address out of pool
-        return (jnp.clip(table_ref[b, j], 0, npages - 1), h, 0, 0)
+        return (jnp.clip(table_ref[b, jc], 0, npages - 1), h, 0, 0)
 
     kv_spec = pl.BlockSpec((1, 1, page, d), kv_map)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -430,7 +669,10 @@ def gqa_fwd_batch_decode_xla(
     mask = jnp.arange(s_len)[None, None, None, :] < kv_lens[:, None, None, None]
     s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
+    # explicit mask: an empty row has m == NEG_INF and exp degenerates
+    # to 1 — mask so l stays 0 and the output is exact zeros (matching
+    # the kernel's block-skipping-independent semantics)
+    p = jnp.where(mask, jnp.exp(s - m), 0.0)
     l = jnp.sum(p, axis=-1, keepdims=True)
     out = jnp.einsum("bhgs,bhsd->bhgd", p / jnp.maximum(l, 1e-30), vt)
     lse = jnp.where(l[..., 0] > 0, m[..., 0] + jnp.log(jnp.maximum(l[..., 0], 1e-30)), NEG_INF)

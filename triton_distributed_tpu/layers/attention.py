@@ -44,7 +44,8 @@ class SpGQAFlashDecodeAttention:
     head_dim: int = 128
     scale: float | None = None
     soft_cap: float = 0.0
-    block_k: int = 2048
+    # None → auto (kernel heuristic: shard_len/2 clamped to [1024, 4096])
+    block_k: int | None = None
     use_pallas: bool = True
     # "bhsd" (B, Hkv, S, D) is the fast decode layout: each KV block is
     # one contiguous DMA run (97% of HBM SOL measured on v5e vs 87% for

@@ -235,6 +235,11 @@ class Transformer:
         wq_mode = c.moe_weight_quant
         if weights_quantized is False:
             wq_mode = None               # raw bf16 leaves despite the config
+        elif weights_quantized and wq_mode is None:
+            # quantized dicts despite a None config (the explicit
+            # mode= override of quantize_moe_weights): size the
+            # residency gate from the 1-byte storage actually in hand
+            wq_mode = "int8"
         w_itemsize = resident_weight_itemsize(wq_mode, c.dtype)
         wr_ok = fused_ok and (
             2 * c.hidden * c.ffn * w_itemsize
