@@ -550,6 +550,12 @@ def _bench_serving_moe_decode(mesh, n, on_tpu, spec):
             # (presets.deepseek_moe_16b); measured 1.88 -> 1.55 ms on
             # the MoE block (docs/PERF.md)
             moe_weight_quant="int8",
+            # int8 KV cache: halves the attention DMA bytes + the cache
+            # HBM (production default, presets.deepseek_moe_16b)
+            kv_quant="int8",
+            # int8 dense projections (wqkv/wo/lm_head): same
+            # weight-HBM-bound argument as the expert matrices
+            dense_weight_quant="int8",
         )
     else:
         b, s_cap = 8, 256
@@ -564,6 +570,7 @@ def _bench_serving_moe_decode(mesh, n, on_tpu, spec):
         model.init(jax.random.PRNGKey(7)), model.shardings(),
     )
     params = model.quantize_moe_weights(params)
+    params = model.quantize_dense_weights(params)
     caches = model.init_cache(b, s_cap)
     # MIXED conversation lengths (a serving batch, not a lockstep one):
     # uniform [S/8, 3S/4] so the longest row + the timing loop's appends
@@ -646,7 +653,7 @@ def _bench_serving_moe_decode(mesh, n, on_tpu, spec):
             f"n={n} B={b} hidden={cfg.hidden} topk={cfg.topk} "
             f"experts/chip={cfg.num_experts} ffn={cfg.ffn} S={s_cap} "
             f"lens~U[S/8,3S/4] wq={cfg.moe_weight_quant} "
-            "1-layer EP-MoE decode "
+            f"kvq={cfg.kv_quant} 1-layer EP-MoE decode "
             + ("self-transport(no wire)" if n == 1 else "multi-chip")
         ),
     }

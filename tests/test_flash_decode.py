@@ -102,6 +102,94 @@ def test_sp_decode_matches_dense(mesh8, use_pallas, global_len):
     assert_allclose(np.asarray(out), np.asarray(out_ref), atol=3e-5, rtol=3e-5)
 
 
+class TestInt8KV:
+    """INT8 KV cache decode (TPU-first serving extension: half the KV
+    bytes at rest and on the attention DMA stream; scales fold exactly
+    into the softmax — see _decode_kernel_dyn's quant mode)."""
+
+    def _q(self, batch=3, hq=16, hkv=4, d=128, s=256, seed=7):
+        from triton_distributed_tpu.kernels.flash_decode import quantize_kv
+
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(ks[0], (batch, hq, d), jnp.float32)
+        k = jax.random.normal(ks[1], (batch, hkv, s, d), jnp.float32)
+        v = jax.random.normal(ks[2], (batch, hkv, s, d), jnp.float32)
+        kq, ksc = quantize_kv(k)
+        vq, vsc = quantize_kv(v)
+        return q, k, v, kq, ksc, vq, vsc
+
+    def test_quantize_roundtrip_error_bound(self):
+        _, k, _, kq, ksc, _, _ = self._q()
+        widened = kq.astype(jnp.float32) * ksc[..., None]
+        # per-row max-abs scaling: error ≤ scale/2 = amax/254 per elem
+        amax = jnp.max(jnp.abs(k), axis=-1, keepdims=True)
+        assert float(jnp.max(jnp.abs(widened - k) / (amax / 254.0 + 1e-9))) <= 1.001
+
+    @pytest.mark.parametrize("kv_lens", [[256, 256, 256], [200, 37, 0], [1, 255, 128]])
+    def test_kernel_matches_widened_xla(self, kv_lens):
+        from triton_distributed_tpu.kernels.flash_decode import (
+            gqa_fwd_batch_decode_q8,
+            gqa_fwd_batch_decode_q8_xla,
+        )
+
+        q, _, _, kq, ksc, vq, vsc = self._q()
+        lens = jnp.asarray(kv_lens, jnp.int32)
+        out, lse = gqa_fwd_batch_decode_q8(q, kq, ksc, vq, vsc, lens)
+        ref, lse_ref = gqa_fwd_batch_decode_q8_xla(q, kq, ksc, vq, vsc, lens)
+        # kernel runs q/k/v in bf16 (the TPU compute dtype); the twin is f32
+        assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2, rtol=2e-2)
+        finite = np.isfinite(np.asarray(lse_ref))
+        assert_allclose(
+            np.asarray(lse)[finite], np.asarray(lse_ref)[finite], atol=2e-2
+        )
+
+    def test_quant_error_vs_full_precision(self):
+        from triton_distributed_tpu.kernels.flash_decode import (
+            gqa_fwd_batch_decode_q8,
+        )
+
+        q, k, v, kq, ksc, vq, vsc = self._q()
+        lens = jnp.asarray([256, 200, 128], jnp.int32)
+        out, _ = gqa_fwd_batch_decode_q8(q, kq, ksc, vq, vsc, lens)
+        ref, _ = gqa_fwd_batch_decode_xla(q, k, v, lens, kv_layout="bhsd")
+        assert float(jnp.max(jnp.abs(out - ref))) < 0.05  # ~int8 noise
+
+    def test_sp_q8_matches_dense(self, mesh8):
+        from triton_distributed_tpu.kernels.flash_decode import (
+            sp_gqa_fwd_batch_decode_q8,
+        )
+
+        q, k, v, kq, ksc, vq, vsc = self._q(s=1024)
+        lens = jnp.asarray([900, 400, 64], jnp.int32)  # empty far shards
+        out = sp_gqa_fwd_batch_decode_q8(q, kq, ksc, vq, vsc, lens, mesh8, "x")
+        ref, _ = gqa_fwd_batch_decode_xla(q, k, v, lens, kv_layout="bhsd")
+        assert float(jnp.max(jnp.abs(out - ref))) < 0.05
+
+    def test_append_kv_q8(self):
+        from triton_distributed_tpu.layers import append_kv
+        from triton_distributed_tpu.kernels.flash_decode import quantize_kv
+
+        rng = np.random.default_rng(0)
+        B, H, S, D = 2, 2, 16, 128
+        k0 = jnp.zeros((B, H, S, D), jnp.float32)
+        kc = {"q": jnp.zeros((B, H, S, D), jnp.int8),
+              "scale": jnp.ones((B, H, S), jnp.float32)}
+        vc = {"q": kc["q"], "scale": kc["scale"]}
+        lens = jnp.asarray([3, 9], jnp.int32)
+        k_new = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+        v_new = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+        kc, vc, lens2 = append_kv(kc, vc, lens, k_new, v_new)
+        assert list(np.asarray(lens2)) == [4, 10]
+        widened = kc["q"].astype(jnp.float32) * kc["scale"][..., None]
+        for b, l in enumerate([3, 9]):
+            assert_allclose(
+                np.asarray(widened[b, :, l]), np.asarray(k_new[b]),
+                atol=2e-2, rtol=2e-2,
+            )
+            # untouched rows stay zero
+            assert float(jnp.sum(jnp.abs(widened[b, :, l + 1:]))) == 0.0
+
+
 def test_aot_twin_roundtrip(tmp_path):
     """The AOT library serializes the decode entry and reloads it with
     identical numerics (≡ the *_aot entries, flash_decode.py:1007-1160)."""

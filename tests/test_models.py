@@ -227,6 +227,95 @@ class TestDecode:
         assert q2["blocks"][1]["moe_up"]["q"] is qparams["blocks"][1][
             "moe_up"]["q"]
 
+    def test_decode_kv_quant_close_to_full_precision(self, mesh_tp):
+        """kv_quant='int8': the decode caches hold int8 values +
+        per-(b, h, s) f32 scales, prefill quantizes its K/V writes,
+        append_kv quantizes each step's rows, and the SP attention
+        consumes the dict caches — logits stay within int8-KV tolerance
+        of the full-precision model over multiple steps."""
+        cfg_f = TransformerConfig(**CFG)
+        cfg_q = TransformerConfig(**CFG, kv_quant="int8")
+        model_f = Transformer(cfg_f, mesh_tp, "tp", ())
+        model_q = Transformer(cfg_q, mesh_tp, "tp", ())
+        params = _sharded_params(model_f)
+        b, smax = 4, 32
+        prompt = jax.random.randint(jax.random.PRNGKey(5), (b, 10), 0, 128)
+
+        caches_f = model_f.init_cache(b, smax)
+        caches_q = model_q.init_cache(b, smax)
+        assert isinstance(caches_q[0][0], dict)
+        assert caches_q[0][0]["q"].dtype == jnp.int8
+        last_f, caches_f, lens_f = model_f.prefill(params, caches_f, prompt)
+        last_q, caches_q, lens_q = model_q.prefill(params, caches_q, prompt)
+        scale = np.abs(np.asarray(last_f)).max()
+        assert np.abs(np.asarray(last_q) - np.asarray(last_f)).max() < 0.05 * scale
+        tok = jnp.argmax(last_f, axis=-1).astype(jnp.int32)
+        for _ in range(3):
+            lg_f, caches_f, lens_f = model_f.decode_step(
+                params, caches_f, lens_f, tok
+            )
+            lg_q, caches_q, lens_q = model_q.decode_step(
+                params, caches_q, lens_q, tok
+            )
+            err = np.abs(np.asarray(lg_q) - np.asarray(lg_f)).max()
+            assert err < 0.05 * np.abs(np.asarray(lg_f)).max()
+            assert err > 0, "kv quant did not engage"
+            tok = jnp.argmax(lg_f, axis=-1).astype(jnp.int32)
+
+    def test_decode_dense_weight_quant_close_to_full_precision(self, mesh_tp):
+        """dense_weight_quant='int8': wqkv/wo/up/down/lm_head become
+        {"q","scale"} dicts; decode rides the grouped-GEMM epilogue-
+        dequant kernel (E=1) while prefill widens — both within
+        per-out-channel-int8 tolerance of the full-precision model."""
+        cfg = TransformerConfig(**CFG, dense_weight_quant="int8")
+        model = Transformer(cfg, mesh_tp, "tp", ())
+        params = _sharded_params(model)
+        b, smax = 8, 32            # B=8 (8-multiple) → grouped-GEMM path
+        prompt = jax.random.randint(jax.random.PRNGKey(9), (b, 8), 0, 128)
+        last_f, caches_f, lens_f = model.prefill(
+            params, model.init_cache(b, smax), prompt
+        )
+        tok = jnp.argmax(last_f, axis=-1).astype(jnp.int32)
+        lg_f, _, _ = model.decode_step(params, caches_f, lens_f, tok)
+
+        qp = model.quantize_dense_weights(params)
+        assert isinstance(qp["lm_head"], dict)
+        assert qp["blocks"][0]["wqkv"]["q"].dtype == jnp.int8
+        last_q, caches_q, lens_q = model.prefill(
+            qp, model.init_cache(b, smax), prompt
+        )
+        lg_q, _, _ = model.decode_step(qp, caches_q, lens_q, tok)
+        for a, bq in ((last_f, last_q), (lg_f, lg_q)):
+            err = np.abs(np.asarray(bq) - np.asarray(a)).max()
+            assert err < 0.05 * np.abs(np.asarray(a)).max()
+            assert err > 0, "dense weight quant did not engage"
+        # B=64 (a block_m multiple) exercises the grouped-GEMM kernel
+        # path of _dmm; same caches, quantized vs full-precision weights
+        b2 = 64
+        prompt2 = jax.random.randint(jax.random.PRNGKey(10), (b2, 4), 0, 128)
+        _, caches2, lens2 = model.prefill(
+            params, model.init_cache(b2, smax), prompt2
+        )
+        tok2 = jnp.zeros((b2,), jnp.int32)
+        lg2_q, _, _ = model.decode_step(qp, caches2, lens2, tok2)
+        lg2_f, _, _ = model.decode_step(params, caches2, lens2, tok2)
+        assert lg2_q.dtype == lg2_f.dtype == jnp.float32
+        err2 = np.abs(np.asarray(lg2_q) - np.asarray(lg2_f)).max()
+        assert 0 < err2 < 0.05 * np.abs(np.asarray(lg2_f)).max()
+        # B=6 (not an 8-multiple) exercises _dmm's widening fallback —
+        # logits dtype and values must match the kernel path's contract
+        b3 = 6
+        _, caches3, lens3 = model.prefill(
+            params, model.init_cache(b3, smax),
+            jax.random.randint(jax.random.PRNGKey(11), (b3, 4), 0, 128),
+        )
+        tok3 = jnp.zeros((b3,), jnp.int32)
+        lg3_q, _, _ = model.decode_step(qp, caches3, lens3, tok3)
+        lg3_f, _, _ = model.decode_step(params, caches3, lens3, tok3)
+        assert lg3_q.dtype == jnp.float32
+        err3 = np.abs(np.asarray(lg3_q) - np.asarray(lg3_f)).max()
+        assert 0 < err3 < 0.05 * np.abs(np.asarray(lg3_f)).max()
+
     def test_residency_gate_keys_on_actual_weights(self, mesh_tp):
         """A preset can default moe_weight_quant while the caller never
         ran quantize_moe_weights: the weight-residency VMEM gate must

@@ -12,11 +12,16 @@ from triton_distributed_tpu.kernels.allgather import (
 from triton_distributed_tpu.kernels.flash_decode import (
     combine_partials,
     gqa_fwd_batch_decode,
+    gqa_fwd_batch_decode_q8,
+    gqa_fwd_batch_decode_q8_xla,
     gqa_fwd_batch_decode_xla,
     paged_gqa_fwd_batch_decode,
     paged_gqa_fwd_batch_decode_xla,
+    quantize_kv,
     sp_gqa_fwd_batch_decode,
     sp_gqa_fwd_batch_decode_device,
+    sp_gqa_fwd_batch_decode_q8,
+    sp_gqa_fwd_batch_decode_q8_device,
     sp_paged_gqa_fwd_batch_decode,
     sp_paged_gqa_fwd_batch_decode_device,
 )

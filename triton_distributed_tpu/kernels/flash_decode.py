@@ -127,9 +127,7 @@ def _decode_kernel(
 
 
 def _decode_kernel_dyn(
-    scale, soft_cap, block_k, n_bufs, g, d,
-    kv_lens_ref, q_ref, k_hbm, v_hbm, out_ref, lse_ref,
-    kbuf, vbuf, sem_k, sem_v, slot_ref, m_ref, l_ref, acc_ref,
+    scale, soft_cap, block_k, n_bufs, g, d, quant, *refs,
 ):
     """Dynamic-trip-count decode: grid is (B, Hkv) ONLY; the KV walk is
     an in-kernel ``fori_loop`` over ceil(kv_lens[b]/block_k) blocks with
@@ -154,7 +152,23 @@ def _decode_kernel_dyn(
     engine never drains between groups (without this, a one-block group
     exposes its full copy latency every grid step: measured 2.4 ms vs
     1.5 ms for the whole walk at B=128, Hkv=8, S=2048).
+
+    ``quant``: int8 KV mode — k_hbm/v_hbm are int8 with per-(b, h, s)
+    f32 scale planes riding their own DMA stream. The scales fold
+    EXACTLY into the softmax (per-column into s before soft-capping,
+    per-column into p before the PV dot), so the only extra VPU work
+    is two int8→bf16 widens and two (G, block_k)-sized multiplies —
+    the D-sized dequant multiply never happens. Halves the KV bytes in
+    HBM and on the DMA stream (2× the context per chip).
     """
+    if quant:
+        (kv_lens_ref, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
+         out_ref, lse_ref,
+         kbuf, vbuf, sem_k, sem_v, slot_ref, m_ref, l_ref, acc_ref,
+         ksbuf, vsbuf, sem_ks, sem_vs) = refs
+    else:
+        (kv_lens_ref, q_ref, k_hbm, v_hbm, out_ref, lse_ref,
+         kbuf, vbuf, sem_k, sem_v, slot_ref, m_ref, l_ref, acc_ref) = refs
     b = pl.program_id(0)
     h = pl.program_id(1)
     nb_total = pl.num_programs(0)
@@ -169,19 +183,35 @@ def _decode_kernel_dyn(
     q = q_ref[0, 0]                            # (G, D)
 
     def dma(bb, hh, j, slot):
-        src_k = k_hbm.at[bb, hh, pl.ds(j * block_k, block_k)]
-        src_v = v_hbm.at[bb, hh, pl.ds(j * block_k, block_k)]
-        return (
-            pltpu.make_async_copy(src_k, kbuf.at[slot], sem_k.at[slot]),
-            pltpu.make_async_copy(src_v, vbuf.at[slot], sem_v.at[slot]),
-        )
+        win = pl.ds(j * block_k, block_k)
+        cps = [
+            pltpu.make_async_copy(
+                k_hbm.at[bb, hh, win], kbuf.at[slot], sem_k.at[slot]
+            ),
+            pltpu.make_async_copy(
+                v_hbm.at[bb, hh, win], vbuf.at[slot], sem_v.at[slot]
+            ),
+        ]
+        if quant:
+            # scale planes ride as (B, Hkv, 1, S): the tiled trailing
+            # pair is (1, S), so the window slice is a full-sublane,
+            # lane-aligned (1, block_k) run — a (B, Hkv, S) layout
+            # would put Hkv on sublanes and single-h slices misalign
+            cps += [
+                pltpu.make_async_copy(
+                    ks_hbm.at[bb, hh, :, win], ksbuf.at[slot], sem_ks.at[slot]
+                ),
+                pltpu.make_async_copy(
+                    vs_hbm.at[bb, hh, :, win], vsbuf.at[slot], sem_vs.at[slot]
+                ),
+            ]
+        return cps
 
     @pl.when(jnp.logical_and(b == 0, h == 0))
     def _warmup():                             # first block of the run
         slot_ref[0] = 0
-        ck, cv = dma(0, 0, 0, 0)
-        ck.start()
-        cv.start()
+        for cp in dma(0, 0, 0, 0):
+            cp.start()
 
     s0 = slot_ref[0]                           # this group's start slot
     m_ref[:] = jnp.full_like(m_ref, NEG_INF)
@@ -200,9 +230,8 @@ def _decode_kernel_dyn(
         # compute instead of max(DMA, compute)).
         @pl.when(j + 1 < nb)
         def _prefetch_in_group():
-            nk, nv = dma(b, h, j + 1, nxt)
-            nk.start()
-            nv.start()
+            for cp in dma(b, h, j + 1, nxt):
+                cp.start()
 
         # group's last block: prefetch the NEXT group's first block so
         # the copy flies while out/lse spill and the grid advances
@@ -215,19 +244,28 @@ def _decode_kernel_dyn(
         def _prefetch_next_group():
             nb_ = jnp.where(h + 1 < nh, b, b + 1)
             nh_ = jnp.where(h + 1 < nh, h + 1, 0)
-            nk, nv = dma(nb_, nh_, 0, nxt)
-            nk.start()
-            nv.start()
+            for cp in dma(nb_, nh_, 0, nxt):
+                cp.start()
 
-        ck, cv = dma(b, h, j, slot)
-        ck.wait()
-        cv.wait()
+        for cp in dma(b, h, j, slot):
+            cp.wait()
 
-        k = kbuf[slot]                         # (block_k, D)
-        v = vbuf[slot]
+        if quant:
+            # widen WITHOUT the scale (the D-sized multiply is the
+            # expensive dequant path) — scales fold per-column below
+            k = kbuf[slot].astype(jnp.bfloat16)    # (block_k, D)
+            v = vbuf[slot].astype(jnp.bfloat16)
+            v_scale = vsbuf[slot]                  # (1, block_k)
+        else:
+            k = kbuf[slot]                         # (block_k, D)
+            v = vbuf[slot]
+            v_scale = None
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                              # (G, block_k)
+        if quant:
+            # exact: scale_s is constant along each k column of the dot
+            s = s * ksbuf[slot]                    # (1, block_k) broadcast
         if soft_cap > 0.0:
             s = soft_cap * jnp.tanh(s / soft_cap)
 
@@ -240,8 +278,14 @@ def _decode_kernel_dyn(
                 # an all-masked block degenerates exp(s − m) to 1
                 p = jnp.where(p_mask, p, 0.0)
             l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
+            if v_scale is not None:
+                # fold V's per-row scale into p (row r of V scales the
+                # whole rank-1 term p[:, r]·v[r]) — exact
+                pv = (p * v_scale).astype(v.dtype)
+            else:
+                pv = p.astype(v.dtype)
             acc_ref[:] = alpha * acc_ref[:] + jnp.dot(
-                p.astype(v.dtype), v, preferred_element_type=jnp.float32
+                pv, v, preferred_element_type=jnp.float32
             )
             m_ref[:] = m_new
 
@@ -370,7 +414,7 @@ def gqa_fwd_batch_decode(
         # per row (HBM reads scale with TRUE lengths, not capacity)
         n_bufs = 2
         kernel = functools.partial(
-            _decode_kernel_dyn, scale, soft_cap, block_k, n_bufs, g, d
+            _decode_kernel_dyn, scale, soft_cap, block_k, n_bufs, g, d, False
         )
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,              # kv_lens → trip counts
@@ -450,6 +494,128 @@ def gqa_fwd_batch_decode(
     )
     out, lse = call(kv_lens.astype(jnp.int32), qg, kf, vf)
     return out.reshape(batch, hq, d), lse.reshape(batch, hq)
+
+
+def quantize_kv(x):
+    """Per-(…, s) row int8 quantization of a (..., S, D) cache tensor:
+    each length-D row gets one f32 scale (max-abs / 127). Returns
+    (int8 values, f32 scales of shape x.shape[:-1]).
+
+    TPU-first serving extension (the reference quantizes only the
+    tokens moving through the MoE wire, low_latency_all_to_all.py:82-90;
+    the stationary KV cache is the larger HBM consumer at decode —
+    int8 halves both the cache footprint and the attention DMA bytes).
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    s = jnp.where(amax > 0.0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(
+        jnp.round(xf / s[..., None]), -127.0, 127.0
+    ).astype(jnp.int8)
+    return q, s
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "soft_cap", "block_k", "interpret"),
+)
+def gqa_fwd_batch_decode_q8(
+    q, k_q, k_scale, v_q, v_scale, kv_lens, *,
+    scale: float | None = None, soft_cap: float = 0.0,
+    block_k: int | None = None, interpret=None,
+):
+    """Local GQA decode over an INT8 KV cache → (out, lse).
+
+    q: (B, Hq, D) bf16/f32; k_q/v_q: (B, Hkv, S, D) int8 [bhsd];
+    k_scale/v_scale: (B, Hkv, S) f32 per-token-per-head scales (from
+    :func:`quantize_kv`). Same contract as :func:`gqa_fwd_batch_decode`
+    — dynamic per-row trip counts, reads scale with TRUE lengths — at
+    half the KV bytes; the scales fold exactly into the softmax (see
+    ``_decode_kernel_dyn``'s quant mode).
+    """
+    batch, hq, d = q.shape
+    _, hkv, s_len, _ = k_q.shape
+    assert hq % hkv == 0, f"GQA needs Hq % Hkv == 0, got {hq} % {hkv}"
+    g = hq // hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if block_k is None:
+        block_k = min(max(s_len // 2, 1024), 4096)
+    block_k = pick_block_k(s_len, block_k, head_dim=d, itemsize=1)
+
+    if d % 128 != 0 or block_k % 128 != 0:
+        # unaligned geometry (the scale-plane DMA slices the lane dim
+        # at block_k granules): widen via XLA and take the dense path
+        k = (k_q.astype(jnp.float32) * k_scale[..., None]).astype(q.dtype)
+        v = (v_q.astype(jnp.float32) * v_scale[..., None]).astype(q.dtype)
+        return gqa_fwd_batch_decode(
+            q, k, v, kv_lens, scale=scale, soft_cap=soft_cap,
+            block_k=block_k, kv_layout="bhsd", interpret=interpret,
+        )
+
+    qg = q.reshape(batch, hkv, g, d).astype(jnp.bfloat16)
+    n_bufs = 2
+    kernel = functools.partial(
+        _decode_kernel_dyn, scale, soft_cap, block_k, n_bufs, g, d, True
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(batch, hkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda b, h, lens: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda b, h, lens: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, g, 1), lambda b, h, lens: (b, h, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n_bufs, block_k, d), jnp.int8),
+            pltpu.VMEM((n_bufs, block_k, d), jnp.int8),
+            pltpu.SemaphoreType.DMA((n_bufs,)),
+            pltpu.SemaphoreType.DMA((n_bufs,)),
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((n_bufs, 1, block_k), jnp.float32),
+            pltpu.VMEM((n_bufs, 1, block_k), jnp.float32),
+            pltpu.SemaphoreType.DMA((n_bufs,)),
+            pltpu.SemaphoreType.DMA((n_bufs,)),
+        ],
+    )
+    call = shmem_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, hkv, g, d), q.dtype),
+            jax.ShapeDtypeStruct((batch, hkv, g, 1), jnp.float32),
+        ],
+        collective_id=None,
+        interpret=local_interpret() if interpret is None else interpret,
+        name="gqa_decode_split_kv_q8",
+    )
+    out, lse = call(
+        kv_lens.astype(jnp.int32), qg, k_q, v_q,
+        k_scale.astype(jnp.float32).reshape(batch, hkv, 1, s_len),
+        v_scale.astype(jnp.float32).reshape(batch, hkv, 1, s_len),
+    )
+    return out.reshape(batch, hq, d), lse.reshape(batch, hq)
+
+
+def gqa_fwd_batch_decode_q8_xla(
+    q, k_q, k_scale, v_q, v_scale, kv_lens, *, scale=None, soft_cap=0.0,
+):
+    """Dense-XLA twin of :func:`gqa_fwd_batch_decode_q8` (correctness
+    reference): widen the int8 cache and run the dense reference."""
+    k = (k_q.astype(jnp.float32) * k_scale[..., None]).astype(q.dtype)
+    v = (v_q.astype(jnp.float32) * v_scale[..., None]).astype(q.dtype)
+    return gqa_fwd_batch_decode_xla(
+        q, k, v, kv_lens, scale=scale, soft_cap=soft_cap, kv_layout="bhsd"
+    )
 
 
 def _paged_decode_kernel(
@@ -801,6 +967,88 @@ def sp_gqa_fwd_batch_decode(
         mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout
     )
     out, lse = local_fn(q, k_cache, v_cache, global_kv_lens)
+    return merge_fn(out, lse)
+
+
+def _local_shard_decode_q8(
+    q, k_q, k_scale, v_q, v_scale, global_kv_lens, axis, *,
+    scale, soft_cap, block_k, interpret=None,
+):
+    """Rank-local INT8 decode over this rank's contiguous KV slice."""
+    r = jax.lax.axis_index(axis)
+    s_loc = k_q.shape[2]
+    local_lens = jnp.clip(
+        global_kv_lens - r * s_loc, 0, s_loc
+    ).astype(jnp.int32)
+    return gqa_fwd_batch_decode_q8(
+        q, k_q, k_scale, v_q, v_scale, local_lens,
+        scale=scale, soft_cap=soft_cap, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def sp_gqa_fwd_batch_decode_q8_device(
+    q, k_q, k_scale, v_q, v_scale, global_kv_lens, axis, *,
+    scale=None, soft_cap=0.0, block_k=None, interpret=None,
+):
+    """Per-device SP decode body over an INT8 KV cache (composable
+    inside any shard_map; quantized twin of
+    :func:`sp_gqa_fwd_batch_decode_device`)."""
+    out, lse = _local_shard_decode_q8(
+        q, k_q, k_scale, v_q, v_scale, global_kv_lens, axis,
+        scale=scale, soft_cap=soft_cap, block_k=block_k,
+        interpret=interpret,
+    )
+    return _merge_shard_partials(out, lse, axis)
+
+
+@functools.lru_cache(maxsize=64)
+def _sp_q8_fns(mesh, axis, scale, soft_cap, block_k):
+    """Jitted (local, merge) pair for the INT8 SP decode — split into
+    two dispatches for the interpreter-deadlock reason documented at
+    :func:`_sp_decode_fns`."""
+
+    def local(q, kq, ks, vq, vs, lens):
+        return _local_shard_decode_q8(
+            q, kq, ks, vq, vs, lens, axis,
+            scale=scale, soft_cap=soft_cap, block_k=block_k,
+        )
+
+    kv_spec = P(None, None, axis)              # (B, Hkv, S[, D]) seq-sharded
+    local_fn = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), kv_spec, kv_spec, kv_spec, kv_spec, P()),
+            out_specs=(P(axis), P(axis)),
+            check_vma=False,
+        )
+    )
+    merge_fn = jax.jit(
+        jax.shard_map(
+            functools.partial(_merge_shard_partials, axis=axis),
+            mesh=mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    return local_fn, merge_fn
+
+
+def sp_gqa_fwd_batch_decode_q8(
+    q, k_q, k_scale, v_q, v_scale, global_kv_lens, mesh, axis="x", *,
+    scale=None, soft_cap=0.0, block_k=None,
+):
+    """Host entry: sequence-parallel GQA decode over an INT8 KV cache.
+
+    k_q/v_q: (B, Hkv, S, D) int8, k_scale/v_scale: (B, Hkv, S) f32 —
+    all with S sharded over ``axis``; q and global_kv_lens replicated.
+    Returns (B, Hq, D) replicated. Half the KV bytes of the bf16 entry
+    both at rest and on the attention DMA stream.
+    """
+    local_fn, merge_fn = _sp_q8_fns(mesh, axis, scale, soft_cap, block_k)
+    out, lse = local_fn(q, k_q, k_scale, v_q, v_scale, global_kv_lens)
     return merge_fn(out, lse)
 
 

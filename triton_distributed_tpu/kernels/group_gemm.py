@@ -74,7 +74,7 @@ def _ggemm_q_kernel(nsteps_k, xdt, be_ref, x_ref, w_ref, s_ref, o_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("block_m", "block_n", "block_k", "vmem_limit_bytes",
-                     "interpret"),
+                     "interpret", "out_dtype"),
 )
 def grouped_matmul(
     x_sorted, w, block_expert, *,
@@ -82,6 +82,7 @@ def grouped_matmul(
     block_m: int = 512, block_n: int = 2048, block_k: int = 512,
     vmem_limit_bytes: int | None = None,
     interpret=None,
+    out_dtype=None,
 ):
     """x_sorted (cap, K) @ w (E, K, N) → (cap, N), expert per M-block.
 
@@ -112,6 +113,11 @@ def grouped_matmul(
     VMEM and folds the scale into the f32 accumulator at the last K
     step — HBM weight traffic halves vs bf16 while the MXU still runs
     the bf16 pipeline. Composes with the weight-resident schedule.
+
+    ``out_dtype`` (default: x's dtype): the store casts the f32
+    accumulator directly to this — pass f32 for logits-grade outputs
+    (a post-hoc ``.astype`` after a bf16 store would re-widen
+    already-rounded values).
     """
     from triton_distributed_tpu.config import compiling_for_tpu
     from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
@@ -161,7 +167,9 @@ def grouped_matmul(
     call = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((cap, ndim), x_sorted.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (cap, ndim), out_dtype or x_sorted.dtype
+        ),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=vmem_limit_bytes
         ),

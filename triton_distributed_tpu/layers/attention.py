@@ -22,9 +22,11 @@ import jax
 import jax.numpy as jnp
 
 from triton_distributed_tpu.kernels.flash_decode import (
+    quantize_kv,
     sp_paged_gqa_fwd_batch_decode,
     sp_gqa_fwd_batch_decode,
     sp_gqa_fwd_batch_decode_device,
+    sp_gqa_fwd_batch_decode_q8,
 )
 
 
@@ -68,12 +70,25 @@ class SpGQAFlashDecodeAttention:
         PAGED mode (``block_table`` given, ≡ the reference layer's
         block_table arg + page_size ctor knob): k/v_cache are page POOLS
         (R·npages_local, Hkv, page, D) sharded over ``axis`` and
-        block_table is (R, B, pages_per_slice) of local page ids."""
+        block_table is (R, B, pages_per_slice) of local page ids.
+
+        INT8 mode: pass each cache as a ``{"q": int8 (B, Hkv, S, D),
+        "scale": f32 (B, Hkv, S)}`` dict (the same quantized-leaf
+        convention as the expert weights; build with
+        :func:`quantize_kv` / models' ``kv_quant`` config) — half the
+        KV bytes at rest and on the attention DMA stream."""
         if block_table is not None:
             return sp_paged_gqa_fwd_batch_decode(
                 q, k_cache, v_cache, global_kv_lens, block_table,
                 self.mesh, self.axis, scale=self.scale,
                 soft_cap=self.soft_cap, use_pallas=self.use_pallas,
+            )
+        if isinstance(k_cache, dict):
+            return sp_gqa_fwd_batch_decode_q8(
+                q, k_cache["q"], k_cache["scale"],
+                v_cache["q"], v_cache["scale"], global_kv_lens,
+                self.mesh, self.axis, scale=self.scale,
+                soft_cap=self.soft_cap, block_k=self.block_k,
             )
         return sp_gqa_fwd_batch_decode(
             q, k_cache, v_cache, global_kv_lens, self.mesh, self.axis,
@@ -106,7 +121,29 @@ def append_kv(k_cache, v_cache, kv_lens, k_new, v_new, kv_layout="bhsd"):
     (JAX out-of-bounds scatter semantics) while the returned length
     still increments — callers must enforce capacity up front (see the
     check in models.Transformer.generate).
+
+    INT8 caches (``{"q", "scale"}`` dicts, bhsd only): the new rows are
+    quantized per (b, h) — one f32 scale per appended D-row — and both
+    planes are scattered.
     """
+    if isinstance(k_cache, dict):
+        assert kv_layout == "bhsd", "int8 caches are bhsd-native"
+        kq_new, ks_new = quantize_kv(k_new)    # (B, Hkv, D) → + (B, Hkv)
+        vq_new, vs_new = quantize_kv(v_new)
+        b = k_cache["q"].shape[0]
+        heads = jnp.arange(k_cache["q"].shape[1])
+        bi = jnp.arange(b)[:, None]
+        hi = heads[None, :]
+        li = kv_lens[:, None]
+        k_cache = {
+            "q": k_cache["q"].at[bi, hi, li].set(kq_new),
+            "scale": k_cache["scale"].at[bi, hi, li].set(ks_new),
+        }
+        v_cache = {
+            "q": v_cache["q"].at[bi, hi, li].set(vq_new),
+            "scale": v_cache["scale"].at[bi, hi, li].set(vs_new),
+        }
+        return k_cache, v_cache, kv_lens + 1
     b = k_cache.shape[0]
     rows = jnp.arange(b)
     if kv_layout == "bshd":

@@ -69,6 +69,13 @@ def deepseek_moe_16b(**overrides) -> TransformerConfig:
         # not fp8: v5e has no native fp8 MXU path and the widening
         # lowers poorly (docs/PERF.md dead-end record)
         moe_weight_quant="int8",
+        # int8 KV cache: half the cache HBM (2× context per chip) and
+        # 25–40% faster decode attention (docs/PERF.md)
+        kv_quant="int8",
+        # int8 dense projections (wqkv/wo/lm_head): decode-time dense
+        # GEMMs are weight-HBM-bound like the expert GEMMs — run params
+        # through Transformer.quantize_dense_weights
+        dense_weight_quant="int8",
     )
     cfg.update(overrides)
     return TransformerConfig(**cfg)
@@ -91,6 +98,8 @@ def tiny(preset=None, **overrides) -> TransformerConfig:
             attn=preset.attn,
             moe_wire_quant=preset.moe_wire_quant,
             moe_weight_quant=preset.moe_weight_quant,
+            kv_quant=preset.kv_quant,
+            dense_weight_quant=preset.dense_weight_quant,
         )
     cfg.update(overrides)
     return TransformerConfig(**cfg)

@@ -388,11 +388,19 @@ def _expert_mlp(ctx: EPMoEContext, rows, eid, valid, w_up, w_down):
         h = jax.lax.ragged_dot(xs, w_up, gs)
         h = _act(ctx.activation, h).astype(ctx.dtype)
         y = jax.lax.ragged_dot(h, w_down, gs)
-    y = jnp.where(ok[:, None], y, 0)
-    # scatter back to received-row order
-    out = jnp.zeros((r + 1, y.shape[-1]), ctx.dtype)
-    dest = jnp.where(sti < r, sti, r)
-    return out.at[dest].set(y)[:r]
+    # no post-GEMM re-masking: invalid/slack rows entered the GEMMs as
+    # exact zeros (xs above), so their outputs are exact zeros — the
+    # old (cap, H) `where` pass was a full ~23 MB r+w of dead HBM
+    # bandwidth at serving shapes.
+    # un-sort via inverse-permutation GATHER: every received row index
+    # appears exactly once in sti (it is a sort of all r rows), so the
+    # inverse is total — scatter only the (cap,) int32 iota (trivial;
+    # padding entries drop out of bounds), then move the big array with
+    # one gather instead of scattering (cap, H) rows.
+    inv = jnp.zeros((r,), jnp.int32).at[sti].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop"
+    )
+    return y[inv]
 
 
 def _slot_tables(ctx: EPMoEContext, rspl, slot_m: int, shift=None):
@@ -531,16 +539,26 @@ def _ep_assignments_device(ctx: EPMoEContext, x, flat_e, w_flat, out_rows,
             ctx, y.reshape(ctx.n, ctx.max_m, ctx.hidden), splits, total
         )
 
-    w_sorted = w_flat[order]
+    # back to assignment order via inverse-permutation GATHER (scatter
+    # only the (T,) iota; total-coverage since ``order`` is a
+    # permutation), then reduce the topk groups with a segmented sum —
+    # assignment t belongs to token t//topk, so the (T, H) array IS
+    # (out_rows, topk, H) row-major. One gather + one reduction pass
+    # instead of a full-width f32 select pass + an f32 scatter-add.
+    inv_order = jnp.zeros((total,), jnp.int32).at[order].set(
+        jnp.arange(total, dtype=jnp.int32)
+    )
+    y_orig = y_sorted[inv_order]                   # (T, H) assignment order
     # masked assignments carry weight exactly 0, but their y rows may be
     # garbage (untransported window slack) — zero them before the MAC so
     # a stray inf/nan cannot poison the sum. Under debug_checksum the
     # poison NaNs ride rows with nonzero weight, so they stay loud.
     y_use = jnp.where(
-        (w_sorted != 0)[:, None], y_sorted.astype(jnp.float32), 0.0
+        (w_flat != 0)[:, None],
+        y_orig.astype(jnp.float32) * w_flat[:, None],
+        0.0,
     )
-    out = jnp.zeros((out_rows, ctx.hidden), jnp.float32)
-    out = out.at[order // ctx.topk].add(y_use * w_sorted[:, None])
+    out = y_use.reshape(out_rows, ctx.topk, ctx.hidden).sum(axis=1)
     return (out, new_state) if state is not None else out
 
 
