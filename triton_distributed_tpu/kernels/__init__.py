@@ -16,6 +16,8 @@ from triton_distributed_tpu.kernels.flash_decode import (
     gqa_fwd_batch_decode_q8_xla,
     gqa_fwd_batch_decode_xla,
     paged_gqa_fwd_batch_decode,
+    paged_gqa_fwd_batch_decode_q8,
+    paged_gqa_fwd_batch_decode_q8_xla,
     paged_gqa_fwd_batch_decode_xla,
     quantize_kv,
     sp_gqa_fwd_batch_decode,
@@ -24,6 +26,7 @@ from triton_distributed_tpu.kernels.flash_decode import (
     sp_gqa_fwd_batch_decode_q8_device,
     sp_paged_gqa_fwd_batch_decode,
     sp_paged_gqa_fwd_batch_decode_device,
+    sp_paged_gqa_fwd_batch_decode_q8,
 )
 from triton_distributed_tpu.kernels.gemm_rs import GemmRSMethod, gemm_rs
 from triton_distributed_tpu.kernels.group_gemm import (

@@ -50,6 +50,7 @@ def _n_valid_blocks(kv_len, block_k):
 def _decode_kernel(
     scale, soft_cap, block_k, kv_lens_ref, q_ref, k_ref, v_ref,
     out_ref, lse_ref, m_ref, l_ref, acc_ref,
+    ks_ref=None, vs_ref=None,
 ):
     """One (batch, kv_head) group; grid dim 2 walks KV blocks sequentially.
 
@@ -70,6 +71,10 @@ def _decode_kernel(
     kernel serves the reference-style bshd view and unaligned
     geometries, where capacity-proportional reads are the price of the
     strided window.
+
+    ``ks_ref``/``vs_ref``: optional (…, 1, block_k) f32 per-row scale
+    blocks — int8 KV mode, with the same exact per-column scale folds
+    as ``_decode_kernel_dyn``'s quant path.
     """
     b = pl.program_id(0)
     ki = pl.program_id(2)
@@ -89,12 +94,18 @@ def _decode_kernel(
         # block_k, D) [bhsd]; flatten the unit block dims either way.
         k = k_ref[...].reshape(block_k, q.shape[-1])
         v = v_ref[...].reshape(block_k, q.shape[-1])
+        if ks_ref is not None:
+            # widen WITHOUT the scale; fold per-column below (exact)
+            k = k.astype(jnp.bfloat16)
+            v = v.astype(jnp.bfloat16)
 
         # Inputs stay in their native (bf16) dtype so the MXU runs at
         # full rate; accumulation is f32 via preferred_element_type.
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                              # (G, block_k) f32
+        if ks_ref is not None:
+            s = s * ks_ref[...].reshape(1, block_k)
         if soft_cap > 0.0:
             s = soft_cap * jnp.tanh(s / soft_cap)
 
@@ -111,6 +122,9 @@ def _decode_kernel(
         # mask, l stays 0 and _finish emits exact zeros + NEG_INF lse
         p = jnp.where(valid, jnp.exp(s - m_new), 0.0)   # (G, block_k)
         l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
+        if vs_ref is not None:
+            # fold V's per-row scale into p (rank-1 exactness)
+            p = p * vs_ref[...].reshape(1, block_k)
         acc_ref[:] = alpha * acc_ref[:] + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
@@ -724,6 +738,115 @@ def paged_gqa_fwd_batch_decode(
     return out.reshape(batch, hq, d), lse.reshape(batch, hq)
 
 
+def _paged_decode_kernel_q8(
+    scale, soft_cap, page, table_ref, kv_lens_ref, q_ref, k_ref, v_ref,
+    ks_ref, vs_ref, out_ref, lse_ref, m_ref, l_ref, acc_ref,
+):
+    """INT8 scalar-prefetch adapter: page-table-driven KV blocks plus
+    their (1, 1, 1, page) scale windows, delegating to the static
+    kernel's quant folds."""
+    del table_ref
+    _decode_kernel(
+        scale, soft_cap, page, kv_lens_ref, q_ref, k_ref, v_ref,
+        out_ref, lse_ref, m_ref, l_ref, acc_ref,
+        ks_ref=ks_ref, vs_ref=vs_ref,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "soft_cap", "interpret")
+)
+def paged_gqa_fwd_batch_decode_q8(
+    q, k_pool, k_scale, v_pool, v_scale, kv_lens, block_table, *,
+    scale: float | None = None, soft_cap: float = 0.0, interpret=None,
+):
+    """PAGED GQA decode over an INT8 page pool.
+
+    k_pool/v_pool: (num_pages, Hkv, page, D) int8; k_scale/v_scale:
+    (num_pages, Hkv, page) f32 per-row scales (reshaped internally to
+    the lane-aligned (num_pages, Hkv, 1, page) DMA layout). Same
+    contract as :func:`paged_gqa_fwd_batch_decode` at half the KV pool
+    bytes — the int8 composition of the paged and quantized serving
+    modes (block-table page walk + exact in-softmax scale folds).
+    """
+    batch, hq, d = q.shape
+    npages, hkv, page, _ = k_pool.shape
+    assert v_pool.shape == k_pool.shape, (k_pool.shape, v_pool.shape)
+    assert hq % hkv == 0, f"GQA needs Hq % Hkv == 0, got {hq} % {hkv}"
+    g = hq // hkv
+    pages_per_seq = block_table.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    qg = q.reshape(batch, hkv, g, d).astype(jnp.bfloat16)
+    grid = (batch, hkv, pages_per_seq)
+
+    def kv_map(b, h, j, table_ref, lens_ref):
+        # same double clamp as the non-q8 kernel's kv_map: steps past
+        # the last valid page revisit it (length-aware skipping, and
+        # clamped steps never consult possibly -1-padded table
+        # entries), and the table lookup never addresses out of pool
+        jc = jnp.minimum(j, _n_valid_blocks(lens_ref[b], page) - 1)
+        return (jnp.clip(table_ref[b, jc], 0, npages - 1), h, 0, 0)
+
+    # the scale windows ride the SAME page walk (leading dims pick the
+    # page; only the block shape differs)
+    kv_spec = pl.BlockSpec((1, 1, page, d), kv_map)
+    sc_spec = pl.BlockSpec((1, 1, 1, page), kv_map)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, g, d), lambda b, h, j, t_, l_: (b, h, 0, 0)
+            ),
+            kv_spec,
+            kv_spec,
+            sc_spec,
+            sc_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda b, h, j, t_, l_: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, g, 1), lambda b, h, j, t_, l_: (b, h, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+        ],
+    )
+    call = pl.pallas_call(
+        functools.partial(_paged_decode_kernel_q8, scale, soft_cap, page),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, hkv, g, d), q.dtype),
+            jax.ShapeDtypeStruct((batch, hkv, g, 1), jnp.float32),
+        ],
+        interpret=local_interpret() if interpret is None else interpret,
+        name="gqa_decode_paged_q8",
+    )
+    out, lse = call(
+        block_table.astype(jnp.int32), kv_lens.astype(jnp.int32),
+        qg, k_pool, v_pool,
+        k_scale.astype(jnp.float32).reshape(npages, hkv, 1, page),
+        v_scale.astype(jnp.float32).reshape(npages, hkv, 1, page),
+    )
+    return out.reshape(batch, hq, d), lse.reshape(batch, hq)
+
+
+def paged_gqa_fwd_batch_decode_q8_xla(
+    q, k_pool, k_scale, v_pool, v_scale, kv_lens, block_table, *,
+    scale=None, soft_cap=0.0,
+):
+    """Dense-XLA twin: widen the int8 pools and take the dense paged
+    reference."""
+    kp = (k_pool.astype(jnp.float32) * k_scale[..., None]).astype(q.dtype)
+    vp = (v_pool.astype(jnp.float32) * v_scale[..., None]).astype(q.dtype)
+    return paged_gqa_fwd_batch_decode_xla(
+        q, kp, vp, kv_lens, block_table, scale=scale, soft_cap=soft_cap
+    )
+
+
 def paged_gqa_fwd_batch_decode_xla(
     q, k_pool, v_pool, kv_lens, block_table, *, scale=None, soft_cap=0.0,
 ):
@@ -1049,6 +1172,70 @@ def sp_gqa_fwd_batch_decode_q8(
     """
     local_fn, merge_fn = _sp_q8_fns(mesh, axis, scale, soft_cap, block_k)
     out, lse = local_fn(q, k_q, k_scale, v_q, v_scale, global_kv_lens)
+    return merge_fn(out, lse)
+
+
+def _local_paged_shard_decode_q8(
+    q, k_pool, k_scale, v_pool, v_scale, global_kv_lens, block_table,
+    axis, *, scale, soft_cap, interpret=None,
+):
+    """Rank-local INT8 paged decode over this rank's sequence slice."""
+    r = jax.lax.axis_index(axis)
+    page = k_pool.shape[2]
+    s_loc = block_table.shape[1] * page
+    local_lens = jnp.clip(
+        global_kv_lens - r * s_loc, 0, s_loc
+    ).astype(jnp.int32)
+    return paged_gqa_fwd_batch_decode_q8(
+        q, k_pool, k_scale, v_pool, v_scale, local_lens, block_table,
+        scale=scale, soft_cap=soft_cap, interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _sp_paged_q8_fns(mesh, axis, scale, soft_cap):
+    """Jitted (local, merge) pair for the INT8 paged SP decode."""
+
+    def local(q, kp, ks, vp, vs, lens, table):
+        return _local_paged_shard_decode_q8(
+            q, kp, ks, vp, vs, lens, table[0], axis,
+            scale=scale, soft_cap=soft_cap,
+        )
+
+    local_fn = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P(),
+                      P(axis)),
+            out_specs=(P(axis), P(axis)),
+            check_vma=False,
+        )
+    )
+    merge_fn = jax.jit(
+        jax.shard_map(
+            functools.partial(_merge_shard_partials, axis=axis),
+            mesh=mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    return local_fn, merge_fn
+
+
+def sp_paged_gqa_fwd_batch_decode_q8(
+    q, k_pool, k_scale, v_pool, v_scale, global_kv_lens, block_table,
+    mesh, axis="x", *, scale=None, soft_cap=0.0,
+):
+    """Host entry: sequence-parallel INT8 PAGED GQA decode — the same
+    per-rank pool/table contract as :func:`sp_paged_gqa_fwd_batch_decode`
+    with int8 pools + (R·npages_local, Hkv, page) f32 scale pools, all
+    sharded ``P(axis)`` on dim 0."""
+    local_fn, merge_fn = _sp_paged_q8_fns(mesh, axis, scale, soft_cap)
+    out, lse = local_fn(
+        q, k_pool, k_scale, v_pool, v_scale, global_kv_lens, block_table
+    )
     return merge_fn(out, lse)
 
 

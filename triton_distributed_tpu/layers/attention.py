@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from triton_distributed_tpu.kernels.flash_decode import (
     quantize_kv,
     sp_paged_gqa_fwd_batch_decode,
+    sp_paged_gqa_fwd_batch_decode_q8,
     sp_gqa_fwd_batch_decode,
     sp_gqa_fwd_batch_decode_device,
     sp_gqa_fwd_batch_decode_q8,
@@ -78,6 +79,13 @@ class SpGQAFlashDecodeAttention:
         :func:`quantize_kv` / models' ``kv_quant`` config) — half the
         KV bytes at rest and on the attention DMA stream."""
         if block_table is not None:
+            if isinstance(k_cache, dict):       # int8 page pools
+                return sp_paged_gqa_fwd_batch_decode_q8(
+                    q, k_cache["q"], k_cache["scale"],
+                    v_cache["q"], v_cache["scale"], global_kv_lens,
+                    block_table, self.mesh, self.axis,
+                    scale=self.scale, soft_cap=self.soft_cap,
+                )
             return sp_paged_gqa_fwd_batch_decode(
                 q, k_cache, v_cache, global_kv_lens, block_table,
                 self.mesh, self.axis, scale=self.scale,

@@ -72,9 +72,12 @@ def main(argv=None) -> None:
         model.init(jax.random.PRNGKey(args.seed)),
         model.shardings(),
     )
-    # serving weight quantization (preset-gated): expert matrices to
-    # int8 + per-channel scales, consumed in the grouped-GEMM epilogue
+    # serving weight quantization (preset-gated): expert matrices and
+    # dense projections to int8 + per-channel scales, consumed in the
+    # grouped-GEMM epilogue (the KV cache quantizes via init_cache
+    # when the preset sets kv_quant)
     params = model.quantize_moe_weights(params)
+    params = model.quantize_dense_weights(params)
 
     cap = args.capacity or -(-(args.prompt_len + args.steps) // 128) * 128
     prompt = jax.random.randint(

@@ -1,0 +1,83 @@
+"""Tutorial 15: the int8 serving stack — quantize every heavy plane.
+
+Serving decode is HBM-bound three ways, and each plane gets its own
+int8 treatment with exact scale folds (docs/PERF.md, round 4):
+
+* KV cache (``kv_quant="int8"``): int8 values + one f32 scale per
+  (batch, head, position) row; the flash-decode kernel folds K's
+  per-column scale into the scores and V's into p before the PV dot,
+  so no D-wide dequantization multiply ever runs. Half the cache HBM
+  — 2× the context per chip — and 25–40% faster decode attention.
+* Expert matrices (``moe_weight_quant="int8"``): per-(expert,
+  out-channel) scales folded into the grouped-GEMM f32 epilogue
+  (exact: dequantization is linear over the K reduction).
+* Dense projections (``dense_weight_quant="int8"``): the same
+  epilogue-dequant kernel with E=1 and block_m=B (one M-block — the
+  grid iterates m outermost, so more blocks would re-stream the
+  weight tiles).
+
+The reference quantizes only the tokens moving through the MoE wire
+(fp8 WITH_SCALE, low_latency_all_to_all.py:82-90); the stationary
+planes are TPU-first extensions. Measured all together at the serving
+headline (B=128, hidden 7168, topk 8, v5e): 4.5 → 2.63 ms/step.
+"""
+
+from _common import get_mesh
+
+mesh = get_mesh()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.models import Transformer, presets
+
+# the DeepSeek serving preset ships all three planes on; the tiny()
+# twin keeps the same quantization topology at CI size
+cfg = presets.tiny(presets.deepseek_moe_16b())
+assert cfg.kv_quant == "int8"
+assert cfg.moe_weight_quant == "int8"
+assert cfg.dense_weight_quant == "int8"
+
+model = Transformer(cfg, mesh, "x", ())
+params = jax.tree.map(
+    lambda p, s: jax.device_put(p, s),
+    model.init(jax.random.PRNGKey(0)), model.shardings(),
+)
+
+# quantize AFTER init/load + device placement (the quantized leaves
+# inherit the sharding of their sources)
+params = model.quantize_moe_weights(params)
+params = model.quantize_dense_weights(params)
+assert params["blocks"][0]["wqkv"]["q"].dtype == jnp.int8
+assert params["lm_head"]["q"].dtype == jnp.int8
+
+B, PROMPT, STEPS, CAP = 4, 12, 4, 64
+prompt = jax.random.randint(jax.random.PRNGKey(1), (B, PROMPT), 0, cfg.vocab)
+
+# init_cache sees kv_quant and allocates {"q": int8, "scale": f32}
+# dicts; prefill quantizes its K/V writes row-by-row
+caches = model.init_cache(B, CAP)
+assert caches[0][0]["q"].dtype == jnp.int8
+last_logits, caches, lens = model._prefill_jit(params, caches, prompt)
+
+first = jax.numpy.argmax(last_logits, axis=-1).astype(jnp.int32)
+toks, caches, lens = model.generate(params, caches, lens, first, steps=STEPS)
+print("int8-stack generation:", np.asarray(toks))
+
+# the full-precision model (same weights pre-quantization) agrees to
+# within int8 noise on the first decode logits
+cfg_f = presets.tiny(presets.deepseek_moe_16b(), kv_quant=None,
+                     moe_weight_quant=None, dense_weight_quant=None)
+model_f = Transformer(cfg_f, mesh, "x", ())
+params_f = jax.tree.map(
+    lambda p, s: jax.device_put(p, s),
+    model_f.init(jax.random.PRNGKey(0)), model_f.shardings(),
+)
+caches_f = model_f.init_cache(B, CAP)
+last_f, caches_f, lens_f = model_f._prefill_jit(params_f, caches_f, prompt)
+err = np.abs(np.asarray(last_logits) - np.asarray(last_f)).max()
+rel = err / np.abs(np.asarray(last_f)).max()
+print(f"quantized vs full-precision prefill logits: rel err {rel:.4f}")
+assert rel < 0.05, rel
+print("OK")
