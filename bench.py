@@ -550,6 +550,8 @@ def _bench_serving_moe_decode(mesh, n, on_tpu, spec):
             # (presets.deepseek_moe_16b); measured 1.88 -> 1.55 ms on
             # the MoE block (docs/PERF.md)
             moe_weight_quant="int8",
+            # W8A8 expert GEMMs: s8×s8 MXU at 2× the bf16 rate
+            moe_act_quant="int8",
             # int8 KV cache: halves the attention DMA bytes + the cache
             # HBM (production default, presets.deepseek_moe_16b)
             kv_quant="int8",
@@ -653,7 +655,8 @@ def _bench_serving_moe_decode(mesh, n, on_tpu, spec):
             f"n={n} B={b} hidden={cfg.hidden} topk={cfg.topk} "
             f"experts/chip={cfg.num_experts} ffn={cfg.ffn} S={s_cap} "
             f"lens~U[S/8,3S/4] wq={cfg.moe_weight_quant} "
-            f"kvq={cfg.kv_quant} 1-layer EP-MoE decode "
+            f"aq={cfg.moe_act_quant} kvq={cfg.kv_quant} "
+            "1-layer EP-MoE decode "
             + ("self-transport(no wire)" if n == 1 else "multi-chip")
         ),
     }

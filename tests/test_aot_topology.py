@@ -392,6 +392,64 @@ class TestCollectiveFamilies:
             _sds(tmesh, (b, pps), jnp.int32),
         )
 
+    def test_flash_decode_q8(self, tmesh):
+        """INT8 KV decode: the dynamic-trip-count kernel's quant mode —
+        int8 payload DMAs + (B, Hkv, 1, S) scale-plane DMAs + in-softmax
+        scale folds — through real Mosaic for the 8-chip topology."""
+        import functools as ft
+
+        from triton_distributed_tpu.kernels.flash_decode import (
+            gqa_fwd_batch_decode_q8,
+        )
+
+        b, hq, hkv, d, s = 4, 16, 8, 128, 1024
+        fn = jax.jit(
+            jax.shard_map(
+                ft.partial(
+                    gqa_fwd_batch_decode_q8, interpret=False, block_k=512
+                ),
+                mesh=tmesh, in_specs=(P(),) * 6, out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+        _assert_compiles(
+            fn,
+            _sds(tmesh, (b, hq, d), jnp.bfloat16),
+            _sds(tmesh, (b, hkv, s, d), jnp.int8),
+            _sds(tmesh, (b, hkv, s), jnp.float32),
+            _sds(tmesh, (b, hkv, s, d), jnp.int8),
+            _sds(tmesh, (b, hkv, s), jnp.float32),
+            _sds(tmesh, (b,), jnp.int32),
+        )
+
+    def test_paged_flash_decode_q8(self, tmesh):
+        """INT8 paged decode: table-driven int8 page windows + their
+        scale windows through real Mosaic."""
+        import functools as ft
+
+        from triton_distributed_tpu.kernels.flash_decode import (
+            paged_gqa_fwd_batch_decode_q8,
+        )
+
+        b, hq, hkv, d, page, pps, npages = 2, 16, 4, 128, 128, 4, 16
+        fn = jax.jit(
+            jax.shard_map(
+                ft.partial(paged_gqa_fwd_batch_decode_q8, interpret=False),
+                mesh=tmesh, in_specs=(P(),) * 7, out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+        _assert_compiles(
+            fn,
+            _sds(tmesh, (b, hq, d), jnp.bfloat16),
+            _sds(tmesh, (npages, hkv, page, d), jnp.int8),
+            _sds(tmesh, (npages, hkv, page), jnp.float32),
+            _sds(tmesh, (npages, hkv, page, d), jnp.int8),
+            _sds(tmesh, (npages, hkv, page), jnp.float32),
+            _sds(tmesh, (b,), jnp.int32),
+            _sds(tmesh, (b, pps), jnp.int32),
+        )
+
     def test_flash_decode_sp(self, tmesh):
         """SP decode: the per-device split-kv kernel + combine compiled
         over the sequence-sharded mesh (the serving hot path)."""

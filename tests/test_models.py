@@ -227,6 +227,51 @@ class TestDecode:
         assert q2["blocks"][1]["moe_up"]["q"] is qparams["blocks"][1][
             "moe_up"]["q"]
 
+    def test_decode_act_quant_close_to_w8a16(self, mesh_tp, monkeypatch):
+        """moe_act_quant='int8' (W8A8): the decode expert GEMMs run the
+        s8×s8 MXU path over per-row-quantized activations — logits stay
+        within combined-int8 tolerance of the W8A16 path and the
+        context actually engages (block_m 128, act_quant set)."""
+        cfg16 = TransformerConfig(
+            **CFG, moe="ep", moe_layers=(1,), num_experts=8, topk=2,
+            moe_weight_quant="int8",
+        )
+        cfg8 = TransformerConfig(
+            **CFG, moe="ep", moe_layers=(1,), num_experts=8, topk=2,
+            moe_weight_quant="int8", moe_act_quant="int8",
+        )
+        m16 = Transformer(cfg16, mesh_tp, "tp", ())
+        m8 = Transformer(cfg8, mesh_tp, "tp", ())
+
+        # forced-fused ctx WITH the Pallas GEMM (W8A8 lives there);
+        # honors the config's act_quant so m8 engages and m16 doesn't
+        from triton_distributed_tpu import ops as _ops
+
+        def fused_ctx(self, m_local, inference=False, weights_quantized=None):
+            c = self.config
+            return _ops.create_ep_moe_context(
+                self.mesh, self.tp_axis, num_experts=c.num_experts,
+                topk=c.topk, max_m=m_local * c.topk, hidden=c.hidden,
+                dtype=c.dtype, transport="fused" if inference else "xla",
+                use_pallas_gemm=True, block_m=8,
+                quant=c.moe_wire_quant if inference else None,
+                act_quant=c.moe_act_quant if inference else None,
+                batch_axes=tuple(self.dp_axes),
+            )
+
+        monkeypatch.setattr(Transformer, "_moe_ep_ctx", fused_ctx)
+        params = _sharded_params(m16)
+        qp = m16.quantize_moe_weights(params)
+        b, smax = 8, 32
+        prompt = jax.random.randint(jax.random.PRNGKey(13), (b, 8), 0, 128)
+        last, caches, lens = m16.prefill(qp, m16.init_cache(b, smax), prompt)
+        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        lg16, _, _ = m16.decode_step(qp, caches, lens, tok)
+        lg8, _, _ = m8.decode_step(qp, caches, lens, tok)
+        err = np.abs(np.asarray(lg8) - np.asarray(lg16)).max()
+        assert err < 0.06 * np.abs(np.asarray(lg16)).max()
+        assert err > 0, "act quant did not engage"
+
     def test_decode_kv_quant_close_to_full_precision(self, mesh_tp):
         """kv_quant='int8': the decode caches hold int8 values +
         per-(b, h, s) f32 scales, prefill quantizes its K/V writes,

@@ -91,6 +91,49 @@ class TestGroupedGemm:
         )
         assert_allclose(y, y_ref, atol=3e-2, rtol=3e-2)
 
+    def test_w8a8_vs_widened_exact_scales(self):
+        """The s8×s8 path's rank-1 epilogue (x_scale[m]·w_scale[e, n])
+        equals the widened f32 product of the SAME quantized operands
+        (both scales are constant over the K reduction, so the fold is
+        exact up to the out-dtype cast)."""
+        m, k, n, e, topk, bm = 64, 128, 256, 8, 2, 16
+        _, ids = _routing(m, e, topk)
+        sti, be, _ = mu.moe_align_block_size(ids, e, bm)
+        x = jax.random.normal(jax.random.PRNGKey(1), (m, k), jnp.bfloat16)
+        w = jax.random.normal(jax.random.PRNGKey(2), (e, k, n)) * 0.05
+        wq, ws = gg.quantize_grouped_weights(w, "int8")
+        xs = mu.gather_sorted(x, sti, topk)
+        xq, xsc = gg.quantize_act_rows(xs)
+        y = gg.grouped_matmul(
+            xq, wq, be, w_scale=ws, x_scale=xsc, block_m=bm,
+            out_dtype=jnp.float32,
+        )
+        xw = xq.astype(jnp.float32) * xsc
+        y_ref = gg.grouped_matmul(
+            xw, gg.dequantize_grouped_weights(wq, ws, jnp.float32), be,
+            block_m=bm,
+        )
+        assert_allclose(y, y_ref, atol=1e-4, rtol=1e-4)
+
+    def test_w8a8_error_vs_full_precision_bounded(self):
+        """W8A8 (per-row act + per-channel weight int8) stays within
+        serving tolerance of the full-precision product."""
+        m, k, n, e, topk, bm = 64, 128, 128, 4, 2, 16
+        _, ids = _routing(m, e, topk)
+        sti, be, _ = mu.moe_align_block_size(ids, e, bm)
+        x = jax.random.normal(jax.random.PRNGKey(1), (m, k), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(2), (e, k, n)) * 0.05
+        wq, ws = gg.quantize_grouped_weights(w, "int8")
+        xs = mu.gather_sorted(x, sti, topk)
+        xq, xsc = gg.quantize_act_rows(xs)
+        y = gg.grouped_matmul(
+            xq, wq, be, w_scale=ws, x_scale=xsc, block_m=bm,
+            out_dtype=jnp.float32,
+        )
+        y_full = gg.grouped_matmul(xs, w.astype(jnp.float32), be, block_m=bm)
+        err = jnp.abs(y - y_full).max() / (jnp.abs(y_full).max() + 1e-9)
+        assert float(err) < 0.03, float(err)
+
     def test_weight_quant_error_bounded(self):
         """int8 per-channel weight quant stays close to the full-
         precision product (the serving-accuracy contract)."""

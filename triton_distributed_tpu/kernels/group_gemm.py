@@ -71,6 +71,46 @@ def _ggemm_q_kernel(nsteps_k, xdt, be_ref, x_ref, w_ref, s_ref, o_ref,
         o_ref[:] = (acc_ref[:] * s_ref[0, 0][None, :]).astype(o_ref.dtype)
 
 
+def _ggemm_q8a_kernel(nsteps_k, be_ref, x_ref, w_ref, xs_ref, ws_ref,
+                      o_ref, acc_ref):
+    """W8A8 variant: BOTH operands ride int8 and the MXU runs its
+    native s8×s8→s32 path (measured 320–350 TOP/s on a v5e — 2× the
+    bf16 rate), with the rank-1 scale correction
+    ``x_scale[m] · w_scale[e, n]`` applied to the s32 accumulator at
+    the last K step (exact: both scales are constant over the K
+    reduction)."""
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jax.lax.dot_general(
+        x_ref[:], w_ref[0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+    @pl.when(kk == nsteps_k - 1)
+    def _store():
+        o_ref[:] = (
+            acc_ref[:].astype(jnp.float32)
+            * xs_ref[:]                        # (block_m, 1)
+            * ws_ref[0, 0][None, :]            # (block_n,)
+        ).astype(o_ref.dtype)
+
+
+def quantize_act_rows(x):
+    """Per-row symmetric int8 activation quantization: (M, K) →
+    ((M, K) int8, (M, 1) f32 scales). The activation-side half of the
+    W8A8 decode path (weights come from :func:`quantize_grouped_weights`)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    s = jnp.where(amax > 0.0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / s), -127.0, 127.0).astype(jnp.int8)
+    return q, s.astype(jnp.float32)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("block_m", "block_n", "block_k", "vmem_limit_bytes",
@@ -78,7 +118,7 @@ def _ggemm_q_kernel(nsteps_k, xdt, be_ref, x_ref, w_ref, s_ref, o_ref,
 )
 def grouped_matmul(
     x_sorted, w, block_expert, *,
-    w_scale=None,
+    w_scale=None, x_scale=None,
     block_m: int = 512, block_n: int = 2048, block_k: int = 512,
     vmem_limit_bytes: int | None = None,
     interpret=None,
@@ -118,6 +158,14 @@ def grouped_matmul(
     accumulator directly to this — pass f32 for logits-grade outputs
     (a post-hoc ``.astype`` after a bf16 store would re-widen
     already-rounded values).
+
+    W8A8 (``x_scale`` given too, x int8 from :func:`quantize_act_rows`):
+    the MXU runs its native s8×s8→s32 path at 2× the bf16 rate and the
+    rank-1 ``x_scale[m]·w_scale[e, n]`` correction lands on the s32
+    accumulator in the epilogue. Decode-size grouped GEMMs at bm=64
+    are MXU-bound (the weight-resident schedule already minimized the
+    HBM reads), so doubling the MXU rate is the remaining lever.
+    ``out_dtype`` defaults to bf16 here (int8 out makes no sense).
     """
     from triton_distributed_tpu.config import compiling_for_tpu
     from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
@@ -138,7 +186,9 @@ def grouped_matmul(
             (1, block_k, block_n), lambda m, n, k, be: (be[m], k, n)
         ),
     ]
+    acc_dtype = jnp.float32
     if w_scale is None:
+        assert x_scale is None, "x_scale requires w_scale (W8A8 mode)"
         kernel = functools.partial(_ggemm_kernel, nsteps_k)
         args = (block_expert, x_sorted, w)
     else:
@@ -149,20 +199,39 @@ def grouped_matmul(
         assert w_scale.shape == (e, ndim), (w_scale.shape, (e, ndim))
         # (E, 1, N): the unit sublane dim equals the array dim, which
         # Mosaic accepts where a (1, block_n) slice of (E, N) is rejected
-        in_specs.append(
-            pl.BlockSpec((1, 1, block_n), lambda m, n, k, be: (be[m], 0, n))
+        ws3 = w_scale.astype(jnp.float32)[:, None, :]
+        ws_spec = pl.BlockSpec(
+            (1, 1, block_n), lambda m, n, k, be: (be[m], 0, n)
         )
-        kernel = functools.partial(_ggemm_q_kernel, nsteps_k, x_sorted.dtype)
-        args = (
-            block_expert, x_sorted, w,
-            w_scale.astype(jnp.float32)[:, None, :],
-        )
+        if x_scale is None:
+            in_specs.append(ws_spec)
+            kernel = functools.partial(
+                _ggemm_q_kernel, nsteps_k, x_sorted.dtype
+            )
+            args = (block_expert, x_sorted, w, ws3)
+        else:
+            assert x_sorted.dtype == jnp.int8, (
+                f"W8A8 needs int8 activations, got {x_sorted.dtype}"
+            )
+            assert x_scale.shape == (cap, 1), (x_scale.shape, (cap, 1))
+            in_specs.append(
+                pl.BlockSpec((block_m, 1), lambda m, n, k, be: (m, 0))
+            )
+            in_specs.append(ws_spec)
+            kernel = functools.partial(_ggemm_q8a_kernel, nsteps_k)
+            args = (
+                block_expert, x_sorted, w,
+                x_scale.astype(jnp.float32), ws3,
+            )
+            acc_dtype = jnp.int32
+            if out_dtype is None:
+                out_dtype = jnp.bfloat16
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(cap // block_m, ndim // block_n, nsteps_k),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((block_m, block_n), lambda m, n, k, be: (m, n)),
-        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), acc_dtype)],
     )
     call = pl.pallas_call(
         kernel,

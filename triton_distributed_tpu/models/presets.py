@@ -69,6 +69,9 @@ def deepseek_moe_16b(**overrides) -> TransformerConfig:
         # not fp8: v5e has no native fp8 MXU path and the widening
         # lowers poorly (docs/PERF.md dead-end record)
         moe_weight_quant="int8",
+        # W8A8 expert GEMMs at decode: the MXU's s8×s8 path runs 2× the
+        # bf16 rate and the wire already quantized the tokens
+        moe_act_quant="int8",
         # int8 KV cache: half the cache HBM (2× context per chip) and
         # 25–40% faster decode attention (docs/PERF.md)
         kv_quant="int8",
@@ -98,6 +101,7 @@ def tiny(preset=None, **overrides) -> TransformerConfig:
             attn=preset.attn,
             moe_wire_quant=preset.moe_wire_quant,
             moe_weight_quant=preset.moe_weight_quant,
+            moe_act_quant=preset.moe_act_quant,
             kv_quant=preset.kv_quant,
             dense_weight_quant=preset.dense_weight_quant,
         )

@@ -82,6 +82,12 @@ class TransformerConfig:
     # the reference quantizes only the moving tokens (WITH_SCALE fp8,
     # low_latency_all_to_all.py:82-90), not the stationary weights.
     moe_weight_quant: str | None = None
+    # W8A8 expert GEMMs ("int8" | None): with int8 expert weights, also
+    # quantize the decode activations per row and run the MXU's native
+    # s8×s8 path at 2× the bf16 rate (ops/moe.EPMoEContext.act_quant).
+    # Adds one more per-row quantization step on the hidden activation;
+    # logits stay within ~1% of the W8A16 path (tests). Decode-only.
+    moe_act_quant: str | None = None
     # Weight-only quantization of the DENSE projections ("int8" |
     # None): wqkv / wo / dense-MLP up/down / lm_head stored int8 with
     # per-out-channel f32 scales, consumed at DECODE time by the
@@ -135,6 +141,16 @@ class TransformerConfig:
             raise ValueError(
                 "dense_weight_quant must be None or 'int8', got "
                 f"{self.dense_weight_quant!r}"
+            )
+        if self.moe_act_quant not in (None, "int8"):
+            raise ValueError(
+                "moe_act_quant must be None or 'int8', got "
+                f"{self.moe_act_quant!r}"
+            )
+        if self.moe_act_quant is not None and self.moe_weight_quant != "int8":
+            raise ValueError(
+                "moe_act_quant (W8A8) needs moe_weight_quant='int8' — the "
+                "s8×s8 MXU path consumes int8 weight dicts"
             )
         if self.moe_weight_quant is not None and self.moe != "ep":
             raise ValueError(
@@ -287,15 +303,25 @@ class Transformer:
             2 * c.hidden * c.ffn * w_itemsize
             <= int(0.7 * fused_vmem_budget())
         )
+        # W8A8 engages only where its int8 weight dicts will exist
+        a8 = c.moe_act_quant if (fused_ok and wq_mode == "int8") else None
+        # block_m: W8A8's s8×s8 MXU rate needs ≥128-row blocks, while
+        # W8A16 prefers 64 (less alignment padding; weight residency
+        # removes the re-streaming penalty) — both measured, docs/PERF.md
+        if wr_ok:
+            bm = 128 if a8 else 64
+        else:
+            bm = 256 if fused_ok else 128
         return ops.create_ep_moe_context(
             self.mesh, self.tp_axis, num_experts=c.num_experts, topk=c.topk,
             max_m=m_local * c.topk, hidden=c.hidden, dtype=c.dtype,
             transport="fused" if fused_ok else "xla",
             use_pallas_gemm=fused_ok,
-            block_m=64 if wr_ok else (256 if fused_ok else 128),
+            block_m=bm,
             gg_block_n=1 << 30 if wr_ok else None,
             gg_block_k=1 << 30 if wr_ok else None,
             quant=c.moe_wire_quant if fused_ok else None,
+            act_quant=a8,
             batch_axes=tuple(self.dp_axes),
         )
 

@@ -91,6 +91,14 @@ class EPMoEContext:
     # "fused" and "pallas" transports; the XLA transport is the
     # differentiable path and stays full-precision.
     quant: str | None = None
+    # W8A8 expert GEMMs ("int8"): quantize the ACTIVATIONS per row too
+    # and run the MXU's native s8×s8→s32 path (2× the bf16 rate, the
+    # remaining lever once the weight-resident schedule has minimized
+    # HBM reads). Requires int8 weight dicts + the Pallas GEMM; sweet
+    # spot block_m=128 (the int8 rate needs ≥128-row blocks while the
+    # alignment-padding tax grows with block_m — measured 292 vs 356 µs
+    # per decode up-GEMM against W8A16 at bm=64, docs/PERF.md).
+    act_quant: str | None = None
 
     @property
     def n(self) -> int:
@@ -148,6 +156,8 @@ def create_ep_moe_context(
             "quantized transport rides the Pallas slot payload; the XLA "
             "transport is the differentiable full-precision path"
         )
+    if ctx.act_quant not in (None, "int8"):
+        raise ValueError(f"act_quant must be None or 'int8', got {ctx.act_quant!r}")
     if ctx.transport == "fused" and ctx.dcn_axis is not None:
         raise ValueError(
             "the fused window-DMA transport is flat (single-slice) only; "
@@ -365,9 +375,33 @@ def _expert_mlp(ctx: EPMoEContext, rows, eid, valid, w_up, w_down):
                 )
             return grouped_matmul(inp, w, be_w, block_m=ctx.block_m, **gg_kw)
 
-        h = gg(xs, w_up)
-        h = _act(ctx.activation, h).astype(ctx.dtype)
-        y = gg(h, w_down)
+        if (
+            ctx.act_quant == "int8"
+            and isinstance(w_up, dict) and isinstance(w_down, dict)
+            and w_up["q"].dtype == jnp.int8 and w_down["q"].dtype == jnp.int8
+        ):
+            # W8A8: per-row int8 activations into the s8×s8 MXU path
+            # (2× rate); the hidden activation re-quantizes after the
+            # nonlinearity (its own per-row scale — the only extra
+            # quantization step beyond what the int8 wire already did)
+            from triton_distributed_tpu.kernels.group_gemm import (
+                quantize_act_rows,
+            )
+
+            def gg8(q_in, s_in, w):
+                return grouped_matmul(
+                    q_in, w["q"], be_w, w_scale=w["scale"], x_scale=s_in,
+                    block_m=ctx.block_m, out_dtype=ctx.dtype, **gg_kw,
+                )
+
+            xq, xsc = quantize_act_rows(xs)
+            h = _act(ctx.activation, gg8(xq, xsc, w_up))
+            hq, hsc = quantize_act_rows(h)
+            y = gg8(hq, hsc, w_down)
+        else:
+            h = gg(xs, w_up)
+            h = _act(ctx.activation, h).astype(ctx.dtype)
+            y = gg(h, w_down)
     else:
         from triton_distributed_tpu.kernels.group_gemm import (
             dequantize_grouped_weights,

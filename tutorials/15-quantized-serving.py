@@ -11,6 +11,9 @@ int8 treatment with exact scale folds (docs/PERF.md, round 4):
 * Expert matrices (``moe_weight_quant="int8"``): per-(expert,
   out-channel) scales folded into the grouped-GEMM f32 epilogue
   (exact: dequantization is linear over the K reduction).
+* Expert ACTIVATIONS too (``moe_act_quant="int8"``, W8A8): per-row
+  int8 tokens into the MXU's native s8×s8 path at 2× the bf16 rate,
+  rank-1 scale correction on the s32 accumulator.
 * Dense projections (``dense_weight_quant="int8"``): the same
   epilogue-dequant kernel with E=1 and block_m=B (one M-block — the
   grid iterates m outermost, so more blocks would re-stream the
@@ -19,7 +22,7 @@ int8 treatment with exact scale folds (docs/PERF.md, round 4):
 The reference quantizes only the tokens moving through the MoE wire
 (fp8 WITH_SCALE, low_latency_all_to_all.py:82-90); the stationary
 planes are TPU-first extensions. Measured all together at the serving
-headline (B=128, hidden 7168, topk 8, v5e): 4.5 → 2.63 ms/step.
+headline (B=128, hidden 7168, topk 8, v5e): 4.5 → 2.48 ms/step.
 """
 
 from _common import get_mesh
@@ -32,11 +35,12 @@ import numpy as np
 
 from triton_distributed_tpu.models import Transformer, presets
 
-# the DeepSeek serving preset ships all three planes on; the tiny()
+# the DeepSeek serving preset ships all four planes on; the tiny()
 # twin keeps the same quantization topology at CI size
 cfg = presets.tiny(presets.deepseek_moe_16b())
 assert cfg.kv_quant == "int8"
 assert cfg.moe_weight_quant == "int8"
+assert cfg.moe_act_quant == "int8"
 assert cfg.dense_weight_quant == "int8"
 
 model = Transformer(cfg, mesh, "x", ())
@@ -68,7 +72,8 @@ print("int8-stack generation:", np.asarray(toks))
 # the full-precision model (same weights pre-quantization) agrees to
 # within int8 noise on the first decode logits
 cfg_f = presets.tiny(presets.deepseek_moe_16b(), kv_quant=None,
-                     moe_weight_quant=None, dense_weight_quant=None)
+                     moe_weight_quant=None, moe_act_quant=None,
+                     dense_weight_quant=None)
 model_f = Transformer(cfg_f, mesh, "x", ())
 params_f = jax.tree.map(
     lambda p, s: jax.device_put(p, s),
