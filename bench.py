@@ -556,8 +556,10 @@ def _bench_serving_moe_decode(mesh, n, on_tpu, spec):
             # HBM (production default, presets.deepseek_moe_16b)
             kv_quant="int8",
             # int8 dense projections (wqkv/wo/lm_head): same
-            # weight-HBM-bound argument as the expert matrices
+            # weight-HBM-bound argument as the expert matrices; W8A8
+            # on the projections (lm_head stays W8A16)
             dense_weight_quant="int8",
+            dense_act_quant="int8",
         )
     else:
         b, s_cap = 8, 256
@@ -683,13 +685,34 @@ def _bench_flash_decode(mesh, n, on_tpu, spec):
     t = bench_loop(step, (q, k, v), lo=lo, hi=hi)
     kv_bytes = 2 * b * s_len * hkv * d * 2
     gbps = kv_bytes / t / 1e9
+
+    # int8 KV twin at the same shape (half the cache bytes; scales fold
+    # in-softmax — kernels/flash_decode.py q8 mode)
+    from triton_distributed_tpu.kernels.flash_decode import (
+        gqa_fwd_batch_decode_q8,
+        quantize_kv,
+    )
+
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+
+    def step_q8(state, s):
+        q, kq, ks, vq, vs = state
+        out, _ = gqa_fwd_batch_decode_q8(
+            q, kq, ks, vq, vs, lens, block_k=4096 if on_tpu else 256
+        )
+        s = s + jnp.sum(out.astype(jnp.float32))
+        return (perturb(q, s), kq, ks, vq, vs), s
+
+    t_q8 = bench_loop(step_q8, (q, kq, ks, vq, vs), lo=lo, hi=hi)
     return {
         "metric": "flash_decode_step",
         "value": round(t * 1e6, 1),
         "unit": "us",
         "kv_gbps": round(gbps, 1),
         "hbm_pct": round(100 * gbps / spec.hbm_gbps, 1),
-        "config": f"B={b} Hq={hq} Hkv={hkv} D={d} S={s_len} bf16",
+        "int8_kv_us": round(t_q8 * 1e6, 1),
+        "config": f"B={b} Hq={hq} Hkv={hkv} D={d} S={s_len} bf16 (+int8-KV twin)",
     }
 
 

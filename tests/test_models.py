@@ -347,6 +347,17 @@ class TestDecode:
         assert lg2_q.dtype == lg2_f.dtype == jnp.float32
         err2 = np.abs(np.asarray(lg2_q) - np.asarray(lg2_f)).max()
         assert 0 < err2 < 0.05 * np.abs(np.asarray(lg2_f)).max()
+        # W8A8 dense projections (dense_act_quant): same caches, logits
+        # within combined-int8 tolerance; lm_head stays W8A16 (f32)
+        cfg8 = TransformerConfig(
+            **CFG, dense_weight_quant="int8", dense_act_quant="int8"
+        )
+        m8 = Transformer(cfg8, mesh_tp, "tp", ())
+        lg8, _, _ = m8.decode_step(qp, caches2, lens2, tok2)
+        assert lg8.dtype == jnp.float32
+        err8 = np.abs(np.asarray(lg8) - np.asarray(lg2_f)).max()
+        assert 0 < err8 < 0.06 * np.abs(np.asarray(lg2_f)).max()
+
         # B=6 (not an 8-multiple) exercises _dmm's widening fallback —
         # logits dtype and values must match the kernel path's contract
         b3 = 6

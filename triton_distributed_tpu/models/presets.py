@@ -79,6 +79,8 @@ def deepseek_moe_16b(**overrides) -> TransformerConfig:
         # GEMMs are weight-HBM-bound like the expert GEMMs — run params
         # through Transformer.quantize_dense_weights
         dense_weight_quant="int8",
+        # W8A8 dense projections (lm_head stays W8A16 for the logits)
+        dense_act_quant="int8",
     )
     cfg.update(overrides)
     return TransformerConfig(**cfg)
@@ -104,6 +106,7 @@ def tiny(preset=None, **overrides) -> TransformerConfig:
             moe_act_quant=preset.moe_act_quant,
             kv_quant=preset.kv_quant,
             dense_weight_quant=preset.dense_weight_quant,
+            dense_act_quant=preset.dense_act_quant,
         )
     cfg.update(overrides)
     return TransformerConfig(**cfg)

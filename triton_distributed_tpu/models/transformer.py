@@ -97,6 +97,12 @@ class TransformerConfig:
     # effect after :meth:`Transformer.quantize_dense_weights`;
     # prefill/training widen transparently. TPU-first extension.
     dense_weight_quant: str | None = None
+    # W8A8 dense projections ("int8" | None): also quantize the B
+    # activation rows per step so the dense decode matmuls ride the
+    # s8×s8 MXU path. Requires dense_weight_quant="int8". The lm_head
+    # stays W8A16 (logits want the f32 accumulator unperturbed by
+    # input quantization); applies to wqkv/wo/up/down.
+    dense_act_quant: str | None = None
     # INT8 KV cache ("int8" | None): decode caches store int8 values +
     # per-(b, head, position) f32 scales and the SP flash-decode kernel
     # folds the scales into the softmax — half the KV bytes at rest
@@ -151,6 +157,16 @@ class TransformerConfig:
             raise ValueError(
                 "moe_act_quant (W8A8) needs moe_weight_quant='int8' — the "
                 "s8×s8 MXU path consumes int8 weight dicts"
+            )
+        if self.dense_act_quant not in (None, "int8"):
+            raise ValueError(
+                "dense_act_quant must be None or 'int8', got "
+                f"{self.dense_act_quant!r}"
+            )
+        if (self.dense_act_quant is not None
+                and self.dense_weight_quant != "int8"):
+            raise ValueError(
+                "dense_act_quant (W8A8) needs dense_weight_quant='int8'"
             )
         if self.moe_weight_quant is not None and self.moe != "ep":
             raise ValueError(
@@ -440,12 +456,15 @@ class Transformer:
             )[0]
         return w.astype(self.config.dtype)
 
-    def _dmm(self, x, w, out_dtype=None):
+    def _dmm(self, x, w, out_dtype=None, act_quant=True):
         """Decode-time dense matmul dispatching on the weight storage:
         quantized dicts ride the grouped-GEMM kernel (E=1, tiled weight
         streaming with epilogue dequant — the decode GEMMs are
         weight-HBM-bound, so 1-byte weights halve the dominant read);
-        plain arrays take the ordinary XLA dot."""
+        plain arrays take the ordinary XLA dot. With
+        ``config.dense_act_quant`` (and ``act_quant=True``), the B
+        activation rows quantize per row and the kernel runs the
+        s8×s8 MXU path (W8A8)."""
         if not isinstance(w, dict):
             return x @ w.astype(out_dtype or self.config.dtype)
         from triton_distributed_tpu.config import fused_vmem_budget
@@ -458,15 +477,35 @@ class Transformer:
         if b % 8 != 0 or b > 1024:              # sublane-odd / huge M
             y = x @ self._dense_w(w)
             return y.astype(out_dtype) if out_dtype is not None else y
+        kw = dict(
+            w_scale=w["scale"][None], block_m=b,
+            vmem_limit_bytes=fused_vmem_budget(),
+            out_dtype=out_dtype,
+        )
+        if (
+            act_quant
+            and self.config.dense_act_quant == "int8"
+            and w["q"].dtype == jnp.int8
+        ):
+            from triton_distributed_tpu.kernels.group_gemm import (
+                quantize_act_rows,
+            )
+
+            xq, xsc = quantize_act_rows(x)
+            # pin the out dtype: W8A8 grouped_matmul would otherwise
+            # default to bf16 (x is int8), silently downcasting an
+            # f32 model's projection outputs
+            kw["out_dtype"] = out_dtype or self.config.dtype
+            return grouped_matmul(
+                xq, w["q"][None], jnp.zeros((1,), jnp.int32),
+                x_scale=xsc, **kw,
+            )
         xp = x.astype(self.config.dtype)
         # out_dtype reaches the kernel store: the f32 accumulator casts
         # straight to it (an astype after a bf16 store would re-widen
         # already-rounded values — logits want full f32)
         return grouped_matmul(
-            xp, w["q"][None], jnp.zeros((1,), jnp.int32),
-            w_scale=w["scale"][None], block_m=b,
-            vmem_limit_bytes=fused_vmem_budget(),
-            out_dtype=out_dtype,
+            xp, w["q"][None], jnp.zeros((1,), jnp.int32), **kw,
         )
 
     def _expert_w(self, w):
@@ -885,7 +924,11 @@ class Transformer:
                 x = x + y.astype(x.dtype)
         x = self._rmsnorm(x, params["norm_f"])
         if isinstance(params["lm_head"], dict):
-            logits = self._dmm(x, params["lm_head"], out_dtype=jnp.float32)
+            # W8A16 deliberately: logits take the f32 accumulator
+            # without input-quantization noise
+            logits = self._dmm(
+                x, params["lm_head"], out_dtype=jnp.float32, act_quant=False
+            )
         else:
             logits = x.astype(jnp.float32) @ params["lm_head"]
         if moe_state is None:
