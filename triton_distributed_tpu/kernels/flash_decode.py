@@ -1009,10 +1009,20 @@ def _merge_shard_partials(out, lse, axis):
     (low_latency_allgather_layer.py); XLA's all_gather over ICI is the
     TPU fast path for this message size.
     """
-    outs = jax.lax.all_gather(out, axis)                     # (R, B, Hq, D)
-    lses = jax.lax.all_gather(lse, axis)                     # (R, B, Hq)
-    merged, _ = combine_partials(outs, lses, out_dtype=out.dtype)
+    merged, _ = _merge_shard_partials_lse(out, lse, axis)
     return merged
+
+
+def _merge_shard_partials_lse(out, lse, axis):
+    """Like :func:`_merge_shard_partials` but returning (out, lse) —
+    callers can merge FURTHER partials (e.g. the current decode step's
+    just-produced token, models/transformer.decode_step: the softmax
+    merge is associative, so the new token rides as an exact
+    single-position partial with lse = its raw score, and the cache
+    append no longer feeds the attention kernel)."""
+    outs = jax.lax.all_gather(out, axis)
+    lses = jax.lax.all_gather(lse, axis)
+    return combine_partials(outs, lses, out_dtype=out.dtype)
 
 
 def sp_gqa_fwd_batch_decode_device(
@@ -1065,10 +1075,10 @@ def _sp_decode_fns(mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout):
     )
     merge_fn = jax.jit(
         jax.shard_map(
-            functools.partial(_merge_shard_partials, axis=axis),
+            functools.partial(_merge_shard_partials_lse, axis=axis),
             mesh=mesh,
             in_specs=(P(axis), P(axis)),
-            out_specs=P(),
+            out_specs=(P(), P()),
             check_vma=False,
         )
     )
@@ -1078,19 +1088,22 @@ def _sp_decode_fns(mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout):
 def sp_gqa_fwd_batch_decode(
     q, k_cache, v_cache, global_kv_lens, mesh, axis="x", *,
     scale=None, soft_cap=0.0, block_k=2048, use_pallas=True,
-    kv_layout="bhsd",
+    kv_layout="bhsd", with_lse=False,
 ):
     """Host entry: sequence-parallel GQA decode on ``mesh``.
 
     k_cache/v_cache: (B, Hkv, S, D) [bhsd, native default] or
     (B, S, Hkv, D) [bshd] with S sharded over ``axis``; q and
-    global_kv_lens replicated. Returns (B, Hq, D) replicated.
+    global_kv_lens replicated. Returns (B, Hq, D) replicated —
+    plus the merged (B, Hq) lse with ``with_lse`` (for callers
+    merging further partials via :func:`combine_partials`).
     """
     local_fn, merge_fn = _sp_decode_fns(
         mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout
     )
     out, lse = local_fn(q, k_cache, v_cache, global_kv_lens)
-    return merge_fn(out, lse)
+    out, lse = merge_fn(out, lse)
+    return (out, lse) if with_lse else out
 
 
 def _local_shard_decode_q8(
@@ -1149,10 +1162,10 @@ def _sp_q8_fns(mesh, axis, scale, soft_cap, block_k):
     )
     merge_fn = jax.jit(
         jax.shard_map(
-            functools.partial(_merge_shard_partials, axis=axis),
+            functools.partial(_merge_shard_partials_lse, axis=axis),
             mesh=mesh,
             in_specs=(P(axis), P(axis)),
-            out_specs=P(),
+            out_specs=(P(), P()),
             check_vma=False,
         )
     )
@@ -1161,18 +1174,20 @@ def _sp_q8_fns(mesh, axis, scale, soft_cap, block_k):
 
 def sp_gqa_fwd_batch_decode_q8(
     q, k_q, k_scale, v_q, v_scale, global_kv_lens, mesh, axis="x", *,
-    scale=None, soft_cap=0.0, block_k=None,
+    scale=None, soft_cap=0.0, block_k=None, with_lse=False,
 ):
     """Host entry: sequence-parallel GQA decode over an INT8 KV cache.
 
     k_q/v_q: (B, Hkv, S, D) int8, k_scale/v_scale: (B, Hkv, S) f32 —
     all with S sharded over ``axis``; q and global_kv_lens replicated.
-    Returns (B, Hq, D) replicated. Half the KV bytes of the bf16 entry
-    both at rest and on the attention DMA stream.
+    Returns (B, Hq, D) replicated (+ merged lse with ``with_lse``).
+    Half the KV bytes of the bf16 entry both at rest and on the
+    attention DMA stream.
     """
     local_fn, merge_fn = _sp_q8_fns(mesh, axis, scale, soft_cap, block_k)
     out, lse = local_fn(q, k_q, k_scale, v_q, v_scale, global_kv_lens)
-    return merge_fn(out, lse)
+    out, lse = merge_fn(out, lse)
+    return (out, lse) if with_lse else out
 
 
 def _local_paged_shard_decode_q8(

@@ -91,19 +91,58 @@ class SpGQAFlashDecodeAttention:
                 self.mesh, self.axis, scale=self.scale,
                 soft_cap=self.soft_cap, use_pallas=self.use_pallas,
             )
+        return self._nonpaged(q, k_cache, v_cache, global_kv_lens, False)
+
+    def _nonpaged(self, q, k_cache, v_cache, global_kv_lens, with_lse):
+        """The ONE non-paged dispatch (dict → int8, array → bf16)."""
         if isinstance(k_cache, dict):
             return sp_gqa_fwd_batch_decode_q8(
                 q, k_cache["q"], k_cache["scale"],
                 v_cache["q"], v_cache["scale"], global_kv_lens,
                 self.mesh, self.axis, scale=self.scale,
                 soft_cap=self.soft_cap, block_k=self.block_k,
+                with_lse=with_lse,
             )
         return sp_gqa_fwd_batch_decode(
             q, k_cache, v_cache, global_kv_lens, self.mesh, self.axis,
             scale=self.scale, soft_cap=self.soft_cap,
             block_k=self.block_k, use_pallas=self.use_pallas,
-            kv_layout=self.kv_layout,
+            kv_layout=self.kv_layout, with_lse=with_lse,
         )
+
+    def partials(self, q, k_cache, v_cache, global_kv_lens):
+        """Like ``__call__`` (non-paged modes) but returning the merged
+        ``(out, lse)`` pair — the softmax merge is associative, so the
+        caller can fold FURTHER partials (e.g. the decode step's
+        just-produced token as an exact single-position partial via
+        ``combine_partials``) without the cache append feeding the
+        attention kernel."""
+        return self._nonpaged(q, k_cache, v_cache, global_kv_lens, True)
+
+    def token_partial(self, q, k_new, v_new):
+        """The (out, lse) partial of ONE just-produced KV position, in
+        THIS layer's score convention (scale + soft_cap) so it can be
+        merged with :meth:`partials` results without domain drift: a
+        weight-1 softmax over a single position has out = v and
+        lse = its (soft-capped, scaled) raw score.
+
+        q: (B, Hq, D); k_new/v_new: (B, Hkv, D). Returns
+        ((B, Hq, D) f32, (B, Hq) f32)."""
+        b, hq, d = q.shape
+        hkv = k_new.shape[1]
+        g = hq // hkv
+        scale = self.scale if self.scale is not None else 1.0 / (d ** 0.5)
+        qg = q.reshape(b, hkv, g, d)
+        s = jnp.einsum(
+            "bhgd,bhd->bhg",
+            qg.astype(jnp.float32), k_new.astype(jnp.float32),
+        ) * scale
+        if self.soft_cap > 0.0:
+            s = self.soft_cap * jnp.tanh(s / self.soft_cap)
+        out = jnp.broadcast_to(
+            v_new[:, :, None].astype(jnp.float32), (b, hkv, g, d)
+        ).reshape(b, hq, d)
+        return out, s.reshape(b, hq)
 
     def device_body(self, q, k_shard, v_shard, global_kv_lens):
         """Per-device body for composition inside a model's shard_map."""
