@@ -1048,8 +1048,21 @@ def sp_gqa_fwd_batch_decode_device(
     return _merge_shard_partials(out, lse, axis)
 
 
+def _sp_specs(axis, batch_axes):
+    """(batch-dim spec, rank-stacked partial spec, merged out spec) for
+    the SP decode shard_maps. With ``batch_axes`` (e.g. a dp mesh axis)
+    the batch dim 0 of q/lens/caches is SHARDED over them — the
+    serving layout on a dp×tp mesh: batch over dp, sequence over tp.
+    The per-rank partials stack rank-major into dim 0, so the stacked
+    dim is sharded over (batch_axes..., axis)."""
+    ba = tuple(batch_axes)
+    b = ba if ba else None
+    return b, ba + (axis,), b
+
+
 @functools.lru_cache(maxsize=64)
-def _sp_decode_fns(mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout):
+def _sp_decode_fns(mesh, axis, scale, soft_cap, block_k, use_pallas,
+                   kv_layout, batch_axes=()):
     """Jitted (local, merge) pair for :func:`sp_gqa_fwd_batch_decode`,
     cached so repeated decode steps don't retrace/recompile."""
     # Two dispatches, not one: on the CPU-interpreter path, mixing the
@@ -1063,13 +1076,14 @@ def _sp_decode_fns(mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout):
             use_pallas=use_pallas, kv_layout=kv_layout,
         )
 
-    kv_spec = P(None, axis) if kv_layout == "bshd" else P(None, None, axis)
+    b, part, out = _sp_specs(axis, batch_axes)
+    kv_spec = P(b, axis) if kv_layout == "bshd" else P(b, None, axis)
     local_fn = jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(), kv_spec, kv_spec, P()),
-            out_specs=(P(axis), P(axis)),
+            in_specs=(P(b), kv_spec, kv_spec, P(b)),
+            out_specs=(P(part), P(part)),
             check_vma=False,
         )
     )
@@ -1077,8 +1091,8 @@ def _sp_decode_fns(mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout):
         jax.shard_map(
             functools.partial(_merge_shard_partials_lse, axis=axis),
             mesh=mesh,
-            in_specs=(P(axis), P(axis)),
-            out_specs=(P(), P()),
+            in_specs=(P(part), P(part)),
+            out_specs=(P(out), P(out)),
             check_vma=False,
         )
     )
@@ -1088,7 +1102,7 @@ def _sp_decode_fns(mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout):
 def sp_gqa_fwd_batch_decode(
     q, k_cache, v_cache, global_kv_lens, mesh, axis="x", *,
     scale=None, soft_cap=0.0, block_k=2048, use_pallas=True,
-    kv_layout="bhsd", with_lse=False,
+    kv_layout="bhsd", with_lse=False, batch_axes=(),
 ):
     """Host entry: sequence-parallel GQA decode on ``mesh``.
 
@@ -1097,9 +1111,13 @@ def sp_gqa_fwd_batch_decode(
     global_kv_lens replicated. Returns (B, Hq, D) replicated —
     plus the merged (B, Hq) lse with ``with_lse`` (for callers
     merging further partials via :func:`combine_partials`).
+    With ``batch_axes`` (dp mesh axes), the batch dim of every
+    operand and result is sharded over them instead — the serving
+    layout on a dp×tp mesh (batch over dp, sequence over ``axis``).
     """
     local_fn, merge_fn = _sp_decode_fns(
-        mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout
+        mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout,
+        tuple(batch_axes),
     )
     out, lse = local_fn(q, k_cache, v_cache, global_kv_lens)
     out, lse = merge_fn(out, lse)
@@ -1139,7 +1157,7 @@ def sp_gqa_fwd_batch_decode_q8_device(
 
 
 @functools.lru_cache(maxsize=64)
-def _sp_q8_fns(mesh, axis, scale, soft_cap, block_k):
+def _sp_q8_fns(mesh, axis, scale, soft_cap, block_k, batch_axes=()):
     """Jitted (local, merge) pair for the INT8 SP decode — split into
     two dispatches for the interpreter-deadlock reason documented at
     :func:`_sp_decode_fns`."""
@@ -1150,13 +1168,14 @@ def _sp_q8_fns(mesh, axis, scale, soft_cap, block_k):
             scale=scale, soft_cap=soft_cap, block_k=block_k,
         )
 
-    kv_spec = P(None, None, axis)              # (B, Hkv, S[, D]) seq-sharded
+    b, part, out = _sp_specs(axis, batch_axes)
+    kv_spec = P(b, None, axis)                 # (B, Hkv, S[, D]) seq-sharded
     local_fn = jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(), kv_spec, kv_spec, kv_spec, kv_spec, P()),
-            out_specs=(P(axis), P(axis)),
+            in_specs=(P(b), kv_spec, kv_spec, kv_spec, kv_spec, P(b)),
+            out_specs=(P(part), P(part)),
             check_vma=False,
         )
     )
@@ -1164,8 +1183,8 @@ def _sp_q8_fns(mesh, axis, scale, soft_cap, block_k):
         jax.shard_map(
             functools.partial(_merge_shard_partials_lse, axis=axis),
             mesh=mesh,
-            in_specs=(P(axis), P(axis)),
-            out_specs=(P(), P()),
+            in_specs=(P(part), P(part)),
+            out_specs=(P(out), P(out)),
             check_vma=False,
         )
     )
@@ -1174,17 +1193,20 @@ def _sp_q8_fns(mesh, axis, scale, soft_cap, block_k):
 
 def sp_gqa_fwd_batch_decode_q8(
     q, k_q, k_scale, v_q, v_scale, global_kv_lens, mesh, axis="x", *,
-    scale=None, soft_cap=0.0, block_k=None, with_lse=False,
+    scale=None, soft_cap=0.0, block_k=None, with_lse=False, batch_axes=(),
 ):
     """Host entry: sequence-parallel GQA decode over an INT8 KV cache.
 
     k_q/v_q: (B, Hkv, S, D) int8, k_scale/v_scale: (B, Hkv, S) f32 —
-    all with S sharded over ``axis``; q and global_kv_lens replicated.
-    Returns (B, Hq, D) replicated (+ merged lse with ``with_lse``).
-    Half the KV bytes of the bf16 entry both at rest and on the
-    attention DMA stream.
+    all with S sharded over ``axis``; q and global_kv_lens replicated
+    (batch dim sharded over ``batch_axes`` when given — the dp×tp
+    serving layout). Returns (B, Hq, D) replicated (+ merged lse with
+    ``with_lse``). Half the KV bytes of the bf16 entry both at rest
+    and on the attention DMA stream.
     """
-    local_fn, merge_fn = _sp_q8_fns(mesh, axis, scale, soft_cap, block_k)
+    local_fn, merge_fn = _sp_q8_fns(
+        mesh, axis, scale, soft_cap, block_k, tuple(batch_axes)
+    )
     out, lse = local_fn(q, k_q, k_scale, v_q, v_scale, global_kv_lens)
     out, lse = merge_fn(out, lse)
     return (out, lse) if with_lse else out

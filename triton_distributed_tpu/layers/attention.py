@@ -45,6 +45,10 @@ class SpGQAFlashDecodeAttention:
     q_heads: int = 32
     kv_heads: int = 8
     head_dim: int = 128
+    # dp mesh axes the BATCH dim is sharded over (the dp×tp serving
+    # layout: batch over dp, sequence over ``axis``); () = replicated.
+    # Non-paged modes only — the paged pool layout is rank-major.
+    batch_axes: tuple = ()
     scale: float | None = None
     soft_cap: float = 0.0
     # None → auto (kernel heuristic: shard_len/2 clamped to [1024, 4096])
@@ -101,13 +105,14 @@ class SpGQAFlashDecodeAttention:
                 v_cache["q"], v_cache["scale"], global_kv_lens,
                 self.mesh, self.axis, scale=self.scale,
                 soft_cap=self.soft_cap, block_k=self.block_k,
-                with_lse=with_lse,
+                with_lse=with_lse, batch_axes=self.batch_axes,
             )
         return sp_gqa_fwd_batch_decode(
             q, k_cache, v_cache, global_kv_lens, self.mesh, self.axis,
             scale=self.scale, soft_cap=self.soft_cap,
             block_k=self.block_k, use_pallas=self.use_pallas,
             kv_layout=self.kv_layout, with_lse=with_lse,
+            batch_axes=self.batch_axes,
         )
 
     def partials(self, q, k_cache, v_cache, global_kv_lens):

@@ -746,18 +746,51 @@ class Transformer:
         return SpGQAFlashDecodeAttention(
             self.mesh, self.tp_axis, q_heads=c.n_heads,
             kv_heads=c.n_kv_heads, head_dim=c.head_dim,
+            batch_axes=tuple(self.dp_axes),
+        )
+
+    @property
+    def cache_sharding(self):
+        """The ONE canonical KV-cache placement for the whole serving
+        session: batch over dp, sequence over tp (dims 0 and 2 of both
+        the (B, Hkv, S, D) planes and the (B, Hkv, S) int8 scales).
+        init_cache places with it, prefill and decode_step pin their
+        cache outputs to it, and the decode jits donate the caches —
+        so the cache is SHARD-RESIDENT and updated in place for the
+        life of the session (≡ sp_flash_decode_layer.py:45-184, whose
+        per-rank KV shard never changes placement), with no
+        involuntary remat/reshard across the prefill→decode boundary."""
+        ba = tuple(self.dp_axes)
+        return NamedSharding(
+            self.mesh, P(ba if ba else None, None, self.tp_axis)
+        )
+
+    @property
+    def batch_sharding(self):
+        """(B,)-vector placement matching :attr:`cache_sharding`'s
+        batch dim (kv_lens, last_tokens, per-row logits)."""
+        ba = tuple(self.dp_axes)
+        return NamedSharding(self.mesh, P(ba if ba else None))
+
+    def _pin_caches(self, caches):
+        """with_sharding_constraint every cache leaf to the canonical
+        :attr:`cache_sharding` (same spec covers the 4D planes and the
+        3D scale leaves — batch dim 0, sequence dim 2)."""
+        sh = self.cache_sharding
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, sh), caches
         )
 
     def init_cache(self, batch: int, max_len: int):
         """Per-layer (k, v) caches, (B, Hkv, S, D) ["bhsd", the fast
-        decode layout — contiguous KV block DMAs] sequence-sharded over
-        tp (≡ the KV sharding of sp_flash_decode_layer.py: each rank
-        holds its slice of the sequence). With ``config.kv_quant``,
-        each cache is a ``{"q": int8, "scale": (B, Hkv, S) f32}`` dict
-        (the quantized-leaf convention shared with the expert
-        weights)."""
+        decode layout — contiguous KV block DMAs] placed on
+        :attr:`cache_sharding` — batch over dp, sequence over tp (≡ the
+        KV sharding of sp_flash_decode_layer.py: each rank holds its
+        slice of the sequence). With ``config.kv_quant``, each cache is
+        a ``{"q": int8, "scale": (B, Hkv, S) f32}`` dict (the
+        quantized-leaf convention shared with the expert weights)."""
         c = self.config
-        spec = NamedSharding(self.mesh, P(None, None, self.tp_axis))
+        spec = self.cache_sharding
         if c.kv_quant is not None:
             zq = jax.device_put(
                 jnp.zeros(
@@ -768,13 +801,19 @@ class Transformer:
             zs = jax.device_put(
                 jnp.ones((batch, c.n_kv_heads, max_len), jnp.float32), spec
             )
-            return [
-                ({"q": zq, "scale": zs}, {"q": zq, "scale": zs})
-                for _ in range(c.n_layers)
-            ]
+
+            # EVERY leaf gets its own buffer (`+ 0` after placement):
+            # the decode jits DONATE the caches, and donating one
+            # physical buffer through two pytree leaves is a runtime
+            # error ("attempt to donate the same buffer twice")
+            def fresh():
+                return {"q": zq + jnp.int8(0), "scale": zs + 0.0}
+
+            return [(fresh(), fresh()) for _ in range(c.n_layers)]
         z = jnp.zeros((batch, c.n_kv_heads, max_len, c.head_dim), c.dtype)
+        zz = jax.device_put(z, spec)
         return [
-            (jax.device_put(z, spec), jax.device_put(z, spec))
+            (zz + jnp.zeros((), c.dtype), zz + jnp.zeros((), c.dtype))
             for _ in range(c.n_layers)
         ]
 
@@ -832,11 +871,25 @@ class Transformer:
         # values
         lens = jnp.clip(lens.astype(jnp.int32), 1, s)
         last = logits.reshape(b, s, -1)[jnp.arange(b), lens - 1]
+        # pin the serving state to the canonical placements so the
+        # prefill outputs are bit-identical in placement to decode's
+        # inputs — without this the dp×tp compile chooses freely and
+        # XLA full-rematerializes the caches at the phase boundary
+        # (last is pinned too: argmax over it produces the first decode
+        # step's last_tokens already batch-over-dp)
+        new_caches = self._pin_caches(new_caches)
+        lens = jax.lax.with_sharding_constraint(lens, self.batch_sharding)
+        last = jax.lax.with_sharding_constraint(last, self.batch_sharding)
         return last, new_caches, lens
 
     @functools.cached_property
     def _prefill_jit(self):
-        return jax.jit(self.prefill)  # lens=None and lens=(B,) trace separately
+        # donate the (zero-filled) input caches: prefill writes into
+        # them and the output placement equals the input placement
+        # (cache_sharding), so XLA aliases instead of allocating a
+        # second cache-sized buffer set
+        return jax.jit(self.prefill, donate_argnums=(1,))
+        # lens=None and lens=(B,) trace separately
 
     def init_decode_state(self, batch: int, abstract: bool = False):
         """Per-layer persistent workspaces for the BARRIER-FREE fused
@@ -882,6 +935,11 @@ class Transformer:
         from triton_distributed_tpu.layers import append_kv
 
         x = params["embed"][last_tokens].astype(c.dtype)        # (B, H)
+        # batch rows over dp end to end: the decode step is
+        # data-parallel over dp (each dp group serves its rows against
+        # its resident cache shards) — pinning x here keeps GSPMD from
+        # electing a layout that replicates the caches
+        x = jax.lax.with_sharding_constraint(x, self.batch_sharding)
         b = x.shape[0]
         new_caches = []
         new_states = None if moe_state is None else list(moe_state)
@@ -953,9 +1011,17 @@ class Transformer:
             )
         else:
             logits = x.astype(jnp.float32) @ params["lm_head"]
+        # outputs pinned to the SAME placements as the inputs
+        # (cache_sharding / batch over dp): with the decode jits'
+        # donation this makes every step's cache update alias in place
+        # — no cache-sized copy, no cross-step reshard
+        new_caches = self._pin_caches(new_caches)
+        new_lens = jax.lax.with_sharding_constraint(
+            kv_lens + 1, self.batch_sharding
+        )
         if moe_state is None:
-            return logits, new_caches, kv_lens + 1
-        return logits, new_caches, kv_lens + 1, new_states
+            return logits, new_caches, new_lens
+        return logits, new_caches, new_lens, new_states
 
     def _decode_moe_ep(self, blk, xn, state=None):
         """Decode-step EP MoE: the B last-token activations ride the EP
@@ -990,9 +1056,35 @@ class Transformer:
             y = ops.ep_moe(xp, logits, w_up, w_down, ctx)
         return y[:b], state
 
+    def decode_abstract_args(self, params, caches, kv_lens, last_tokens):
+        """``ShapeDtypeStruct`` twins of one decode step's arguments
+        with the CANONICAL serving placements attached (caches on
+        :attr:`cache_sharding`, lens/tokens on :attr:`batch_sharding`;
+        params keep their live placements). Lower the decode jits from
+        THESE when compile-checking the serving data flow (dryrun /
+        shardguard tests): a program lowered from the live arrays
+        reports those arrays' own shardings back, so a phase-boundary
+        check against it could never fail."""
+
+        def abs_(x, s):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+
+        return (
+            jax.tree.map(lambda x: abs_(x, x.sharding), params),
+            jax.tree.map(lambda x: abs_(x, self.cache_sharding), caches),
+            abs_(kv_lens, self.batch_sharding),
+            abs_(last_tokens, self.batch_sharding),
+        )
+
     @functools.cached_property
     def _decode_jit(self):
-        return jax.jit(self.decode_step)
+        # donate caches + kv_lens: with the in/out placements pinned
+        # (cache_sharding), XLA aliases the cache params to the cache
+        # results and append_kv updates IN PLACE — the production entry
+        # no longer pays a cache-sized copy per token (≡ the reference
+        # kernels mutating the persistent cache tensors,
+        # flash_decode.py:763-846)
+        return jax.jit(self.decode_step, donate_argnums=(1, 2))
 
     @functools.cached_property
     def _decode_jit_state(self):
@@ -1000,10 +1092,11 @@ class Transformer:
             return self.decode_step(params, caches, kv_lens, last_tokens,
                                     moe_state)
 
-        # donate the LL workspaces: the barrier-free protocol requires
-        # the SAME physical buffers across steps (skewed peers' in-
-        # flight DMAs target the persistent addresses)
-        return jax.jit(step, donate_argnums=(4,))
+        # donate the caches/lens (in-place update, see _decode_jit) AND
+        # the LL workspaces: the barrier-free protocol requires the
+        # SAME physical buffers across steps (skewed peers' in-flight
+        # DMAs target the persistent addresses)
+        return jax.jit(step, donate_argnums=(1, 2, 4))
 
     def generate(self, params, caches, kv_lens, last_tokens, steps: int,
                  moe_state=None):

@@ -17,6 +17,12 @@ from triton_distributed_tpu.runtime.multislice import (
     is_dcn_axis,
     num_slices,
 )
+from triton_distributed_tpu.runtime.shardguard import (
+    assert_args_aliased,
+    assert_no_involuntary_resharding,
+    find_involuntary_resharding,
+    input_output_aliased_params,
+)
 from triton_distributed_tpu.runtime.topology import (
     AllGatherMethod,
     LinkKind,
@@ -44,4 +50,8 @@ __all__ = [
     "create_hybrid_mesh",
     "is_dcn_axis",
     "num_slices",
+    "assert_no_involuntary_resharding",
+    "assert_args_aliased",
+    "find_involuntary_resharding",
+    "input_output_aliased_params",
 ]

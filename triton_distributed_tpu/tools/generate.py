@@ -89,6 +89,7 @@ def main(argv=None) -> None:
     # measure execution, not trace+compile
     warm = model._prefill_jit(params, model.init_cache(args.batch, cap), prompt)
     jax.block_until_ready(warm[0])
+    del warm  # cache-sized pytree — free it before the timed phases
 
     caches = model.init_cache(args.batch, cap)
     t0 = time.perf_counter()
@@ -99,15 +100,20 @@ def main(argv=None) -> None:
     first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
     # LL workspaces for EP-MoE decode (None for dense presets / off-TPU)
     moe_state = model.init_decode_state(args.batch)
-    # one warm step to exclude decode compile from the timing
+    # one warm step to exclude decode compile from the timing — on
+    # THROWAWAY cache/lens buffers: the decode jits donate their cache
+    # and lens arguments (in-place update), so warming on the live ones
+    # would delete the buffers the timed run needs
+    warm_c = model.init_cache(args.batch, cap)
     if moe_state is None:
-        _, caches_w, lens_w = model._decode_jit(params, caches, lens, first)
+        _, caches_w, lens_w = model._decode_jit(params, warm_c, lens + 0, first)
     else:
         # the state is donated per step — keep threading the returned one
         _, caches_w, lens_w, moe_state = model._decode_jit_state(
-            params, caches, lens, first, moe_state
+            params, warm_c, lens + 0, first, moe_state
         )
     jax.block_until_ready(lens_w)
+    del warm_c, caches_w
 
     t0 = time.perf_counter()
     res = model.generate(
