@@ -436,6 +436,55 @@ class TestDecode:
                 toks_np[i, : first_bad[i]], ref_np[i, : first_bad[i]]
             )
 
+    def test_generate_scan_matches_generate(self, mesh_tp):
+        """The on-device multi-step decode (ONE jitted lax.scan over
+        steps) must produce the same tokens and lens as the per-step
+        python-loop entry."""
+        model = _model(mesh_tp, moe="ep")
+        params = _sharded_params(model)
+        b, smax, steps = 2, 32, 3
+        first = jnp.array([5, 9], jnp.int32)
+        toks_a, _, lens_a = model.generate(
+            params, model.init_cache(b, smax),
+            jnp.zeros((b,), jnp.int32), first, steps,
+        )
+        toks_b, _, lens_b = model.generate_scan(
+            params, model.init_cache(b, smax),
+            jnp.zeros((b,), jnp.int32), first, steps,
+        )
+        np.testing.assert_array_equal(np.asarray(toks_a), np.asarray(toks_b))
+        assert np.asarray(lens_b).tolist() == [steps] * b
+
+    def test_generate_scan_threads_ll_state(self, mesh_tp, monkeypatch):
+        """generate_scan carries the barrier-free LL MoE state through
+        the scan (the functional EPMoEState carry exists precisely for
+        this) and matches the stateless scan's tokens; the state's
+        parity must have rolled `steps` times."""
+        model = _model(mesh_tp, moe="ep")
+        monkeypatch.setattr(Transformer, "_moe_ep_ctx", _force_fused_ctx())
+        params = _sharded_params(model)
+        b, smax, steps = 8, 32, 2
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (b, 8), 0, 128)
+        caches = model.init_cache(b, smax)
+        last, caches, lens = model.prefill(params, caches, prompt)
+        first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+        state = model.init_decode_state(b)
+        assert state is not None and state[1] is not None
+        toks_ll, _, lens_ll, state = model.generate_scan(
+            params, caches, lens, first, steps, moe_state=state
+        )
+        assert int(np.asarray(state[1].parity)[0]) == steps % 2
+
+        caches_b = model.init_cache(b, smax)
+        _, caches_b, lens_b = model.prefill(params, caches_b, prompt)
+        toks_ref, _, _ = model.generate_scan(
+            params, caches_b, lens_b, first, steps
+        )
+        np.testing.assert_array_equal(
+            np.asarray(toks_ll), np.asarray(toks_ref)
+        )
+
     @staticmethod
     def _dense_decode(c, params, last, b, smax, steps):
         params = jax.tree.map(jnp.asarray, jax.tree.map(np.asarray, params))

@@ -463,6 +463,10 @@ def gqa_fwd_batch_decode(
             collective_id=None,
             interpret=local_interpret() if interpret is None else interpret,
             name="gqa_decode_split_kv_dyn",
+            # the slot-rotation carry (SMEM) and cross-step DMA prefetch
+            # are only correct under SEQUENTIAL grid execution — pin it
+            # so a parallel/Megacore default can't corrupt the pipeline
+            dimension_semantics=("arbitrary", "arbitrary"),
         )
         out, lse = call(kv_lens.astype(jnp.int32), qg, k_cache, v_cache)
         return out.reshape(batch, hq, d), lse.reshape(batch, hq)

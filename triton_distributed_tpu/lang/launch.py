@@ -33,15 +33,24 @@ def shmem_call(
     interpret=None,
     input_output_aliases=None,
     name=None,
+    dimension_semantics=None,
 ):
     """``pl.pallas_call`` preconfigured for SHMEM-style distributed kernels:
-    side-effecting, collective, interpreted off-TPU."""
+    side-effecting, collective, interpreted off-TPU.
+
+    ``dimension_semantics``: per-grid-dim tuple of "parallel"/"arbitrary".
+    Kernels whose correctness depends on SEQUENTIAL grid execution (e.g.
+    cross-step scratch carries, DMA slot rotation) must pin every dim
+    "arbitrary" — a future parallel/Megacore default would silently
+    corrupt them.
+    """
     # collective_id=None → a purely local kernel (no barrier semaphore);
     # Mosaic requires it unset in that case.
     compiler_params = pltpu.CompilerParams(
         has_side_effects=True,
         collective_id=collective_id,
         vmem_limit_bytes=vmem_limit_bytes,
+        dimension_semantics=dimension_semantics,
     )
     kwargs = {}
     if grid_spec is not None:

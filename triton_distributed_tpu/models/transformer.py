@@ -474,11 +474,19 @@ class Transformer:
         # ONE M-block (block_m = B): the grid iterates (m, n, k) with m
         # outermost, so a second M-block would re-stream every weight
         # tile — doubling the int8 reads back to bf16 volume (measured)
-        if b % 8 != 0 or b > 1024:              # sublane-odd / huge M
+        if b > 1024:                             # huge M: decode never is
             y = x @ self._dense_w(w)
             return y.astype(out_dtype) if out_dtype is not None else y
+        # sublane-odd B: pad rows up to the next multiple of 8 and slice
+        # the result — the kernel path (f32 accumulator straight to the
+        # store) then serves EVERY decode batch size; the old fallback
+        # re-dequantized the full weight matrix in HBM per step and
+        # rounded logits through bf16
+        bp = -(-b // 8) * 8
+        if bp != b:
+            x = jnp.pad(x, ((0, bp - b), (0, 0)))
         kw = dict(
-            w_scale=w["scale"][None], block_m=b,
+            w_scale=w["scale"][None], block_m=bp,
             vmem_limit_bytes=fused_vmem_budget(),
             out_dtype=out_dtype,
         )
@@ -496,17 +504,19 @@ class Transformer:
             # default to bf16 (x is int8), silently downcasting an
             # f32 model's projection outputs
             kw["out_dtype"] = out_dtype or self.config.dtype
-            return grouped_matmul(
+            y = grouped_matmul(
                 xq, w["q"][None], jnp.zeros((1,), jnp.int32),
                 x_scale=xsc, **kw,
             )
+            return y[:b] if bp != b else y
         xp = x.astype(self.config.dtype)
         # out_dtype reaches the kernel store: the f32 accumulator casts
         # straight to it (an astype after a bf16 store would re-widen
         # already-rounded values — logits want full f32)
-        return grouped_matmul(
+        y = grouped_matmul(
             xp, w["q"][None], jnp.zeros((1,), jnp.int32), **kw,
         )
+        return y[:b] if bp != b else y
 
     def _expert_w(self, w):
         """Expert weights for a dense consumer: widen a quantized dict,
@@ -963,6 +973,21 @@ class Transformer:
             # with a cache-sized copy pass; measured ~170 µs/step at
             # the serving shape). The append below only feeds the NEXT
             # step and schedules independently.
+            if isinstance(ck, dict):
+                # int8 cache: every LATER step reads this token's
+                # quantized form — attend it quantized NOW too, so the
+                # step's logits are bit-consistent with re-running
+                # attention over the appended quantized cache. The
+                # append below re-quantizes to the SAME ints (the row
+                # max maps to exactly ±127, so the scale is preserved).
+                from triton_distributed_tpu.kernels.flash_decode import (
+                    quantize_kv,
+                )
+
+                kq8, ks8 = quantize_kv(k)
+                vq8, vs8 = quantize_kv(v)
+                k = (kq8.astype(jnp.float32) * ks8[..., None]).astype(k.dtype)
+                v = (vq8.astype(jnp.float32) * vs8[..., None]).astype(v.dtype)
             o_c, lse_c = self._sp_attn.partials(q, ck, cv, kv_lens)
             # the token partial comes from the SAME layer so its score
             # convention (scale, soft_cap) cannot drift from the
@@ -1127,6 +1152,61 @@ class Transformer:
             last_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             out.append(last_tokens)
         toks = jnp.stack(out, axis=1)
+        if moe_state is None:
+            return toks, caches, kv_lens
+        return toks, caches, kv_lens, moe_state
+
+    @functools.cached_property
+    def _generate_scan_jit(self):
+        @functools.partial(
+            jax.jit, static_argnums=(4,), donate_argnums=(1, 2, 5)
+        )
+        def run(params, caches, kv_lens, last_tokens, steps, moe_state):
+            def body(carry, _):
+                caches, lens, toks, state = carry
+                if state is None:
+                    logits, caches, lens = self.decode_step(
+                        params, caches, lens, toks
+                    )
+                else:
+                    logits, caches, lens, state = self.decode_step(
+                        params, caches, lens, toks, state
+                    )
+                toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (caches, lens, toks, state), toks
+
+            (caches, lens, toks, state), out = jax.lax.scan(
+                body, (caches, kv_lens, last_tokens, moe_state),
+                None, length=steps,
+            )
+            return out.swapaxes(0, 1), caches, lens, state
+
+        return run
+
+    def generate_scan(self, params, caches, kv_lens, last_tokens,
+                      steps: int, moe_state=None):
+        """Greedy-decode ``steps`` tokens ON DEVICE: one jitted program
+        whose ``lax.scan`` carries the caches, lens, tokens and the LL
+        MoE state across steps — no host round-trip per token. Same
+        results as :meth:`generate` (the per-step twin kept for
+        step-at-a-time callers and CI); behind a dispatch relay this is
+        the serving entry (one dispatch per SEQUENCE instead of ~90 ms
+        × steps). The functional ``EPMoEState`` carry exists precisely
+        so the barrier-free fused transport can ride a scan; caches,
+        lens and state are donated (in place across calls, like the
+        per-step jits)."""
+        cap = _cache_capacity(caches)
+        try:
+            max_len = int(np.asarray(kv_lens).max()) + steps
+            assert max_len <= cap, (
+                f"cache capacity {cap} < {max_len} needed — writes past "
+                f"capacity are silently dropped (see layers.append_kv)"
+            )
+        except jax.errors.TracerArrayConversionError:
+            pass  # traced lens: caller owns the capacity contract
+        toks, caches, kv_lens, moe_state = self._generate_scan_jit(
+            params, caches, kv_lens, last_tokens, steps, moe_state
+        )
         if moe_state is None:
             return toks, caches, kv_lens
         return toks, caches, kv_lens, moe_state

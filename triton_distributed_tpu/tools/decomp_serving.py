@@ -1,0 +1,238 @@
+"""Decompose the serving decode step's attention+projections side.
+
+VERDICT r4: the MoE block got a decomposition-driven 2.8× (2.60 → 0.92
+ms, docs/PERF.md); the attention+rest side (1.58 ms of the 2.50 ms
+step) had not. This tool times each component of the non-MoE side of
+``Transformer.decode_step`` at the serving headline config with the
+bench.py fori-loop methodology, printing one JSON line per component —
+the measured table lives in docs/PERF.md and drives which pieces get
+attacked.
+
+Run on the chip::
+
+    python -m triton_distributed_tpu.tools.decomp_serving
+
+Components (the decode_step data path, models/transformer.py):
+embed gather → rmsnorm → wqkv (W8A8) → flash-decode q8 partials →
+token partial + combine → append_kv (int8 scatter) → wo (W8A8) →
+rmsnorm → [MoE block, timed elsewhere] → final rmsnorm → lm_head
+(W8A16) → argmax.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+
+def main() -> None:
+    sys.path.insert(0, ".")
+    from bench import bench_loop, perturb
+
+    from triton_distributed_tpu.kernels.flash_decode import (
+        combine_partials,
+        quantize_kv,
+    )
+    from triton_distributed_tpu.layers import append_kv
+    from triton_distributed_tpu.models import Transformer, TransformerConfig
+
+    devs = jax.devices()
+    mesh = Mesh(np.asarray(devs), ("x",))
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        b, s_cap = 128, 2048
+        cfg = TransformerConfig(
+            vocab=4096, n_layers=1, hidden=7168, ffn=2048, n_heads=56,
+            n_kv_heads=8, head_dim=128, moe="ep", moe_layers=(0,),
+            num_experts=8, topk=8, param_dtype=jnp.bfloat16,
+            moe_weight_quant="int8", moe_act_quant="int8",
+            kv_quant="int8", dense_weight_quant="int8",
+            dense_act_quant="int8",
+        )
+        lo, hi = 16, 128
+    else:
+        b, s_cap = 8, 256
+        cfg = TransformerConfig(
+            vocab=512, n_layers=1, hidden=256, ffn=128, n_heads=8,
+            n_kv_heads=4, head_dim=32, moe="ep", moe_layers=(0,),
+            num_experts=8, topk=2, param_dtype=jnp.bfloat16,
+        )
+        lo, hi = 1, 3
+    model = Transformer(cfg, mesh, tp_axis="x")
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, s),
+        model.init(jax.random.PRNGKey(7)), model.shardings(),
+    )
+    params = model.quantize_moe_weights(params)
+    params = model.quantize_dense_weights(params)
+    blk = params["blocks"][0]
+    c = cfg
+
+    lens = jnp.asarray(
+        np.random.default_rng(11).integers(s_cap // 8, 3 * s_cap // 4, (b,)),
+        jnp.int32,
+    )
+    caches = model.init_cache(b, s_cap)
+    ck, cv = caches[0]
+    key = jax.random.PRNGKey(8)
+    x0 = jax.random.normal(key, (b, c.hidden), c.dtype)
+    q0 = jax.random.normal(key, (b, c.n_heads, c.head_dim), c.dtype)
+    k0 = jax.random.normal(key, (b, c.n_kv_heads, c.head_dim), c.dtype)
+    logits0 = jax.random.normal(key, (b, c.vocab), jnp.float32)
+
+    def report(name, t_us, note=""):
+        print(
+            json.dumps({"component": name, "us": round(t_us, 1), "note": note}),
+            flush=True,
+        )
+
+    def run(name, step, state, note=""):
+        try:
+            t = bench_loop(step, state, lo=lo, hi=hi)
+            report(name, t * 1e6, note)
+            return t
+        except Exception as e:  # keep the table coming
+            print(
+                json.dumps({"component": name,
+                            "error": f"{type(e).__name__}: {e}"[:200]}),
+                flush=True,
+            )
+            return float("nan")
+
+    # ---- full step (the headline) + MoE block, for the residual
+    moe_state = model.init_decode_state(b)
+    toks0 = jnp.zeros((b,), jnp.int32)
+
+    def full_step(state, s):
+        prm, caches, lens_, toks, mst = state
+        if mst is None:
+            logits, caches, lens_ = model.decode_step(prm, caches, lens_, toks)
+        else:
+            logits, caches, lens_, mst = model.decode_step(
+                prm, caches, lens_, toks, mst
+            )
+        toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        s = s + jnp.sum(toks.astype(jnp.float32))
+        return (prm, caches, lens_, toks, mst), s
+
+    t_full = run(
+        "full_step", full_step,
+        (params, model.init_cache(b, s_cap), lens, toks0, moe_state),
+    )
+
+    from triton_distributed_tpu.ops import create_ep_moe_state, ep_moe
+
+    ctx = model._moe_ep_ctx(-(-b // model.token_shards), inference=True)
+    mst2 = create_ep_moe_state(ctx) if ctx.transport == "fused" else None
+    w_up, w_down = (
+        w if isinstance(w, dict) else w.astype(c.dtype)
+        for w in (blk["moe_up"], blk["moe_down"])
+    )
+
+    def moe_step(state, s):
+        x, mst = state
+        logits_r = x.astype(jnp.float32) @ blk["router"]
+        if mst is None:
+            y = ep_moe(x, logits_r, w_up, w_down, ctx)
+        else:
+            y, mst = ep_moe(x, logits_r, w_up, w_down, ctx, state=mst)
+        s = s + jnp.sum(y.astype(jnp.float32))
+        return (perturb(x, s), mst), s
+
+    t_moe = run("moe_block", moe_step, (x0, mst2))
+    if np.isfinite(t_full) and np.isfinite(t_moe):
+        report("attn_rest(residual)", (t_full - t_moe) * 1e6)
+
+    # ---- the attention kernel (SP q8 partials at the mixed lens)
+    def attn_step(state, s):
+        q, = state
+        o, lse = model._sp_attn.partials(q, ck, cv, lens)
+        s = s + jnp.sum(o.astype(jnp.float32))
+        return (perturb(q, s),), s
+
+    run("flash_decode_q8", attn_step, (q0,),
+        note=f"mixed lens U[{s_cap//8},{3*s_cap//4}]")
+
+    # ---- token partial + combine
+    def tok_step(state, s):
+        q, k = state
+        o_c = jnp.zeros((b, c.n_heads, c.head_dim), jnp.float32)
+        lse_c = jnp.zeros((b, c.n_heads), jnp.float32)
+        o_new, lse_new = model._sp_attn.token_partial(q, k, k)
+        o, _ = combine_partials(
+            jnp.stack([o_c, o_new]), jnp.stack([lse_c, lse_new]),
+            out_dtype=jnp.float32,
+        )
+        s = s + jnp.sum(o)
+        return (perturb(q, s), k), s
+
+    run("token_partial+combine", tok_step, (q0, k0))
+
+    # ---- append_kv (int8 quantize + scatter at one position per row)
+    def append_step(state, s):
+        ck_, cv_, lens_, k = state
+        ck_, cv_, lens_ = append_kv(ck_, cv_, lens_ % (s_cap - 1), k, k)
+        s = s + jnp.sum(lens_.astype(jnp.float32))
+        return (ck_, cv_, lens_, perturb(k, s)), s
+
+    run("append_kv", append_step, (ck, cv, lens, k0))
+
+    # ---- dense projections (storage-dispatching _dmm)
+    def proj(name, w, m_in, note=""):
+        x = jax.random.normal(key, (b, m_in), c.dtype)
+
+        def step(state, s):
+            x, = state
+            y = model._dmm(x, w)
+            s = s + jnp.sum(y.astype(jnp.float32))
+            return (perturb(x, s),), s
+
+        run(name, step, (x,), note)
+
+    proj("wqkv", blk["wqkv"], c.hidden, "W8A8" if c.dense_act_quant else "")
+    proj("wo", blk["wo"], c.q_dim, "W8A8" if c.dense_act_quant else "")
+
+    def head_step(state, s):
+        x, = state
+        y = model._dmm(x, params["lm_head"], out_dtype=jnp.float32,
+                       act_quant=False) if isinstance(params["lm_head"], dict) \
+            else x.astype(jnp.float32) @ params["lm_head"]
+        s = s + jnp.sum(y)
+        return (perturb(x, s),), s
+
+    run("lm_head", head_step, (x0,), "W8A16")
+
+    # ---- glue: rmsnorms, argmax, embed gather, router
+    def norm_step(state, s):
+        x, = state
+        y = model._rmsnorm(x, blk["norm_attn"])
+        s = s + jnp.sum(y.astype(jnp.float32))
+        return (perturb(x, s),), s
+
+    run("rmsnorm(x1)", norm_step, (x0,))
+
+    def argmax_step(state, s):
+        lg, = state
+        t = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        s = s + jnp.sum(t.astype(jnp.float32))
+        return (perturb(lg, s),), s
+
+    run("argmax", argmax_step, (logits0,))
+
+    def embed_step(state, s):
+        t, = state
+        x = params["embed"][t].astype(c.dtype)
+        s = s + jnp.sum(x.astype(jnp.float32))
+        t = (t + 1) % c.vocab
+        return (t,), s
+
+    run("embed_gather", embed_step, (toks0,))
+
+
+if __name__ == "__main__":
+    main()
