@@ -168,18 +168,23 @@ def _decode_kernel_dyn(
     1.5 ms for the whole walk at B=128, Hkv=8, S=2048).
 
     ``quant``: int8 KV mode — k_hbm/v_hbm are int8 with per-(b, h, s)
-    f32 scale planes riding their own DMA stream. The scales fold
-    EXACTLY into the softmax (per-column into s before soft-capping,
-    per-column into p before the PV dot), so the only extra VPU work
-    is two int8→bf16 widens and two (G, block_k)-sized multiplies —
-    the D-sized dequant multiply never happens. Halves the KV bytes in
-    HBM and on the DMA stream (2× the context per chip).
+    f32 scale planes. The scales fold EXACTLY into the softmax
+    (per-column into s before soft-capping, per-column into p before
+    the PV dot), so the only extra VPU work is two int8→bf16 widens
+    and two (G, block_k)-sized multiplies — the D-sized dequant
+    multiply never happens. Halves the KV bytes in HBM and on the DMA
+    stream (2× the context per chip). The scale planes arrive as
+    PIPELINED (1, 1, 1, S) VMEM blocks — Mosaic's grid pipeline
+    prefetches each (b, h) row's whole scale vector (8 KB at S=2048)
+    — NOT as per-block manual DMAs: at serving batch sizes the walk is
+    DMA-COUNT bound (thousands of 0.1-µs-class issues), and the two
+    4 KB scale copies per block doubled the count for 3% of the bytes
+    (measured: see docs/PERF.md round-5 serving attention section).
     """
     if quant:
-        (kv_lens_ref, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
+        (kv_lens_ref, q_ref, k_hbm, v_hbm, ks_ref, vs_ref,
          out_ref, lse_ref,
-         kbuf, vbuf, sem_k, sem_v, slot_ref, m_ref, l_ref, acc_ref,
-         ksbuf, vsbuf, sem_ks, sem_vs) = refs
+         kbuf, vbuf, sem_k, sem_v, slot_ref, m_ref, l_ref, acc_ref) = refs
     else:
         (kv_lens_ref, q_ref, k_hbm, v_hbm, out_ref, lse_ref,
          kbuf, vbuf, sem_k, sem_v, slot_ref, m_ref, l_ref, acc_ref) = refs
@@ -198,7 +203,7 @@ def _decode_kernel_dyn(
 
     def dma(bb, hh, j, slot):
         win = pl.ds(j * block_k, block_k)
-        cps = [
+        return [
             pltpu.make_async_copy(
                 k_hbm.at[bb, hh, win], kbuf.at[slot], sem_k.at[slot]
             ),
@@ -206,20 +211,6 @@ def _decode_kernel_dyn(
                 v_hbm.at[bb, hh, win], vbuf.at[slot], sem_v.at[slot]
             ),
         ]
-        if quant:
-            # scale planes ride as (B, Hkv, 1, S): the tiled trailing
-            # pair is (1, S), so the window slice is a full-sublane,
-            # lane-aligned (1, block_k) run — a (B, Hkv, S) layout
-            # would put Hkv on sublanes and single-h slices misalign
-            cps += [
-                pltpu.make_async_copy(
-                    ks_hbm.at[bb, hh, :, win], ksbuf.at[slot], sem_ks.at[slot]
-                ),
-                pltpu.make_async_copy(
-                    vs_hbm.at[bb, hh, :, win], vsbuf.at[slot], sem_vs.at[slot]
-                ),
-            ]
-        return cps
 
     @pl.when(jnp.logical_and(b == 0, h == 0))
     def _warmup():                             # first block of the run
@@ -264,12 +255,13 @@ def _decode_kernel_dyn(
         for cp in dma(b, h, j, slot):
             cp.wait()
 
+        win = pl.ds(j * block_k, block_k)
         if quant:
             # widen WITHOUT the scale (the D-sized multiply is the
             # expensive dequant path) — scales fold per-column below
             k = kbuf[slot].astype(jnp.bfloat16)    # (block_k, D)
             v = vbuf[slot].astype(jnp.bfloat16)
-            v_scale = vsbuf[slot]                  # (1, block_k)
+            v_scale = vs_ref[0, 0, :, win]         # (1, block_k)
         else:
             k = kbuf[slot]                         # (block_k, D)
             v = vbuf[slot]
@@ -279,7 +271,7 @@ def _decode_kernel_dyn(
         ) * scale                              # (G, block_k)
         if quant:
             # exact: scale_s is constant along each k column of the dot
-            s = s * ksbuf[slot]                    # (1, block_k) broadcast
+            s = s * ks_ref[0, 0, :, win]           # (1, block_k) broadcast
         if soft_cap > 0.0:
             s = soft_cap * jnp.tanh(s / soft_cap)
 
@@ -533,14 +525,28 @@ def quantize_kv(x):
     return q, s
 
 
+def _q8_auto_block_k(batch, hkv, s_len):
+    """Block size for the int8 walk — the r4 heuristic (half capacity
+    clamped to [1024, 4096]) re-validated round 5 by a PAIRED sweep at
+    the serving headline (B=128, Hkv=8, S=2048, mixed lens U[S/8,
+    3S/4], v5e): 1024 best; 512 +20%, 256 +57% (per-block overhead),
+    2048 +5% (over-read on partial rows). The walk is bytes/BW bound
+    (~0.17 µs/block fixed + ~470-580 GB/s effective on 131-262 KB
+    contiguous runs) — NOT DMA-count bound: moving the per-block scale
+    copies onto the grid pipeline and deepening n_bufs 2→4 measured
+    neutral at 1024 (docs/PERF.md round-5 serving attention)."""
+    del batch, hkv
+    return min(max(s_len // 2, 1024), 4096)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "soft_cap", "block_k", "interpret"),
+    static_argnames=("scale", "soft_cap", "block_k", "n_bufs", "interpret"),
 )
 def gqa_fwd_batch_decode_q8(
     q, k_q, k_scale, v_q, v_scale, kv_lens, *,
     scale: float | None = None, soft_cap: float = 0.0,
-    block_k: int | None = None, interpret=None,
+    block_k: int | None = None, n_bufs: int = 4, interpret=None,
 ):
     """Local GQA decode over an INT8 KV cache → (out, lse).
 
@@ -548,8 +554,11 @@ def gqa_fwd_batch_decode_q8(
     k_scale/v_scale: (B, Hkv, S) f32 per-token-per-head scales (from
     :func:`quantize_kv`). Same contract as :func:`gqa_fwd_batch_decode`
     — dynamic per-row trip counts, reads scale with TRUE lengths — at
-    half the KV bytes; the scales fold exactly into the softmax (see
-    ``_decode_kernel_dyn``'s quant mode).
+    half the KV bytes; the scales fold exactly into the softmax and
+    ride the grid pipeline, not per-block DMAs (see
+    ``_decode_kernel_dyn``'s quant mode). ``n_bufs``: KV slot depth —
+    4 keeps the DMA engine fed across short (1-2 block) rows where
+    double buffering drains at every group boundary.
     """
     batch, hq, d = q.shape
     _, hkv, s_len, _ = k_q.shape
@@ -558,12 +567,12 @@ def gqa_fwd_batch_decode_q8(
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if block_k is None:
-        block_k = min(max(s_len // 2, 1024), 4096)
+        block_k = _q8_auto_block_k(batch, hkv, s_len)
     block_k = pick_block_k(s_len, block_k, head_dim=d, itemsize=1)
 
     if d % 128 != 0 or block_k % 128 != 0:
-        # unaligned geometry (the scale-plane DMA slices the lane dim
-        # at block_k granules): widen via XLA and take the dense path
+        # unaligned geometry (the in-kernel scale slice works at lane
+        # granules): widen via XLA and take the dense path
         k = (k_q.astype(jnp.float32) * k_scale[..., None]).astype(q.dtype)
         v = (v_q.astype(jnp.float32) * v_scale[..., None]).astype(q.dtype)
         return gqa_fwd_batch_decode(
@@ -572,7 +581,6 @@ def gqa_fwd_batch_decode_q8(
         )
 
     qg = q.reshape(batch, hkv, g, d).astype(jnp.bfloat16)
-    n_bufs = 2
     kernel = functools.partial(
         _decode_kernel_dyn, scale, soft_cap, block_k, n_bufs, g, d, True
     )
@@ -583,8 +591,12 @@ def gqa_fwd_batch_decode_q8(
             pl.BlockSpec((1, 1, g, d), lambda b, h, lens: (b, h, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            # scale planes (B, Hkv, 1, S): whole per-(b, h) rows on the
+            # grid pipeline — serving walks are DMA-COUNT bound, and
+            # per-block 4 KB scale copies doubled the count (see
+            # _decode_kernel_dyn's quant note)
+            pl.BlockSpec((1, 1, 1, s_len), lambda b, h, lens: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, 1, s_len), lambda b, h, lens: (b, h, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, g, d), lambda b, h, lens: (b, h, 0, 0)),
@@ -599,10 +611,6 @@ def gqa_fwd_batch_decode_q8(
             pltpu.VMEM((g, 1), jnp.float32),
             pltpu.VMEM((g, 1), jnp.float32),
             pltpu.VMEM((g, d), jnp.float32),
-            pltpu.VMEM((n_bufs, 1, block_k), jnp.float32),
-            pltpu.VMEM((n_bufs, 1, block_k), jnp.float32),
-            pltpu.SemaphoreType.DMA((n_bufs,)),
-            pltpu.SemaphoreType.DMA((n_bufs,)),
         ],
     )
     call = shmem_call(
@@ -615,6 +623,7 @@ def gqa_fwd_batch_decode_q8(
         collective_id=None,
         interpret=local_interpret() if interpret is None else interpret,
         name="gqa_decode_split_kv_q8",
+        dimension_semantics=("arbitrary", "arbitrary"),
     )
     out, lse = call(
         kv_lens.astype(jnp.int32), qg, k_q, v_q,
@@ -781,6 +790,19 @@ def paged_gqa_fwd_batch_decode_q8(
     pages_per_seq = block_table.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+
+    if d % 128 != 0 or page % 128 != 0:
+        # unaligned geometry (the (…, 1, page) scale windows slice the
+        # lane dim at page granules): widen and take the full-precision
+        # paged path — the SAME fallback discipline (and precision) as
+        # the contiguous q8 entry, so mixed-geometry callers see one
+        # numerical behavior across cache layouts
+        kp = (k_pool.astype(jnp.float32) * k_scale[..., None]).astype(q.dtype)
+        vp = (v_pool.astype(jnp.float32) * v_scale[..., None]).astype(q.dtype)
+        return paged_gqa_fwd_batch_decode(
+            q, kp, vp, kv_lens, block_table, scale=scale,
+            soft_cap=soft_cap, interpret=interpret,
+        )
 
     qg = q.reshape(batch, hkv, g, d).astype(jnp.bfloat16)
     grid = (batch, hkv, pages_per_seq)
@@ -1234,7 +1256,7 @@ def _local_paged_shard_decode_q8(
 
 
 @functools.lru_cache(maxsize=64)
-def _sp_paged_q8_fns(mesh, axis, scale, soft_cap):
+def _sp_paged_q8_fns(mesh, axis, scale, soft_cap, with_lse=False):
     """Jitted (local, merge) pair for the INT8 paged SP decode."""
 
     def local(q, kp, ks, vp, vs, lens, table):
@@ -1255,10 +1277,14 @@ def _sp_paged_q8_fns(mesh, axis, scale, soft_cap):
     )
     merge_fn = jax.jit(
         jax.shard_map(
-            functools.partial(_merge_shard_partials, axis=axis),
+            functools.partial(
+                _merge_shard_partials_lse if with_lse
+                else _merge_shard_partials,
+                axis=axis,
+            ),
             mesh=mesh,
             in_specs=(P(axis), P(axis)),
-            out_specs=P(),
+            out_specs=(P(), P()) if with_lse else P(),
             check_vma=False,
         )
     )
@@ -1267,13 +1293,17 @@ def _sp_paged_q8_fns(mesh, axis, scale, soft_cap):
 
 def sp_paged_gqa_fwd_batch_decode_q8(
     q, k_pool, k_scale, v_pool, v_scale, global_kv_lens, block_table,
-    mesh, axis="x", *, scale=None, soft_cap=0.0,
+    mesh, axis="x", *, scale=None, soft_cap=0.0, with_lse=False,
 ):
     """Host entry: sequence-parallel INT8 PAGED GQA decode — the same
     per-rank pool/table contract as :func:`sp_paged_gqa_fwd_batch_decode`
     with int8 pools + (R·npages_local, Hkv, page) f32 scale pools, all
-    sharded ``P(axis)`` on dim 0."""
-    local_fn, merge_fn = _sp_paged_q8_fns(mesh, axis, scale, soft_cap)
+    sharded ``P(axis)`` on dim 0. ``with_lse``: also return the merged
+    (B, Hq) lse so callers can fold further partials (the paged decode
+    step's just-produced token, models/transformer.decode_step)."""
+    local_fn, merge_fn = _sp_paged_q8_fns(
+        mesh, axis, scale, soft_cap, with_lse
+    )
     out, lse = local_fn(
         q, k_pool, k_scale, v_pool, v_scale, global_kv_lens, block_table
     )
@@ -1281,7 +1311,7 @@ def sp_paged_gqa_fwd_batch_decode_q8(
 
 
 @functools.lru_cache(maxsize=64)
-def _sp_paged_fns(mesh, axis, scale, soft_cap, use_pallas):
+def _sp_paged_fns(mesh, axis, scale, soft_cap, use_pallas, with_lse=False):
     """Jitted (local, merge) pair for the PAGED SP decode — split into
     two dispatches for the same interpreter-deadlock reason as
     :func:`_sp_decode_fns`."""
@@ -1303,10 +1333,14 @@ def _sp_paged_fns(mesh, axis, scale, soft_cap, use_pallas):
     )
     merge_fn = jax.jit(
         jax.shard_map(
-            functools.partial(_merge_shard_partials, axis=axis),
+            functools.partial(
+                _merge_shard_partials_lse if with_lse
+                else _merge_shard_partials,
+                axis=axis,
+            ),
             mesh=mesh,
             in_specs=(P(axis), P(axis)),
-            out_specs=P(),
+            out_specs=(P(), P()) if with_lse else P(),
             check_vma=False,
         )
     )
@@ -1315,7 +1349,7 @@ def _sp_paged_fns(mesh, axis, scale, soft_cap, use_pallas):
 
 def sp_paged_gqa_fwd_batch_decode(
     q, k_pool, v_pool, global_kv_lens, block_table, mesh, axis="x", *,
-    scale=None, soft_cap=0.0, use_pallas=True,
+    scale=None, soft_cap=0.0, use_pallas=True, with_lse=False,
 ):
     """Host entry: sequence-parallel PAGED GQA decode on ``mesh``.
 
@@ -1327,8 +1361,11 @@ def sp_paged_gqa_fwd_batch_decode(
       dim 0 — rank r's local pool is its shard.
     * block_table: (R, B, pages_per_slice) sharded P(axis), LOCAL page
       ids into each rank's own pool shard.
-    * q, global_kv_lens replicated. Returns (B, Hq, D) replicated.
+    * q, global_kv_lens replicated. Returns (B, Hq, D) replicated
+      (+ the merged (B, Hq) lse with ``with_lse``).
     """
-    local_fn, merge_fn = _sp_paged_fns(mesh, axis, scale, soft_cap, use_pallas)
+    local_fn, merge_fn = _sp_paged_fns(
+        mesh, axis, scale, soft_cap, use_pallas, with_lse
+    )
     out, lse = local_fn(q, k_pool, v_pool, global_kv_lens, block_table)
     return merge_fn(out, lse)

@@ -11,6 +11,7 @@ from triton_distributed_tpu.layers.allgather import AllGatherLayer
 from triton_distributed_tpu.layers.attention import (
     SpGQAFlashDecodeAttention,
     append_kv,
+    paged_append_kv,
 )
 from triton_distributed_tpu.layers.linear import (
     ColumnParallelLinear,
@@ -23,6 +24,7 @@ __all__ = [
     "AllGatherLayer",
     "SpGQAFlashDecodeAttention",
     "append_kv",
+    "paged_append_kv",
     "ColumnParallelLinear",
     "RowParallelLinear",
     "ParallelMLP",

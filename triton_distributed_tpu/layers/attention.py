@@ -115,13 +115,30 @@ class SpGQAFlashDecodeAttention:
             batch_axes=self.batch_axes,
         )
 
-    def partials(self, q, k_cache, v_cache, global_kv_lens):
-        """Like ``__call__`` (non-paged modes) but returning the merged
-        ``(out, lse)`` pair — the softmax merge is associative, so the
-        caller can fold FURTHER partials (e.g. the decode step's
-        just-produced token as an exact single-position partial via
-        ``combine_partials``) without the cache append feeding the
-        attention kernel."""
+    def partials(self, q, k_cache, v_cache, global_kv_lens,
+                 block_table=None):
+        """Like ``__call__`` but returning the merged ``(out, lse)``
+        pair — the softmax merge is associative, so the caller can fold
+        FURTHER partials (e.g. the decode step's just-produced token as
+        an exact single-position partial via ``combine_partials``)
+        without the cache append feeding the attention kernel. With
+        ``block_table``, the caches are page POOLS (the paged serving
+        mode; see ``__call__``)."""
+        if block_table is not None:
+            if isinstance(k_cache, dict):
+                return sp_paged_gqa_fwd_batch_decode_q8(
+                    q, k_cache["q"], k_cache["scale"],
+                    v_cache["q"], v_cache["scale"], global_kv_lens,
+                    block_table, self.mesh, self.axis,
+                    scale=self.scale, soft_cap=self.soft_cap,
+                    with_lse=True,
+                )
+            return sp_paged_gqa_fwd_batch_decode(
+                q, k_cache, v_cache, global_kv_lens, block_table,
+                self.mesh, self.axis, scale=self.scale,
+                soft_cap=self.soft_cap, use_pallas=self.use_pallas,
+                with_lse=True,
+            )
         return self._nonpaged(q, k_cache, v_cache, global_kv_lens, True)
 
     def token_partial(self, q, k_new, v_new):
@@ -213,3 +230,61 @@ def append_kv(k_cache, v_cache, kv_lens, k_new, v_new, kv_layout="bhsd"):
             v_new.astype(v_cache.dtype)
         )
     return k_cache, v_cache, kv_lens + 1
+
+
+def paged_append_kv(k_pool, v_pool, block_table, kv_lens, k_new, v_new):
+    """Append one decode step's K/V into PAGE POOLS at each row's
+    current length — the paged twin of :func:`append_kv` (≡ the
+    reference kernels writing through the block table,
+    flash_decode.py:763-846).
+
+    k_pool/v_pool: (R·npages_local, Hkv, page, D) pools — or int8
+    ``{"q", "scale"}`` dicts with (R·npages_local, Hkv, page) scale
+    pools; block_table: (R, B, pages_per_slice) LOCAL page ids (rank
+    r's pool shard is rows [r·npages_local, (r+1)·npages_local));
+    kv_lens: (B,) GLOBAL lengths before the append. A row at global
+    position L lives on sequence slice L // (pages_per_slice·page), in
+    local page (L mod s_loc) // page, at offset L mod page. Rows at
+    capacity drop the write (JAX OOB scatter semantics), like
+    append_kv. Written at the global level — GSPMD partitions the
+    scatter (on one device this is a plain in-place write; a rank-local
+    shard_map twin is the multi-host optimization, same as the
+    reference's per-rank table writes)."""
+    r, b, pps = block_table.shape
+    pool0 = k_pool["q"] if isinstance(k_pool, dict) else k_pool
+    npages_local = pool0.shape[0] // r
+    page = pool0.shape[2]
+    s_loc = pps * page
+    rows = jnp.arange(b)
+    slice_idx = kv_lens // s_loc
+    local = kv_lens % s_loc
+    off = local % page
+    local_id = block_table[
+        jnp.clip(slice_idx, 0, r - 1), rows, local // page
+    ]
+    # rows past capacity get an out-of-range pool index on purpose —
+    # the scatter drops them (same contract as append_kv)
+    pool_idx = jnp.where(
+        kv_lens < r * s_loc,
+        slice_idx * npages_local + local_id,
+        pool0.shape[0],
+    )
+    heads = jnp.arange(pool0.shape[1])
+    pi = pool_idx[:, None]
+    hi = heads[None, :]
+    oi = off[:, None]
+    if isinstance(k_pool, dict):
+        kq_new, ks_new = quantize_kv(k_new)     # (B, Hkv, D) → + (B, Hkv)
+        vq_new, vs_new = quantize_kv(v_new)
+        k_pool = {
+            "q": k_pool["q"].at[pi, hi, oi].set(kq_new),
+            "scale": k_pool["scale"].at[pi, hi, oi].set(ks_new),
+        }
+        v_pool = {
+            "q": v_pool["q"].at[pi, hi, oi].set(vq_new),
+            "scale": v_pool["scale"].at[pi, hi, oi].set(vs_new),
+        }
+        return k_pool, v_pool, kv_lens + 1
+    k_pool = k_pool.at[pi, hi, oi].set(k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[pi, hi, oi].set(v_new.astype(v_pool.dtype))
+    return k_pool, v_pool, kv_lens + 1

@@ -194,6 +194,16 @@ def _cache_capacity(caches):
     return (ck["q"] if isinstance(ck, dict) else ck).shape[2]
 
 
+def _serving_capacity(caches, block_table=None):
+    """Capacity in sequence positions: the bhsd S dim for contiguous
+    caches, R·pps·page for page pools."""
+    if block_table is None:
+        return _cache_capacity(caches)
+    page = _cache_capacity(caches)      # dim 2 of a pool IS the page
+    r, _, pps = block_table.shape
+    return r * pps * page
+
+
 def _update_q8(cache, q_new, s_new):
     """Write a quantized (B, Hkv, S', …) prefix into an int8 cache dict."""
     return {
@@ -782,11 +792,12 @@ class Transformer:
         ba = tuple(self.dp_axes)
         return NamedSharding(self.mesh, P(ba if ba else None))
 
-    def _pin_caches(self, caches):
+    def _pin_caches(self, caches, paged=False):
         """with_sharding_constraint every cache leaf to the canonical
         :attr:`cache_sharding` (same spec covers the 4D planes and the
-        3D scale leaves — batch dim 0, sequence dim 2)."""
-        sh = self.cache_sharding
+        3D scale leaves — batch dim 0, sequence dim 2); page pools pin
+        their rank-major page dim over tp instead."""
+        sh = self._paged_sharding if paged else self.cache_sharding
         return jax.tree.map(
             lambda x: jax.lax.with_sharding_constraint(x, sh), caches
         )
@@ -826,6 +837,110 @@ class Transformer:
             (zz + jnp.zeros((), c.dtype), zz + jnp.zeros((), c.dtype))
             for _ in range(c.n_layers)
         ]
+
+    @property
+    def _paged_sharding(self):
+        """Pool placement: pages (rank-major dim 0) over tp."""
+        return NamedSharding(self.mesh, P(self.tp_axis))
+
+    def init_paged_cache(self, batch: int, max_len: int, page: int = 1024):
+        """PAGED twin of :meth:`init_cache` — the production serving
+        mode (the reference's block-table path is its default decode
+        entry, flash_decode.py:763-846). Returns ``(caches, table)``:
+        per-layer (k_pool, v_pool) page pools of shape
+        (R·B·pps, Hkv, page, D) sharded over tp on the page dim (rank
+        r owns its sequence slice's pages), int8 ``{"q","scale"}``
+        dicts under ``config.kv_quant``; and ONE (R, B, pps) block
+        table of LOCAL page ids shared by every layer (dense identity
+        allocation — a serving stack with its own allocator passes any
+        table honoring the same contract). Paged mode is tp-only: the
+        pool layout is rank-major, so dp composes by running one model
+        per dp group."""
+        c = self.config
+        if self.dp_axes:
+            raise ValueError("paged caches are tp-only (rank-major pools)")
+        r = self.tp
+        if max_len % (r * page):
+            raise ValueError(
+                f"capacity {max_len} must split into {r} rank slices of "
+                f"whole {page}-row pages"
+            )
+        pps = max_len // r // page
+        npages = r * batch * pps
+        spec = self._paged_sharding
+        table = jax.device_put(
+            jnp.broadcast_to(
+                jnp.arange(batch * pps, dtype=jnp.int32).reshape(
+                    1, batch, pps
+                ),
+                (r, batch, pps),
+            ),
+            spec,
+        )
+        if c.kv_quant is not None:
+            zq = jax.device_put(
+                jnp.zeros((npages, c.n_kv_heads, page, c.head_dim),
+                          jnp.int8),
+                spec,
+            )
+            zs = jax.device_put(
+                jnp.ones((npages, c.n_kv_heads, page), jnp.float32), spec
+            )
+
+            def fresh():
+                # independent buffers per leaf — the decode jits donate
+                return {"q": zq + jnp.int8(0), "scale": zs + 0.0}
+
+            return [(fresh(), fresh()) for _ in range(c.n_layers)], table
+        z = jax.device_put(
+            jnp.zeros((npages, c.n_kv_heads, page, c.head_dim), c.dtype),
+            spec,
+        )
+        zero = jnp.zeros((), c.dtype)
+        return [(z + zero, z + zero) for _ in range(c.n_layers)], table
+
+    def paginate_caches(self, caches, page: int = 1024):
+        """Convert CONTIGUOUS (prefill-filled) caches into page pools +
+        table — the prefill→paged-decode bridge: one reshape/transpose
+        per plane, no gather (pages of the dense identity allocation
+        are exactly the contiguous cache's page-aligned rows)."""
+        r = self.tp
+
+        def split(x):                       # (B, Hkv, S, D?) → pools
+            b, hkv, s = x.shape[:3]
+            tail = x.shape[3:]
+            pps = s // r // page
+            y = x.reshape((b, hkv, r, pps, page) + tail)
+            # (R, B, pps, Hkv, page, tail) → rank-major page rows
+            y = jnp.moveaxis(y, (2, 0, 3, 1), (0, 1, 2, 3))
+            return jax.device_put(
+                y.reshape((r * b * pps, hkv, page) + tail),
+                self._paged_sharding,
+            )
+
+        out = []
+        batch = None
+        for ck, cv in caches:
+            if isinstance(ck, dict):
+                batch = ck["q"].shape[0]
+                s = ck["q"].shape[2]
+                ck = {"q": split(ck["q"]), "scale": split(ck["scale"])}
+                cv = {"q": split(cv["q"]), "scale": split(cv["scale"])}
+            else:
+                batch, s = ck.shape[0], ck.shape[2]
+                ck, cv = split(ck), split(cv)
+            out.append((ck, cv))
+        pps = s // r // page
+        table = jax.device_put(
+            jnp.broadcast_to(
+                jnp.arange(batch * pps, dtype=jnp.int32).reshape(
+                    1, batch, pps
+                ),
+                (r, batch, pps),
+            ),
+            self._paged_sharding,
+        )
+        return out, table
 
     def prefill(self, params, caches, tokens, lens=None):
         """Process a whole prompt batch in ONE forward pass and fill the
@@ -925,9 +1040,15 @@ class Transformer:
         ]
 
     def decode_step(self, params, caches, kv_lens, last_tokens,
-                    moe_state=None):
+                    moe_state=None, block_table=None):
         """One token of SP decode: replicated (B,) last tokens + seq-
         sharded caches → (B, vocab) logits, updated caches/lens.
+
+        ``block_table`` switches to PAGED serving: ``caches`` are the
+        page pools from :meth:`init_paged_cache` /
+        :meth:`paginate_caches` and attention + append walk the table
+        (≡ the reference's block-table decode default,
+        flash_decode.py:763-846).
 
         Attention runs through the distributed flash-decode layer
         (local split-kv + AG(out,lse) + LSE combine); projections are
@@ -988,7 +1109,9 @@ class Transformer:
                 vq8, vs8 = quantize_kv(v)
                 k = (kq8.astype(jnp.float32) * ks8[..., None]).astype(k.dtype)
                 v = (vq8.astype(jnp.float32) * vs8[..., None]).astype(v.dtype)
-            o_c, lse_c = self._sp_attn.partials(q, ck, cv, kv_lens)
+            o_c, lse_c = self._sp_attn.partials(
+                q, ck, cv, kv_lens, block_table
+            )
             # the token partial comes from the SAME layer so its score
             # convention (scale, soft_cap) cannot drift from the
             # kernel's lse domain
@@ -998,7 +1121,12 @@ class Transformer:
                 jnp.stack([lse_c, lse_new]),
                 out_dtype=o_c.dtype,
             )
-            ck, cv, _ = append_kv(ck, cv, kv_lens, k, v, kv_layout="bhsd")
+            if block_table is None:
+                ck, cv, _ = append_kv(ck, cv, kv_lens, k, v, kv_layout="bhsd")
+            else:
+                from triton_distributed_tpu.layers import paged_append_kv
+
+                ck, cv, _ = paged_append_kv(ck, cv, block_table, kv_lens, k, v)
             new_caches.append((ck, cv))
             o = self._dmm(o.reshape(b, c.q_dim), blk["wo"])
             x = x + o
@@ -1040,7 +1168,7 @@ class Transformer:
         # (cache_sharding / batch over dp): with the decode jits'
         # donation this makes every step's cache update alias in place
         # — no cache-sized copy, no cross-step reshard
-        new_caches = self._pin_caches(new_caches)
+        new_caches = self._pin_caches(new_caches, paged=block_table is not None)
         new_lens = jax.lax.with_sharding_constraint(
             kv_lens + 1, self.batch_sharding
         )
@@ -1113,9 +1241,10 @@ class Transformer:
 
     @functools.cached_property
     def _decode_jit_state(self):
-        def step(params, caches, kv_lens, last_tokens, moe_state):
+        def step(params, caches, kv_lens, last_tokens, moe_state,
+                 block_table=None):
             return self.decode_step(params, caches, kv_lens, last_tokens,
-                                    moe_state)
+                                    moe_state, block_table)
 
         # donate the caches/lens (in-place update, see _decode_jit) AND
         # the LL workspaces: the barrier-free protocol requires the
@@ -1124,13 +1253,14 @@ class Transformer:
         return jax.jit(step, donate_argnums=(1, 2, 4))
 
     def generate(self, params, caches, kv_lens, last_tokens, steps: int,
-                 moe_state=None):
+                 moe_state=None, block_table=None):
         """Greedy decode ``steps`` tokens. The whole decode step is one
         jitted program (cached across steps and calls by shape). With
         ``moe_state`` (init_decode_state), EP-MoE blocks run the
         barrier-free fused transport and the state comes back as a 4th
-        result for continuation."""
-        cap = _cache_capacity(caches)  # (B, Hkv, S, D) bhsd layout
+        result for continuation. With ``block_table``, caches are page
+        pools (init_paged_cache / paginate_caches)."""
+        cap = _serving_capacity(caches, block_table)
         try:
             max_len = int(np.asarray(kv_lens).max()) + steps
             assert max_len <= cap, (
@@ -1143,11 +1273,13 @@ class Transformer:
         for _ in range(steps):
             if moe_state is None:
                 logits, caches, kv_lens = self._decode_jit(
-                    params, caches, kv_lens, last_tokens
+                    params, caches, kv_lens, last_tokens,
+                    block_table=block_table,
                 )
             else:
                 logits, caches, kv_lens, moe_state = self._decode_jit_state(
-                    params, caches, kv_lens, last_tokens, moe_state
+                    params, caches, kv_lens, last_tokens, moe_state,
+                    block_table=block_table,
                 )
             last_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             out.append(last_tokens)
@@ -1161,16 +1293,19 @@ class Transformer:
         @functools.partial(
             jax.jit, static_argnums=(4,), donate_argnums=(1, 2, 5)
         )
-        def run(params, caches, kv_lens, last_tokens, steps, moe_state):
+        def run(params, caches, kv_lens, last_tokens, steps, moe_state,
+                block_table=None):
             def body(carry, _):
                 caches, lens, toks, state = carry
                 if state is None:
                     logits, caches, lens = self.decode_step(
-                        params, caches, lens, toks
+                        params, caches, lens, toks,
+                        block_table=block_table,
                     )
                 else:
                     logits, caches, lens, state = self.decode_step(
-                        params, caches, lens, toks, state
+                        params, caches, lens, toks, state,
+                        block_table=block_table,
                     )
                 toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return (caches, lens, toks, state), toks
@@ -1184,7 +1319,7 @@ class Transformer:
         return run
 
     def generate_scan(self, params, caches, kv_lens, last_tokens,
-                      steps: int, moe_state=None):
+                      steps: int, moe_state=None, block_table=None):
         """Greedy-decode ``steps`` tokens ON DEVICE: one jitted program
         whose ``lax.scan`` carries the caches, lens, tokens and the LL
         MoE state across steps — no host round-trip per token. Same
@@ -1195,7 +1330,7 @@ class Transformer:
         so the barrier-free fused transport can ride a scan; caches,
         lens and state are donated (in place across calls, like the
         per-step jits)."""
-        cap = _cache_capacity(caches)
+        cap = _serving_capacity(caches, block_table)
         try:
             max_len = int(np.asarray(kv_lens).max()) + steps
             assert max_len <= cap, (
@@ -1205,7 +1340,8 @@ class Transformer:
         except jax.errors.TracerArrayConversionError:
             pass  # traced lens: caller owns the capacity contract
         toks, caches, kv_lens, moe_state = self._generate_scan_jit(
-            params, caches, kv_lens, last_tokens, steps, moe_state
+            params, caches, kv_lens, last_tokens, steps, moe_state,
+            block_table,
         )
         if moe_state is None:
             return toks, caches, kv_lens
