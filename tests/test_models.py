@@ -118,7 +118,7 @@ class TestDecode:
         assert state is not None and state[1] is not None  # MoE layer 1
         ref_caches, ref_lens, ref_tok = caches, lens, first
         ll_caches, ll_lens, ll_tok = caches, lens, first
-        for step in range(2):
+        for step in range(3):
             ref_logits, ref_caches, ref_lens = model.decode_step(
                 params, ref_caches, ref_lens, ref_tok
             )
@@ -132,6 +132,49 @@ class TestDecode:
             ref_tok = jnp.argmax(ref_logits, axis=-1).astype(jnp.int32)
             ll_tok = jnp.argmax(ll_logits, axis=-1).astype(jnp.int32)
             assert int(np.asarray(state[1].parity)[0]) == (step + 1) % 2
+
+    def test_decode_fused_ll_real_ctx_executes(self, mesh_tp):
+        """The REAL ``_moe_ep_ctx`` path (no monkeypatch) under
+        ``config.force_fused_transport`` runs 3 consecutive fused-LL
+        decode steps on the 8-device interpreter mesh — chunked
+        transport + donable functional state + append + SP attention
+        composed in the production step — and matches the XLA-transport
+        logits (VERDICT r4 #4)."""
+        from triton_distributed_tpu.config import config as tcfg
+
+        model = _model(mesh_tp, moe="ep")
+        params = _sharded_params(model)
+        b, smax = 8, 32
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (b, 8), 0, 128)
+        caches = model.init_cache(b, smax)
+        last, caches, lens = model.prefill(params, caches, prompt)
+        first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        ref_c, ref_l, ref_t = caches, lens, first
+        ll_c, ll_l, ll_t = caches, lens, first
+
+        tcfg.force_fused_transport = True
+        try:
+            m_ll = _model(mesh_tp, moe="ep")   # fresh ctx/jit caches
+            ctx = m_ll._moe_ep_ctx(1, inference=True)
+            assert ctx.transport == "fused"
+            state = m_ll.init_decode_state(b)
+            assert state is not None and state[1] is not None
+            for step in range(3):
+                ref_lg, ref_c, ref_l = model.decode_step(
+                    params, ref_c, ref_l, ref_t
+                )
+                ll_lg, ll_c, ll_l, state = m_ll.decode_step(
+                    params, ll_c, ll_l, ll_t, state
+                )
+                np.testing.assert_allclose(
+                    np.asarray(ll_lg), np.asarray(ref_lg),
+                    atol=1e-5, rtol=1e-5,
+                )
+                ref_t = jnp.argmax(ref_lg, axis=-1).astype(jnp.int32)
+                ll_t = jnp.argmax(ll_lg, axis=-1).astype(jnp.int32)
+                assert int(np.asarray(state[1].parity)[0]) == (step + 1) % 2
+        finally:
+            tcfg.force_fused_transport = False
 
     def test_decode_wire_quant_close_to_full_precision(self, mesh_tp,
                                                        monkeypatch):

@@ -292,14 +292,23 @@ class Transformer:
         # 100× slower and can wedge the interpreter's worker pool — the
         # fused decode path's compile/correctness coverage lives in
         # tests/test_ep_moe.py, test_races.py and test_aot_topology.py).
-        from triton_distributed_tpu.config import compiling_for_tpu
+        from triton_distributed_tpu.config import (
+            compiling_for_tpu,
+            config as _cfg,
+        )
         from triton_distributed_tpu.runtime import is_dcn_axis
 
+        # force_fused_transport: bounded off-TPU execution of the fused
+        # transport on the interpreter (the multi-device execution
+        # evidence for the composed fused-LL step) — transport only;
+        # the Mosaic-only grouped-GEMM/W8A8 paths still need real
+        # lowering (pallas_ok below)
         fused_ok = (
             inference
-            and compiling_for_tpu()
+            and (compiling_for_tpu() or _cfg.force_fused_transport)
             and not is_dcn_axis(self.mesh, self.tp_axis)
         )
+        pallas_ok = fused_ok and compiling_for_tpu()
         # the scalar-prefetch grouped-GEMM kernel in WEIGHT-RESIDENT
         # mode (whole-N/K tiles, block_m 64) wins the decode-size expert
         # MLP on hardware: less alignment padding without per-block
@@ -325,24 +334,24 @@ class Transformer:
             # residency gate from the 1-byte storage actually in hand
             wq_mode = "int8"
         w_itemsize = resident_weight_itemsize(wq_mode, c.dtype)
-        wr_ok = fused_ok and (
+        wr_ok = pallas_ok and (
             2 * c.hidden * c.ffn * w_itemsize
             <= int(0.7 * fused_vmem_budget())
         )
         # W8A8 engages only where its int8 weight dicts will exist
-        a8 = c.moe_act_quant if (fused_ok and wq_mode == "int8") else None
+        a8 = c.moe_act_quant if (pallas_ok and wq_mode == "int8") else None
         # block_m: W8A8's s8×s8 MXU rate needs ≥128-row blocks, while
         # W8A16 prefers 64 (less alignment padding; weight residency
         # removes the re-streaming penalty) — both measured, docs/PERF.md
         if wr_ok:
             bm = 128 if a8 else 64
         else:
-            bm = 256 if fused_ok else 128
+            bm = 256 if pallas_ok else 128
         return ops.create_ep_moe_context(
             self.mesh, self.tp_axis, num_experts=c.num_experts, topk=c.topk,
             max_m=m_local * c.topk, hidden=c.hidden, dtype=c.dtype,
             transport="fused" if fused_ok else "xla",
-            use_pallas_gemm=fused_ok,
+            use_pallas_gemm=pallas_ok,
             block_m=bm,
             gg_block_n=1 << 30 if wr_ok else None,
             gg_block_k=1 << 30 if wr_ok else None,
