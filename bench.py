@@ -386,7 +386,8 @@ def main() -> None:
 
     for fn in (_bench_gemm_rs, _bench_group_gemm, _bench_moe_a2a,
                _bench_flash_decode, _bench_serving_moe_decode,
-               _bench_serving_multilayer, _bench_serving_paged):
+               _bench_serving_multilayer, _bench_serving_paged,
+               _bench_generate_scan):
         try:
             print(json.dumps(fn(mesh, n, on_tpu, spec)), file=sys.stderr, flush=True)
         except Exception as e:
@@ -659,6 +660,89 @@ def _bench_serving_multilayer(mesh, n, on_tpu, spec):
         # 1 MoE; report both raw marginal and the extrapolation ratio)
         out["us_per_layer_marginal"] = round((t_step - t1) / (layers - 1) * 1e6, 1)
         out["vs_1l_extrapolation"] = round(t_step / (layers * t1), 3)
+    return out
+
+
+def _bench_generate_scan(mesh, n, on_tpu, spec):
+    """On-device multi-step decode (VERDICT r4 #6): `generate_scan`
+    folds the whole decode into ONE jitted lax.scan — this times it at
+    the serving headline and reports per-step cost vs the single-step
+    extrapolation. Methodology: wall-clock DELTA between steps=64 and
+    steps=32 sequences (one dispatch each, host fetch as fence) — the
+    ~90 ms relay dispatch round-trip cancels, exactly the artifact the
+    scan entry exists to kill. Fresh caches per invocation keep the
+    workload constant (caches/lens/state are donated); the LL state is
+    threaded call to call."""
+    import time as _time
+
+    from triton_distributed_tpu.models import Transformer, TransformerConfig
+
+    if on_tpu:
+        b, s_cap = 128, 2048
+        cfg = TransformerConfig(
+            vocab=4096, n_layers=1, hidden=7168, ffn=2048, n_heads=56,
+            n_kv_heads=8, head_dim=128, moe="ep", moe_layers=(0,),
+            num_experts=8, topk=8, param_dtype=jnp.bfloat16,
+            moe_weight_quant="int8", moe_act_quant="int8", kv_quant="int8",
+            dense_weight_quant="int8", dense_act_quant="int8",
+        )
+        lo_steps, hi_steps, reps = 32, 64, 5
+    else:
+        b, s_cap = 8, 256
+        cfg = TransformerConfig(
+            vocab=512, n_layers=1, hidden=256, ffn=128, n_heads=8,
+            n_kv_heads=4, head_dim=32, moe="ep", moe_layers=(0,),
+            num_experts=8, topk=2, param_dtype=jnp.bfloat16,
+        )
+        lo_steps, hi_steps, reps = 2, 4, 2
+    model = Transformer(cfg, mesh, tp_axis="x")
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, s),
+        model.init(jax.random.PRNGKey(7)), model.shardings(),
+    )
+    params = model.quantize_moe_weights(params)
+    params = model.quantize_dense_weights(params)
+    lens = jnp.asarray(
+        np.random.default_rng(11).integers(s_cap // 8, 3 * s_cap // 4, (b,)),
+        jnp.int32,
+    )
+    toks0 = jnp.zeros((b,), jnp.int32)
+    mst = model.init_decode_state(b)
+
+    def run(steps, mst):
+        caches = model.init_cache(b, s_cap)    # outside the timed window
+        lens0 = lens + 0
+        t0 = _time.perf_counter()
+        out = model.generate_scan(
+            params, caches, lens0, toks0, steps, moe_state=mst
+        )
+        np.asarray(out[0])                     # host fetch = the fence
+        return _time.perf_counter() - t0, (out[3] if mst is not None else None)
+
+    for s in (lo_steps, hi_steps, lo_steps, hi_steps):  # compile + warm
+        _, mst = run(s, mst)
+    deltas = []
+    for _ in range(reps):
+        t_lo, mst = run(lo_steps, mst)
+        t_hi, mst = run(hi_steps, mst)
+        deltas.append((t_hi - t_lo) / (hi_steps - lo_steps))
+    t_step = float(np.median(deltas))
+    if t_step <= 0:
+        raise RuntimeError("generate_scan delta swamped by noise")
+    out = {
+        "metric": "generate_scan_step",
+        "value": round(t_step * 1e6, 1),
+        "unit": "us",
+        "tok_per_s": round(b / t_step, 0),
+        "steps": f"{lo_steps}->{hi_steps}",
+        "config": (
+            f"n={n} B={b} hidden={cfg.hidden} S={s_cap} one-program "
+            "lax.scan decode (donated carries, LL state threaded)"
+        ),
+    }
+    t1 = _SHARED.get("serving_step_1l")
+    if t1:
+        out["vs_single_step"] = round(t_step / t1, 3)
     return out
 
 

@@ -202,6 +202,47 @@ class TestInt8KV:
             np.asarray(lse)[finite], np.asarray(lse_ref)[finite], atol=2e-2
         )
 
+    @pytest.mark.parametrize("lens", [(256, 200), (129, 0)])
+    def test_paged_q8_mh_aligned(self, lens):
+        """ALIGNED geometry (page and D multiples of 128) takes the
+        round-5 MULTIHEAD page walk (grid (B,), table-indexed manual
+        DMAs, `_paged_kernel_dyn_mh`) — the serving-shape kernel; the
+        smaller-page tests above exercise the widen fallback."""
+        from triton_distributed_tpu.kernels.flash_decode import (
+            paged_gqa_fwd_batch_decode_q8,
+            paged_gqa_fwd_batch_decode_q8_xla,
+            quantize_kv,
+        )
+
+        rng = np.random.default_rng(9)
+        B, HQ, HKV, D, PAGE, PAGES = 2, 8, 2, 128, 128, 2
+        npages = B * PAGES + 1
+        kp = jnp.asarray(
+            rng.standard_normal((npages, HKV, PAGE, D)), jnp.float32
+        )
+        vp = jnp.asarray(
+            rng.standard_normal((npages, HKV, PAGE, D)), jnp.float32
+        )
+        kq, ks = quantize_kv(kp)
+        vq, vs = quantize_kv(vp)
+        table = jnp.asarray(
+            rng.permutation(B * PAGES).reshape(B, PAGES).astype(np.int32)
+        )
+        q = jnp.asarray(rng.standard_normal((B, HQ, D)), jnp.float32)
+        kv_lens = jnp.asarray(lens, jnp.int32)
+        out, lse = paged_gqa_fwd_batch_decode_q8(
+            q, kq, ks, vq, vs, kv_lens, table
+        )
+        ref, lse_ref = paged_gqa_fwd_batch_decode_q8_xla(
+            q, kq, ks, vq, vs, kv_lens, table
+        )
+        assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2,
+                        rtol=2e-2)
+        finite = np.isfinite(np.asarray(lse_ref))
+        assert_allclose(
+            np.asarray(lse)[finite], np.asarray(lse_ref)[finite], atol=2e-2
+        )
+
     def test_sp_paged_q8_matches_dense(self, mesh8):
         from triton_distributed_tpu.kernels.flash_decode import (
             sp_paged_gqa_fwd_batch_decode_q8,

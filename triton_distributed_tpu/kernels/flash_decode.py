@@ -327,6 +327,278 @@ def _decode_kernel_dyn(
     )
 
 
+def _decode_kernel_dyn_mh(
+    scale, soft_cap, block_k, n_bufs, hkv, g, d, *refs,
+):
+    """MULTIHEAD dynamic-trip INT8 decode: grid (B,) — every KV head of
+    a batch row in ONE grid step.
+
+    Round-5 measurement (docs/PERF.md): at serving batch sizes the
+    per-(b, h) grid of ``_decode_kernel_dyn`` pays ~0.55 µs of
+    per-group overhead (grid step, out/lse spill, q/scale pipeline
+    fetch, state re-init) × B·Hkv = 1024 groups — roughly half the
+    kernel's time at B=128, while the same kernel at B=4 (32 groups)
+    runs at 97% of HBM SOL. Folding the Hkv heads into one step cuts
+    the group count 8×: the K/V copies become single strided DMAs
+    (Hkv contiguous (block_k, D) runs each), the softmax state blocks
+    up to (Hkv·G, ·), and the per-head compute unrolls statically.
+    Trip counts are per-ROW (all heads share kv_lens[b]) — which is
+    what makes the merge natural.
+
+    Same quant semantics as ``_decode_kernel_dyn``: int8 K/V widened
+    without scales, per-column scale folds into s and p, pipelined
+    (1, Hkv, 1, S) scale blocks, SMEM slot-rotation carry with
+    cross-row prefetch.
+    """
+    (kv_lens_ref, q_ref, k_hbm, v_hbm, ks_ref, vs_ref,
+     out_ref, lse_ref,
+     kbuf, vbuf, sem_k, sem_v, slot_ref, m_ref, l_ref, acc_ref) = refs
+    b = pl.program_id(0)
+    nb_total = pl.num_programs(0)
+    kv_len = kv_lens_ref[b]
+    nb = jnp.minimum(
+        _n_valid_blocks(kv_len, block_k),
+        k_hbm.shape[2] // block_k,
+    )
+
+    def dma(bb, j, slot):
+        win = pl.ds(j * block_k, block_k)
+        return [
+            pltpu.make_async_copy(
+                k_hbm.at[bb, :, win], kbuf.at[slot], sem_k.at[slot]
+            ),
+            pltpu.make_async_copy(
+                v_hbm.at[bb, :, win], vbuf.at[slot], sem_v.at[slot]
+            ),
+        ]
+
+    @pl.when(b == 0)
+    def _warmup():
+        slot_ref[0] = 0
+        for cp in dma(0, 0, 0):
+            cp.start()
+
+    s0 = slot_ref[0]
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def body(j, _):
+        slot = jax.lax.rem(s0 + j, n_bufs)
+        nxt = jax.lax.rem(s0 + j + 1, n_bufs)
+
+        @pl.when(j + 1 < nb)
+        def _prefetch_in_group():
+            for cp in dma(b, j + 1, nxt):
+                cp.start()
+
+        @pl.when(jnp.logical_and(j + 1 == nb, b + 1 < nb_total))
+        def _prefetch_next_group():
+            for cp in dma(b + 1, 0, nxt):
+                cp.start()
+
+        for cp in dma(b, j, slot):
+            cp.wait()
+
+        win = pl.ds(j * block_k, block_k)
+
+        def heads(masked):
+            if masked:
+                pos = j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, block_k), 1
+                )
+                valid = pos < kv_len               # (1, block_k)
+            for h in range(hkv):                   # static unroll
+                q = q_ref[0, h]                    # (G, D) bf16
+                k = kbuf[slot, h].astype(jnp.bfloat16)
+                v = vbuf[slot, h].astype(jnp.bfloat16)
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * scale                          # (G, block_k)
+                s = s * ks_ref[0, h, :, win]
+                if soft_cap > 0.0:
+                    s = soft_cap * jnp.tanh(s / soft_cap)
+                if masked:
+                    s = jnp.where(valid, s, NEG_INF)
+                lo, hi = h * g, (h + 1) * g
+                m = m_ref[lo:hi]
+                m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+                alpha = jnp.exp(m - m_new)
+                p = jnp.exp(s - m_new)
+                if masked:
+                    p = jnp.where(valid, p, 0.0)
+                l_ref[lo:hi] = alpha * l_ref[lo:hi] + jnp.sum(
+                    p, axis=1, keepdims=True
+                )
+                pv = (p * vs_ref[0, h, :, win]).astype(v.dtype)
+                acc_ref[lo:hi] = alpha * acc_ref[lo:hi] + jnp.dot(
+                    pv, v, preferred_element_type=jnp.float32
+                )
+                m_ref[lo:hi] = m_new
+
+        is_tail = jnp.logical_and(
+            j + 1 == nb, (j + 1) * block_k > kv_len
+        )
+
+        @pl.when(is_tail)
+        def _masked():
+            heads(True)
+
+        @pl.when(jnp.logical_not(is_tail))
+        def _plain():
+            heads(False)
+
+        return 0
+
+    jax.lax.fori_loop(0, nb, body, 0)
+    slot_ref[0] = jax.lax.rem(s0 + nb, n_bufs)     # hand the rotation on
+    for h in range(hkv):
+        lo, hi = h * g, (h + 1) * g
+        l = l_ref[lo:hi]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        out_ref[0, h] = (acc_ref[lo:hi] / safe_l).astype(out_ref.dtype)
+        lse_ref[0, h] = jnp.where(
+            l > 0.0, m_ref[lo:hi] + jnp.log(safe_l), jnp.full_like(l, NEG_INF)
+        )
+
+
+def _paged_kernel_dyn_mh(
+    scale, soft_cap, page, n_bufs, hkv, g, d, *refs,
+):
+    """MULTIHEAD dynamic-trip INT8 PAGED decode: grid (B,), all heads
+    per step, the page walk as in-kernel manual DMAs indexed through
+    the SMEM block table (scalar-prefetch — ``table_ref[b, j]`` picks
+    the pool slab for row b's j-th page). The paged twin of
+    :func:`_decode_kernel_dyn_mh`, for the same reason: the static
+    (B, Hkv, pages) grid pays per-group overhead ~B·Hkv× — after the
+    contiguous kernel went multihead, the paged serving step measured
+    1.39× contiguous (was 1.08× grid-vs-grid, docs/PERF.md r5).
+
+    Scale pools ride as (npages, Hkv, 1, page) ANY refs with their own
+    small manual DMAs per page block — a table-indexed fetch can't use
+    the grid pipeline (index maps change per grid step, not per inner
+    loop iteration)."""
+    (table_ref, kv_lens_ref, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
+     out_ref, lse_ref,
+     kbuf, vbuf, ksbuf, vsbuf, sem_k, sem_v, sem_ks, sem_vs,
+     slot_ref, m_ref, l_ref, acc_ref) = refs
+    b = pl.program_id(0)
+    nb_total = pl.num_programs(0)
+    npages = k_hbm.shape[0]
+    pps = table_ref.shape[1]
+    kv_len = kv_lens_ref[b]
+    nb = jnp.minimum(_n_valid_blocks(kv_len, page), pps)
+
+    def dma(bb, j, slot):
+        # row bb's j-th page; clamp to the valid range so a prefetch
+        # into a short row's padding never addresses out of pool
+        jc = jnp.minimum(
+            j, jnp.maximum(_n_valid_blocks(kv_lens_ref[bb], page) - 1, 0)
+        )
+        pid = jnp.clip(table_ref[bb, jc], 0, npages - 1)
+        return [
+            pltpu.make_async_copy(
+                k_hbm.at[pid], kbuf.at[slot], sem_k.at[slot]
+            ),
+            pltpu.make_async_copy(
+                v_hbm.at[pid], vbuf.at[slot], sem_v.at[slot]
+            ),
+            pltpu.make_async_copy(
+                ks_hbm.at[pid], ksbuf.at[slot], sem_ks.at[slot]
+            ),
+            pltpu.make_async_copy(
+                vs_hbm.at[pid], vsbuf.at[slot], sem_vs.at[slot]
+            ),
+        ]
+
+    @pl.when(b == 0)
+    def _warmup():
+        slot_ref[0] = 0
+        for cp in dma(0, 0, 0):
+            cp.start()
+
+    s0 = slot_ref[0]
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def body(j, _):
+        slot = jax.lax.rem(s0 + j, n_bufs)
+        nxt = jax.lax.rem(s0 + j + 1, n_bufs)
+
+        @pl.when(j + 1 < nb)
+        def _prefetch_in_group():
+            for cp in dma(b, j + 1, nxt):
+                cp.start()
+
+        @pl.when(jnp.logical_and(j + 1 == nb, b + 1 < nb_total))
+        def _prefetch_next_group():
+            for cp in dma(b + 1, 0, nxt):
+                cp.start()
+
+        for cp in dma(b, j, slot):
+            cp.wait()
+
+        def heads(masked):
+            if masked:
+                pos = j * page + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, page), 1
+                )
+                valid = pos < kv_len
+            for h in range(hkv):                   # static unroll
+                q = q_ref[0, h]                    # (G, D) bf16
+                k = kbuf[slot, h].astype(jnp.bfloat16)
+                v = vbuf[slot, h].astype(jnp.bfloat16)
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * scale
+                s = s * ksbuf[slot, h]             # (1, page)
+                if soft_cap > 0.0:
+                    s = soft_cap * jnp.tanh(s / soft_cap)
+                if masked:
+                    s = jnp.where(valid, s, NEG_INF)
+                lo, hi = h * g, (h + 1) * g
+                m = m_ref[lo:hi]
+                m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+                alpha = jnp.exp(m - m_new)
+                p = jnp.exp(s - m_new)
+                if masked:
+                    p = jnp.where(valid, p, 0.0)
+                l_ref[lo:hi] = alpha * l_ref[lo:hi] + jnp.sum(
+                    p, axis=1, keepdims=True
+                )
+                pv = (p * vsbuf[slot, h]).astype(v.dtype)
+                acc_ref[lo:hi] = alpha * acc_ref[lo:hi] + jnp.dot(
+                    pv, v, preferred_element_type=jnp.float32
+                )
+                m_ref[lo:hi] = m_new
+
+        is_tail = jnp.logical_and(j + 1 == nb, (j + 1) * page > kv_len)
+
+        @pl.when(is_tail)
+        def _masked():
+            heads(True)
+
+        @pl.when(jnp.logical_not(is_tail))
+        def _plain():
+            heads(False)
+
+        return 0
+
+    jax.lax.fori_loop(0, nb, body, 0)
+    slot_ref[0] = jax.lax.rem(s0 + nb, n_bufs)
+    for h in range(hkv):
+        lo, hi = h * g, (h + 1) * g
+        l = l_ref[lo:hi]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        out_ref[0, h] = (acc_ref[lo:hi] / safe_l).astype(out_ref.dtype)
+        lse_ref[0, h] = jnp.where(
+            l > 0.0, m_ref[lo:hi] + jnp.log(safe_l), jnp.full_like(l, NEG_INF)
+        )
+
+
 def pick_block_k(s_len: int, requested: int, *, head_dim: int = 128,
                  itemsize: int = 2) -> int:
     """Largest divisor of ``s_len`` ≤ ``requested``, preferring sublane
@@ -541,12 +813,14 @@ def _q8_auto_block_k(batch, hkv, s_len):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "soft_cap", "block_k", "n_bufs", "interpret"),
+    static_argnames=("scale", "soft_cap", "block_k", "n_bufs", "multihead",
+                     "interpret"),
 )
 def gqa_fwd_batch_decode_q8(
     q, k_q, k_scale, v_q, v_scale, kv_lens, *,
     scale: float | None = None, soft_cap: float = 0.0,
-    block_k: int | None = None, n_bufs: int = 4, interpret=None,
+    block_k: int | None = None, n_bufs: int = 4, multihead: bool = True,
+    interpret=None,
 ):
     """Local GQA decode over an INT8 KV cache → (out, lse).
 
@@ -558,7 +832,11 @@ def gqa_fwd_batch_decode_q8(
     ride the grid pipeline, not per-block DMAs (see
     ``_decode_kernel_dyn``'s quant mode). ``n_bufs``: KV slot depth —
     4 keeps the DMA engine fed across short (1-2 block) rows where
-    double buffering drains at every group boundary.
+    double buffering drains at every group boundary. ``multihead``
+    (default): grid (B,) with all Hkv heads per step — 8× fewer grid
+    groups, the round-5 fix for the per-group overhead that dominated
+    the serving shape (``_decode_kernel_dyn_mh``); False keeps the
+    per-(b, h) grid (comparison/debug).
     """
     batch, hq, d = q.shape
     _, hkv, s_len, _ = k_q.shape
@@ -581,38 +859,90 @@ def gqa_fwd_batch_decode_q8(
         )
 
     qg = q.reshape(batch, hkv, g, d).astype(jnp.bfloat16)
-    kernel = functools.partial(
-        _decode_kernel_dyn, scale, soft_cap, block_k, n_bufs, g, d, True
-    )
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(batch, hkv),
-        in_specs=[
-            pl.BlockSpec((1, 1, g, d), lambda b, h, lens: (b, h, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-            # scale planes (B, Hkv, 1, S): whole per-(b, h) rows on the
-            # grid pipeline — serving walks are DMA-COUNT bound, and
-            # per-block 4 KB scale copies doubled the count (see
-            # _decode_kernel_dyn's quant note)
-            pl.BlockSpec((1, 1, 1, s_len), lambda b, h, lens: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, 1, s_len), lambda b, h, lens: (b, h, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, g, d), lambda b, h, lens: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, g, 1), lambda b, h, lens: (b, h, 0, 0)),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((n_bufs, block_k, d), jnp.int8),
-            pltpu.VMEM((n_bufs, block_k, d), jnp.int8),
-            pltpu.SemaphoreType.DMA((n_bufs,)),
-            pltpu.SemaphoreType.DMA((n_bufs,)),
-            pltpu.SMEM((1,), jnp.int32),
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, d), jnp.float32),
-        ],
-    )
+    ks4 = k_scale.astype(jnp.float32).reshape(batch, hkv, 1, s_len)
+    vs4 = v_scale.astype(jnp.float32).reshape(batch, hkv, 1, s_len)
+    # multihead KV slots are Hkv× bigger: keep them within the default
+    # 16 MB scoped-VMEM limit (shallower buffering first, then raise
+    # the limit — a bk=2048 four-deep config measured 84 KB over it)
+    def _kv_bytes(nb):
+        return 2 * nb * hkv * block_k * d
+
+    while multihead and n_bufs > 2 and _kv_bytes(n_bufs) > 12 * 1024 * 1024:
+        n_bufs -= 1
+    vmem_limit = None
+    if multihead and _kv_bytes(n_bufs) > 12 * 1024 * 1024:
+        vmem_limit = _kv_bytes(n_bufs) + 8 * 1024 * 1024
+    if multihead:
+        kernel = functools.partial(
+            _decode_kernel_dyn_mh, scale, soft_cap, block_k, n_bufs,
+            hkv, g, d,
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch,),
+            in_specs=[
+                pl.BlockSpec((1, hkv, g, d), lambda b, lens: (b, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                # whole per-row scale planes on the grid pipeline (see
+                # _decode_kernel_dyn's quant note)
+                pl.BlockSpec(
+                    (1, hkv, 1, s_len), lambda b, lens: (b, 0, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, hkv, 1, s_len), lambda b, lens: (b, 0, 0, 0)
+                ),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, hkv, g, d), lambda b, lens: (b, 0, 0, 0)),
+                pl.BlockSpec((1, hkv, g, 1), lambda b, lens: (b, 0, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((n_bufs, hkv, block_k, d), jnp.int8),
+                pltpu.VMEM((n_bufs, hkv, block_k, d), jnp.int8),
+                pltpu.SemaphoreType.DMA((n_bufs,)),
+                pltpu.SemaphoreType.DMA((n_bufs,)),
+                pltpu.SMEM((1,), jnp.int32),
+                pltpu.VMEM((hkv * g, 1), jnp.float32),
+                pltpu.VMEM((hkv * g, 1), jnp.float32),
+                pltpu.VMEM((hkv * g, d), jnp.float32),
+            ],
+        )
+        dims = ("arbitrary",)
+    else:
+        kernel = functools.partial(
+            _decode_kernel_dyn, scale, soft_cap, block_k, n_bufs, g, d, True
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch, hkv),
+            in_specs=[
+                pl.BlockSpec((1, 1, g, d), lambda b, h, lens: (b, h, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(
+                    (1, 1, 1, s_len), lambda b, h, lens: (b, h, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, 1, s_len), lambda b, h, lens: (b, h, 0, 0)
+                ),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, g, d), lambda b, h, lens: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, g, 1), lambda b, h, lens: (b, h, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((n_bufs, block_k, d), jnp.int8),
+                pltpu.VMEM((n_bufs, block_k, d), jnp.int8),
+                pltpu.SemaphoreType.DMA((n_bufs,)),
+                pltpu.SemaphoreType.DMA((n_bufs,)),
+                pltpu.SMEM((1,), jnp.int32),
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, d), jnp.float32),
+            ],
+        )
+        dims = ("arbitrary", "arbitrary")
     call = shmem_call(
         kernel,
         grid_spec=grid_spec,
@@ -621,15 +951,14 @@ def gqa_fwd_batch_decode_q8(
             jax.ShapeDtypeStruct((batch, hkv, g, 1), jnp.float32),
         ],
         collective_id=None,
+        vmem_limit_bytes=vmem_limit,
         interpret=local_interpret() if interpret is None else interpret,
-        name="gqa_decode_split_kv_q8",
-        dimension_semantics=("arbitrary", "arbitrary"),
+        name="gqa_decode_split_kv_q8" + ("_mh" if multihead else ""),
+        # slot-rotation carries + cross-step DMA prefetch require
+        # SEQUENTIAL grid execution
+        dimension_semantics=dims,
     )
-    out, lse = call(
-        kv_lens.astype(jnp.int32), qg, k_q, v_q,
-        k_scale.astype(jnp.float32).reshape(batch, hkv, 1, s_len),
-        v_scale.astype(jnp.float32).reshape(batch, hkv, 1, s_len),
-    )
+    out, lse = call(kv_lens.astype(jnp.int32), qg, k_q, v_q, ks4, vs4)
     return out.reshape(batch, hq, d), lse.reshape(batch, hq)
 
 
@@ -695,11 +1024,11 @@ def paged_gqa_fwd_batch_decode(
     assert v_pool.shape == k_pool.shape, (k_pool.shape, v_pool.shape)
     assert hq % hkv == 0, f"GQA needs Hq % Hkv == 0, got {hq} % {hkv}"
     g = hq // hkv
-    pages_per_seq = block_table.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
 
     qg = q.reshape(batch, hkv, g, d)
+    pages_per_seq = block_table.shape[1]
     grid = (batch, hkv, pages_per_seq)
 
     def kv_map(b, h, j, table_ref, lens_ref):
@@ -751,21 +1080,6 @@ def paged_gqa_fwd_batch_decode(
     return out.reshape(batch, hq, d), lse.reshape(batch, hq)
 
 
-def _paged_decode_kernel_q8(
-    scale, soft_cap, page, table_ref, kv_lens_ref, q_ref, k_ref, v_ref,
-    ks_ref, vs_ref, out_ref, lse_ref, m_ref, l_ref, acc_ref,
-):
-    """INT8 scalar-prefetch adapter: page-table-driven KV blocks plus
-    their (1, 1, 1, page) scale windows, delegating to the static
-    kernel's quant folds."""
-    del table_ref
-    _decode_kernel(
-        scale, soft_cap, page, kv_lens_ref, q_ref, k_ref, v_ref,
-        out_ref, lse_ref, m_ref, l_ref, acc_ref,
-        ks_ref=ks_ref, vs_ref=vs_ref,
-    )
-
-
 @functools.partial(
     jax.jit, static_argnames=("scale", "soft_cap", "interpret")
 )
@@ -787,7 +1101,6 @@ def paged_gqa_fwd_batch_decode_q8(
     assert v_pool.shape == k_pool.shape, (k_pool.shape, v_pool.shape)
     assert hq % hkv == 0, f"GQA needs Hq % Hkv == 0, got {hq} % {hkv}"
     g = hq // hkv
-    pages_per_seq = block_table.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
 
@@ -805,53 +1118,63 @@ def paged_gqa_fwd_batch_decode_q8(
         )
 
     qg = q.reshape(batch, hkv, g, d).astype(jnp.bfloat16)
-    grid = (batch, hkv, pages_per_seq)
 
-    def kv_map(b, h, j, table_ref, lens_ref):
-        # same double clamp as the non-q8 kernel's kv_map: steps past
-        # the last valid page revisit it (length-aware skipping, and
-        # clamped steps never consult possibly -1-padded table
-        # entries), and the table lookup never addresses out of pool
-        jc = jnp.minimum(j, _n_valid_blocks(lens_ref[b], page) - 1)
-        return (jnp.clip(table_ref[b, jc], 0, npages - 1), h, 0, 0)
-
-    # the scale windows ride the SAME page walk (leading dims pick the
-    # page; only the block shape differs)
-    kv_spec = pl.BlockSpec((1, 1, page, d), kv_map)
-    sc_spec = pl.BlockSpec((1, 1, 1, page), kv_map)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=grid,
+    # MULTIHEAD page walk (grid (B,), manual table-indexed DMAs): 8×
+    # fewer grid groups than the static (B, Hkv, pages) grid — the
+    # per-group overhead fix of _decode_kernel_dyn_mh applied to the
+    # paged mode (see _paged_kernel_dyn_mh)
+    n_bufs = 4
+    while n_bufs > 2 and 2 * n_bufs * hkv * page * d > 12 * 1024 * 1024:
+        n_bufs -= 1
+    vmem_limit = None
+    if 2 * n_bufs * hkv * page * d > 12 * 1024 * 1024:
+        vmem_limit = 2 * n_bufs * hkv * page * d + 8 * 1024 * 1024
+    mh_kernel = functools.partial(
+        _paged_kernel_dyn_mh, scale, soft_cap, page, n_bufs, hkv, g, d
+    )
+    mh_grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                 # block table, kv_lens
+        grid=(batch,),
         in_specs=[
-            pl.BlockSpec(
-                (1, 1, g, d), lambda b, h, j, t_, l_: (b, h, 0, 0)
-            ),
-            kv_spec,
-            kv_spec,
-            sc_spec,
-            sc_spec,
+            pl.BlockSpec((1, hkv, g, d), lambda b, t_, l_: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, g, d), lambda b, h, j, t_, l_: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, g, 1), lambda b, h, j, t_, l_: (b, h, 0, 0)),
+            pl.BlockSpec((1, hkv, g, d), lambda b, t_, l_: (b, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, g, 1), lambda b, t_, l_: (b, 0, 0, 0)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((n_bufs, hkv, page, d), jnp.int8),
+            pltpu.VMEM((n_bufs, hkv, page, d), jnp.int8),
+            pltpu.VMEM((n_bufs, hkv, 1, page), jnp.float32),
+            pltpu.VMEM((n_bufs, hkv, 1, page), jnp.float32),
+            pltpu.SemaphoreType.DMA((n_bufs,)),
+            pltpu.SemaphoreType.DMA((n_bufs,)),
+            pltpu.SemaphoreType.DMA((n_bufs,)),
+            pltpu.SemaphoreType.DMA((n_bufs,)),
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.VMEM((hkv * g, 1), jnp.float32),
+            pltpu.VMEM((hkv * g, 1), jnp.float32),
+            pltpu.VMEM((hkv * g, d), jnp.float32),
         ],
     )
-    call = pl.pallas_call(
-        functools.partial(_paged_decode_kernel_q8, scale, soft_cap, page),
-        grid_spec=grid_spec,
+    mh_call = shmem_call(
+        mh_kernel,
+        grid_spec=mh_grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((batch, hkv, g, d), q.dtype),
             jax.ShapeDtypeStruct((batch, hkv, g, 1), jnp.float32),
         ],
+        collective_id=None,
+        vmem_limit_bytes=vmem_limit,
         interpret=local_interpret() if interpret is None else interpret,
-        name="gqa_decode_paged_q8",
+        name="gqa_decode_paged_q8_mh",
+        dimension_semantics=("arbitrary",),   # slot carry is sequential
     )
-    out, lse = call(
+    out, lse = mh_call(
         block_table.astype(jnp.int32), kv_lens.astype(jnp.int32),
         qg, k_pool, v_pool,
         k_scale.astype(jnp.float32).reshape(npages, hkv, 1, page),
