@@ -2,14 +2,29 @@
 random comm-stream sleep, allgather.py:72-77: prove consumers truly wait
 on signals by widening race windows)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from triton_distributed_tpu.config import config
-from triton_distributed_tpu.kernels import all_gather, all_to_all, reduce_scatter
-from triton_distributed_tpu.runtime import AllGatherMethod
+from triton_distributed_tpu.kernels import (
+    AGGemmMethod,
+    GemmRSMethod,
+    ag_gemm,
+    all_gather,
+    all_to_all,
+    gemm_rs,
+    reduce_scatter,
+)
+from triton_distributed_tpu.kernels.flash_decode import (
+    gqa_fwd_batch_decode_xla,
+    sp_gqa_fwd_batch_decode,
+)
+from triton_distributed_tpu.runtime import AllGatherMethod, Delay, FaultPlan, fault_plan
 from triton_distributed_tpu.utils import assert_allclose
+
+pytestmark = pytest.mark.chaos
 
 
 @pytest.fixture()
@@ -37,6 +52,66 @@ def test_all_to_all_under_chaos(mesh8, chaos):
     x = jnp.arange(64 * 128, dtype=jnp.float32).reshape(64, 128)
     y = all_to_all(all_to_all(x, mesh8, "x"), mesh8, "x")
     assert_allclose(y, x)
+
+
+def test_ag_gemm_under_chaos(mesh8, chaos):
+    """Fused AG-GEMM under comm delays: the consumer GEMM must truly
+    wait on the ring's signals for every slab it reads (the ``chaos=``
+    leg of the builder cache key, previously untested)."""
+    a = jax.random.normal(jax.random.PRNGKey(1), (64, 32), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(2), (32, 128), jnp.float32)
+    c = ag_gemm(a, b, mesh8, "x", method=AGGemmMethod.PALLAS_FUSED)
+    ref = jnp.dot(a, b, preferred_element_type=jnp.float32)
+    assert_allclose(np.asarray(c, np.float32), np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+
+def test_gemm_rs_under_chaos(mesh8, chaos):
+    """Fused GEMM-RS under comm delays: every reduced stripe must wait
+    on its producer's signal before the scatter consumes it."""
+    a = jax.random.normal(jax.random.PRNGKey(3), (64, 32), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(4), (32, 48), jnp.float32)
+    c = gemm_rs(a, b, mesh8, "x", method=GemmRSMethod.PALLAS_FUSED)
+    ref = jnp.dot(a, b, preferred_element_type=jnp.float32)
+    assert_allclose(np.asarray(c, np.float32), np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+
+def test_ag_gemm_under_seeded_fault_plan(mesh8):
+    """Same site through the fault engine instead of the global boolean:
+    seeded per-(rank, step) delays on the ag_gemm ring stay bit-correct
+    and replay identically (plan is in the builder cache key)."""
+    a = jax.random.normal(jax.random.PRNGKey(1), (64, 32), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(2), (32, 128), jnp.float32)
+    ref = np.asarray(jnp.dot(a, b, preferred_element_type=jnp.float32))
+    plan = FaultPlan(seed=13, faults=(
+        Delay(site="ag_gemm", cycles=60_000, jitter=0.8),
+    ))
+    runs = []
+    for _ in range(2):
+        with fault_plan(plan):
+            runs.append(np.asarray(ag_gemm(
+                a, b, mesh8, "x", method=AGGemmMethod.PALLAS_FUSED
+            ), np.float32))
+    assert_allclose(runs[0], ref, atol=1e-4, rtol=1e-4)
+    np.testing.assert_array_equal(runs[0], runs[1])
+
+
+def test_flash_decode_combine_under_chaos(mesh8, chaos):
+    """SP flash-decode under chaos: the delay widens the slot-rotation
+    window between KV prefetch issue and wait inside the local decode,
+    and the cross-rank (out, lse) combine must still merge partial
+    ranks to the dense answer."""
+    B, Hq, Hkv, D, S = 2, 8, 2, 128, 1024
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(kq, (B, Hq, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S, Hkv, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S, Hkv, D), jnp.float32)
+    lens = jnp.asarray([700, 130], jnp.int32)   # partial + near-empty ranks
+    out = sp_gqa_fwd_batch_decode(
+        q, k, v, lens, mesh8, "x", use_pallas=True, block_k=128,
+        kv_layout="bshd",
+    )
+    out_ref, _ = gqa_fwd_batch_decode_xla(q, k, v, lens, kv_layout="bshd")
+    assert_allclose(np.asarray(out), np.asarray(out_ref), atol=3e-5, rtol=3e-5)
 
 
 def test_moe_a2a_under_chaos(mesh8, chaos):

@@ -180,6 +180,70 @@ class TestServingShardResidency:
         assert_args_aliased(comp2, (x,), lambda a: a[0])
         assert 0 in input_output_aliased_params(comp2)
 
+    def test_decode_boundary_violation_raises(self, mesh2x4):
+        """The ISSUE-1 negative path on the REAL decode program (not a
+        synthetic lambda): caches living off the canonical placement
+        must make ``assert_no_involuntary_resharding`` raise with the
+        offending leaf paths in the message."""
+        model, params = _model(mesh2x4)
+        b = 4
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (b, 16), 0, 128),
+            NamedSharding(mesh2x4, P("dp")),
+        )
+        caches = model.init_cache(b, 32)
+        last, caches, lens = model._prefill_jit(params, caches, tokens)
+        first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        args = (params, caches, lens, first)
+        comp = model._decode_jit.lower(
+            *model.decode_abstract_args(*args)
+        ).compile()
+        bad_caches = jax.tree.map(
+            lambda x: jax.device_put(
+                np.asarray(x), NamedSharding(mesh2x4, P())
+            ),
+            caches,
+        )
+        with pytest.raises(AssertionError, match="involuntary resharding"):
+            assert_no_involuntary_resharding(
+                comp, (params, bad_caches, lens, first), min_bytes=0
+            )
+
+    def test_reshard_guard_min_bytes_filters_small_leaves(self, mesh2x4):
+        """Leaves below ``min_bytes`` are exempt: resharding a few KB per
+        call is noise, and flagging it would make the guard uninhabitable
+        for scalar step counters and lens vectors."""
+        want = NamedSharding(mesh2x4, P("dp", None))
+        have = NamedSharding(mesh2x4, P(None, "tp"))
+        comp = jax.jit(lambda a: a * 2).lower(
+            jax.ShapeDtypeStruct((8, 8), jnp.float32, sharding=want)
+        ).compile()
+        x = jax.device_put(jnp.zeros((8, 8), jnp.float32), have)
+        # 256 bytes: flagged at min_bytes=0, exempt at the 1 MiB default
+        assert find_involuntary_resharding(comp, (x,), min_bytes=0)
+        assert_no_involuntary_resharding(comp, (x,))
+
+    def test_guard_rejects_mismatched_arg_tree(self, mesh2x4):
+        """Passing a different argument tree than the program was
+        lowered with must be a loud ValueError, not a silent mispairing
+        of leaves with parameter shardings."""
+        sh = NamedSharding(mesh2x4, P())
+        comp = jax.jit(lambda a, b: a + b).lower(
+            jax.ShapeDtypeStruct((8,), jnp.float32, sharding=sh),
+            jax.ShapeDtypeStruct((8,), jnp.float32, sharding=sh),
+        ).compile()
+        x = jax.device_put(jnp.zeros((8,), jnp.float32), sh)
+        with pytest.raises(ValueError, match="does not match the compiled"):
+            find_involuntary_resharding(comp, (x,), min_bytes=0)
+
+    def test_leaf_range_rejects_foreign_selector(self):
+        from triton_distributed_tpu.runtime.shardguard import leaf_range
+
+        args = (jnp.zeros((4,)), jnp.zeros((8,)))
+        assert leaf_range(args, lambda a: a[1]) == range(1, 2)
+        with pytest.raises(ValueError, match="top-level args"):
+            leaf_range(args, lambda a: "not an arg")
+
     def test_alias_guard_handles_dropped_unused_args(self, mesh2x4):
         """jit(keep_unused=False) drops unused argument leaves from the
         compiled signature — the guards must renumber through the kept

@@ -196,7 +196,8 @@ def _fused_kernel(
         )(a_hbm, b_hbm, out_hbm)
 
     ag_forward_ring(
-        n, axis, mesh_axes, x_hbm, ag_hbm, m, send_sem, recv_sem, consume
+        n, axis, mesh_axes, x_hbm, ag_hbm, m, send_sem, recv_sem, consume,
+        site="ag_gemm",
     )
     if publish_local:
         cp.wait()
@@ -297,7 +298,10 @@ def _build_fused(
         if dcn_axis is not None and nd > 1 else None
     )
     if dcn_axis is None:
-        body = mk_call(m_gathered, blocks, collective_id)
+        body = lang.maybe_instrument(
+            mk_call(m_gathered, blocks, collective_id),
+            axis=axis, site="ag_gemm", collective_id=collective_id, n=n,
+        )
     elif chunk_blocks is None:
         call = mk_call(m_gathered, blocks, collective_id)
 
@@ -478,8 +482,17 @@ def auto_ag_gemm_method(mesh, axis, a, b, dp: int = 1,
     cross-slice factor as ``dcn_axis`` for the hierarchical engine) or on
     shapes with no divisor blocking — and the fallback is *logged* so
     nobody silently benchmarks XLA believing it is the fused kernel."""
+    from triton_distributed_tpu.config import pallas_collectives_available
+
     n = mesh.shape[axis]
     nd = mesh.shape[dcn_axis] if dcn_axis else 1
+    if not pallas_collectives_available():
+        _warn_once(
+            ("ag_gemm", "nosim"),
+            "ag_gemm: Pallas collectives unavailable off-TPU (jax lacks "
+            "the TPU-simulation interpreter); using XLA_RING engine",
+        )
+        return AGGemmMethod.XLA_RING
     topo = detect_topology(mesh, axis)
     if topo.link_kind == LinkKind.DCN:
         _warn_once(

@@ -38,7 +38,7 @@ def _a2a_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
     for i in range(n - 1):
         pi = jax.lax.rem(me + 1 + i, n)
         peer = lang.pe_flat(axis, pi, mesh_axes)
-        chaos_delay()
+        chaos_delay(site="all_to_all", step=i, me=me, n=n)
         handles.append(
             lang.putmem_signal_nbi_block(
                 out_ref.at[pl.ds(me * m, m)],      # lands in peer's slot `me`
@@ -62,7 +62,7 @@ def _build_a2a_call(mesh_axes, axis, n, local_shape, dtype, collective_id,
     assert local_shape[0] % n == 0, (
         f"per-device rows {local_shape[0]} not divisible by {n}"
     )
-    return lang.shmem_call(
+    call = lang.shmem_call(
         functools.partial(_a2a_kernel, n, axis, mesh_axes),
         out_shape=jax.ShapeDtypeStruct(local_shape, dtype),
         in_specs=lang.vmem_specs(1),
@@ -72,6 +72,9 @@ def _build_a2a_call(mesh_axes, axis, n, local_shape, dtype, collective_id,
         ],
         collective_id=collective_id,
         name="a2a_dense",
+    )
+    return lang.maybe_instrument(
+        call, axis=axis, site="all_to_all", collective_id=collective_id, n=n
     )
 
 
@@ -106,6 +109,11 @@ def _build_all_to_all(mesh, axis, shape, dtype, collective_id, chaos):
 def all_to_all(x, mesh, axis: str = "x", *, collective_id: int = 4):
     """Equal-split AllToAll along dim 0 (row block j of device i → row block
     i of device j). Input/output sharded P(axis) on dim 0."""
+    from triton_distributed_tpu.config import pallas_collectives_available
+
+    if not pallas_collectives_available():
+        # off-TPU without the TPU-simulation interpreter: XLA-native twin
+        return all_to_all_xla(x, mesh, axis)
     n = mesh.shape[axis]
     if n == 1:
         return x

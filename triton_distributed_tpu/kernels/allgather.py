@@ -32,7 +32,10 @@ from triton_distributed_tpu.runtime import (
     detect_topology,
     ring_neighbors,
 )
+from triton_distributed_tpu.runtime import faults as _faults
 from triton_distributed_tpu.utils.testing import chaos_delay
+
+_SITE = "allgather"     # fault-plan / watchdog site for every AG engine
 
 
 def _ring_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
@@ -44,7 +47,11 @@ def _ring_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
     left, right = lang.pe_flat(axis, left, mesh_axes), lang.pe_flat(axis, right, mesh_axes)
 
     out_ref[pl.ds(me * m, m)] = x_ref[:]
-    lang.neighbor_barrier(axis, left, right)
+    # payload-corruption hook: the local slab is both what the ring
+    # forwards and what lands in the result, so a corrupted word here
+    # propagates exactly like a corrupted wire payload would
+    _faults.maybe_corrupt(out_ref, _SITE, me, n, row_off=me * m)
+    lang.neighbor_barrier(axis, left, right, site=_SITE, me=me, n=n)
 
     # One semaphore slot per step: a slot's credit can then only come from
     # that step's DMA, so a wait being satisfied proves that *specific*
@@ -52,7 +59,7 @@ def _ring_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
     # earlier wait while its data is still in flight).
     for s in range(n - 1):
         src = jax.lax.rem(me + n - s, n) if s > 0 else me
-        chaos_delay()
+        chaos_delay(site=_SITE, step=s, me=me, n=n)
         dma = lang.remote_copy(
             out_ref.at[pl.ds(src * m, m)],
             out_ref.at[pl.ds(src * m, m)],
@@ -76,14 +83,14 @@ def _ring_bidir_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem
     left, right = lang.pe_flat(axis, left, mesh_axes), lang.pe_flat(axis, right, mesh_axes)
 
     out_ref[pl.ds(me * m, m)] = x_ref[:]
-    lang.neighbor_barrier(axis, left, right)
+    lang.neighbor_barrier(axis, left, right, site=_SITE, me=me, n=n)
 
     # Per-step distinct semaphore slots (see _ring_ag_kernel): cw uses
     # slots [0, n-1), ccw uses [n-1, 2(n-1)).
     for s in range(n - 1):
         cw_src = jax.lax.rem(me + n - s, n)   # shard forwarded clockwise
         ccw_src = jax.lax.rem(me + s, n)      # shard forwarded counter-clockwise
-        chaos_delay()
+        chaos_delay(site=_SITE, step=s, me=me, n=n)
         cw = lang.remote_copy(
             out_ref.at[pl.ds(cw_src * m, m), pl.ds(0, kh)],
             out_ref.at[pl.ds(cw_src * m, m), pl.ds(0, kh)],
@@ -114,12 +121,13 @@ def _ll_push_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
     m = x_ref.shape[0]
 
     out_ref[pl.ds(me * m, m)] = x_ref[:]
+    _faults.maybe_corrupt(out_ref, _SITE, me, n, row_off=me * m)
     lang.barrier_all(axis, mesh_axes)
 
     handles = []
     for i in range(n - 1):
         peer = lang.pe_flat(axis, jax.lax.rem(me + 1 + i, n), mesh_axes)
-        chaos_delay()
+        chaos_delay(site=_SITE, step=i, me=me, n=n)
         handles.append(
             lang.putmem_signal_nbi_block(
                 out_ref.at[pl.ds(me * m, m)],
@@ -181,7 +189,7 @@ def _ll_persist_kernel(
     handles = []
     for i in range(n - 1):
         peer = lang.pe_flat(axis, jax.lax.rem(me + 1 + i, n), mesh_axes)
-        chaos_delay()
+        chaos_delay(site=_SITE, step=i, me=me, n=n)
         handles.append(
             lang.putmem_signal_nbi_block(
                 ws_out.at[pl.ds(base + me * m, m)],   # peer's slot `me`
@@ -217,8 +225,15 @@ def _build_all_gather(mesh, axis, method, shape, dtype, collective_id, chaos):
     invocation would rebuild pallas_call+shard_map+jit and retrace."""
     n = mesh.shape[axis]
     if method == AllGatherMethod.XLA_FALLBACK:
-        fn = jax.shard_map(
+        # instrumented like the Pallas engines: an XLA collective can
+        # wedge too (DCN partner loss), and the watchdog/stall hooks are
+        # pure host callbacks — no Pallas machinery needed
+        body = lang.maybe_instrument(
             lambda s: jax.lax.all_gather(s, axis, tiled=True),
+            axis=axis, site=_SITE, collective_id=collective_id, n=n,
+        )
+        fn = jax.shard_map(
+            body,
             mesh=mesh,
             in_specs=P(axis),
             out_specs=P(None),
@@ -238,6 +253,9 @@ def _build_all_gather(mesh, axis, method, shape, dtype, collective_id, chaos):
         ],
         collective_id=collective_id,
         name=f"ag_{method.value}",
+    )
+    call = lang.maybe_instrument(
+        call, axis=axis, site=_SITE, collective_id=collective_id, n=n
     )
     fn = jax.shard_map(
         call, mesh=mesh, in_specs=P(axis), out_specs=P(None), check_vma=False
@@ -283,6 +301,9 @@ def _build_ll_persist(mesh, axis, m_local, k, dtype, collective_id, chaos,
         # doesn't (collective_id arg kept for the state cache key only)
         collective_id=None,
         name="ag_ll_persist",
+    )
+    call = lang.maybe_instrument(
+        call, axis=axis, site=_SITE, collective_id=collective_id, n=n
     )
     fn = jax.shard_map(
         call,
@@ -386,9 +407,19 @@ def all_gather(
     if n == 1:
         return x
     if method is None:
+        from triton_distributed_tpu.config import pallas_collectives_available
         from triton_distributed_tpu.runtime.topology import LinkKind
         from triton_distributed_tpu.tune.autotuner import tuned_method_or_none
 
+        if not pallas_collectives_available():
+            # off-TPU on a jax without the TPU-simulation interpreter:
+            # the Pallas engines cannot execute — degrade to XLA
+            method = AllGatherMethod.XLA_FALLBACK
+            fn = _build_all_gather(
+                mesh, axis, method, x.shape, x.dtype, collective_id,
+                interp_key(),
+            )
+            return fn(x)
         topo = detect_topology(mesh, axis)
         if topo.link_kind == LinkKind.DCN:
             # Pallas remote DMA cannot cross DCN: never bench Pallas

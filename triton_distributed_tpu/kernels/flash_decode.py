@@ -35,8 +35,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
-from triton_distributed_tpu.config import local_interpret
+from triton_distributed_tpu.config import interp_key, local_interpret
 from triton_distributed_tpu.lang.launch import shmem_call
+from triton_distributed_tpu.utils.testing import chaos_delay
 
 NEG_INF = -1.0e30  # finite -inf stand-in: exp(NEG_INF - m) == 0 without NaNs
 
@@ -397,6 +398,9 @@ def _decode_kernel_dyn_mh(
             for cp in dma(b + 1, 0, nxt):
                 cp.start()
 
+        # chaos hook: widen the slot-rotation window between the
+        # prefetch issues and this block's wait (the race-prone carry)
+        chaos_delay(site="flash_decode", step=None, me=None, n=None)
         for cp in dma(b, j, slot):
             cp.wait()
 
@@ -1411,9 +1415,12 @@ def _sp_specs(axis, batch_axes):
 
 @functools.lru_cache(maxsize=64)
 def _sp_decode_fns(mesh, axis, scale, soft_cap, block_k, use_pallas,
-                   kv_layout, batch_axes=()):
+                   kv_layout, batch_axes=(), ikey=()):
     """Jitted (local, merge) pair for :func:`sp_gqa_fwd_batch_decode`,
-    cached so repeated decode steps don't retrace/recompile."""
+    cached so repeated decode steps don't retrace/recompile. ``ikey``
+    is ``config.interp_key()`` — chaos/fault knobs are traced into the
+    local decode kernel, so toggling them must rebuild (the same
+    convention as every collective builder)."""
     # Two dispatches, not one: on the CPU-interpreter path, mixing the
     # io_callback-driven Pallas simulation and an XLA collective in a single
     # program can starve the collective rendezvous threads (deadlock). On
@@ -1466,7 +1473,7 @@ def sp_gqa_fwd_batch_decode(
     """
     local_fn, merge_fn = _sp_decode_fns(
         mesh, axis, scale, soft_cap, block_k, use_pallas, kv_layout,
-        tuple(batch_axes),
+        tuple(batch_axes), interp_key(),
     )
     out, lse = local_fn(q, k_cache, v_cache, global_kv_lens)
     out, lse = merge_fn(out, lse)
@@ -1506,7 +1513,7 @@ def sp_gqa_fwd_batch_decode_q8_device(
 
 
 @functools.lru_cache(maxsize=64)
-def _sp_q8_fns(mesh, axis, scale, soft_cap, block_k, batch_axes=()):
+def _sp_q8_fns(mesh, axis, scale, soft_cap, block_k, batch_axes=(), ikey=()):
     """Jitted (local, merge) pair for the INT8 SP decode — split into
     two dispatches for the interpreter-deadlock reason documented at
     :func:`_sp_decode_fns`."""
@@ -1554,7 +1561,7 @@ def sp_gqa_fwd_batch_decode_q8(
     and on the attention DMA stream.
     """
     local_fn, merge_fn = _sp_q8_fns(
-        mesh, axis, scale, soft_cap, block_k, tuple(batch_axes)
+        mesh, axis, scale, soft_cap, block_k, tuple(batch_axes), interp_key()
     )
     out, lse = local_fn(q, k_q, k_scale, v_q, v_scale, global_kv_lens)
     out, lse = merge_fn(out, lse)
@@ -1579,7 +1586,7 @@ def _local_paged_shard_decode_q8(
 
 
 @functools.lru_cache(maxsize=64)
-def _sp_paged_q8_fns(mesh, axis, scale, soft_cap, with_lse=False):
+def _sp_paged_q8_fns(mesh, axis, scale, soft_cap, with_lse=False, ikey=()):
     """Jitted (local, merge) pair for the INT8 paged SP decode."""
 
     def local(q, kp, ks, vp, vs, lens, table):
@@ -1625,7 +1632,7 @@ def sp_paged_gqa_fwd_batch_decode_q8(
     (B, Hq) lse so callers can fold further partials (the paged decode
     step's just-produced token, models/transformer.decode_step)."""
     local_fn, merge_fn = _sp_paged_q8_fns(
-        mesh, axis, scale, soft_cap, with_lse
+        mesh, axis, scale, soft_cap, with_lse, interp_key()
     )
     out, lse = local_fn(
         q, k_pool, k_scale, v_pool, v_scale, global_kv_lens, block_table
@@ -1634,7 +1641,8 @@ def sp_paged_gqa_fwd_batch_decode_q8(
 
 
 @functools.lru_cache(maxsize=64)
-def _sp_paged_fns(mesh, axis, scale, soft_cap, use_pallas, with_lse=False):
+def _sp_paged_fns(mesh, axis, scale, soft_cap, use_pallas, with_lse=False,
+                  ikey=()):
     """Jitted (local, merge) pair for the PAGED SP decode — split into
     two dispatches for the same interpreter-deadlock reason as
     :func:`_sp_decode_fns`."""
@@ -1688,7 +1696,7 @@ def sp_paged_gqa_fwd_batch_decode(
       (+ the merged (B, Hq) lse with ``with_lse``).
     """
     local_fn, merge_fn = _sp_paged_fns(
-        mesh, axis, scale, soft_cap, use_pallas, with_lse
+        mesh, axis, scale, soft_cap, use_pallas, with_lse, interp_key()
     )
     out, lse = local_fn(q, k_pool, v_pool, global_kv_lens, block_table)
     return merge_fn(out, lse)

@@ -115,6 +115,7 @@ def _fused_kernel(
         n, axis, mesh_axes, out_hbm, (w0, w1), (r0, r1),
         send_sem, recv_sem, ack_sem, partial_into,
         ew_add_pipeline(m_local, n_out, out_hbm.dtype.itemsize),
+        site="gemm_rs",
     )
 
 
@@ -219,7 +220,10 @@ def _build_fused(
                 break
 
     if dcn_axis is None:
-        call = mk_call(n_out, blocks, collective_id)
+        call = lang.maybe_instrument(
+            mk_call(n_out, blocks, collective_id),
+            axis=axis, site="gemm_rs", collective_id=collective_id, n=n,
+        )
 
         def body(a, b):
             return call(a, b)[0]
@@ -376,8 +380,17 @@ def auto_gemm_rs_method(mesh, axis, a, b, dp: int = 1,
     logged (nobody should benchmark XLA believing it is the fused kernel).
     A cross-slice TP factor declared as ``dcn_axis`` keeps the fused
     engine intra-slice; only ``axis`` itself crossing DCN forces XLA."""
+    from triton_distributed_tpu.config import pallas_collectives_available
+
     n = mesh.shape[axis]
     nd = mesh.shape[dcn_axis] if dcn_axis else 1
+    if not pallas_collectives_available():
+        _warn_once(
+            ("gemm_rs", "nosim"),
+            "gemm_rs: Pallas collectives unavailable off-TPU (jax lacks "
+            "the TPU-simulation interpreter); using XLA_RING engine",
+        )
+        return GemmRSMethod.XLA_RING
     topo = detect_topology(mesh, axis)
     if topo.link_kind == LinkKind.DCN:
         _warn_once(

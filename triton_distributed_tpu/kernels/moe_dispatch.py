@@ -409,7 +409,7 @@ def _chunked_a2a_kernel(
     for i in range(n - 1):
         pi = jax.lax.rem(me + 1 + i, n)
         peer = lang.pe_flat(axis, pi, mesh_axes)
-        chaos_delay()
+        chaos_delay(site="moe_dispatch", step=i, me=me, n=n)
         lang.remote_copy(
             meta_hbm.at[pl.ds(pi * mr, mr)],
             dst_meta.at[pl.ds((mbase + me) * mr, mr)],   # peer slot `me`

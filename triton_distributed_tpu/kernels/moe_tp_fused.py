@@ -136,7 +136,8 @@ def ag_group_gemm_kernel(
         )(a_hbm, w_hbm, out_hbm)
 
     ag_forward_ring(
-        n, axis, mesh_axes, xs_hbm, ag_hbm, cap, send_sem, recv_sem, consume
+        n, axis, mesh_axes, xs_hbm, ag_hbm, cap, send_sem, recv_sem, consume,
+        site="moe_tp",
     )
 
 
@@ -173,6 +174,7 @@ def moe_reduce_rs_kernel(
         n, axis, mesh_axes, out_hbm, (w0, w1), (r0, r1),
         send_sem, recv_sem, ack_sem, partial_into,
         ew_add_pipeline(cap, h, out_hbm.dtype.itemsize),
+        site="moe_tp",
     )
 
 

@@ -48,13 +48,13 @@ def ring_reduce_core(
     left, right = ring_neighbors(me, n)
     left, right = lang.pe_flat(axis, left, mesh_axes), lang.pe_flat(axis, right, mesh_axes)
 
-    lang.neighbor_barrier(axis, left, right)
+    lang.neighbor_barrier(axis, left, right, site="reduce_scatter", me=me, n=n)
 
     # acc starts as my contribution to shard (me+1), the first one I forward.
     acc_ref[:] = make_partial(jax.lax.rem(me + 1, n))
 
     for s in range(n - 1):
-        chaos_delay()
+        chaos_delay(site="reduce_scatter", step=s, me=me, n=n)
         if s >= 2:
             # left must have consumed my slot (s-2) before I rewrite it
             pltpu.semaphore_wait(ack_sem, 1)
@@ -195,6 +195,12 @@ def reduce_scatter(
 
     Host entry ≡ reference ``reduce_scatter_2d_op`` (reduce_scatter.py:863).
     """
+    from triton_distributed_tpu.config import pallas_collectives_available
+
+    if not pallas_collectives_available():
+        # off-TPU without the TPU-simulation interpreter: degrade to the
+        # XLA-native psum_scatter twin
+        return reduce_scatter_xla(x, mesh, axis, stacked=stacked)
     n = mesh.shape[axis]
     full_shape = x.shape[1:] if stacked else x.shape
     if n == 1:
@@ -238,6 +244,10 @@ def _build_reduce_scatter(mesh, axis, full_shape, dtype, stacked, collective_id,
         ],
         collective_id=collective_id,
         name="rs_ring",
+    )
+    call = lang.maybe_instrument(
+        call, axis=axis, site="reduce_scatter", collective_id=collective_id,
+        n=n,
     )
     body = (lambda s: call(s[0])) if stacked else call
     fn = jax.shard_map(

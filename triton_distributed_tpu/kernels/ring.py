@@ -36,7 +36,7 @@ from triton_distributed_tpu.utils.testing import chaos_delay
 
 def ag_forward_ring(
     n, axis, mesh_axes, local_hbm, ag_hbm, slab_rows, send_sem, recv_sem,
-    consume,
+    consume, *, site=None,
 ):
     """Run the AG forward ring; ``consume(s, src, a_hbm, a_row_off)``
     computes over shard ``src`` (rows ``[a_row_off, a_row_off+slab_rows)``
@@ -59,7 +59,7 @@ def ag_forward_ring(
     left = lang.pe_flat(axis, left, mesh_axes)
     right = lang.pe_flat(axis, right, mesh_axes)
 
-    lang.neighbor_barrier(axis, left, right)
+    lang.neighbor_barrier(axis, left, right, site=site, me=me, n=n)
 
     def fwd(src, slot, from_local):
         src_ref = local_hbm if from_local else ag_hbm.at[
@@ -78,7 +78,7 @@ def ag_forward_ring(
         if s > 0:
             fwd(src, s - 1, s == 1).wait_recv()
         if s < n - 1:
-            chaos_delay()
+            chaos_delay(site=site, step=s, me=me, n=n)
             fwd(src, s, s == 0).start()
         if s == 0:
             consume(s, src, local_hbm, 0)
@@ -91,7 +91,7 @@ def ag_forward_ring(
 
 def reduce_ring(
     n, axis, mesh_axes, out_hbm, work, recv, send_sem, recv_sem, ack_sem,
-    partial_into, fold,
+    partial_into, fold, *, site=None,
 ):
     """Run the compute-into-the-ring reduce.
 
@@ -116,13 +116,13 @@ def reduce_ring(
             work[slot], recv[slot], send_sem.at[slot], recv_sem.at[slot], left
         )
 
-    lang.neighbor_barrier(axis, left, right)
+    lang.neighbor_barrier(axis, left, right, site=site, me=me, n=n)
     # my contribution to shard (me+1), the first one I forward
     partial_into(jax.lax.rem(me + 1, n), work[0])
 
     for s in range(n - 1):
         slot = s % 2
-        chaos_delay()
+        chaos_delay(site=site, step=s, me=me, n=n)
         if s >= 2:
             # left must have folded my slot (s-2) before I rewrite it
             pltpu.semaphore_wait(ack_sem, 1)
@@ -138,7 +138,7 @@ def reduce_ring(
         # received: partial sum of shard (me+2+s) accumulated so far by
         # the ring to my right; fold in my own contribution.
         fold(work[1 - slot], recv[slot], out_hbm if s == n - 2 else work[1 - slot])
-        lang.signal_op(ack_sem, 1, pe=right)
+        lang.signal_op(ack_sem, 1, pe=right, site=site, me=me, n=n)
 
     ring_dma((n - 2) % 2).wait_send()
     # drain leftover acks: n-1 received, max(n-3, 0) consumed in-loop

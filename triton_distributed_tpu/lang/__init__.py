@@ -27,7 +27,12 @@ from triton_distributed_tpu.lang.shmem import (
     signal_op,
     signal_wait_until,
 )
-from triton_distributed_tpu.lang.launch import shmem_call, on_mesh, vmem_specs
+from triton_distributed_tpu.lang.launch import (
+    maybe_instrument,
+    on_mesh,
+    shmem_call,
+    vmem_specs,
+)
 
 __all__ = [
     "my_pe",
@@ -48,6 +53,7 @@ __all__ = [
     "CMP_EQ",
     "CMP_GE",
     "shmem_call",
+    "maybe_instrument",
     "on_mesh",
     "vmem_specs",
 ]

@@ -89,6 +89,52 @@ def vmem_specs(n: int):
     return [pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(n)]
 
 
+def maybe_instrument(call, *, axis, site, collective_id, n):
+    """Wrap a per-device collective callable (used inside shard_map) with
+    the robustness host hooks — the ``shmem_call`` side of the collective
+    watchdog (:mod:`triton_distributed_tpu.runtime.watchdog`):
+
+    * an ENTRY heartbeat callback per rank (registers the launch with the
+      armed watchdog and holds the fault plan's single-peer stall gates),
+      data-tied to the kernel's operands via ``optimization_barrier`` so
+      XLA cannot start the collective before the heartbeat fires;
+    * an EXIT heartbeat data-tied to the kernel's outputs, so the
+      watchdog can tell *which ranks* are still inside a wedged launch.
+
+    Returns ``call`` untouched when neither a watchdog is armed nor the
+    active fault plan stalls this site — the wrapped/unwrapped decision
+    is part of the trace-cache key (``config.interp_key`` folds in
+    ``faults.trace_key``), so builders cache correctly across arming.
+    """
+    from triton_distributed_tpu.runtime import faults, watchdog
+
+    plan = faults.active_plan()
+    stalls = plan is not None and plan.stalled_ranks(site)
+    if not (watchdog.armed() or stalls):
+        return call
+
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    enter_cb = functools.partial(watchdog._hb_enter, site, collective_id, n)
+    exit_cb = functools.partial(watchdog._hb_exit, site, collective_id, n)
+    hb = jax.ShapeDtypeStruct((), jnp.int32)
+
+    def body(*args):
+        me = jax.lax.axis_index(axis)
+        gate = io_callback(enter_cb, hb, me)
+        args = tuple(
+            jax.lax.optimization_barrier((a, gate))[0] for a in args
+        )
+        out = call(*args)
+        leaves = jax.tree.leaves(out)
+        dep = leaves[0].reshape(-1)[:1] if leaves else jnp.zeros((1,))
+        io_callback(exit_cb, hb, me, dep)
+        return out
+
+    return body
+
+
 def on_mesh(mesh, in_specs, out_specs, axis_names=None, jit=True):
     """Decorator: run ``fn`` SPMD on ``mesh`` via shard_map (+jit)."""
 

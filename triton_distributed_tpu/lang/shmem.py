@@ -113,10 +113,22 @@ def putmem_signal_nbi_block(dst_ref, src_ref, send_sem, recv_sem, pe):
     return putmem_nbi_block(dst_ref, src_ref, send_sem, recv_sem, pe)
 
 
-def signal_op(sem, inc=1, pe=None):
+def signal_op(sem, inc=1, pe=None, *, site=None, me=None, n=None):
     """Increment a (possibly remote) regular semaphore
     (≡ libshmem_device.signal_op with NVSHMEM_SIGNAL_ADD, and the dialect's
-    ``distributed.notify``, DistributedOps.td:151-164)."""
+    ``distributed.notify``, DistributedOps.td:151-164).
+
+    ``site``/``me``/``n`` are fault-engine coordinates (see
+    :mod:`triton_distributed_tpu.runtime.faults`): when an active
+    :class:`FaultPlan` carries drop/dup signal faults matching ``site``,
+    the matching rank's increment is suppressed or doubled — modelling a
+    lost or replayed notification. Call sites that pass no coordinates
+    are not hookable (plan signal faults skip them).
+    """
+    from triton_distributed_tpu.runtime import faults
+
+    if faults.inject_signal(sem, inc, pe, site, me, n):
+        return
     if pe is None:
         pltpu.semaphore_signal(sem, inc=inc)
     else:
@@ -161,13 +173,15 @@ def barrier_all(axis, mesh_axes=None):
     barrier_sem_wait_all(pltpu.get_barrier_semaphore(), axis, mesh_axes)
 
 
-def neighbor_barrier(axis, left, right):
+def neighbor_barrier(axis, left, right, *, site=None, me=None, n=None):
     """Ring-neighbor barrier on the global barrier semaphore: no RDMA into
     a peer that hasn't entered the kernel yet. ``left``/``right`` are flat
-    logical device ids (already pe_flat-translated)."""
+    logical device ids (already pe_flat-translated). ``site``/``me``/``n``
+    expose the two outgoing credits to the fault engine's signal faults
+    (see :func:`signal_op`)."""
     sem = pltpu.get_barrier_semaphore()
-    signal_op(sem, 1, pe=left)
-    signal_op(sem, 1, pe=right)
+    signal_op(sem, 1, pe=left, site=site, me=me, n=n)
+    signal_op(sem, 1, pe=right, site=site, me=me, n=n)
     pltpu.semaphore_wait(sem, 2)
 
 

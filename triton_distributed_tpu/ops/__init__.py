@@ -32,15 +32,23 @@ from triton_distributed_tpu.ops.moe_tp import (
 from triton_distributed_tpu.ops.overlap import (
     OverlapContext,
     ag_gemm,
+    ag_gemm_safe,
     create_ag_gemm_context,
     create_gemm_rs_context,
     gemm_rs,
+    gemm_rs_safe,
+    preflight,
+    with_fallback,
 )
 
 __all__ = [
     "OverlapContext",
     "ag_gemm",
     "gemm_rs",
+    "ag_gemm_safe",
+    "gemm_rs_safe",
+    "preflight",
+    "with_fallback",
     "create_ag_gemm_context",
     "create_gemm_rs_context",
     "EPMoEContext",

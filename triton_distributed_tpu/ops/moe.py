@@ -146,9 +146,19 @@ def create_ep_moe_context(
         max_m=max_m, hidden=hidden, **kw,
     )
     if ctx.transport is None:
-        ctx = replace(
-            ctx, transport="pallas" if ctx.dcn_axis is not None else "fused"
-        )
+        from triton_distributed_tpu.config import pallas_collectives_available
+
+        if not pallas_collectives_available() and ctx.quant is None:
+            # off-TPU without the TPU-simulation interpreter: the Pallas
+            # transports cannot execute — auto-select degrades to the
+            # XLA a2a (quantized payloads still require Pallas and fail
+            # loudly below)
+            ctx = replace(ctx, transport="xla")
+        else:
+            ctx = replace(
+                ctx,
+                transport="pallas" if ctx.dcn_axis is not None else "fused",
+            )
     assert num_experts % ctx.n == 0, f"{num_experts} experts over {ctx.n} ranks"
     ctx.a2a  # fail fast on bad quant/hidden geometry, not at trace time
     if ctx.quant is not None and ctx.transport == "xla":
@@ -778,6 +788,19 @@ def ep_moe(x, logits, w_up, w_down, ctx: EPMoEContext, state=None):
     """
     from triton_distributed_tpu.config import interp_key
 
+    reason = _transport_degrade_reason(ctx)
+    if reason is not None:
+        from triton_distributed_tpu.ops.overlap import _log_demotion_once
+
+        _log_demotion_once("ep_moe", reason)
+        demoted = replace(ctx, transport="xla")
+        out = _build_ep_moe(demoted, interp_key())(x, logits, w_up, w_down)
+        if state is not None:
+            # the LL workspaces carry no obligations while the fused
+            # transport is demoted — return them untouched so the caller's
+            # state threading survives the degradation window
+            return out, state
+        return out
     if state is None:
         return _build_ep_moe(ctx, interp_key())(x, logits, w_up, w_down)
     if ctx.transport != "fused":
@@ -785,6 +808,28 @@ def ep_moe(x, logits, w_up, w_down, ctx: EPMoEContext, state=None):
     fn = _build_ep_moe(ctx, interp_key(), state.instance)
     out, ws = fn(x, logits, w_up, w_down, state.as_dict())
     return out, EPMoEState(instance=state.instance, **ws)
+
+
+def _transport_degrade_reason(ctx: EPMoEContext) -> str | None:
+    """Should the Pallas/fused MoE transport demote to the XLA a2a for
+    this call? Same probe family as ``ops.overlap.preflight``: an
+    unhealthy peer in the active fault plan or a prior watchdog trip.
+    Quantized wire payloads cannot demote (the XLA transport is
+    full-precision only) — those keep the fused path and surface
+    whatever the fault is."""
+    if ctx.transport not in ("fused", "pallas") or ctx.quant is not None:
+        return None
+    from triton_distributed_tpu.runtime import faults, watchdog
+
+    plan = faults.active_plan()
+    if plan is not None and plan.unhealthy_peers:
+        return (
+            f"fault plan marks peer(s) {plan.unhealthy_peers} unhealthy "
+            f"(plan seed={plan.seed})"
+        )
+    if watchdog.last_trip() is not None:
+        return "collective watchdog tripped on a prior step"
+    return None
 
 
 _EP_MOE_TUNERS: OrderedDict = OrderedDict()

@@ -31,6 +31,7 @@ The weight gradients overlap too:
 from __future__ import annotations
 
 import functools
+import logging
 from dataclasses import dataclass
 
 import jax
@@ -267,3 +268,119 @@ def _gemm_rs_bwd(ctx, res, g):
 
 
 gemm_rs.defvjp(_gemm_rs_fwd, _gemm_rs_bwd)
+
+
+# ------------------------------------------------------ graceful degradation
+
+logger = logging.getLogger(__name__)
+_demotions_logged: set = set()
+
+
+def _log_demotion_once(engine: str, reason: str) -> None:
+    key = (engine, reason.split("(")[0])
+    if key not in _demotions_logged:
+        _demotions_logged.add(key)
+        logger.warning(
+            "%s: demoting fused engine to its XLA-native fallback — %s "
+            "(logged once per engine/reason)", engine, reason,
+        )
+
+
+def preflight(ctx: OverlapContext, engine: str, a, b) -> str | None:
+    """Why the fused ``engine`` must NOT run for these arguments — or
+    None when it is safe. Checked conditions, in order:
+
+    * the active :class:`~triton_distributed_tpu.runtime.faults.FaultPlan`
+      marks peers unhealthy (a fused single-kernel ring has no way to
+      route around a failed peer — the XLA path at least fails fast and
+      collectively);
+    * the collective watchdog tripped on a prior step (whatever wedged
+      once will wedge again until an operator intervenes — clear with
+      ``runtime.watchdog.clear_trip()`` after recovery);
+    * the VMEM/blockability probe: the shape admits no Mosaic blocking
+      under the current ``TDTPU_FUSED_VMEM_BUDGET``, or the environment
+      cannot execute Pallas collectives at all (both folded into the
+      engine's own auto heuristic, reused here so the probe and the
+      dispatcher can never disagree).
+    """
+    from triton_distributed_tpu.runtime import faults, watchdog
+    from triton_distributed_tpu.runtime import mesh_axes_size
+
+    plan = faults.active_plan()
+    if plan is not None and plan.unhealthy_peers:
+        return (
+            f"fault plan marks peer(s) {plan.unhealthy_peers} unhealthy "
+            f"(plan seed={plan.seed})"
+        )
+    if watchdog.last_trip() is not None:
+        return "collective watchdog tripped on a prior step"
+    dp = mesh_axes_size(ctx.mesh, tuple(ctx.batch_axes))
+    if engine == "ag_gemm":
+        from triton_distributed_tpu.kernels.ag_gemm import auto_ag_gemm_method
+
+        if auto_ag_gemm_method(ctx.mesh, ctx.axis, a, b, dp=dp) != \
+                AGGemmMethod.PALLAS_FUSED:
+            return "VMEM budget / blockability probe failed"
+    elif engine == "gemm_rs":
+        from triton_distributed_tpu.kernels.gemm_rs import auto_gemm_rs_method
+
+        if auto_gemm_rs_method(ctx.mesh, ctx.axis, a, b, dp=dp) != \
+                GemmRSMethod.PALLAS_FUSED:
+            return "VMEM budget / blockability probe failed"
+    return None
+
+
+def with_fallback(fused_fn, native_fn, *, engine: str, probe=None):
+    """Wrap a fused-engine entry with preflight-probe demotion to its
+    XLA-native equivalent (``tools.native``): when ``probe`` returns a
+    reason string the call is routed to ``native_fn`` and the demotion
+    is logged ONCE per engine/reason; otherwise ``fused_fn`` runs
+    untouched. The probe runs on the host before tracing — degradation
+    is a dispatch decision, not an exception handler, so a demoted step
+    is exactly as deterministic as a healthy one."""
+
+    probe = probe or (lambda *a, **k: None)
+
+    @functools.wraps(fused_fn)
+    def wrapped(*args, **kwargs):
+        reason = probe(*args, **kwargs)
+        if reason:
+            _log_demotion_once(engine, reason)
+            return native_fn(*args, **kwargs)
+        return fused_fn(*args, **kwargs)
+
+    wrapped.__wrapped_engine__ = engine
+    return wrapped
+
+
+def _native_ag_gemm(a, b, ctx: OverlapContext):
+    from triton_distributed_tpu.tools.native import xla_ag_gemm
+
+    return xla_ag_gemm(
+        a, b, ctx.mesh, ctx.axis,
+        batch_axes=ctx.batch_axes, out_dtype=ctx.out_dtype or a.dtype,
+    )
+
+
+def _native_gemm_rs(a, b, ctx: OverlapContext):
+    from triton_distributed_tpu.tools.native import xla_gemm_rs
+
+    return xla_gemm_rs(
+        a, b, ctx.mesh, ctx.axis,
+        batch_axes=ctx.batch_axes, out_dtype=ctx.out_dtype or a.dtype,
+    )
+
+
+#: ``ag_gemm``/``gemm_rs`` with the degradation matrix applied — the
+#: entries serving loops should call (models.Transformer routes through
+#: these): healthy steps run the differentiable fused ops; a failed
+#: preflight (unhealthy peer, prior watchdog trip, VMEM probe) demotes
+#: to the XLA-native twin, logged once.
+ag_gemm_safe = with_fallback(
+    ag_gemm, _native_ag_gemm, engine="ag_gemm",
+    probe=lambda a, b, ctx: preflight(ctx, "ag_gemm", a, b),
+)
+gemm_rs_safe = with_fallback(
+    gemm_rs, _native_gemm_rs, engine="gemm_rs",
+    probe=lambda a, b, ctx: preflight(ctx, "gemm_rs", a, b),
+)

@@ -12,6 +12,19 @@ from triton_distributed_tpu.runtime.bootstrap import (
     get_context,
     initialize_distributed,
 )
+from triton_distributed_tpu.runtime.faults import (
+    Corrupt,
+    Delay,
+    FaultPlan,
+    SignalFault,
+    Stall,
+    fault_plan,
+    set_fault_plan,
+)
+from triton_distributed_tpu.runtime.watchdog import (
+    WatchdogTimeout,
+    collective_watchdog,
+)
 from triton_distributed_tpu.runtime.multislice import (
     create_hybrid_mesh,
     is_dcn_axis,
@@ -54,4 +67,13 @@ __all__ = [
     "assert_args_aliased",
     "find_involuntary_resharding",
     "input_output_aliased_params",
+    "FaultPlan",
+    "Delay",
+    "Stall",
+    "SignalFault",
+    "Corrupt",
+    "fault_plan",
+    "set_fault_plan",
+    "collective_watchdog",
+    "WatchdogTimeout",
 ]

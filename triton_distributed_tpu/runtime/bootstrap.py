@@ -11,13 +11,18 @@ device.
 
 from __future__ import annotations
 
+import logging
 import os
+import random
+import time
 from dataclasses import dataclass
 from typing import Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -64,12 +69,10 @@ def initialize_distributed(
     # Must run before any backend touch: jax.distributed.initialize has to
     # precede backend initialization, so the "already initialized" guard
     # checks the distributed client state, not jax.process_count().
-    already = jax.distributed.is_initialized()
+    already = _distributed_initialized()
     if coord and nproc > 1 and not already:
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=nproc,
-            process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
+        _initialize_with_retry(
+            coord, nproc, int(os.environ.get("JAX_PROCESS_ID", "0"))
         )
 
     devices = jax.devices()
@@ -97,6 +100,87 @@ def initialize_distributed(
     if seed is not None:
         init_seed(ctx.rank, seed)
     return ctx
+
+
+def _distributed_initialized() -> bool:
+    """Is the jax distributed client up? ``jax.distributed.is_initialized``
+    where it exists; older jax exposes only the global client state."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    state = getattr(
+        getattr(jax, "_src", None), "distributed", None
+    )
+    return getattr(getattr(state, "global_state", None), "client", None) is not None
+
+
+def _initialize_with_retry(
+    coord: str,
+    nproc: int,
+    pid: int,
+    *,
+    retries: int | None = None,
+    backoff: float | None = None,
+    cap: float | None = None,
+    sleep=time.sleep,
+    initialize=None,
+) -> None:
+    """``jax.distributed.initialize`` with bounded exponential backoff.
+
+    Multi-host rendezvous is the single flakiest step of a pod-scale
+    launch: the coordinator process may simply not be listening yet
+    (scheduler skew), or a transient DNS/conntrack blip drops the first
+    connection. The reference framework retries nothing — one refused
+    connection kills the whole job. Here each attempt backs off
+    ``backoff * 2**attempt`` seconds (clamped to ``cap``) with ±50%
+    jitter so restarting workers don't re-dogpile the coordinator, and
+    the terminal failure names the coordinator address instead of
+    surfacing the raw rendezvous exception from deep inside jax.
+
+    Knobs (env): ``TDTPU_BOOTSTRAP_RETRIES`` (default 5 attempts),
+    ``TDTPU_BOOTSTRAP_BACKOFF`` (base seconds, default 0.5),
+    ``TDTPU_BOOTSTRAP_BACKOFF_CAP`` (default 8.0).
+    """
+    retries = retries if retries is not None else int(
+        os.environ.get("TDTPU_BOOTSTRAP_RETRIES", "5")
+    )
+    backoff = backoff if backoff is not None else float(
+        os.environ.get("TDTPU_BOOTSTRAP_BACKOFF", "0.5")
+    )
+    cap = cap if cap is not None else float(
+        os.environ.get("TDTPU_BOOTSTRAP_BACKOFF_CAP", "8.0")
+    )
+    initialize = initialize or jax.distributed.initialize
+    retries = max(int(retries), 1)
+    last = None
+    for attempt in range(retries):
+        try:
+            initialize(
+                coordinator_address=coord,
+                num_processes=nproc,
+                process_id=pid,
+            )
+            return
+        except Exception as e:                  # noqa: BLE001 — rendezvous
+            last = e                            # errors surface as various
+            if attempt == retries - 1:          # RuntimeError/XlaRuntimeError
+                break                           # subclasses across jax versions
+            delay = min(cap, backoff * (2.0 ** attempt))
+            delay *= 0.5 + random.random()      # ±50% de-dogpile jitter
+            logger.warning(
+                "jax.distributed.initialize attempt %d/%d against "
+                "coordinator %s failed (%s); retrying in %.2fs",
+                attempt + 1, retries, coord, e, delay,
+            )
+            sleep(delay)
+    raise RuntimeError(
+        f"jax.distributed.initialize failed after {retries} attempt(s) "
+        f"rendezvousing with coordinator {coord!r} "
+        f"(num_processes={nproc}, process_id={pid}). Check that the "
+        "coordinator process is reachable on that address/port and that "
+        "JAX_NUM_PROCESSES/JAX_PROCESS_ID are consistent across hosts. "
+        f"Last error: {last}"
+    ) from last
 
 
 def _default_axis_names(ndim: int) -> tuple[str, ...]:

@@ -1,0 +1,414 @@
+"""Deterministic fault-plan engine for the distributed kernels.
+
+The reference framework's whole robustness story is one knob: a random
+comm-stream sleep gated by ``for_correctness`` (reference:
+python/triton_dist/kernels/nvidia/allgather.py:72-77), mirrored here as
+the global boolean ``config.chaos_delay``. A serving stack needs
+strictly more, and needs it *reproducible*: a failed nightly chaos run
+that cannot be replayed is noise, not signal.
+
+A :class:`FaultPlan` is a seeded, declarative set of faults injected
+through the hook points the kernels already have:
+
+* :class:`Delay` — per-(rank, step) delay distributions at the existing
+  ``chaos_delay`` call sites (in a ring collective the (rank, step)
+  pair identifies the edge the delayed DMA travels). Replaces the
+  all-ranks-same-cycles behaviour of ``config.chaos_delay`` with a
+  seeded per-edge draw.
+* :class:`Stall` — a single-peer stall: the named rank blocks on a
+  HOST-side gate at collective entry (wired through
+  ``lang.launch`` instrumentation), wedging every other rank inside
+  its semaphore waits — the hung-collective scenario the watchdog
+  (:mod:`triton_distributed_tpu.runtime.watchdog`) exists to detect.
+  Gates are released by a watchdog trip, by plan deactivation, or by
+  the ``TDTPU_STALL_TIMEOUT`` backstop.
+* :class:`SignalFault` — dropped or duplicated semaphore increments at
+  the ``lang.shmem.signal_op`` hook (a dropped barrier credit is a
+  permanent wedge; a duplicated one is a premature release racing the
+  payload). These model NIC/driver misbehaviour the TPU ICI fabric
+  itself won't produce — they exist to exercise the watchdog and the
+  race detector, not to pass correctness runs.
+* :class:`Corrupt` — payload-word corruption: one element of a
+  collective's in-flight payload is overwritten at a kernel-chosen
+  hook point before the send. Deterministic under the seed, so a
+  corrupted result is bit-identical across replays (the property the
+  end-to-end determinism test asserts).
+
+All trace-time decisions (which ranks delay, how long, which word is
+corrupted) are pure functions of ``(plan.seed, site, rank, step)``, so
+the same plan replays the same fault sequence. Plans participate in the
+kernel trace-cache key via :func:`trace_key` (folded into
+``config.interp_key``): activating, changing, or clearing a plan
+invalidates cached kernel builds instead of silently reusing traces
+with stale injections.
+
+Usage::
+
+    plan = FaultPlan(seed=7, faults=(Delay(site="allgather", jitter=0.5),))
+    with fault_plan(plan):
+        y = all_gather(x, mesh, "x")          # delays injected, seeded
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import zlib
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+#: Site names used by the kernel hook points. "*" in a fault matches any.
+SITES = (
+    "allgather", "reduce_scatter", "all_to_all", "ag_gemm", "gemm_rs",
+    "moe_dispatch", "flash_decode",
+)
+
+
+@dataclass(frozen=True)
+class Delay:
+    """Seeded in-kernel delay at a ``chaos_delay`` hook point.
+
+    ``rank``/``step`` of None match all; ``cycles`` is the base delay,
+    ``jitter`` the relative spread — the injected delay for (rank, step)
+    is ``cycles * (1 - jitter + 2 * jitter * u)`` with ``u`` a
+    deterministic uniform draw from (seed, site, rank, step).
+    """
+
+    site: str = "*"
+    rank: int | None = None
+    step: int | None = None
+    cycles: int = 100_000
+    jitter: float = 0.0
+
+
+@dataclass(frozen=True)
+class Stall:
+    """Single-peer stall: ``rank`` blocks on a host gate at entry of the
+    matching collective until released (watchdog trip / deactivation /
+    ``TDTPU_STALL_TIMEOUT``)."""
+
+    site: str = "*"
+    rank: int = 0
+
+
+@dataclass(frozen=True)
+class SignalFault:
+    """Drop (``kind="drop"``) or duplicate (``kind="dup"``) the matching
+    rank's outgoing semaphore increments at hooked signal sites."""
+
+    site: str = "*"
+    rank: int | None = None
+    kind: str = "drop"
+
+
+@dataclass(frozen=True)
+class Corrupt:
+    """Overwrite one payload word of ``rank``'s outgoing shard before
+    the send: column ``word`` of the shard's first row gets ``value``."""
+
+    site: str = "*"
+    rank: int = 0
+    step: int | None = None
+    word: int = 0
+    value: float = 1.0e9
+
+
+_FAULT_TYPES = (Delay, Stall, SignalFault, Corrupt)
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """A seeded, declarative set of faults (see module docstring).
+
+    ``unhealthy_peers`` carries no injection of its own: it marks ranks
+    the degradation layer (``ops.overlap.with_fallback`` /
+    ``ops.moe.ep_moe``) must treat as failed, demoting fused engines to
+    their XLA-native equivalents.
+    """
+
+    seed: int = 0
+    faults: tuple = ()
+    unhealthy_peers: tuple = ()
+
+    def __post_init__(self):
+        for f in self.faults:
+            if not isinstance(f, _FAULT_TYPES):
+                raise TypeError(f"not a fault: {f!r}")
+            if isinstance(f, SignalFault) and f.kind not in ("drop", "dup"):
+                raise ValueError(f"SignalFault.kind must be drop|dup: {f.kind!r}")
+
+    # -- determinism core ---------------------------------------------------
+    def _u(self, *key) -> float:
+        """Deterministic uniform in [0, 1) from (seed, *key) — crc32 is
+        stable across processes/platforms (unlike ``hash``)."""
+        h = zlib.crc32(repr((self.seed,) + key).encode())
+        return h / 2.0 ** 32
+
+    @staticmethod
+    def _site_match(fault_site: str, site: str | None) -> bool:
+        if fault_site == "*":
+            return True
+        return site is not None and fault_site == site
+
+    # -- trace-time queries (all pure in (seed, site, rank, step)) ----------
+    def delay_cycles(self, site: str | None, step: int | None, n: int):
+        """Per-rank injected delay cycles for this (site, step): a length-n
+        tuple of ints (0 = no delay for that rank)."""
+        out = []
+        for r in range(n):
+            cyc = 0
+            for f in self.faults:
+                if not isinstance(f, Delay):
+                    continue
+                if not self._site_match(f.site, site):
+                    continue
+                if f.rank is not None and f.rank != r:
+                    continue
+                if f.step is not None and step is not None and f.step != step:
+                    continue
+                u = self._u("delay", site, r, step)
+                cyc = max(
+                    cyc, int(f.cycles * (1.0 - f.jitter + 2.0 * f.jitter * u))
+                )
+            out.append(cyc)
+        return tuple(out)
+
+    def signal_factor(self, site: str | None, rank: int) -> int:
+        """Multiplier on ``rank``'s outgoing signal increments at hooked
+        sites: 1 = untouched, 0 = dropped, 2 = duplicated."""
+        for f in self.faults:
+            if isinstance(f, SignalFault) and self._site_match(f.site, site):
+                if f.rank is None or f.rank == rank:
+                    return 0 if f.kind == "drop" else 2
+        return 1
+
+    def corruption(self, site: str | None, rank: int, step: int | None = None):
+        """(word, value) to stamp into ``rank``'s outgoing payload at this
+        (site, step), or None."""
+        for f in self.faults:
+            if isinstance(f, Corrupt) and self._site_match(f.site, site):
+                if f.rank != rank:
+                    continue
+                if f.step is not None and step is not None and f.step != step:
+                    continue
+                return f.word, f.value
+        return None
+
+    def stalled_ranks(self, site: str | None) -> tuple:
+        return tuple(sorted({
+            f.rank for f in self.faults
+            if isinstance(f, Stall) and self._site_match(f.site, site)
+        }))
+
+    def schedule(self, site: str, n: int, steps: int) -> tuple:
+        """The fully materialized injection schedule for one collective:
+        every (kind, rank, step, params) entry this plan would inject at
+        ``site`` over ``steps`` ring steps on ``n`` ranks. Two plans with
+        the same seed+faults produce identical schedules — the object the
+        determinism test compares."""
+        entries = []
+        for s in range(steps):
+            for r, cyc in enumerate(self.delay_cycles(site, s, n)):
+                if cyc:
+                    entries.append(("delay", r, s, cyc))
+        for r in range(n):
+            fac = self.signal_factor(site, r)
+            if fac != 1:
+                entries.append(("signal", r, None, fac))
+            c = self.corruption(site, r)
+            if c is not None:
+                entries.append(("corrupt", r, None, c))
+        for r in self.stalled_ranks(site):
+            entries.append(("stall", r, None, None))
+        return tuple(entries)
+
+    def key(self) -> tuple:
+        """Hashable identity for trace caches (frozen dataclasses hash by
+        value, so the plan itself is the key)."""
+        return (self.seed, self.faults, self.unhealthy_peers)
+
+
+# ---------------------------------------------------------------- activation
+
+_ACTIVE: FaultPlan | None = None
+_GATES: dict = {}           # (site, rank) -> threading.Event
+_GATES_LOCK = threading.Lock()
+
+
+def active_plan() -> FaultPlan | None:
+    return _ACTIVE
+
+
+def trace_key() -> tuple:
+    """The fault-engine contribution to ``config.interp_key``: the active
+    plan's identity plus whether collective instrumentation (watchdog
+    heartbeats / stall gates) must be traced in. Changing either must
+    invalidate cached kernel builds."""
+    from triton_distributed_tpu.runtime import watchdog
+
+    return (
+        _ACTIVE.key() if _ACTIVE is not None else None,
+        watchdog.armed(),
+    )
+
+
+@contextmanager
+def fault_plan(plan: FaultPlan):
+    """Activate ``plan`` for the dynamic extent of the block. Nested
+    activation is rejected (two overlapping plans have no defined
+    composition). All stall gates are released on exit, so a plan can
+    never wedge code outside its own scope."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError(f"a fault plan is already active: {_ACTIVE}")
+    _ACTIVE = plan
+    try:
+        yield plan
+    finally:
+        _ACTIVE = None
+        release_stalls()
+
+
+def set_fault_plan(plan: FaultPlan | None):
+    """Imperative twin of :func:`fault_plan` for host loops that cannot
+    scope a context manager (clears stall gates when deactivating)."""
+    global _ACTIVE
+    _ACTIVE = plan
+    if plan is None:
+        release_stalls()
+
+
+# ---------------------------------------------------------------- stalls
+
+def stall_timeout() -> float:
+    """Backstop for a stall gate nobody releases (no watchdog armed):
+    seconds before a stalled rank proceeds anyway."""
+    return float(os.environ.get("TDTPU_STALL_TIMEOUT", "30"))
+
+
+def _gate(site: str, rank: int) -> threading.Event:
+    with _GATES_LOCK:
+        return _GATES.setdefault((site, rank), threading.Event())
+
+
+def stall_wait(site: str, rank: int) -> None:
+    """Host-side stall gate, called from the collective-entry heartbeat
+    (runs on an io_callback worker thread, NOT the main thread). Blocks
+    iff the active plan stalls ``rank`` at ``site``."""
+    plan = _ACTIVE
+    if plan is None or rank not in plan.stalled_ranks(site):
+        return
+    ev = _gate(site, rank)
+    if not ev.wait(timeout=stall_timeout()):
+        logger.warning(
+            "fault plan stall (site=%s rank=%d) hit the %.0fs "
+            "TDTPU_STALL_TIMEOUT backstop with no watchdog release",
+            site, rank, stall_timeout(),
+        )
+
+
+def release_stalls() -> None:
+    """Release every stall gate (watchdog trip / plan deactivation)."""
+    with _GATES_LOCK:
+        for ev in _GATES.values():
+            ev.set()
+        _GATES.clear()
+
+
+# ------------------------------------------------------- trace-time injectors
+# Called from INSIDE Pallas kernel bodies at trace time. They emit
+# rank-conditional Mosaic ops (pl.when on the traced rank index), so one
+# SPMD trace carries every rank's faults.
+
+def inject_delay(site, step, me, n, base_cycles) -> bool:
+    """Inject the active plan's delays at a ``chaos_delay`` hook point.
+    Returns False when no plan is active (legacy ``config.chaos_delay``
+    behaviour applies); True when the plan handled the site (possibly
+    injecting nothing)."""
+    plan = _ACTIVE
+    if plan is None:
+        return False
+    from jax.experimental import pallas as pl
+
+    if n is None:
+        # hook site without rank context: only uniform (rank=None) faults
+        cyc = plan.delay_cycles(site, step, 1)[0]
+        if cyc:
+            pl.delay(cyc)
+        return True
+    table = plan.delay_cycles(site, step, n)
+    if not any(table):
+        return True
+    if len(set(table)) == 1 or me is None:
+        pl.delay(max(table))
+        return True
+    for r, cyc in enumerate(table):
+        if not cyc:
+            continue
+
+        @pl.when(me == r)
+        def _(cyc=cyc):
+            pl.delay(cyc)
+
+    return True
+
+
+def inject_signal(sem, inc, pe, site, me, n) -> bool:
+    """Apply drop/dup signal faults at a ``signal_op`` hook point.
+    Returns True when the plan emitted the (possibly faulted) signals
+    itself; False when the caller should signal normally."""
+    plan = _ACTIVE
+    if plan is None or site is None or me is None or n is None:
+        return False
+    factors = [plan.signal_factor(site, r) for r in range(n)]
+    if all(f == 1 for f in factors):
+        return False
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def emit(times):
+        for _ in range(times):
+            if pe is None:
+                pltpu.semaphore_signal(sem, inc=inc)
+            else:
+                pltpu.semaphore_signal(
+                    sem, inc=inc, device_id=pe,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+
+    for r, fac in enumerate(factors):
+
+        @pl.when(me == r)
+        def _(fac=fac):
+            emit(fac)
+
+    return True
+
+
+def maybe_corrupt(ref, site, me, n, *, row_off=0, step=None) -> None:
+    """Stamp the plan's corruption (if any) into ``ref``: for each rank r
+    with a matching :class:`Corrupt`, word ``fault.word`` of row
+    ``row_off`` (this rank's outgoing shard head) is overwritten. No-op
+    without an active plan."""
+    plan = _ACTIVE
+    if plan is None or n is None:
+        return
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ncols = ref.shape[-1]
+    for r in range(n):
+        c = plan.corruption(site, r, step)
+        if c is None:
+            continue
+        word, value = c
+        col = word % ncols
+
+        @pl.when(me == r)
+        def _(col=col, value=value):
+            ref[pl.ds(row_off, 1), pl.ds(col, 1)] = jnp.full(
+                (1, 1), value, ref.dtype
+            )

@@ -71,7 +71,7 @@ def _leaf_pairs(compiled, args):
         arg_sh, is_leaf=lambda x: isinstance(x, jax.sharding.Sharding)
     )
     kept = _kept_indices(compiled, len(flat_args))
-    if len(kept) != len(flat_sh):
+    if len(kept) != len(flat_sh) or (kept and kept[-1] >= len(flat_args)):
         raise ValueError(
             f"argument tree ({len(flat_args)} leaves, {len(kept)} kept) "
             f"does not match the compiled signature ({len(flat_sh)} "

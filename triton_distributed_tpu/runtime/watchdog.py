@@ -1,0 +1,287 @@
+"""Host-side deadline watchdog over collective launches.
+
+A wedged collective on this stack does not crash — it *waits*: every
+rank parks inside a semaphore wait whose credit never arrives (stalled
+peer, dropped signal, io_callback worker-pool starvation on the CPU
+interpreter — see ``config.ensure_interpreter_unblocked``). The default
+observable is a silent hang that eats the whole CI budget.
+
+The watchdog turns that into a bounded, diagnosable failure:
+
+* :func:`collective_watchdog` is a context manager that ARMS a deadline.
+  While armed, instrumented collective launches (``lang.launch``
+  wraps the per-device callable when armed — arming participates in
+  ``config.interp_key`` so cached builds rebuild with hooks) emit
+  per-rank enter/exit heartbeats through host callbacks.
+* A monitor thread watches the in-flight records. When a collective has
+  been open longer than the deadline it **trips**: it captures rank-level
+  diagnostics (which ranks entered, which never exited, expected vs
+  observed semaphore credits derived from the heartbeats, the active
+  fault plan), releases any fault-plan stall gates so a *gate-held* run
+  can drain instead of wedging forever, and dumps the report to the log.
+* On context exit the pending callbacks are flushed
+  (``jax.effects_barrier``) and a trip raises :class:`WatchdogTimeout`
+  with the full report — the "raise instead of hang" contract.
+
+Scope and honesty: the watchdog can *unwedge* only stalls it owns (the
+fault plan's host-side gates). A genuine device-side wedge — a lost DMA
+on real hardware, a dropped barrier credit — cannot be cancelled from
+the host; for those the watchdog still produces the diagnostic dump on
+the monitor thread (the part a hang denies you), and
+``TDTPU_WATCHDOG_KILL=1`` additionally hard-exits the process (exit
+code 70) after a grace period so CI fails in seconds, not hours. The
+test-suite equivalent is conftest's ``faulthandler`` deadline.
+
+Host-loop runs (``tools/generate.py --watchdog-deadline``) arm the same
+context around model build + decode so every instrumented collective in
+the step loop is covered.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+
+class WatchdogTimeout(RuntimeError):
+    """A collective exceeded the armed deadline (see the message for the
+    rank/semaphore diagnostics captured at trip time)."""
+
+
+@dataclass
+class _Record:
+    """One in-flight collective launch, assembled from rank heartbeats."""
+
+    site: str
+    collective_id: object
+    n: int
+    t_start: float
+    entered: set = field(default_factory=set)
+    gated: set = field(default_factory=set)     # ranks held by a stall gate
+    exited: set = field(default_factory=set)
+
+    @property
+    def complete(self) -> bool:
+        return len(self.exited) >= self.n
+
+    def describe(self, deadline: float) -> str:
+        missing_enter = sorted(set(range(self.n)) - self.entered)
+        missing_exit = sorted(set(range(self.n)) - self.exited)
+        # Heartbeat-derived semaphore view: a rank that entered has sent
+        # its barrier credits to its peers; one that never exited never
+        # consumed its final waits. Expected credits per rank on the
+        # entry barrier: n-1; observed: ranks entered minus self.
+        expected = self.n - 1
+        observed = max(len(self.entered) - 1, 0)
+        lines = [
+            f"collective watchdog: deadline {deadline:.2f}s exceeded for "
+            f"'{self.site}' (collective_id={self.collective_id}, "
+            f"n={self.n}, open {time.monotonic() - self.t_start:.2f}s)",
+            f"  ranks entered : {sorted(self.entered)} "
+            f"(missing {missing_enter})",
+            f"  ranks exited  : {sorted(self.exited)} "
+            f"(missing {missing_exit})",
+        ]
+        if self.gated:
+            lines.append(
+                f"  stalled at fault-plan entry gate: rank "
+                f"{sorted(self.gated)}"
+            )
+        lines.append(
+            f"  barrier semaphore: expected {expected} credits/rank, "
+            f"observed {observed} (from entry heartbeats)"
+        )
+        from triton_distributed_tpu.runtime import faults
+
+        lines.append(f"  active fault plan: {faults.active_plan()!r}")
+        return "\n".join(lines)
+
+
+class CollectiveWatchdog:
+    """Deadline monitor; use via :func:`collective_watchdog`."""
+
+    def __init__(self, deadline: float = 10.0, poll: float = 0.02):
+        self.deadline = float(deadline)
+        self.poll = float(poll)
+        self.trip_report: str | None = None
+        self._records: list[_Record] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- heartbeats (io_callback worker threads) ---------------------------
+    def on_enter(self, site, collective_id, n, me) -> None:
+        me = int(me)
+        with self._lock:
+            rec = self._open_record(site, collective_id, n, me)
+            rec.entered.add(me)
+        from triton_distributed_tpu.runtime import faults
+
+        plan = faults.active_plan()
+        if plan is not None and me in plan.stalled_ranks(site):
+            with self._lock:
+                rec.gated.add(me)
+            faults.stall_wait(site, me)
+            with self._lock:
+                rec.gated.discard(me)
+
+    def on_exit(self, site, collective_id, n, me) -> None:
+        me = int(me)
+        with self._lock:
+            for rec in self._records:
+                if (
+                    rec.site == site
+                    and rec.collective_id == collective_id
+                    and me in rec.entered
+                    and me not in rec.exited
+                ):
+                    rec.exited.add(me)
+                    break
+            self._records = [r for r in self._records if not r.complete]
+
+    def _open_record(self, site, collective_id, n, me) -> _Record:
+        for rec in self._records:
+            if (
+                rec.site == site
+                and rec.collective_id == collective_id
+                and me not in rec.entered
+            ):
+                return rec
+        rec = _Record(site, collective_id, n, time.monotonic())
+        self._records.append(rec)
+        return rec
+
+    # -- monitor thread ----------------------------------------------------
+    def _monitor(self):
+        while not self._stop.wait(self.poll):
+            now = time.monotonic()
+            with self._lock:
+                expired = [
+                    r for r in self._records
+                    if now - r.t_start > self.deadline and not r.complete
+                ]
+                if not expired:
+                    continue
+                report = "\n".join(r.describe(self.deadline) for r in expired)
+                self.trip_report = report
+            logger.error("%s", report)
+            from triton_distributed_tpu.runtime import faults
+
+            # unwedge what we own: plan-injected stalls are host gates
+            faults.release_stalls()
+            if os.environ.get("TDTPU_WATCHDOG_KILL") == "1":
+                time.sleep(max(self.deadline, 1.0))
+                if any(not r.complete for r in self._records):
+                    logger.critical(
+                        "watchdog: collective still wedged after stall "
+                        "release — hard-exiting (TDTPU_WATCHDOG_KILL=1)"
+                    )
+                    os._exit(70)
+            return                      # one trip is terminal per arming
+
+    # -- arming ------------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._monitor, name="tdtpu-collective-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+_ARMED: CollectiveWatchdog | None = None
+_LAST_TRIP: str | None = None
+
+
+def armed() -> bool:
+    """Is a watchdog armed? Folded into ``config.interp_key`` (via
+    ``faults.trace_key``): arming must rebuild kernels with heartbeat
+    instrumentation."""
+    return _ARMED is not None
+
+
+def current() -> CollectiveWatchdog | None:
+    return _ARMED
+
+
+def last_trip() -> str | None:
+    """The most recent trip report (sticky across arming scopes) — the
+    degradation layer's "watchdog tripped on a prior step" probe input.
+    Cleared with :func:`clear_trip`."""
+    return _LAST_TRIP
+
+
+def clear_trip() -> None:
+    global _LAST_TRIP
+    _LAST_TRIP = None
+
+
+# -- io_callback targets (module-level so traced closures stay tiny) --------
+
+def _hb_enter(site, collective_id, n, me):
+    import numpy as np
+
+    wd = _ARMED
+    if wd is not None:
+        wd.on_enter(site, collective_id, n, me)
+    else:
+        # no watchdog: the stall gate still applies (plan semantics do
+        # not depend on whether anyone is watching)
+        from triton_distributed_tpu.runtime import faults
+
+        faults.stall_wait(site, int(me))
+    return np.int32(0)
+
+
+def _hb_exit(site, collective_id, n, me, _dep):
+    import numpy as np
+
+    wd = _ARMED
+    if wd is not None:
+        wd.on_exit(site, collective_id, n, me)
+    return np.int32(0)
+
+
+class collective_watchdog:
+    """``with collective_watchdog(deadline=2.0): ...`` — arm a deadline
+    over every instrumented collective launched in the block. Raises
+    :class:`WatchdogTimeout` at block exit if any collective overran
+    (after flushing pending heartbeats via ``jax.effects_barrier``)."""
+
+    def __init__(self, deadline: float = 10.0, poll: float = 0.02):
+        self.deadline = deadline
+        self.poll = poll
+        self.wd: CollectiveWatchdog | None = None
+
+    def __enter__(self) -> CollectiveWatchdog:
+        global _ARMED
+        if _ARMED is not None:
+            raise RuntimeError("a collective watchdog is already armed")
+        self.wd = CollectiveWatchdog(self.deadline, self.poll)
+        _ARMED = self.wd
+        self.wd.start()
+        return self.wd
+
+    def __exit__(self, exc_type, exc, tb):
+        global _ARMED, _LAST_TRIP
+        try:
+            import jax
+
+            jax.effects_barrier()
+        except Exception:       # flushing is best-effort during unwind
+            pass
+        self.wd.stop()
+        _ARMED = None
+        if self.wd.trip_report is not None:
+            _LAST_TRIP = self.wd.trip_report
+            if exc_type is None:
+                raise WatchdogTimeout(self.wd.trip_report)
+        return False

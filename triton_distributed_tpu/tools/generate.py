@@ -33,8 +33,29 @@ def main(argv=None) -> None:
     p.add_argument("--capacity", type=int, default=None,
                    help="KV cache capacity (default prompt+steps rounded up)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--watchdog-deadline", type=float, default=0.0,
+                   help="seconds before a wedged collective launch aborts "
+                        "the run with rank/semaphore diagnostics instead of "
+                        "hanging (0 = watchdog off). Armed around the WHOLE "
+                        "run so every build traces the heartbeat hooks in.")
     args = p.parse_args(argv)
 
+    import contextlib
+
+    from triton_distributed_tpu.runtime.watchdog import collective_watchdog
+
+    # arm BEFORE any build: arming participates in config.interp_key, so
+    # kernels built inside the context carry the heartbeat instrumentation
+    # the deadline monitor needs
+    guard = (
+        collective_watchdog(deadline=args.watchdog_deadline)
+        if args.watchdog_deadline > 0 else contextlib.nullcontext()
+    )
+    with guard:
+        _run(args)
+
+
+def _run(args) -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -1,12 +1,22 @@
-"""ctypes bindings for the native runtime library (csrc/tdtpu_native.cpp).
+"""Native runtime: ctypes bindings + XLA-native degradation targets.
 
-Reference: csrc/{op_pybind.cc,registry.cc} expose CUDA host utilities
-into Python via pybind11/torch; here the binding layer is ctypes over a
-plain C ABI (pybind11 is not in this toolchain) and the library is
-built on first use with g++ (cached under csrc/build/). Every entry
-point has a pure-python fallback so the package works where no
-compiler exists — the native path is the fast path, not a hard
-dependency.
+Two kinds of "native" live here:
+
+* ctypes bindings for the native host library (csrc/tdtpu_native.cpp).
+  Reference: csrc/{op_pybind.cc,registry.cc} expose CUDA host utilities
+  into Python via pybind11/torch; here the binding layer is ctypes over a
+  plain C ABI (pybind11 is not in this toolchain) and the library is
+  built on first use with g++ (cached under csrc/build/). Every entry
+  point has a pure-python fallback so the package works where no
+  compiler exists — the native path is the fast path, not a hard
+  dependency.
+* **XLA-native collective equivalents** (bottom of the module): the
+  degradation targets of ``ops.overlap.with_fallback`` — pure
+  ``lax.all_gather``/``psum_scatter`` + ``jnp.dot`` twins of the fused
+  Pallas engines, one per engine in the degradation matrix
+  (docs/ROBUSTNESS.md). Numerically equivalent (same f32 accumulation),
+  strictly slower (no compute/communication overlap), and dependent on
+  nothing but XLA — the floor the serving stack can always stand on.
 """
 
 from __future__ import annotations
@@ -235,3 +245,79 @@ class TokenDataset:
             self.close()
         except Exception:
             pass
+
+
+# ------------------------------------------- XLA-native degradation targets
+# The fused-engine fallbacks used by ops.overlap.with_fallback and the
+# EP-MoE transport demotion. Deliberately the *simplest correct* XLA
+# programs (gather → dot, dot → psum_scatter): when a preflight probe
+# has already failed, predictability beats cleverness.
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=128)
+def _xla_ag_gemm_fn(mesh, axis, batch_axes, out_dtype):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    ba = tuple(batch_axes)
+
+    def body(a_loc, b_loc):
+        a_full = jax.lax.all_gather(a_loc, axis, tiled=True)
+        return jnp.dot(
+            a_full, b_loc, preferred_element_type=jnp.float32
+        ).astype(out_dtype)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(ba + (axis,) if ba else axis, None), P(None, axis)),
+        out_specs=P(ba if ba else None, axis),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def xla_ag_gemm(a, b, mesh, axis, *, batch_axes=(), out_dtype=None):
+    """AllGather(A) @ B via plain XLA — the ag_gemm degradation target.
+    Same layout contract as ``kernels.ag_gemm`` (rows sharded over
+    ``(*batch_axes, axis)``, B cols sharded over ``axis``)."""
+    import jax.numpy as jnp
+
+    out_dtype = jnp.dtype(out_dtype or a.dtype)
+    return _xla_ag_gemm_fn(mesh, axis, tuple(batch_axes), out_dtype)(a, b)
+
+
+@_functools.lru_cache(maxsize=128)
+def _xla_gemm_rs_fn(mesh, axis, batch_axes, out_dtype):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    ba = tuple(batch_axes)
+
+    def body(a_loc, b_loc):
+        part = jnp.dot(a_loc, b_loc, preferred_element_type=jnp.float32)
+        return jax.lax.psum_scatter(
+            part, axis, scatter_dimension=0, tiled=True
+        ).astype(out_dtype)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(ba if ba else None, axis), P(axis, None)),
+        out_specs=P(ba + (axis,) if ba else axis, None),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def xla_gemm_rs(a, b, mesh, axis, *, batch_axes=(), out_dtype=None):
+    """(A @ B) → ReduceScatter via plain XLA — the gemm_rs degradation
+    target. Same layout contract as ``kernels.gemm_rs``."""
+    import jax.numpy as jnp
+
+    out_dtype = jnp.dtype(out_dtype or a.dtype)
+    return _xla_gemm_rs_fn(mesh, axis, tuple(batch_axes), out_dtype)(a, b)

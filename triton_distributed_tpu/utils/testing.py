@@ -38,14 +38,30 @@ def assert_allclose(actual, expected, atol=1e-3, rtol=1e-3, verbose=True):
     raise AssertionError("\n".join(msg))
 
 
-def chaos_delay(cycles: int = 100_000, enable: bool | None = None):
+def chaos_delay(cycles: int = 100_000, enable: bool | None = None, *,
+                site: str | None = None, step: int | None = None,
+                me=None, n: int | None = None):
     """In-kernel delay to widen race windows (call inside a Pallas kernel).
 
     ≡ the reference's ``torch.cuda._sleep`` injection (allgather.py:72-77).
-    No-op unless chaos testing is enabled.
+
+    Two regimes:
+
+    * An active :class:`~triton_distributed_tpu.runtime.faults.FaultPlan`
+      owns this hook point: seeded per-(rank, step) delays are injected
+      from the plan (``site``/``step``/``me``/``n`` give the plan its
+      coordinates; ``me`` is the traced rank index, ``n`` the static
+      ring size). The global ``config.chaos_delay`` boolean is ignored.
+    * No plan: legacy behaviour — a fixed ``cycles`` delay when
+      ``config.chaos_delay`` (or ``enable``) is set, identically on
+      every rank.
     """
     from jax.experimental import pallas as pl
 
+    from triton_distributed_tpu.runtime import faults
+
+    if faults.inject_delay(site, step, me, n, cycles):
+        return
     on = config.chaos_delay if enable is None else enable
     if on:
         pl.delay(cycles)
