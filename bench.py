@@ -226,7 +226,71 @@ def bench_paired(step_a, step_b, state, *, lo=8, hi=40, reps=11):
     )
 
 
-def main() -> None:
+def _parse_args(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="triton_distributed_tpu driver benchmark"
+    )
+    ap.add_argument(
+        "--lint", action="store_true",
+        help="run shmemlint over the benched kernel families BEFORE any "
+        "timing; abort (exit 2) on protocol errors so a broken "
+        "semaphore protocol fails in seconds instead of hanging the "
+        "timed run",
+    )
+    ap.add_argument(
+        "--faults", default=None, metavar="SPEC",
+        help="replay a nightly chaos line on real hardware: a "
+        "(seed, faults) spec, e.g. \"seed=7; Delay(site=allgather, "
+        "rank=2, cycles=50000)\" or the JSON twin (see "
+        "runtime.faults.parse_plan). The plan is active for every "
+        "benched collective.",
+    )
+    return ap.parse_args(argv)
+
+
+def _run_lint() -> None:
+    """bench --lint: static protocol pass over the benched kernel set."""
+    from triton_distributed_tpu.analysis import lint as shmemlint
+    from triton_distributed_tpu.analysis.findings import Severity
+
+    findings = shmemlint.lint_all(n=8)
+    for f in findings:
+        print(json.dumps({"lint": f.to_json()}), file=sys.stderr, flush=True)
+    errs = sum(f.severity >= Severity.ERROR for f in findings)
+    print(
+        json.dumps({"metric": "shmemlint", "errors": errs,
+                    "findings": len(findings)}),
+        file=sys.stderr, flush=True,
+    )
+    if errs:
+        print(
+            json.dumps({
+                "metric": "ag_gemm_tflops_per_chip", "value": 0.0,
+                "unit": "TFLOP/s", "vs_baseline": 0.0,
+                "error": f"shmemlint found {errs} protocol error(s); "
+                "refusing to time broken kernels",
+            }),
+            flush=True,
+        )
+        sys.exit(2)
+
+
+def main(argv=None) -> None:
+    args = _parse_args(argv)
+    if args.lint:
+        _run_lint()
+    if args.faults:
+        from triton_distributed_tpu.runtime import faults as _rt_faults
+
+        plan = _rt_faults.parse_plan(args.faults)
+        _rt_faults.set_fault_plan(plan)
+        print(
+            json.dumps({"metric": "fault_replay", "plan": repr(plan)}),
+            file=sys.stderr, flush=True,
+        )
+
     from triton_distributed_tpu.kernels.ag_gemm import (
         _build_fused,
         _build_xla_naive,

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# lint-shmem: the fail-fast protocol gate of the tier-1 flow.
+#
+# Runs BEFORE the full test budget: a semaphore-protocol regression in a
+# SHMEM kernel (missed wait, credit off-by-one, collective_id collision)
+# fails here in seconds — statically, with rank/semaphore diagnostics —
+# instead of surfacing as a hang the chaos suite's watchdog has to catch
+# minutes later (or not at all on a jax without the TPU-simulation
+# interpreter, where the dynamic race passes are skipped entirely).
+#
+# Two legs, mirroring the satellite contract in docs/ANALYSIS.md:
+#   1. the `analysis`-marked pytest subset (rule fixtures + API surface);
+#   2. the CLI over every registered kernel family on an 8-rank mesh
+#      (exits nonzero on any ERROR-severity finding).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q -m analysis \
+  -p no:cacheprovider "$@"
+JAX_PLATFORMS=cpu python -m triton_distributed_tpu.analysis.lint --mesh 8

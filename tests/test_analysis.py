@@ -1,0 +1,214 @@
+"""shmemlint: static semaphore-protocol analysis (ISSUE 2 acceptance).
+
+The properties pinned here:
+
+* every registered kernel family lints CLEAN on an 8-rank abstract mesh
+  (and the analyzer is shape/size-generic: a 3-rank mesh too);
+* the seeded broken kernels each produce their expected rule ID with
+  rank + site diagnostics — including the ``test_races.py`` caveat (a
+  deliberately missing wait the dynamic race detector has MISSED under
+  ``dma_execution_mode="on_wait"``): :func:`fixtures.missing_wait` is
+  that bug and SL001 flags it statically, forever, on any jax;
+* the CLI (``python -m triton_distributed_tpu.analysis.lint``) walks
+  the registry and exits nonzero exactly when errors exist.
+
+Everything here is static — no interpreter, no devices, no mesh: these
+tests run identically on the 2-vCPU CI runner and a TPU host.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.analysis
+
+from triton_distributed_tpu.analysis import events, fixtures
+from triton_distributed_tpu.analysis.checks import simulate
+from triton_distributed_tpu.analysis.findings import RULES, Severity
+from triton_distributed_tpu.analysis.lint import (
+    _cross_family_checks,
+    analyze_family,
+    analyze_spec,
+    lint_all,
+    lint_family,
+    main as lint_main,
+)
+from triton_distributed_tpu.kernels.registry import families
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _analyze_fixture(fx, n=8, site="fixture"):
+    spec, in_shapes = fx()
+    return analyze_spec(spec, in_shapes(n), n, kernel_name=fx.__name__,
+                        site=site)
+
+
+# ------------------------------------------------------------ registry clean
+
+class TestRegistryClean:
+    def test_all_registered_families_lint_clean_mesh8(self):
+        """ISSUE acceptance: the full registry on --mesh 8, no findings."""
+        findings = lint_all(n=8)
+        assert findings == [], [f.format() for f in findings]
+
+    def test_registry_clean_on_odd_mesh(self):
+        findings = lint_all(n=3)
+        assert findings == [], [f.format() for f in findings]
+
+    def test_every_family_produces_cross_rank_traffic(self):
+        """A vacuously-clean analyzer is worthless: every family's
+        symbolic execution must record real cross-rank events (puts to
+        a different rank and/or remote signals) on every rank."""
+        for name, fam in families().items():
+            rec, _ = analyze_family(fam, 4)
+            for r in range(4):
+                cross = [
+                    e for e in rec.traces[r]
+                    if (isinstance(e, events.PutEvent) and e.dst_rank != r)
+                    or (isinstance(e, events.SignalEvent) and e.target != r)
+                ]
+                assert cross, f"{name}: rank {r} recorded no remote traffic"
+
+    def test_replay_completes_and_balances(self):
+        """The replay simulation itself: the ring allgather completes
+        with every semaphore exactly drained."""
+        rec, _ = analyze_family(families()["allgather.ring_1d"], 4)
+        sim = simulate(rec)
+        assert sim.completed
+        for k, total in sim.delivered.items():
+            assert sim.consumed.get(k, 0) == total, k
+
+
+# --------------------------------------------------------- seeded fixtures
+
+class TestSeededFixtures:
+    def test_missing_wait_flagged_with_rank_and_site(self):
+        """The test_races.py caveat, covered forever: the deliberately
+        removed wait the dynamic detector missed is SL001 here, naming
+        the semaphore, the ranks and the site."""
+        rec, findings = _analyze_fixture(fixtures.missing_wait)
+        assert "SL001" in _rules(findings)
+        f = next(f for f in findings if f.rule == "SL001")
+        assert f.severity == Severity.ERROR
+        assert f.site == "fixture"
+        assert len(f.ranks) > 0
+        assert f.sem
+        # the unordered landing is also caught as a buffer hazard
+        assert "SL004" in _rules(findings)
+
+    def test_credit_imbalance_flagged(self):
+        """Signal-1/wait-2 off-by-one → SL002 on every rank, with the
+        available-vs-required credit arithmetic in the message."""
+        rec, findings = _analyze_fixture(fixtures.credit_imbalance)
+        sl2 = [f for f in findings if f.rule == "SL002"]
+        assert sl2, _rules(findings)
+        assert {r for f in sl2 for r in f.ranks} == set(range(8))
+        assert "only 1 are available" in sl2[0].message
+
+    def test_deadlock_cycle_flagged_with_full_chain(self):
+        rec, findings = _analyze_fixture(fixtures.deadlock)
+        f = next(f for f in findings if f.rule == "SL003")
+        assert set(f.ranks) == set(range(8))
+        for r in range(8):
+            assert f"rank {r}" in f.message
+
+    def test_duplicate_collective_id_flagged(self):
+        (sa, ia), (sb, ib) = fixtures.duplicate_collective_id()
+        ra, _ = analyze_spec(sa, ia(8), 8, kernel_name="dup_a",
+                             site="site_a")
+        rb, _ = analyze_spec(sb, ib(8), 8, kernel_name="dup_b",
+                             site="site_b")
+        findings = _cross_family_checks([ra, rb])
+        assert _rules(findings) == ["SL005"]
+        assert "45" in findings[0].message
+
+    def test_same_site_engines_may_share_collective_id(self):
+        """Engine variants of one op entry share its default id by
+        design — no false positive."""
+        fams = families()
+        recs = [
+            analyze_family(fams[n], 4)[0]
+            for n in ("allgather.ring_1d", "allgather.ll_small")
+        ]
+        assert _cross_family_checks(recs) == []
+
+    def test_barrier_sequence_mismatch_flagged(self):
+        rec, findings = _analyze_fixture(fixtures.barrier_mismatch)
+        f = next(f for f in findings if f.rule == "SL005")
+        assert set(f.ranks) == set(range(1, 8))
+
+    def test_undrained_dma_flagged(self):
+        rec, findings = _analyze_fixture(fixtures.undrained_dma)
+        assert _rules(findings) == ["SL007"]
+        assert all("send_sem" in f.sem for f in findings)
+
+    def test_vmem_overcommit_flagged(self):
+        rec, findings = _analyze_fixture(fixtures.vmem_overcommit)
+        f = next(f for f in findings if f.rule == "SL006")
+        assert "big_ref" in f.message
+
+
+# ------------------------------------------------------------------ the CLI
+
+class TestCLI:
+    def test_cli_clean_registry_exits_zero(self, capsys):
+        assert lint_main(["--mesh", "4"]) == 0
+        err = capsys.readouterr().err
+        assert "0 error(s)" in err
+
+    def test_cli_kernel_filter_and_json(self, capsys):
+        assert lint_main(["--mesh", "4", "--kernel", "allgather",
+                          "--json"]) == 0
+
+    def test_cli_rejects_trivial_mesh(self):
+        with pytest.raises(SystemExit):
+            lint_main(["--mesh", "1"])
+
+    def test_cli_list(self, capsys):
+        assert lint_main(["--list"]) == 0
+        out = capsys.readouterr().out
+        for name in families():
+            assert name in out
+
+    def test_allow_demotes_severity(self):
+        spec, in_shapes = fixtures.vmem_overcommit()
+        _, findings = analyze_spec(spec, in_shapes(4), 4,
+                                   kernel_name="fx", site="fixture")
+        from triton_distributed_tpu.analysis.lint import _apply_allow
+
+        demoted = _apply_allow(findings, {"SL006"})
+        assert all(f.severity == Severity.INFO for f in demoted
+                   if f.rule == "SL006")
+
+
+# --------------------------------------------------------------- event model
+
+class TestEventModel:
+    def test_rule_catalog_is_stable(self):
+        """Rule ids are load-bearing (docs, suppressions, this file):
+        removing or renumbering one is a breaking change."""
+        assert set(RULES) == {
+            "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007"
+        }
+
+    def test_ring_trace_targets_right_neighbor(self):
+        rec, _ = analyze_family(families()["allgather.ring_1d"], 4)
+        for r in range(4):
+            puts = [e for e in rec.traces[r]
+                    if isinstance(e, events.PutEvent) and not e.local]
+            assert puts and all(p.dst_rank == (r + 1) % 4 for p in puts)
+
+    def test_region_overlap_semantics(self):
+        a = events.Region("buf", (0, 0), (8, 128))
+        b = events.Region("buf", (7, 0), (9, 128))
+        c = events.Region("buf", (8, 0), (16, 128))
+        d = events.Region("other", (0, 0), (8, 128))
+        assert a.overlaps(b) and b.overlaps(c)
+        assert not a.overlaps(c) and not a.overlaps(d)
+
+    def test_lint_family_by_name(self):
+        assert lint_family("gemm_rs.fused", n=4) == []
+        with pytest.raises(KeyError):
+            lint_family("no_such_kernel")

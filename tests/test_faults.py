@@ -114,6 +114,138 @@ class TestFaultPlan:
                     pass
 
 
+# ------------------------------------------------------------- stall cap
+
+
+class TestStallCap:
+    def test_matrix_larger_than_cap_skips_excess(self, monkeypatch):
+        """ISSUE satellite: a 5-stall matrix under
+        ``max_concurrent_stalls=2`` holds at most 2 gates; the other 3
+        stall_wait calls return immediately instead of parking worker
+        threads (the 2-vCPU CI wedge the cap exists to prevent)."""
+        monkeypatch.setenv("TDTPU_STALL_TIMEOUT", "20")
+        plan = FaultPlan(
+            seed=0,
+            faults=tuple(Stall(site=f"cap{i}", rank=0) for i in range(5)),
+            max_concurrent_stalls=2,
+        )
+        done: list = []
+
+        def worker(i):
+            faults.stall_wait(f"cap{i}", 0)
+            done.append(i)
+
+        with fault_plan(plan):
+            threads = [
+                threading.Thread(target=worker, args=(i,), daemon=True)
+                for i in range(5)
+            ]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 10
+            while len(done) < 3 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # 3 of 5 skipped promptly; exactly the cap's worth held
+            assert len(done) == 3, f"over-cap stalls did not skip: {done}"
+            assert faults.held_stalls() == 2
+            faults.release_stalls()
+            for t in threads:
+                t.join(timeout=10)
+            assert not any(t.is_alive() for t in threads)
+        assert faults.held_stalls() == 0, "held count must drain to zero"
+
+    def test_uncapped_plan_holds_all(self, monkeypatch):
+        """Without a cap every matching stall parks (the pre-cap
+        behaviour chaos tests rely on)."""
+        monkeypatch.setenv("TDTPU_STALL_TIMEOUT", "20")
+        plan = FaultPlan(
+            seed=0,
+            faults=tuple(Stall(site=f"unc{i}", rank=0) for i in range(3)),
+        )
+        with fault_plan(plan):
+            threads = [
+                threading.Thread(
+                    target=faults.stall_wait, args=(f"unc{i}", 0),
+                    daemon=True,
+                )
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 10
+            while faults.held_stalls() < 3 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert faults.held_stalls() == 3
+            faults.release_stalls()
+            for t in threads:
+                t.join(timeout=10)
+        assert faults.held_stalls() == 0
+
+    def test_cap_in_trace_key(self):
+        """Changing the cap must invalidate cached kernel builds, same
+        as any other plan field."""
+        a = FaultPlan(seed=1, max_concurrent_stalls=2)
+        b = FaultPlan(seed=1, max_concurrent_stalls=3)
+        assert a.key() != b.key()
+
+
+# ------------------------------------------------------------ plan replay
+
+
+class TestParsePlan:
+    """bench --faults replay: a nightly chaos line round-trips back
+    into the plan that produced it."""
+
+    def test_compact_format(self):
+        plan = faults.parse_plan(
+            "seed=7; Delay(site=allgather, rank=2, cycles=50000); "
+            "Stall(site=ag_gemm, rank=3); max_concurrent_stalls=2"
+        )
+        assert plan == FaultPlan(
+            seed=7,
+            faults=(
+                Delay(site="allgather", rank=2, cycles=50000),
+                Stall(site="ag_gemm", rank=3),
+            ),
+            max_concurrent_stalls=2,
+        )
+
+    def test_json_format(self):
+        plan = faults.parse_plan(
+            '{"seed": 7, "faults": [{"kind": "Delay", "site": '
+            '"allgather", "cycles": 50000}], "max_concurrent_stalls": 2}'
+        )
+        assert plan == FaultPlan(
+            seed=7,
+            faults=(Delay(site="allgather", cycles=50000),),
+            max_concurrent_stalls=2,
+        )
+
+    def test_repr_roundtrip(self):
+        """The compact format is the dataclass reprs joined by ';' —
+        exactly what a nightly log line carries."""
+        plan = FaultPlan(
+            seed=11,
+            faults=(
+                SignalFault(site="allgather", rank=1, kind="drop"),
+                Corrupt(site="gemm_rs", rank=2, word=3, value=5.0),
+            ),
+            max_concurrent_stalls=1,
+        )
+        line = "seed=11; " + "; ".join(
+            repr(f) for f in plan.faults
+        ) + "; max_concurrent_stalls=1"
+        assert faults.parse_plan(line) == plan
+
+    def test_unknown_fault_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faults.parse_plan("Frob(site=allgather)")
+
+    def test_garbage_segment_rejected(self):
+        with pytest.raises(ValueError, match="cannot parse"):
+            faults.parse_plan("seed=1; what even is this")
+
+
 # ---------------------------------------------------------------- watchdog
 
 

@@ -1,0 +1,449 @@
+"""Checker passes over recorded per-rank SHMEM event traces.
+
+The core is a cross-rank *replay*: all ranks' straight-line traces are
+advanced together under TPU semaphore semantics — a DMA start delivers
+its credits immediately (the transfer completes asynchronously
+regardless of sender progress), a ``signal_op`` delivers when executed,
+a wait blocks until enough credits are available and consumes them.
+Replay to quiescence either completes (then the balance/hazard rules
+run) or wedges (then the blocked waits are classified into
+unsatisfiable waits and genuine cross-rank deadlock cycles).
+
+Ordering is tracked with vector clocks:
+
+* every executed event stamps the executing rank's clock;
+* a credit carries the sender's clock at delivery;
+* a *consuming wait* joins the clocks of the credits it can actually
+  vouch for. TPU semaphores count, they don't tag: a wait for ``v``
+  knows *which* transfers have landed only when the credit source is
+  unambiguous — all credits on the slot come from one source rank
+  (per-(src, dst) issue order is a hardware guarantee), or the wait has
+  consumed *every* credit the slot will ever carry (the barrier
+  pattern). Ambiguous consumption keeps the count but joins nothing —
+  conservative in exactly the way slot-reuse bugs require.
+
+The buffer-hazard rule then asks, for every remote DMA landing in a
+symmetric buffer: is each local access to an overlapping region ordered
+against the landing, either because the access happened-before the DMA
+*start* (clock comparison) or because a wait that vouches for the
+landing completed before the access (consumption order)? Neither ⇒ the
+classic write-after-read/write-after-write over RDMA.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from triton_distributed_tpu.analysis import events as ev
+from triton_distributed_tpu.analysis.findings import Finding
+
+
+def _fmt_key(key) -> str:
+    name, slot = key
+    return name + (str(list(slot)) if slot else "")
+
+
+# ---------------------------------------------------------------- simulation
+
+@dataclass
+class Credit:
+    weight: int
+    src: int
+    src_idx: int
+    clock: tuple
+    kind: str                   # signal | dma_send | dma_recv | local_copy
+    consumed: int = 0
+
+
+@dataclass
+class SimResult:
+    completed: bool
+    pcs: list
+    credits: dict               # (owner, key) -> [Credit]
+    delivered: dict             # (owner, key) -> int
+    consumed: dict              # (owner, key) -> int
+    total_ever: dict            # (owner, key) -> int (whole-trace count)
+    sources: dict               # (owner, key) -> set of src ranks
+    remote_writes: list         # PutEvents with cross-rank landings
+    guarantee: dict             # (src, src_idx) -> (dst, wait_idx, wait_ctr)
+    local_access: list          # per rank: [(idx, ctr, vc, region, kind)]
+    blocked: list               # [(rank, WaitEvent)]
+
+
+def _deliveries(e):
+    """Static (owner, key, weight, kind, src_idx) deliveries of one event."""
+    out = []
+    if isinstance(e, ev.PutEvent):
+        kind = "local_copy" if e.local else "dma_send"
+        out.append((e.rank, e.send_key, 1, kind))
+        if not e.local and e.recv_key is not None:
+            out.append((e.dst_rank, e.recv_key, 1, "dma_recv"))
+    elif isinstance(e, ev.SignalEvent):
+        out.append((e.target, e.key, e.inc, "signal"))
+    return out
+
+
+def simulate(rec: ev.Recorder) -> SimResult:
+    n = rec.n
+    traces = rec.traces
+    credits: dict = {}
+    delivered: dict = {}
+    consumed: dict = {}
+    total_ever: dict = {}
+    sources: dict = {}
+    for r in range(n):
+        for e in traces[r]:
+            for owner, key, w, kind in _deliveries(e):
+                k = (owner, key)
+                total_ever[k] = total_ever.get(k, 0) + w
+                sources.setdefault(k, set()).add(e.rank)
+
+    clocks = [[0] * n for _ in range(n)]
+    pcs = [0] * n
+    remote_writes: list = []
+    guarantee: dict = {}
+    local_access: list = [[] for _ in range(n)]
+
+    def execute(r, e):
+        clocks[r][r] += 1
+        e.vc = tuple(clocks[r])
+        e.ctr = clocks[r][r]
+        if isinstance(e, ev.PutEvent):
+            # the RDMA reads its source and (locally) writes its
+            # destination; modeled at start time for hazard purposes
+            local_access[r].append((e.idx, e.ctr, e.vc, e.src_region, "r"))
+            if e.local:
+                local_access[r].append(
+                    (e.idx, e.ctr, e.vc, e.dst_region, "w"))
+            else:
+                remote_writes.append(e)
+            for owner, key, w, kind in _deliveries(e):
+                k = (owner, key)
+                credits.setdefault(k, []).append(
+                    Credit(w, r, e.idx, e.vc, kind))
+                delivered[k] = delivered.get(k, 0) + w
+        elif isinstance(e, ev.SignalEvent):
+            k = (e.target, e.key)
+            credits.setdefault(k, []).append(
+                Credit(e.inc, r, e.idx, e.vc, "signal"))
+            delivered[k] = delivered.get(k, 0) + e.inc
+        elif isinstance(e, (ev.ReadEvent, ev.WriteEvent)):
+            kind = "r" if isinstance(e, ev.ReadEvent) else "w"
+            local_access[r].append((e.idx, e.ctr, e.vc, e.region, kind))
+
+    def try_wait(r, e) -> bool:
+        k = (r, e.key)
+        avail = delivered.get(k, 0) - consumed.get(k, 0)
+        if avail < e.value:
+            return False
+        clocks[r][r] += 1
+        e.vc = None  # assigned below after joins
+        e.ctr = clocks[r][r]
+        pool = credits.get(k, [])
+        cum_before = consumed.get(k, 0)
+        cum = cum_before + e.value
+        consumed[k] = cum
+        # consume the earliest-delivered credits
+        need = e.value
+        taken = []
+        for c in pool:
+            if need == 0:
+                break
+            free = c.weight - c.consumed
+            if free == 0:
+                continue
+            take = min(free, need)
+            c.consumed += take
+            need -= take
+            taken.append(c)
+        # which credits can this wait vouch for? (see module docstring)
+        single_src = len(sources.get(k, set())) <= 1
+        all_ever = cum >= total_ever.get(k, 0)
+        if single_src or all_ever:
+            vouched = [c for c in pool if c.consumed == c.weight]
+            for c in vouched:
+                for d in range(n):
+                    clocks[r][d] = max(clocks[r][d], c.clock[d])
+                if c.kind == "dma_recv":
+                    guarantee.setdefault(
+                        (c.src, c.src_idx), (r, e.idx, e.ctr))
+        e.vc = tuple(clocks[r])
+        return True
+
+    progress = True
+    while progress:
+        progress = False
+        for r in range(n):
+            while pcs[r] < len(traces[r]):
+                e = traces[r][pcs[r]]
+                if isinstance(e, ev.WaitEvent):
+                    if not try_wait(r, e):
+                        break
+                else:
+                    execute(r, e)
+                pcs[r] += 1
+                progress = True
+
+    blocked = [
+        (r, traces[r][pcs[r]])
+        for r in range(n)
+        if pcs[r] < len(traces[r])
+    ]
+    return SimResult(
+        completed=not blocked,
+        pcs=pcs,
+        credits=credits,
+        delivered=delivered,
+        consumed=consumed,
+        total_ever=total_ever,
+        sources=sources,
+        remote_writes=remote_writes,
+        guarantee=guarantee,
+        local_access=local_access,
+        blocked=blocked,
+    )
+
+
+# ------------------------------------------------------------------- checks
+
+def _check_blocked(rec, sim) -> list:
+    """Classify a wedged replay: waits whose credits never come (SL002)
+    vs genuine cross-rank wait-for cycles (SL003)."""
+    findings = []
+    kernel, site = rec.info.kernel, rec.info.site
+    providers: dict = {}
+    for r, w in sim.blocked:
+        k = (r, w.key)
+        provs = set()
+        future = 0
+        for s in range(rec.n):
+            for e in rec.traces[s][sim.pcs[s]:]:
+                for owner, key, wt, kind in _deliveries(e):
+                    if (owner, key) == k:
+                        provs.add(s)
+                        future += wt
+        avail = sim.delivered.get(k, 0) - sim.consumed.get(k, 0)
+        if avail + future < w.value:
+            findings.append(Finding(
+                "SL002", kernel,
+                f"rank {r} waits for {w.value} credit(s) on "
+                f"{_fmt_key(w.key)} but only {avail} are available and "
+                f"{future} more can ever arrive (all ranks' remaining "
+                "events considered) — this is a hang at runtime",
+                site=site, ranks=(r,), sem=_fmt_key(w.key), phase=w.phase,
+            ))
+        else:
+            providers[r] = provs
+    # cycle hunt over ranks blocked purely on other blocked ranks
+    seen_cycles = set()
+    for start in providers:
+        path, node = [], start
+        on_path = {}
+        while node in providers and node not in on_path:
+            on_path[node] = len(path)
+            path.append(node)
+            nxts = [s for s in providers[node] if s in providers]
+            if not nxts:
+                path = []
+                break
+            node = min(nxts)
+        if path and node in on_path:
+            cycle = tuple(path[on_path[node]:])
+            canon = tuple(sorted(cycle))
+            if canon in seen_cycles:
+                continue
+            seen_cycles.add(canon)
+            chain = " -> ".join(
+                f"rank {r} [waits {_fmt_key(dict(sim.blocked)[r].key)}]"
+                for r in cycle
+            ) + f" -> rank {cycle[0]}"
+            findings.append(Finding(
+                "SL003", kernel,
+                f"cross-rank wait-for cycle: {chain}; every rank's "
+                "missing credit sits behind another parked wait",
+                site=site, ranks=canon,
+                sem=_fmt_key(dict(sim.blocked)[cycle[0]].key),
+                phase=dict(sim.blocked)[cycle[0]].phase,
+            ))
+    if not findings and sim.blocked:
+        # blocked on providers that are themselves SL002/..-stuck
+        ranks = tuple(sorted(r for r, _ in sim.blocked))
+        r0, w0 = sim.blocked[0]
+        findings.append(Finding(
+            "SL002", kernel,
+            f"ranks {list(ranks)} are transitively wedged behind an "
+            "unsatisfiable wait",
+            site=site, ranks=ranks, sem=_fmt_key(w0.key), phase=w0.phase,
+        ))
+    return findings
+
+
+def _check_balance(rec, sim) -> list:
+    """SL001/SL007: credits left on semaphores after a clean run."""
+    findings = []
+    kernel, site = rec.info.kernel, rec.info.site
+    leftovers: dict = {}
+    for (owner, key), total in sim.delivered.items():
+        used = sim.consumed.get((owner, key), 0)
+        if total > used:
+            kinds = {
+                c.kind for c in sim.credits[(owner, key)]
+                if c.consumed < c.weight
+            }
+            leftovers.setdefault((key, frozenset(kinds)), []).append(
+                (owner, total - used))
+    for (key, kinds), owners in sorted(
+        leftovers.items(), key=lambda kv: str(kv[0])
+    ):
+        ranks = tuple(r for r, _ in owners)
+        excess = {r: x for r, x in owners}
+        if kinds <= {"dma_send", "local_copy"}:
+            findings.append(Finding(
+                "SL007", kernel,
+                f"{sum(excess.values())} started DMA(s) never locally "
+                f"drained on {_fmt_key(key)} (missing quiet()/"
+                f"wait_send()); per-rank excess {excess}",
+                site=site, ranks=ranks, sem=_fmt_key(key),
+            ))
+        else:
+            findings.append(Finding(
+                "SL001", kernel,
+                f"credit imbalance on {_fmt_key(key)}: "
+                f"{sum(excess.values())} credit(s) signaled but never "
+                f"consumed by a wait (per-rank excess {excess}) — a "
+                "missing signal_wait_until / off-by-one in the wait "
+                "value; the next launch reusing this semaphore is "
+                "released early",
+                site=site, ranks=ranks, sem=_fmt_key(key),
+            ))
+    return findings
+
+
+def _check_hazards(rec, sim) -> list:
+    """SL004: remote DMA landings unordered against local accesses."""
+    findings = []
+    kernel, site = rec.info.kernel, rec.info.site
+    reported = set()
+    for w in sim.remote_writes:
+        d = w.dst_rank
+        if not (0 <= d < rec.n):
+            continue
+        g = sim.guarantee.get((w.rank, w.idx))
+        for idx, ctr, vc, region, kind in sim.local_access[d]:
+            if region is None or not w.dst_region.overlaps(region):
+                continue
+            # access happened-before the DMA start?
+            if w.vc[d] >= ctr:
+                continue
+            # a wait vouching for the landing completed before the access?
+            if g is not None and g[0] == d and g[1] < idx:
+                continue
+            sig = ("local", w.send_key, region.ref, d, kind)
+            if sig in reported:
+                continue
+            reported.add(sig)
+            findings.append(Finding(
+                "SL004", kernel,
+                f"put from rank {w.rank} lands in {w.dst_region} on rank "
+                f"{d} while rank {d} {'reads' if kind == 'r' else 'writes'}"
+                f" {region} with no ordering wait/fence between them "
+                "(write-after-read over RDMA)",
+                site=site, ranks=(w.rank, d), sem=_fmt_key(w.recv_key),
+                phase=w.phase,
+            ))
+        # unordered overlapping landings from two different sources
+        for w2 in sim.remote_writes:
+            if w2 is w or w2.dst_rank != d or w2.rank == w.rank:
+                continue
+            if not w.dst_region.overlaps(w2.dst_region):
+                continue
+            if (w.rank, w.idx) > (w2.rank, w2.idx):
+                continue
+            g1 = sim.guarantee.get((w.rank, w.idx))
+            g2 = sim.guarantee.get((w2.rank, w2.idx))
+            ordered = (
+                (g1 is not None and g1[0] == d and w2.vc[d] >= g1[2])
+                or (g2 is not None and g2[0] == d and w.vc[d] >= g2[2])
+            )
+            if ordered:
+                continue
+            sig = ("waw", d, w.dst_region.ref,
+                   tuple(sorted((w.rank, w2.rank))))
+            if sig in reported:
+                continue
+            reported.add(sig)
+            findings.append(Finding(
+                "SL004", kernel,
+                f"unordered overlapping RDMA landings on rank {d}: "
+                f"{w.dst_region} from rank {w.rank} vs {w2.dst_region} "
+                f"from rank {w2.rank} (write-after-write over RDMA)",
+                site=site, ranks=(w.rank, w2.rank, d),
+                sem=_fmt_key(w.recv_key), phase=w.phase,
+            ))
+    return findings
+
+
+def _check_barriers(rec) -> list:
+    """SL005 (per family): barrier use without a collective_id; ranks
+    disagreeing on the barrier sequence. (Cross-family collective_id
+    uniqueness lives in lint.py where all families are visible.)"""
+    findings = []
+    kernel, site = rec.info.kernel, rec.info.site
+    if rec.barrier_sem_used and rec.info.collective_id is None:
+        findings.append(Finding(
+            "SL005", kernel,
+            "kernel touches the global barrier semaphore but its launch "
+            "sets no collective_id (Mosaic rejects this at compile time; "
+            "two such kernels would share one unkeyed rendezvous)",
+            site=site,
+        ))
+
+    def seq(r):
+        out = []
+        for e in rec.traces[r]:
+            if isinstance(e, ev.BarrierEvent):
+                out.append(("barrier", e.collective_id))
+            elif isinstance(e, ev.WaitEvent) and e.key[0] == "barrier_sem":
+                out.append(("wait", e.value))
+        return out
+
+    ref = seq(0)
+    bad = tuple(r for r in range(1, rec.n) if seq(r) != ref)
+    if bad:
+        findings.append(Finding(
+            "SL005", kernel,
+            f"ranks {list(bad)} execute a different barrier sequence "
+            f"than rank 0 ({seq(bad[0])} vs {ref}) — collective "
+            "rendezvous diverges across ranks",
+            site=site, ranks=bad,
+        ))
+    return findings
+
+
+def _check_vmem(rec) -> list:
+    """SL006: VMEM-resident working set vs the per-core budget."""
+    from triton_distributed_tpu.config import fused_vmem_budget
+
+    limit = rec.info.vmem_limit_bytes or fused_vmem_budget()
+    if rec.info.vmem_bytes <= limit:
+        return []
+    top = sorted(rec.info.vmem_breakdown, key=lambda kv: -kv[1])[:4]
+    detail = ", ".join(f"{n}={b // 1024}KiB" for n, b in top)
+    return [Finding(
+        "SL006", rec.info.kernel,
+        f"VMEM working set {rec.info.vmem_bytes // 1024}KiB exceeds the "
+        f"budget {limit // 1024}KiB (largest: {detail})",
+        site=rec.info.site,
+    )]
+
+
+def check_family(rec: ev.Recorder) -> list:
+    """All per-family passes over one recorded kernel family."""
+    sim = simulate(rec)
+    findings = _check_barriers(rec) + _check_vmem(rec)
+    if sim.completed:
+        findings += _check_balance(rec, sim)
+        findings += _check_hazards(rec, sim)
+    else:
+        findings += _check_blocked(rec, sim)
+    return findings

@@ -1,0 +1,180 @@
+"""Event/trace model for shmemlint and the active-recorder registry.
+
+The ``lang.shmem`` primitives and the abstract evaluator's patched
+Pallas environment feed a :class:`Recorder` while a kernel body is
+symbolically executed once per rank. The result is one straight-line
+event list per rank; :mod:`checks` replays all of them together as a
+cross-rank schedule.
+
+Events are deliberately low-level — every cross-rank interaction is
+expressed as semaphore credits and consuming waits, exactly the TPU
+semantics the kernels are written against:
+
+* a remote put delivers one credit to the *sender's* send semaphore
+  (local drain) and one to the *receiver's* recv semaphore (arrival,
+  ordered after the payload lands);
+* ``signal_op`` delivers ``inc`` credits to the target rank's
+  semaphore;
+* a wait for value ``v`` blocks until ``v`` credits are available and
+  consumes them (TPU consuming-wait semantics).
+
+Barrier/fence events ride along as markers for the hygiene checks and
+phase attribution.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+# ------------------------------------------------------------------ regions
+
+@dataclass(frozen=True)
+class Region:
+    """A rectangular element region of a named ref: per-dim half-open
+    ``[lo, hi)`` intervals. Ref names are SPMD — the same name on two
+    ranks denotes each rank's own instance of the symmetric buffer."""
+
+    ref: str
+    lo: tuple
+    hi: tuple
+
+    def overlaps(self, other: "Region") -> bool:
+        if self.ref != other.ref:
+            return False
+        ndim = min(len(self.lo), len(other.lo))
+        for d in range(ndim):
+            if self.hi[d] <= other.lo[d] or other.hi[d] <= self.lo[d]:
+                return False
+        return True
+
+    def __str__(self):
+        spans = ",".join(
+            f"{lo}:{hi}" for lo, hi in zip(self.lo, self.hi)
+        )
+        return f"{self.ref}[{spans}]"
+
+
+# ------------------------------------------------------------------- events
+
+@dataclass
+class Event:
+    rank: int = -1      # assigned by the recorder
+    idx: int = -1       # position in the rank's trace
+    phase: int = 0      # number of barrier_all calls passed on this rank
+
+
+@dataclass
+class ReadEvent(Event):
+    region: Region = None
+
+
+@dataclass
+class WriteEvent(Event):
+    region: Region = None
+
+
+@dataclass
+class PutEvent(Event):
+    """A started DMA. ``dst_rank == rank`` with ``local=True`` is a
+    local async copy (single completion semaphore ``send_key``)."""
+
+    src_region: Region = None
+    dst_region: Region = None
+    dst_rank: int = -1
+    send_key: tuple = None      # (sem_name, slot) on the issuing rank
+    recv_key: tuple = None      # (sem_name, slot) on the dst rank
+    local: bool = False
+
+
+@dataclass
+class SignalEvent(Event):
+    key: tuple = None
+    target: int = -1
+    inc: int = 1
+    site: str | None = None
+
+
+@dataclass
+class WaitEvent(Event):
+    key: tuple = None
+    value: int = 1
+
+
+@dataclass
+class BarrierEvent(Event):
+    collective_id: object = None
+
+
+@dataclass
+class FenceEvent(Event):
+    pass
+
+
+# ----------------------------------------------------------------- recorder
+
+@dataclass
+class LaunchInfo:
+    """Static launch facts the checks need alongside the traces."""
+
+    kernel: str = "?"
+    site: str | None = None
+    collective_id: object = None
+    vmem_limit_bytes: int | None = None
+    vmem_bytes: int = 0                 # VMEM-resident working set
+    vmem_breakdown: tuple = ()
+
+
+class Recorder:
+    """Per-kernel-family trace recorder. ``me`` is the rank currently
+    being symbolically executed; hooks consult :func:`active_recorder`
+    and append to ``traces[me]``."""
+
+    def __init__(self, n: int, axis: str, mesh_axes=None,
+                 info: LaunchInfo | None = None):
+        self.n = int(n)
+        self.axis = axis
+        self.mesh_axes = tuple(mesh_axes) if mesh_axes else (axis,)
+        self.me: int | None = None
+        self.info = info or LaunchInfo()
+        self.traces: list[list[Event]] = [[] for _ in range(self.n)]
+        self._phase = 0
+        self.barrier_sem_used = False
+
+    def emit(self, ev: Event) -> Event:
+        assert self.me is not None, "recorder has no current rank"
+        ev.rank = self.me
+        ev.idx = len(self.traces[self.me])
+        ev.phase = self._phase
+        if isinstance(ev, BarrierEvent):
+            self._phase += 1
+        self.traces[self.me].append(ev)
+        return ev
+
+    def start_rank(self, me: int) -> None:
+        self.me = int(me)
+        self._phase = 0
+
+    # convenience used by checks/tests
+    def events(self, kind=None):
+        for r in range(self.n):
+            for ev in self.traces[r]:
+                if kind is None or isinstance(ev, kind):
+                    yield ev
+
+
+_ACTIVE: Recorder | None = None
+
+
+def active_recorder() -> Recorder | None:
+    """The recorder the ``lang.shmem`` hook layer feeds, or None when no
+    symbolic execution is in progress (the common case — every hook
+    call site checks this first and falls through to real Pallas)."""
+    return _ACTIVE
+
+
+def set_recorder(rec: Recorder | None) -> Recorder | None:
+    global _ACTIVE
+    old = _ACTIVE
+    _ACTIVE = rec
+    return old

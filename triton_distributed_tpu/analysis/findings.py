@@ -1,0 +1,133 @@
+"""Finding model and the SL rule catalog.
+
+Rule IDs are STABLE — tests, suppression annotations and docs refer to
+them by name (docs/ANALYSIS.md is the human-facing catalog). Adding a
+rule appends; renumbering is a breaking change.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+
+#: rule id -> (slug, default severity, one-line description)
+RULES = {
+    "SL001": (
+        "credit-imbalance",
+        Severity.ERROR,
+        "semaphore credits left unconsumed at kernel exit (signals/DMA "
+        "arrivals exceed waits) — the next launch reusing the semaphore "
+        "inherits stale credits and releases a wait early",
+    ),
+    "SL002": (
+        "unsatisfiable-wait",
+        Severity.ERROR,
+        "a semaphore wait whose required credits never arrive on any "
+        "rank — at runtime this is a silent hang the watchdog must catch",
+    ),
+    "SL003": (
+        "deadlock-cycle",
+        Severity.ERROR,
+        "cross-rank wait-for cycle: every rank in the chain is parked in "
+        "a wait whose credit is behind another parked rank's wait",
+    ),
+    "SL004": (
+        "unsynchronized-buffer-write",
+        Severity.ERROR,
+        "a remote DMA lands in a symmetric-buffer region that a local "
+        "access also touches, with no wait/fence ordering the two "
+        "(write-after-read / write-after-write over RDMA)",
+    ),
+    "SL005": (
+        "barrier-hygiene",
+        Severity.ERROR,
+        "collective_id misuse: duplicate id across kernel families, "
+        "barrier-semaphore use without a collective_id, or ranks "
+        "disagreeing on the barrier sequence",
+    ),
+    "SL006": (
+        "vmem-overcommit",
+        Severity.ERROR,
+        "the kernel's VMEM-resident working set (inputs + outputs + "
+        "scratch) exceeds the per-core VMEM budget",
+    ),
+    "SL007": (
+        "undrained-dma",
+        Severity.WARNING,
+        "a started DMA whose send (local completion) semaphore is never "
+        "waited — the kernel can exit with the transfer in flight "
+        "(missing quiet()/wait_send())",
+    ),
+}
+
+
+@dataclass
+class Finding:
+    """One lint finding, with enough coordinates to act on it:
+    ``kernel`` (registry family), ``site`` (fault-plan site name),
+    ``ranks`` involved, the semaphore ``sem`` (name + slot), and the
+    barrier-``phase`` index the event sat in (number of ``barrier_all``
+    calls the rank had passed)."""
+
+    rule: str
+    kernel: str
+    message: str
+    site: str | None = None
+    ranks: tuple = ()
+    sem: str | None = None
+    phase: int | None = None
+    severity: Severity = field(default=None)  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.rule not in RULES:
+            raise ValueError(f"unknown rule id: {self.rule}")
+        if self.severity is None:
+            self.severity = RULES[self.rule][1]
+
+    @property
+    def slug(self) -> str:
+        return RULES[self.rule][0]
+
+    def format(self) -> str:
+        loc = self.kernel + (f" [site={self.site}]" if self.site else "")
+        bits = []
+        if self.ranks:
+            bits.append(f"ranks={list(self.ranks)}")
+        if self.sem:
+            bits.append(f"sem={self.sem}")
+        if self.phase is not None:
+            bits.append(f"phase={self.phase}")
+        tail = (" (" + ", ".join(bits) + ")") if bits else ""
+        return (
+            f"{self.rule} {self.severity.name.lower()} {self.slug} "
+            f"@ {loc}: {self.message}{tail}"
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "slug": self.slug,
+            "severity": self.severity.name.lower(),
+            "kernel": self.kernel,
+            "site": self.site,
+            "ranks": list(self.ranks),
+            "sem": self.sem,
+            "phase": self.phase,
+            "message": self.message,
+        }
+
+
+def worst(findings) -> Severity | None:
+    sevs = [f.severity for f in findings]
+    return max(sevs) if sevs else None
+
+
+def has_errors(findings) -> bool:
+    return any(f.severity >= Severity.ERROR for f in findings)

@@ -1,0 +1,244 @@
+"""Deliberately broken SHMEM kernels — one per shmemlint rule.
+
+These exist so every rule is pinned by a real kernel body forever, and
+specifically to close the caveat ``tests/test_races.py`` documents: the
+TPU interpreter's dynamic race detector has MISSED a deliberately
+removed wait under ``dma_execution_mode="on_wait"``. The
+:func:`missing_wait` fixture is exactly that bug, and
+``tests/test_analysis.py`` asserts shmemlint flags it (SL001) with
+rank + semaphore diagnostics — statically, on any jax, no interpreter
+required.
+
+Each fixture returns a hand-built
+:class:`~triton_distributed_tpu.lang.launch.LaunchSpec` plus the
+per-device input shapes, ready for
+:func:`triton_distributed_tpu.analysis.lint.analyze_spec`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from triton_distributed_tpu import lang
+from triton_distributed_tpu.lang.launch import LaunchSpec
+
+_F32 = np.dtype(np.float32)
+
+
+def _spec(kernel, name, out_shapes=(), scratch=(), collective_id=None,
+          vmem_limit_bytes=None):
+    import jax
+
+    return LaunchSpec(
+        name=name,
+        kernel=kernel,
+        out_shape=[jax.ShapeDtypeStruct(s, d) for s, d in out_shapes],
+        in_specs=None,
+        out_specs=None,
+        scratch_shapes=tuple(scratch),
+        collective_id=collective_id,
+        vmem_limit_bytes=vmem_limit_bytes,
+    )
+
+
+def _sems(*shapes):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return [pltpu.SemaphoreType.DMA(s) if s else pltpu.SemaphoreType.REGULAR(())
+            for s in shapes]
+
+
+def missing_wait(axis="x"):
+    """The test_races caveat, seeded: every rank pushes its shard to
+    every peer and signals arrival, but the consuming
+    ``signal_wait_until`` was "forgotten" — the kernel reads the
+    gathered buffer with nothing ordering the landings. Dynamically
+    this is a probabilistic wrong-answer; statically it is SL001
+    (unconsumed flag credits) + SL004 (unordered landing vs the read).
+    """
+
+    def kernel(x_ref, out_ref, chk_ref, send_sem, recv_sem, flag_sem):
+        me = lang.my_pe(axis)
+        n = lang.n_pes(axis)
+        m = x_ref.shape[0]
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        out_ref[pl.ds(me * m, m)] = x_ref[:]
+        lang.barrier_all(axis)
+        handles = []
+        for i in range(n - 1):
+            peer = (me + 1 + i) % n
+            handles.append(lang.putmem_signal_nbi_block(
+                out_ref.at[pl.ds(me * m, m)],
+                x_ref,
+                send_sem.at[i],
+                recv_sem.at[i],
+                peer,
+            ))
+            lang.signal_op(flag_sem, 1, pe=peer, site="fixture")
+        lang.quiet(*handles)
+        # BUG: no `for i in range(n-1): lang.signal_wait_until(flag_sem, 1)`
+        # and no recv waits — the landings are unordered with this read:
+        chk_ref[0, 0] = jnp.sum(out_ref[:])
+
+    return (
+        _spec(
+            kernel, "fixture_missing_wait",
+            out_shapes=[((8 * 8, 128), _F32), ((1, 1), _F32)],
+            scratch=_sems((8,), (8,), None),
+            collective_id=40,
+        ),
+        lambda n: [((8, 128), _F32)],
+    )
+
+
+def credit_imbalance(axis="x"):
+    """Off-by-one credit accounting: each rank sends ONE barrier credit
+    (to its right neighbor) but waits for TWO — the classic symptom
+    that today only shows up as a hang the watchdog must catch. SL002.
+    """
+
+    def kernel(x_ref, out_ref, sem):
+        me = lang.my_pe(axis)
+        n = lang.n_pes(axis)
+        lang.signal_op(sem, 1, pe=(me + 1) % n, site="fixture")
+        lang.signal_wait_until(sem, 2)     # BUG: only 1 credit ever comes
+        out_ref[:] = x_ref[:]
+
+    return (
+        _spec(
+            kernel, "fixture_credit_imbalance",
+            out_shapes=[((8, 128), _F32)],
+            scratch=_sems(None),
+            collective_id=41,
+        ),
+        lambda n: [((8, 128), _F32)],
+    )
+
+
+def deadlock(axis="x"):
+    """Wait-before-signal around the ring: every rank parks in a wait
+    whose credit is behind the next rank's identical wait. SL003 with
+    the full rank cycle."""
+
+    def kernel(x_ref, out_ref, sem):
+        me = lang.my_pe(axis)
+        n = lang.n_pes(axis)
+        lang.signal_wait_until(sem, 1)     # BUG: nobody signals first
+        lang.signal_op(sem, 1, pe=(me + 1) % n, site="fixture")
+        out_ref[:] = x_ref[:]
+
+    return (
+        _spec(
+            kernel, "fixture_deadlock",
+            out_shapes=[((8, 128), _F32)],
+            scratch=_sems(None),
+            collective_id=42,
+        ),
+        lambda n: [((8, 128), _F32)],
+    )
+
+
+def barrier_mismatch(axis="x"):
+    """Rank 0 runs an extra ``barrier_all`` the other ranks don't —
+    diverging collective sequences across ranks. SL005 (and the missing
+    peers make the extra barrier an SL002 hang)."""
+
+    def kernel(x_ref, out_ref):
+        me = lang.my_pe(axis)
+        lang.barrier_all(axis)
+        if me == 0:                        # BUG: rank-dependent barrier
+            lang.barrier_all(axis)
+        out_ref[:] = x_ref[:]
+
+    return (
+        _spec(
+            kernel, "fixture_barrier_mismatch",
+            out_shapes=[((8, 128), _F32)],
+            collective_id=43,
+        ),
+        lambda n: [((8, 128), _F32)],
+    )
+
+
+def undrained_dma(axis="x"):
+    """Puts whose local completion is never drained (missing ``quiet``/
+    ``wait_send``) — the kernel can exit with transfers in flight.
+    SL007."""
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem):
+        me = lang.my_pe(axis)
+        n = lang.n_pes(axis)
+        m = x_ref.shape[0]
+        from jax.experimental import pallas as pl
+
+        out_ref[pl.ds(me * m, m)] = x_ref[:]
+        lang.barrier_all(axis)
+        handles = []
+        for i in range(n - 1):
+            peer = (me + 1 + i) % n
+            handles.append(lang.putmem_signal_nbi_block(
+                out_ref.at[pl.ds(me * m, m)], x_ref,
+                send_sem.at[i], recv_sem.at[i], peer,
+            ))
+        for h in handles:
+            h.wait_recv()
+        # BUG: no lang.quiet(*handles) — send semaphores never drained
+
+    return (
+        _spec(
+            kernel, "fixture_undrained_dma",
+            out_shapes=[((8 * 8, 128), _F32)],
+            scratch=_sems((8,), (8,)),
+            collective_id=44,
+        ),
+        lambda n: [((8, 128), _F32)],
+    )
+
+
+def vmem_overcommit(axis="x"):
+    """VMEM working set exceeding the launch's declared budget. SL006."""
+
+    def kernel(x_ref, out_ref, big_ref, sem):
+        out_ref[:] = x_ref[:]
+        lang.signal_op(sem, 1, site="fixture")
+        lang.signal_wait_until(sem, 1)
+
+    from jax.experimental.pallas import tpu as pltpu
+    import jax.numpy as jnp
+
+    return (
+        _spec(
+            kernel, "fixture_vmem_overcommit",
+            out_shapes=[((8, 128), _F32)],
+            scratch=[pltpu.VMEM((64, 128), jnp.float32)] + _sems(None),
+            collective_id=None,
+            vmem_limit_bytes=16 * 1024,   # 16 KiB budget vs ~40 KiB set
+        ),
+        lambda n: [((8, 128), _F32)],
+    )
+
+
+def duplicate_collective_id(axis="x"):
+    """TWO kernel families at DIFFERENT sites sharing one
+    collective_id — their barrier rendezvous collide when both are
+    launched in a program (the ad-hoc id-rail hazard ADVICE.md flagged
+    on gemm_rs's +96 range). The cross-family SL005 check catches it;
+    returns both (spec, in_shapes) pairs."""
+
+    def mk(name, site):
+        def kernel(x_ref, out_ref):
+            lang.barrier_all(axis)
+            out_ref[:] = x_ref[:]
+
+        return _spec(
+            kernel, name,
+            out_shapes=[((8, 128), _F32)],
+            collective_id=45,              # BUG: shared across sites
+        )
+
+    return (
+        (mk("fixture_dup_cid_a", "site_a"), lambda n: [((8, 128), _F32)]),
+        (mk("fixture_dup_cid_b", "site_b"), lambda n: [((8, 128), _F32)]),
+    )

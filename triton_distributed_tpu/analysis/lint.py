@@ -1,0 +1,207 @@
+"""shmemlint public API and CLI.
+
+API::
+
+    from triton_distributed_tpu import analysis
+    findings = analysis.lint_all(n=8)                  # whole registry
+    findings = analysis.lint_family("ag_gemm.fused", n=8)
+
+CLI (exits nonzero when any ERROR-severity finding survives)::
+
+    python -m triton_distributed_tpu.analysis.lint [--mesh 8]
+        [--kernel ag_gemm] [--json] [--list]
+
+No devices are required: kernel builders are constructed over a
+``jax.sharding.AbstractMesh`` (nothing executes — the analyzer runs the
+kernel *bodies* symbolically), so the lint pass runs identically on a
+dev laptop, a CI runner and a TPU host, including on a jax without the
+TPU-simulation interpreter where the dynamic race/chaos suites cannot
+run at all.
+
+Suppressing an intentional violation: pass ``allow={"SL007", ...}`` to
+the API (or ``--allow SL007`` on the CLI) — the finding is still
+printed, demoted to INFO. See docs/ANALYSIS.md for the rule catalog.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from triton_distributed_tpu.analysis import abstract, checks
+from triton_distributed_tpu.analysis.findings import (
+    Finding,
+    Severity,
+    has_errors,
+)
+
+_TOKENS = itertools.count()
+
+
+def lint_mesh(n: int = 8, axis: str = "x"):
+    """An abstract n-device 1D mesh for kernel construction. Builders
+    only read ``shape``/``axis_names`` at build time, so no physical
+    devices back it."""
+    import jax
+
+    return jax.sharding.AbstractMesh(((axis, int(n)),))
+
+
+def analyze_spec(spec, in_shapes, n, *, kernel_name, site=None, init=None,
+                 axis="x", mesh_axes=("x",)):
+    """Symbolically execute one captured/hand-built LaunchSpec and run
+    the checker passes. Returns (recorder, findings)."""
+    rec = abstract.run_symbolic(
+        spec, in_shapes, n, axis=axis, mesh_axes=mesh_axes, init=init,
+        kernel_name=kernel_name, site=site,
+    )
+    return rec, checks.check_family(rec)
+
+
+def analyze_family(fam, n: int = 8, mesh=None):
+    """Build one registry family over an abstract mesh, read back the
+    captured LaunchSpec, and analyze it. Returns (recorder, findings)."""
+    from triton_distributed_tpu.lang.launch import captured_launch
+
+    mesh = mesh if mesh is not None else lint_mesh(n, fam.axis)
+    fam.build(mesh, n, ("shmemlint", next(_TOKENS)))
+    spec = captured_launch(fam.launch_name)
+    if spec is None:
+        raise RuntimeError(
+            f"family {fam.name!r}: builder did not construct a "
+            f"shmem_call named {fam.launch_name!r}"
+        )
+    return analyze_spec(
+        spec, fam.in_shapes(n), n,
+        kernel_name=fam.name, site=fam.site,
+        init=fam.init(n) if fam.init else None,
+        axis=fam.axis, mesh_axes=fam.mesh_axes,
+    )
+
+
+def _apply_allow(findings, allow):
+    allow = set(allow or ())
+    for f in findings:
+        if f.rule in allow:
+            f.severity = Severity.INFO
+    return findings
+
+
+def lint_family(name: str, n: int = 8, mesh=None, allow=None):
+    """Lint one registry family by name; returns the findings."""
+    from triton_distributed_tpu.kernels.registry import families
+
+    fam = families()[name]
+    _, findings = analyze_family(fam, n, mesh)
+    return _apply_allow(findings, allow)
+
+
+def _cross_family_checks(recorders) -> list:
+    """SL005 across the registry: two DIFFERENT-site families sharing a
+    collective_id share one barrier-semaphore rendezvous — interleaved
+    launches would satisfy each other's barriers. Engine variants of
+    one op entry (same fault-plan site) deliberately share their op's
+    default id: only one of them runs per call."""
+    findings = []
+    by_id: dict = {}
+    for rec in recorders:
+        cid = rec.info.collective_id
+        if cid is None or not rec.barrier_sem_used:
+            continue
+        by_id.setdefault(cid, {}).setdefault(
+            rec.info.site, []).append(rec.info.kernel)
+    for cid, sites in sorted(by_id.items(), key=lambda kv: str(kv[0])):
+        if len(sites) > 1:
+            kernels = sorted(k for ks in sites.values() for k in ks)
+            findings.append(Finding(
+                "SL005", "+".join(kernels),
+                f"collective_id {cid!r} is shared by kernel families of "
+                f"different sites {sorted(map(str, sites))} "
+                f"({kernels}) — their barrier rendezvous collide when "
+                "launched in one program",
+            ))
+    return findings
+
+
+def lint_all(n: int = 8, mesh=None, kernels=None, allow=None):
+    """Lint every registered kernel family (optionally filtered by the
+    ``kernels`` substring list) plus the cross-family hygiene checks.
+    Returns the combined findings list."""
+    from triton_distributed_tpu.kernels.registry import families
+
+    fams = families()
+    if kernels:
+        fams = {
+            name: f for name, f in fams.items()
+            if any(k in name for k in kernels)
+        }
+        if not fams:
+            raise ValueError(f"no registered kernel matches {kernels}")
+    findings, recorders = [], []
+    for name in sorted(fams):
+        rec, f = analyze_family(fams[name], n, mesh)
+        recorders.append(rec)
+        findings += f
+    findings += _cross_family_checks(recorders)
+    return _apply_allow(findings, allow)
+
+
+# ---------------------------------------------------------------------- CLI
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m triton_distributed_tpu.analysis.lint",
+        description="shmemlint: static semaphore-protocol and deadlock "
+        "analysis over the registered SHMEM kernel families",
+    )
+    ap.add_argument("--mesh", type=int, default=8, metavar="N",
+                    help="abstract mesh size to analyze on (default 8)")
+    ap.add_argument("--kernel", action="append", default=None,
+                    metavar="SUBSTR",
+                    help="only families whose name contains SUBSTR "
+                    "(repeatable); e.g. --kernel ag_gemm")
+    ap.add_argument("--allow", action="append", default=None,
+                    metavar="RULE",
+                    help="demote RULE (e.g. SL007) to info severity")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON object per finding on stdout")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered kernel families and exit")
+    args = ap.parse_args(argv)
+
+    if args.mesh < 2:
+        ap.error("--mesh must be >= 2 (a 1-rank mesh has no protocol)")
+
+    from triton_distributed_tpu.kernels.registry import families
+
+    if args.list:
+        for name, fam in sorted(families().items()):
+            print(f"{name:24s} site={fam.site} launch={fam.launch_name}")
+        return 0
+
+    findings = lint_all(n=args.mesh, kernels=args.kernel, allow=args.allow)
+    checked = sorted(
+        name for name in families()
+        if not args.kernel or any(k in name for k in args.kernel)
+    )
+    if args.json:
+        for f in findings:
+            print(json.dumps(f.to_json()))
+    else:
+        for f in sorted(findings, key=lambda f: -f.severity):
+            print(f.format())
+        errs = sum(f.severity >= Severity.ERROR for f in findings)
+        warns = sum(f.severity == Severity.WARNING for f in findings)
+        print(
+            f"shmemlint: {len(checked)} kernel families on a "
+            f"{args.mesh}-rank mesh: {errs} error(s), {warns} warning(s)",
+            file=sys.stderr,
+        )
+    return 1 if has_errors(findings) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

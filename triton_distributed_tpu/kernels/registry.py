@@ -1,0 +1,271 @@
+"""Registry of SHMEM kernel families for static analysis (shmemlint).
+
+Each :class:`KernelFamily` names one protocol the kernel library ships
+and knows how to *construct* it through the real builder (so the
+analyzer sees the exact kernel partial, scratch semaphores,
+collective_id and VMEM limits production uses — captured by the
+``lang.launch.shmem_call`` hook) plus the per-device input shapes the
+capture cannot know. Shapes are small lint shapes: the protocol under
+analysis (signal/wait structure, slot indexing, barrier usage) is
+shape-generic; only the region arithmetic needs concrete numbers.
+
+Builders are lru-cached, so every build call gets a fresh
+``("shmemlint", token)`` in an unused key argument — guaranteeing the
+captured LaunchSpec was produced by THIS build, not a stale cache hit
+from another configuration.
+
+Central collective-id ledger: the ids below are the ones the op entries
+default to. ``analysis.lint`` cross-checks uniqueness across families
+(rule SL005) — a new family colliding with an existing id fails lint
+instead of deadlocking a rendezvous at runtime (ADVICE r5: gemm_rs's
++96 chunk rail vs ag_gemm's +64 rail).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class KernelFamily:
+    """One analyzable kernel family.
+
+    ``build(mesh, n, token)`` constructs the kernel via its real
+    builder (mesh may be a ``jax.sharding.AbstractMesh`` — nothing is
+    executed); ``launch_name`` is the ``shmem_call`` name to read the
+    captured :class:`~triton_distributed_tpu.lang.launch.LaunchSpec`
+    back under; ``in_shapes(n)`` gives per-device input (shape, dtype)
+    pairs; ``init(n)`` optionally seeds ref contents by name or
+    positional index (count-carrying protocols need representative
+    values to steer their receive loops).
+    """
+
+    name: str
+    site: str | None
+    launch_name: str
+    build: callable
+    in_shapes: callable
+    init: callable = None
+    axis: str = "x"
+    mesh_axes: tuple = ("x",)
+
+
+_F32 = np.dtype(np.float32)
+_I32 = np.dtype(np.int32)
+
+
+# ----------------------------------------------------------------- builders
+
+def _ag(method):
+    def build(mesh, n, token):
+        import jax.numpy as jnp
+
+        from triton_distributed_tpu.kernels.allgather import (
+            _build_all_gather,
+        )
+
+        _build_all_gather(
+            mesh, "x", method, (8 * n, 128), jnp.dtype(jnp.float32), 2,
+            token,
+        )
+
+    return build
+
+
+def _ag_ll_persist(mesh, n, token):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.kernels.allgather import _build_ll_persist
+
+    _build_ll_persist(
+        mesh, "x", 8, 128, jnp.dtype(jnp.float32), 12, token,
+    )
+
+
+def _rs_ring(mesh, n, token):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.kernels.reduce_scatter import (
+        _build_reduce_scatter,
+    )
+
+    _build_reduce_scatter(
+        mesh, "x", (8 * n, 128), jnp.dtype(jnp.float32), False, 3, token
+    )
+
+
+def _rs_stream(mesh, n, token):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.kernels.reduce_scatter import (
+        _build_rs_stream,
+    )
+
+    _build_rs_stream(
+        mesh, "x", 8 * n, 128, jnp.dtype(jnp.float32), False, 3, token
+    )
+
+
+def _a2a(mesh, n, token):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.kernels.all_to_all import _build_a2a_call
+
+    _build_a2a_call(
+        ("x",), "x", n, (8 * n, 128), jnp.dtype(jnp.float32), 4, token
+    )
+
+
+def _ag_gemm(mesh, n, token):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.kernels.ag_gemm import _build_fused
+
+    _build_fused(
+        mesh, "x", (), (16 * n, 128), (128, 64 * n),
+        jnp.dtype(jnp.float32), jnp.dtype(jnp.float32), 5, token,
+        return_gathered=True,
+    )
+
+
+def _gemm_rs(mesh, n, token):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.kernels.gemm_rs import _build_fused
+
+    _build_fused(
+        mesh, "x", (), (16 * n, 128 * n), (128 * n, 64),
+        jnp.dtype(jnp.float32), jnp.dtype(jnp.float32), 6, token,
+    )
+
+
+#: lint geometry for the chunked MoE a2a: 8-row alignment tiles, 1 chunk
+#: of 8 rows per peer, 2-chunk slots, a 1-row meta block whose chunk
+#: count sits at (row 0, lane 1).
+_MOE_GEOM = dict(a=8, chunk_u=1, slot_u=2, mr=1, nck_row=0, nck_lane=1,
+                 kmax=2, cap=16, hidden=128)
+
+
+def _moe_a2a(know_recv, collective_id):
+    def build(mesh, n, token):
+        import jax.numpy as jnp
+
+        from triton_distributed_tpu.kernels.moe_dispatch import (
+            _build_chunked_a2a,
+        )
+
+        g = _MOE_GEOM
+        _build_chunked_a2a(
+            ("x",), "x", n, g["a"], g["chunk_u"], g["slot_u"], g["mr"],
+            g["nck_row"], g["nck_lane"], g["kmax"], g["cap"], g["hidden"],
+            jnp.dtype(jnp.float32), know_recv, collective_id, token,
+        )
+
+    return build
+
+
+def _moe_in_shapes(n):
+    g = _MOE_GEOM
+    return [
+        ((1,), _I32),                       # parity
+        ((n,), _I32),                       # offs (a-units)
+        ((n,), _I32),                       # sendk
+        ((n,), _I32),                       # recvk
+        ((n * g["slot_u"] * g["a"], g["hidden"]), _F32),   # payload
+        ((n * g["mr"], 128), _I32),         # meta
+    ]
+
+
+def _moe_init(know_recv):
+    def init(n):
+        g = _MOE_GEOM
+        seed = {
+            "offs_ref": np.arange(n, dtype=np.int32) * g["slot_u"],
+            "sendk_ref": np.ones((n,), np.int32),
+            "recvk_ref": np.ones((n,), np.int32),
+        }
+        if not know_recv:
+            # the dispatch leg reads incoming chunk counts from the
+            # landed metadata head; per-rank symbolic execution has no
+            # peer memory, so seed the receive metadata with the counts
+            # a symmetric peer would send (1 chunk each)
+            meta = np.zeros((n * g["mr"], 128), np.int32)
+            meta[:, g["nck_lane"]] = 1
+            seed[6 + 1] = meta              # output ref: dst_meta
+            src = np.zeros((n * g["mr"], 128), np.int32)
+            src[:, g["nck_lane"]] = 1
+            seed["meta_hbm"] = src
+        return seed
+
+    return init
+
+
+#: every analyzable kernel family, keyed by registry name.
+def families() -> dict:
+    from triton_distributed_tpu.runtime import AllGatherMethod
+
+    fams = [
+        KernelFamily(
+            "allgather.ring_1d", "allgather", "ag_ring_1d",
+            _ag(AllGatherMethod.RING_1D),
+            lambda n: [((8, 128), _F32)],
+        ),
+        KernelFamily(
+            "allgather.ring_bidir", "allgather", "ag_ring_bidir",
+            _ag(AllGatherMethod.RING_BIDIR),
+            lambda n: [((8, 128), _F32)],
+        ),
+        KernelFamily(
+            "allgather.ll_small", "allgather", "ag_ll_small",
+            _ag(AllGatherMethod.LL_SMALL),
+            lambda n: [((8, 128), _F32)],
+        ),
+        KernelFamily(
+            "allgather.ll_persist", "allgather", "ag_ll_persist",
+            _ag_ll_persist,
+            lambda n: [((1,), _I32), ((8, 128), _F32),
+                       ((2 * n * 8, 128), _F32)],
+        ),
+        KernelFamily(
+            "reduce_scatter.ring", "reduce_scatter", "rs_ring",
+            _rs_ring,
+            lambda n: [((8 * n, 128), _F32)],
+        ),
+        KernelFamily(
+            "reduce_scatter.stream", "reduce_scatter", "rs_ring_stream",
+            _rs_stream,
+            lambda n: [((8 * n, 128), _F32)],
+        ),
+        KernelFamily(
+            "all_to_all.dense", "all_to_all", "a2a_dense",
+            _a2a,
+            lambda n: [((8 * n, 128), _F32)],
+        ),
+        KernelFamily(
+            "ag_gemm.fused", "ag_gemm", "ag_gemm_fused",
+            _ag_gemm,
+            lambda n: [((16, 128), _F32), ((128, 64), _F32)],
+        ),
+        KernelFamily(
+            "gemm_rs.fused", "gemm_rs", "gemm_rs_fused",
+            _gemm_rs,
+            # A rows are unsharded (each device holds all M rows of its
+            # K-column shard); B is row-sharded
+            lambda n: [((16 * n, 128), _F32), ((128, 64), _F32)],
+        ),
+        KernelFamily(
+            "moe_dispatch.a2a", "moe_dispatch", "moe_chunked_a2a",
+            _moe_a2a(False, 10),
+            _moe_in_shapes,
+            init=_moe_init(False),
+        ),
+        KernelFamily(
+            "moe_combine.a2a", "moe_dispatch", "moe_chunked_a2a",
+            _moe_a2a(True, 11),
+            _moe_in_shapes,
+            init=_moe_init(True),
+        ),
+    ]
+    return {f.name: f for f in fams}

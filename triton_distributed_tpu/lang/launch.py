@@ -10,12 +10,45 @@ over the target mesh. These helpers cut that boilerplate.
 from __future__ import annotations
 
 import functools
+from dataclasses import dataclass
 
 import jax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu.config import interpret_params
+
+
+@dataclass
+class LaunchSpec:
+    """Static facts about one ``shmem_call`` construction — the
+    shmemlint capture (:mod:`triton_distributed_tpu.analysis`): the
+    kernel callable plus everything the abstract evaluator needs to
+    materialize refs (out shapes, memory spaces, scratch incl.
+    semaphores) and the checker passes need for hygiene/VMEM rules
+    (collective_id, vmem_limit_bytes). Input SHAPES are a call-time
+    property pallas never sees at build time; the kernel registry
+    supplies them alongside the captured spec."""
+
+    name: str
+    kernel: object
+    out_shape: object
+    in_specs: object
+    out_specs: object
+    scratch_shapes: tuple
+    collective_id: object
+    vmem_limit_bytes: int | None
+    grid: object = None
+
+
+#: most recent LaunchSpec per kernel name. Builders are lru-cached, so
+#: the analyzer busts their caches (a fresh token in an unused key arg)
+#: to guarantee the spec it reads back was built from ITS shapes.
+_LAUNCH_SPECS: dict = {}
+
+
+def captured_launch(name: str) -> LaunchSpec | None:
+    return _LAUNCH_SPECS.get(name)
 
 
 def shmem_call(
@@ -74,6 +107,17 @@ def shmem_call(
         kwargs["input_output_aliases"] = input_output_aliases
     if name is not None:
         kwargs["name"] = name
+        _LAUNCH_SPECS[name] = LaunchSpec(
+            name=name,
+            kernel=kernel,
+            out_shape=out_shape,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=tuple(scratch_shapes),
+            collective_id=collective_id,
+            vmem_limit_bytes=vmem_limit_bytes,
+            grid=grid,
+        )
     return pl.pallas_call(
         kernel,
         out_shape=out_shape,

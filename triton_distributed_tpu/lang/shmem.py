@@ -42,6 +42,18 @@ from __future__ import annotations
 import jax
 from jax.experimental.pallas import tpu as pltpu
 
+
+def _arec():
+    """The active shmemlint recorder, or None (the overwhelmingly common
+    case: no symbolic execution in progress). Primitives with host-level
+    control flow that cannot run outside a mesh context (axis_index,
+    fori_loop) branch on this; everything else is intercepted by the
+    evaluator's patched Pallas environment
+    (:mod:`triton_distributed_tpu.analysis.abstract`)."""
+    from triton_distributed_tpu.analysis import events
+
+    return events.active_recorder()
+
 # Signal-op / compare constants, mirroring NVSHMEM_SIGNAL_* / NVSHMEM_CMP_*
 # (libshmem_device.py constants section).
 SIGNAL_SET = "set"   # emulated — see module docstring
@@ -52,6 +64,9 @@ CMP_GE = "ge"
 
 def my_pe(axis) -> jax.Array:
     """This device's index along mesh axis(es) ``axis`` (≡ nvshmem_my_pe)."""
+    rec = _arec()
+    if rec is not None:
+        return rec.me
     return jax.lax.axis_index(axis)
 
 
@@ -66,6 +81,11 @@ def pe_flat(axis, idx, mesh_axes=None):
     """
     if mesh_axes is None or tuple(mesh_axes) == (axis,):
         return idx
+    if _arec() is not None:
+        raise NotImplementedError(
+            "shmemlint analyzes kernels on an abstract 1D mesh; "
+            f"multi-axis pe_flat over {mesh_axes} is not modeled"
+        )
     from triton_distributed_tpu.runtime.topology import flat_device_id
 
     return flat_device_id(tuple(mesh_axes), axis, idx)
@@ -73,6 +93,9 @@ def pe_flat(axis, idx, mesh_axes=None):
 
 def n_pes(axis) -> jax.Array:
     """Number of devices along ``axis`` (≡ nvshmem_n_pes)."""
+    rec = _arec()
+    if rec is not None:
+        return rec.n
     return jax.lax.axis_size(axis)
 
 
@@ -125,6 +148,17 @@ def signal_op(sem, inc=1, pe=None, *, site=None, me=None, n=None):
     lost or replayed notification. Call sites that pass no coordinates
     are not hookable (plan signal faults skip them).
     """
+    rec = _arec()
+    if rec is not None:
+        from triton_distributed_tpu.analysis import events
+
+        rec.emit(events.SignalEvent(
+            key=sem.key,
+            target=rec.me if pe is None else int(pe),
+            inc=int(inc),
+            site=site,
+        ))
+        return
     from triton_distributed_tpu.runtime import faults
 
     if faults.inject_signal(sem, inc, pe, site, me, n):
@@ -150,8 +184,13 @@ def fence():
     TPU RDMA to a given destination is delivered in issue order per
     (src, dst) pair and the recv semaphore fires post-arrival, so the
     reference's fence (libshmem_device.fence) is a no-op here. Kept for
-    API parity.
+    API parity (and recorded for the shmemlint ordering passes).
     """
+    rec = _arec()
+    if rec is not None:
+        from triton_distributed_tpu.analysis import events
+
+        rec.emit(events.FenceEvent())
     return None
 
 
@@ -170,6 +209,11 @@ def barrier_all(axis, mesh_axes=None):
     Requires the enclosing pallas_call to set a ``collective_id`` in its
     CompilerParams (the global barrier semaphore is keyed by it).
     """
+    rec = _arec()
+    if rec is not None:
+        from triton_distributed_tpu.analysis import events
+
+        rec.emit(events.BarrierEvent(collective_id=rec.info.collective_id))
     barrier_sem_wait_all(pltpu.get_barrier_semaphore(), axis, mesh_axes)
 
 
@@ -179,6 +223,11 @@ def neighbor_barrier(axis, left, right, *, site=None, me=None, n=None):
     logical device ids (already pe_flat-translated). ``site``/``me``/``n``
     expose the two outgoing credits to the fault engine's signal faults
     (see :func:`signal_op`)."""
+    rec = _arec()
+    if rec is not None:
+        from triton_distributed_tpu.analysis import events
+
+        rec.emit(events.BarrierEvent(collective_id=rec.info.collective_id))
     sem = pltpu.get_barrier_semaphore()
     signal_op(sem, 1, pe=left, site=site, me=me, n=n)
     signal_op(sem, 1, pe=right, site=site, me=me, n=n)
@@ -187,6 +236,16 @@ def neighbor_barrier(axis, left, right, *, site=None, me=None, n=None):
 
 def barrier_sem_wait_all(sem, axis, mesh_axes=None):
     """Signal every peer on a user regular semaphore and wait for all."""
+    rec = _arec()
+    if rec is not None:
+        # symbolic execution: concrete rank loop (axis_index/fori_loop
+        # have no meaning outside a mesh trace); events flow through the
+        # hooked signal_op / the evaluator's patched semaphore_wait
+        for i in range(rec.n - 1):
+            signal_op(sem, 1, pe=pe_flat(axis, (rec.me + i + 1) % rec.n,
+                                         mesh_axes))
+        signal_wait_until(sem, rec.n - 1)
+        return
     n = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
 

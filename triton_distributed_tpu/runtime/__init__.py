@@ -19,6 +19,7 @@ from triton_distributed_tpu.runtime.faults import (
     SignalFault,
     Stall,
     fault_plan,
+    parse_plan,
     set_fault_plan,
 )
 from triton_distributed_tpu.runtime.watchdog import (
@@ -68,6 +69,7 @@ __all__ = [
     "find_involuntary_resharding",
     "input_output_aliased_params",
     "FaultPlan",
+    "parse_plan",
     "Delay",
     "Stall",
     "SignalFault",

@@ -127,11 +127,20 @@ class FaultPlan:
     the degradation layer (``ops.overlap.with_fallback`` /
     ``ops.moe.ep_moe``) must treat as failed, demoting fused engines to
     their XLA-native equivalents.
+
+    ``max_concurrent_stalls`` caps how many stall gates the plan may
+    HOLD at once (None = unlimited). Every held gate parks an
+    io_callback worker thread; on small hosts (2-vCPU CI runners) a big
+    stall matrix can park the whole pool and the *interpreter itself*
+    wedges (``config.ensure_interpreter_unblocked``). Stalls beyond the
+    cap are skipped with a log line — the plan degrades to a sparser
+    matrix instead of deadlocking the harness.
     """
 
     seed: int = 0
     faults: tuple = ()
     unhealthy_peers: tuple = ()
+    max_concurrent_stalls: int | None = None
 
     def __post_init__(self):
         for f in self.faults:
@@ -228,7 +237,77 @@ class FaultPlan:
     def key(self) -> tuple:
         """Hashable identity for trace caches (frozen dataclasses hash by
         value, so the plan itself is the key)."""
-        return (self.seed, self.faults, self.unhealthy_peers)
+        return (self.seed, self.faults, self.unhealthy_peers,
+                self.max_concurrent_stalls)
+
+
+# ------------------------------------------------------------------ parsing
+
+def parse_plan(text: str) -> FaultPlan:
+    """Parse a nightly chaos line back into a :class:`FaultPlan` — the
+    replay half of the determinism contract (a failed chaos run that
+    cannot be replayed is noise). Two formats:
+
+    * compact: ``"seed=7; Delay(site=allgather, rank=2, cycles=50000);
+      Stall(site=ag_gemm, rank=3); max_concurrent_stalls=2"`` — the
+      dataclass reprs minus the quotes;
+    * JSON: ``{"seed": 7, "faults": [{"kind": "Delay", "site":
+      "allgather", "cycles": 50000}], "max_concurrent_stalls": 2}``.
+    """
+    import json
+    import re
+
+    kinds = {c.__name__: c for c in _FAULT_TYPES}
+
+    def coerce(v):
+        if isinstance(v, str):
+            v = v.strip().strip("'\"")
+            for conv in (int, float):
+                try:
+                    return conv(v)
+                except ValueError:
+                    pass
+            if v in ("None", "null"):
+                return None
+        return v
+
+    text = text.strip()
+    if text.startswith("{"):
+        d = json.loads(text)
+        faults = tuple(
+            kinds[f.pop("kind")](**{k: coerce(v) for k, v in f.items()})
+            for f in d.get("faults", ())
+        )
+        return FaultPlan(
+            seed=int(d.get("seed", 0)),
+            faults=faults,
+            unhealthy_peers=tuple(d.get("unhealthy_peers", ())),
+            max_concurrent_stalls=d.get("max_concurrent_stalls"),
+        )
+
+    seed, cap, faults = 0, None, []
+    for seg in filter(None, (s.strip() for s in text.split(";"))):
+        m = re.fullmatch(r"(\w+)\s*\(\s*(.*?)\s*\)", seg)
+        if m:
+            kind, body = m.group(1), m.group(2)
+            if kind not in kinds:
+                raise ValueError(f"unknown fault kind {kind!r} in {seg!r}")
+            kw = {}
+            for item in filter(None, (i.strip() for i in body.split(","))):
+                k, _, v = item.partition("=")
+                kw[k.strip()] = coerce(v)
+            faults.append(kinds[kind](**kw))
+            continue
+        k, _, v = seg.partition("=")
+        k = k.strip()
+        if k == "seed":
+            seed = int(coerce(v))
+        elif k == "max_concurrent_stalls":
+            cap = coerce(v)
+        else:
+            raise ValueError(f"cannot parse fault-plan segment {seg!r}")
+    return FaultPlan(seed=seed, faults=tuple(faults),
+                     max_concurrent_stalls=cap)
 
 
 # ---------------------------------------------------------------- activation
@@ -294,20 +373,48 @@ def _gate(site: str, rank: int) -> threading.Event:
         return _GATES.setdefault((site, rank), threading.Event())
 
 
+_HELD = 0        # stall gates currently held (guarded by _GATES_LOCK)
+
+
+def held_stalls() -> int:
+    """How many stall gates are currently parked on worker threads."""
+    with _GATES_LOCK:
+        return _HELD
+
+
 def stall_wait(site: str, rank: int) -> None:
     """Host-side stall gate, called from the collective-entry heartbeat
     (runs on an io_callback worker thread, NOT the main thread). Blocks
-    iff the active plan stalls ``rank`` at ``site``."""
+    iff the active plan stalls ``rank`` at ``site`` — unless the plan's
+    ``max_concurrent_stalls`` gates are already held, in which case the
+    stall is SKIPPED (logged): a parked gate costs a worker thread, and
+    exhausting the pool wedges the interpreter itself (ROADMAP: big
+    stall matrices on 2-vCPU CI runners)."""
+    global _HELD
     plan = _ACTIVE
     if plan is None or rank not in plan.stalled_ranks(site):
         return
+    cap = plan.max_concurrent_stalls
+    with _GATES_LOCK:
+        if cap is not None and _HELD >= cap:
+            logger.info(
+                "fault plan stall (site=%s rank=%d) skipped: "
+                "max_concurrent_stalls=%d gates already held",
+                site, rank, cap,
+            )
+            return
+        _HELD += 1
     ev = _gate(site, rank)
-    if not ev.wait(timeout=stall_timeout()):
-        logger.warning(
-            "fault plan stall (site=%s rank=%d) hit the %.0fs "
-            "TDTPU_STALL_TIMEOUT backstop with no watchdog release",
-            site, rank, stall_timeout(),
-        )
+    try:
+        if not ev.wait(timeout=stall_timeout()):
+            logger.warning(
+                "fault plan stall (site=%s rank=%d) hit the %.0fs "
+                "TDTPU_STALL_TIMEOUT backstop with no watchdog release",
+                site, rank, stall_timeout(),
+            )
+    finally:
+        with _GATES_LOCK:
+            _HELD -= 1
 
 
 def release_stalls() -> None:

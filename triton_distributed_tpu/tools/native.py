@@ -252,15 +252,26 @@ class TokenDataset:
 # EP-MoE transport demotion. Deliberately the *simplest correct* XLA
 # programs (gather → dot, dot → psum_scatter): when a preflight probe
 # has already failed, predictability beats cleverness.
+#
+# Instrumented like the Pallas engines (lang.maybe_instrument): an XLA
+# collective can wedge too — a dead host mid-rendezvous hangs
+# all_gather/psum_scatter exactly like a lost DMA credit — and the
+# degradation path being the UNINSTRUMENTED one would mean the watchdog
+# goes blind at the moment it is most needed (ROADMAP: "watchdog
+# coverage for the XLA collective paths"). The builders key on
+# config.interp_key() so arming a watchdog / activating a plan rebuilds
+# with the heartbeat hooks traced in, same contract as the kernels.
 
 import functools as _functools
 
 
 @_functools.lru_cache(maxsize=128)
-def _xla_ag_gemm_fn(mesh, axis, batch_axes, out_dtype):
+def _xla_ag_gemm_fn(mesh, axis, batch_axes, out_dtype, ikey=None):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
+
+    from triton_distributed_tpu import lang
 
     ba = tuple(batch_axes)
 
@@ -270,6 +281,10 @@ def _xla_ag_gemm_fn(mesh, axis, batch_axes, out_dtype):
             a_full, b_loc, preferred_element_type=jnp.float32
         ).astype(out_dtype)
 
+    body = lang.maybe_instrument(
+        body, axis=axis, site="ag_gemm", collective_id="xla_fallback",
+        n=mesh.shape[axis],
+    )
     fn = jax.shard_map(
         body,
         mesh=mesh,
@@ -286,15 +301,21 @@ def xla_ag_gemm(a, b, mesh, axis, *, batch_axes=(), out_dtype=None):
     ``(*batch_axes, axis)``, B cols sharded over ``axis``)."""
     import jax.numpy as jnp
 
+    from triton_distributed_tpu.config import interp_key
+
     out_dtype = jnp.dtype(out_dtype or a.dtype)
-    return _xla_ag_gemm_fn(mesh, axis, tuple(batch_axes), out_dtype)(a, b)
+    return _xla_ag_gemm_fn(
+        mesh, axis, tuple(batch_axes), out_dtype, interp_key()
+    )(a, b)
 
 
 @_functools.lru_cache(maxsize=128)
-def _xla_gemm_rs_fn(mesh, axis, batch_axes, out_dtype):
+def _xla_gemm_rs_fn(mesh, axis, batch_axes, out_dtype, ikey=None):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
+
+    from triton_distributed_tpu import lang
 
     ba = tuple(batch_axes)
 
@@ -304,6 +325,10 @@ def _xla_gemm_rs_fn(mesh, axis, batch_axes, out_dtype):
             part, axis, scatter_dimension=0, tiled=True
         ).astype(out_dtype)
 
+    body = lang.maybe_instrument(
+        body, axis=axis, site="gemm_rs", collective_id="xla_fallback",
+        n=mesh.shape[axis],
+    )
     fn = jax.shard_map(
         body,
         mesh=mesh,
@@ -319,5 +344,9 @@ def xla_gemm_rs(a, b, mesh, axis, *, batch_axes=(), out_dtype=None):
     target. Same layout contract as ``kernels.gemm_rs``."""
     import jax.numpy as jnp
 
+    from triton_distributed_tpu.config import interp_key
+
     out_dtype = jnp.dtype(out_dtype or a.dtype)
-    return _xla_gemm_rs_fn(mesh, axis, tuple(batch_axes), out_dtype)(a, b)
+    return _xla_gemm_rs_fn(
+        mesh, axis, tuple(batch_axes), out_dtype, interp_key()
+    )(a, b)
