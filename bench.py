@@ -448,10 +448,10 @@ def main(argv=None) -> None:
         flush=True,
     )
 
-    for fn in (_bench_gemm_rs, _bench_group_gemm, _bench_moe_a2a,
-               _bench_flash_decode, _bench_serving_moe_decode,
-               _bench_serving_multilayer, _bench_serving_paged,
-               _bench_generate_scan):
+    for fn in (_bench_gemm_rs, _bench_wire_rings, _bench_group_gemm,
+               _bench_moe_a2a, _bench_flash_decode,
+               _bench_serving_moe_decode, _bench_serving_multilayer,
+               _bench_serving_paged, _bench_generate_scan):
         try:
             print(json.dumps(fn(mesh, n, on_tpu, spec)), file=sys.stderr, flush=True)
         except Exception as e:
@@ -499,6 +499,141 @@ def _bench_gemm_rs(mesh, n, on_tpu, spec):
         "mfu": round(tflops / spec.bf16_tflops, 4),
         "config": f"n={n} M={m} K={k} N={nn} bf16 fused-streaming",
     }
+
+
+def _bench_wire_rings(mesh, n, on_tpu, spec):
+    """Quantized-wire streaming rings on COMM-BOUND shapes (ISSUE 3):
+    decode-side small-M AG-GEMM and GEMM-RS shards where the bf16 ring
+    transfer, not the shard matmul, is the per-step critical path.
+    Reports per-step wire bytes bf16 vs fp8 (the ≥1.8× acceptance
+    check), projected overlap_pct for both wires from the perf model,
+    the auto-selector's picks on the comm-bound AND the compute-bound
+    north-star configs (must be fp8 resp. bf16), and measured accuracy
+    deltas of the fp8/int8 wire vs the bf16-wire twin (XLA ring engines
+    — byte-identical wire layout to the fused kernels, runnable at any
+    n)."""
+    from triton_distributed_tpu.kernels.ag_gemm import AGGemmMethod, ag_gemm
+    from triton_distributed_tpu.kernels.gemm_rs import GemmRSMethod, gemm_rs
+    from triton_distributed_tpu.lang import wire as wirelib
+    from triton_distributed_tpu.tune.perf_model import (
+        auto_wire_dtype,
+        estimate_gemm_ms,
+        overlap_efficiency,
+        ring_wire_ms,
+    )
+
+    tp = 8
+    # comm-bound: decode-scale M (batch rows), Llama-7B K, a small
+    # per-shard N (qkv-head-scale projection) — the weight fetch no
+    # longer hides the A-slab ring transfer, so the wire IS the
+    # per-step critical path
+    m_cb, k_cb, nl_cb = 1024, 8192, 512
+    slab_cb = m_cb // tp
+    # compute-bound: the north-star prefill shard
+    m_ns, k_ns, nl_ns = 8192, 8192, 28672 // tp
+    slab_ns = m_ns // tp
+
+    fmt = wirelib.make_wire_format("fp8", slab_cb, strict=False)
+    bf16_bytes = slab_cb * k_cb * 2
+    fp8_bytes = fmt.slab_bytes(slab_cb, k_cb)
+    compute_cb = estimate_gemm_ms(slab_cb, k_cb, nl_cb, spec)
+    out = {
+        "metric": "wire_quantized_rings",
+        "wire_reduction_fp8": round(bf16_bytes / fp8_bytes, 3),
+        "wire_bytes_per_step": {"bf16": bf16_bytes, "fp8": fp8_bytes},
+        "overlap_pct_bf16": round(
+            100 * overlap_efficiency(compute_cb, ring_wire_ms(bf16_bytes, spec)), 1
+        ),
+        "overlap_pct_fp8": round(
+            100 * overlap_efficiency(compute_cb, ring_wire_ms(fp8_bytes, spec)), 1
+        ),
+        "auto_pick_comm_bound": auto_wire_dtype(slab_cb, k_cb, nl_cb, 2, spec=spec),
+        "auto_pick_north_star": auto_wire_dtype(slab_ns, k_ns, nl_ns, 2, spec=spec),
+        "config": (
+            f"comm-bound M={m_cb} K={k_cb} N/tp={nl_cb} tp={tp} "
+            f"(slab {slab_cb}×{k_cb}) vs north-star M={m_ns}"
+        ),
+    }
+
+    # measured accuracy deltas vs the bf16-wire twin (small shapes off
+    # TPU; the wire layout is identical to the fused engines')
+    if n == 1:
+        # a 1-device mesh short-circuits the rings — no wire is crossed
+        # and a 0.0 delta would be vacuous, not evidence
+        out["accuracy"] = (
+            "n=1: no wire crossed; pinned tolerances in tests/test_wire.py"
+        )
+        return out
+    ma, ka, na = (512, 2048, 512) if not on_tpu else (1024, 8192, 512)
+    a = jax.random.normal(jax.random.PRNGKey(21), (ma, ka), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(22), (ka, na), jnp.bfloat16)
+    ref = np.asarray(
+        ag_gemm(a, b, mesh, "x", method=AGGemmMethod.XLA_RING), np.float32
+    )
+    scale = float(np.abs(ref).max()) or 1.0
+    for w in ("fp8", "int8"):
+        got = np.asarray(
+            ag_gemm(a, b, mesh, "x", method=AGGemmMethod.XLA_RING,
+                    wire_dtype=w),
+            np.float32,
+        )
+        out[f"ag_{w}_rel_err"] = round(
+            float(np.abs(got - ref).max()) / scale, 5
+        )
+    a2 = jax.random.normal(jax.random.PRNGKey(23), (ma, ka), jnp.bfloat16)
+    b2 = jax.random.normal(jax.random.PRNGKey(24), (ka, na), jnp.bfloat16)
+    ref2 = np.asarray(
+        gemm_rs(a2, b2, mesh, "x", method=GemmRSMethod.XLA_RING), np.float32
+    )
+    scale2 = float(np.abs(ref2).max()) or 1.0
+    for w in ("fp8", "int8"):
+        got = np.asarray(
+            gemm_rs(a2, b2, mesh, "x", method=GemmRSMethod.XLA_RING,
+                    wire_dtype=w),
+            np.float32,
+        )
+        out[f"rs_{w}_rel_err"] = round(
+            float(np.abs(got - ref2).max()) / scale2, 5
+        )
+
+    if on_tpu and n > 1:
+        # real multi-chip: time the fused wire vs bf16 twin, paired.
+        # int8 wire — the in-kernel wire this Mosaic can lower
+        # (lang.wire.inkernel_wire_ok; fp8 extf is rejected)
+        from triton_distributed_tpu.kernels.ag_gemm import _build_fused
+
+        dtype = jnp.bfloat16
+        av = jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(25), (m_cb, k_cb), dtype),
+            NamedSharding(mesh, P("x", None)),
+        )
+        bv = jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(26), (k_cb, nl_cb * n), dtype),
+            NamedSharding(mesh, P(None, "x")),
+        )
+        raw = _build_fused(
+            mesh, "x", (), av.shape, bv.shape, jnp.dtype(dtype),
+            jnp.dtype(dtype), 5, False, False,
+        )
+        comp = _build_fused(
+            mesh, "x", (), av.shape, bv.shape, jnp.dtype(dtype),
+            jnp.dtype(dtype), 5, False, False, None, "int8",
+        )
+
+        def mk(fn):
+            def step(state, s):
+                a, b = state
+                o, _ = fn(a, b)
+                s = s + jnp.sum(o.astype(jnp.float32))
+                return (perturb(a, s), b), s
+            return step
+
+        t_raw, t_q, ratio, iqr = bench_paired(
+            mk(raw), mk(comp), (av, bv), lo=8, hi=40, reps=11
+        )
+        out["fused_int8_vs_bf16_ratio"] = round(ratio, 4)
+        out["fused_int8_vs_bf16_iqr"] = [round(v, 4) for v in iqr]
+    return out
 
 
 def _bench_group_gemm(mesh, n, on_tpu, spec):

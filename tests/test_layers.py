@@ -228,3 +228,49 @@ class TestSpDecodeLayer:
         assert float(at(k2, 0, 3).sum()) == hkv * d
         assert float(at(v2, 1, 5).sum()) == 2 * hkv * d
         assert float(at(k2, 0, 4).sum()) == 0
+
+    def test_append_kv_int8_prequantized_is_bit_exact(self):
+        """Threading the already-computed (q, scale) pairs into the int8
+        append caches EXACTLY the ints the caller attended — the bf16
+        round-trip re-quantization can differ by 1 LSB (ADVICE r5),
+        which is what decode_step used to rely on not happening."""
+        from triton_distributed_tpu.kernels.flash_decode import quantize_kv
+
+        b, s, hkv, d = 2, 8, 2, 128
+        kc = {
+            "q": jnp.zeros((b, hkv, s, d), jnp.int8),
+            "scale": jnp.zeros((b, hkv, s), jnp.float32),
+        }
+        vc = {
+            "q": jnp.zeros((b, hkv, s, d), jnp.int8),
+            "scale": jnp.zeros((b, hkv, s), jnp.float32),
+        }
+        lens = jnp.array([3, 5], jnp.int32)
+        kn = jax.random.normal(jax.random.PRNGKey(3), (b, hkv, d), jnp.float32)
+        vn = jax.random.normal(jax.random.PRNGKey(4), (b, hkv, d), jnp.float32)
+        kq, ks = quantize_kv(kn)
+        vq, vs = quantize_kv(vn)
+        # the decode_step path: attend the DEQUANTIZED bf16 round-trip,
+        # but append the original pairs
+        kn_rt = (kq.astype(jnp.float32) * ks[..., None]).astype(jnp.bfloat16)
+        vn_rt = (vq.astype(jnp.float32) * vs[..., None]).astype(jnp.bfloat16)
+        k2, v2, _ = layers.append_kv(
+            kc, vc, lens, kn_rt, vn_rt, kv_layout="bhsd",
+            k_quant=(kq, ks), v_quant=(vq, vs),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(k2["q"][0, :, 3]), np.asarray(kq[0])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(k2["scale"][0, :, 3]), np.asarray(ks[0])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(v2["q"][1, :, 5]), np.asarray(vq[1])
+        )
+        # and the legacy path (no pairs) still works
+        k3, v3, _ = layers.append_kv(
+            kc, vc, lens, kn, vn, kv_layout="bhsd"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(k3["q"][0, :, 3]), np.asarray(kq[0])
+        )

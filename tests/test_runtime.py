@@ -33,3 +33,49 @@ def test_auto_allgather_method(mesh8):
     big = auto_allgather_method(topo, 1 << 24)
     assert small == AllGatherMethod.LL_SMALL
     assert big == AllGatherMethod.RING_BIDIR
+
+
+class TestShardguardSelfcheck:
+    """Pin the private jax/XLA surfaces shardguard parses (ADVICE r5):
+    drift in `_kept_var_idx` or the HLO input_output_alias table must
+    fail HERE with shardguard.selfcheck's diagnostic, not as spurious
+    donation errors in a serving loop."""
+
+    def test_selfcheck_passes_on_this_jax(self):
+        from triton_distributed_tpu.runtime import shardguard
+
+        shardguard.selfcheck()   # raises with a clear message on drift
+
+    def test_alias_table_roundtrip(self):
+        from triton_distributed_tpu.runtime import shardguard
+
+        f = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+        x = jnp.zeros((64, 64), jnp.float32)
+        compiled = f.lower(x, jnp.ones((64, 64), jnp.float32)).compile()
+        aliased = shardguard.input_output_aliased_params(compiled)
+        assert 0 in aliased
+
+    def test_kept_indices_track_unused_leaves(self):
+        from triton_distributed_tpu.runtime import shardguard
+
+        g = jax.jit(lambda used, unused: used * 2.0)
+        x = jnp.zeros((8, 8), jnp.float32)
+        compiled = g.lower(x, x).compile()
+        kept = shardguard._kept_indices(compiled, 2)
+        flat_sh = jax.tree_util.tree_leaves(
+            compiled.input_shardings[0],
+            is_leaf=lambda s: isinstance(s, jax.sharding.Sharding),
+        )
+        assert len(kept) == len(flat_sh)
+
+    def test_assert_args_aliased_flags_dropped_donation(self):
+        import pytest as _pytest
+
+        from triton_distributed_tpu.runtime import shardguard
+
+        f = jax.jit(lambda s, x: s + x)      # NOT donated
+        x = jnp.zeros((64, 64), jnp.float32)
+        y = jnp.ones((64, 64), jnp.float32)
+        compiled = f.lower(x, y).compile()
+        with _pytest.raises(AssertionError, match="NOT input/output-aliased"):
+            shardguard.assert_args_aliased(compiled, (x, y), lambda a: a[0])

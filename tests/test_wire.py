@@ -1,0 +1,403 @@
+"""Quantized-wire streaming rings (ISSUE 3): lang.wire layout, the
+XLA-ring wire twins (byte-identical layout to the fused Pallas wire),
+the standalone collectives' wire knobs, the perf-model/topology wire
+auto-selection, and the collective-id rail ledger.
+
+Accuracy tolerances are PINNED here (the acceptance contract):
+
+* fp8 (e4m3) wire: one rounding per element ≤ 2^-3 relative → AG-side
+  (quantize once) max error ≤ 6% of the output scale; RS-side (per-hop
+  requant over n-1 hops) ≤ 15%.
+* int8 wire with per-chunk scales: ≤ 2% AG-side / 4% RS-side on
+  well-conditioned slabs; the worst-case OUTLIER slab test pins the
+  known failure mode (one huge row inflates the chunk scale and
+  flattens its neighbors) so the guidance in docs/PERF.md stays honest.
+
+The fused Pallas wire engines themselves need the TPU-simulation
+interpreter (skipped without it); their protocol is checked statically
+for every jax by the registry families in test_analysis.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import requires_tpu_sim
+
+from triton_distributed_tpu.lang import wire as wirelib
+
+
+def _rel_err(got, ref):
+    ref = np.asarray(ref, np.float64)
+    got = np.asarray(got, np.float64)
+    scale = np.abs(ref).max() or 1.0
+    return float(np.abs(got - ref).max() / scale)
+
+
+# ------------------------------------------------------------- the layout
+
+class TestWireFormat:
+    def test_normalize(self):
+        assert wirelib.normalize_wire(None) is None
+        assert wirelib.normalize_wire("bf16") is None
+        assert wirelib.normalize_wire("fp8") == "fp8"
+        assert wirelib.normalize_wire("int8") == "int8"
+        assert wirelib.normalize_wire("auto") == "auto"
+        with pytest.raises(ValueError):
+            wirelib.normalize_wire("fp4")
+
+    def test_chunking_and_bytes(self):
+        fmt = wirelib.make_wire_format("fp8", 128)
+        assert fmt.chunk_rows == 64 and fmt.chunks(128) == 2
+        # payload at 1 B/elem + one (128·4 B) scale row per chunk
+        assert fmt.slab_bytes(128, 8192) == 128 * 8192 + 2 * 512
+        # vs the bf16 wire: the acceptance ratio at ring-slab scale
+        assert 128 * 8192 * 2 / fmt.slab_bytes(128, 8192) > 1.8
+
+    def test_whole_slab_chunk_for_tiny_slabs(self):
+        fmt = wirelib.make_wire_format("int8", 16)
+        assert fmt.chunk_rows == 16 and fmt.chunks(16) == 1
+
+    def test_wire_blockable_rejects_tiny_slabs(self):
+        # an 8×32 slab: the 512 B scale row eats the compression → must
+        # be rejected, not shipped larger than the bf16 wire
+        assert not wirelib.wire_blockable(8, 32, "fp8", strict=False)
+        assert wirelib.wire_blockable(64, 2048, "fp8", strict=False)
+
+    @pytest.mark.parametrize("quant", ["fp8", "int8"])
+    def test_roundtrip_tolerance(self, quant):
+        fmt = wirelib.make_wire_format(quant, 128)
+        x = jax.random.normal(jax.random.PRNGKey(0), (128, 1024), jnp.float32)
+        q, s = wirelib.quantize_slab(x, fmt)
+        assert q.dtype == fmt.wire_dtype
+        assert s.shape == fmt.scale_shape(128)
+        y = wirelib.dequantize_slab(q, s, fmt, jnp.float32)
+        tol = 0.06 if quant == "fp8" else 0.02
+        assert _rel_err(y, x) < tol
+
+    def test_outlier_slab_worst_case(self):
+        """One huge row per chunk inflates the shared scale: int8 must
+        still round-trip the OUTLIER exactly-ish while its neighbors
+        degrade gracefully (bounded by outlier/127 per element) — the
+        documented worst case of per-chunk scales."""
+        fmt = wirelib.make_wire_format("int8", 64)
+        x = np.random.default_rng(1).normal(size=(64, 512)).astype(np.float32)
+        x[0, :] *= 1000.0                       # the outlier row
+        q, s = wirelib.quantize_slab(jnp.asarray(x), fmt)
+        y = np.asarray(wirelib.dequantize_slab(q, s, fmt, jnp.float32))
+        # outlier row: ~2 valid digits survive
+        assert _rel_err(y[0], x[0]) < 0.01
+        # neighbor rows: absolute error bounded by half a quantization
+        # step of the inflated scale
+        step = float(np.asarray(s)[0, 0])
+        assert np.abs(y[1:] - x[1:]).max() <= 0.5 * step * 1.01
+        # fp8 keeps per-element exponents: neighbors stay accurate even
+        # under the inflated chunk scale
+        fmt8 = wirelib.make_wire_format("fp8", 64)
+        q8, s8 = wirelib.quantize_slab(jnp.asarray(x), fmt8)
+        y8 = np.asarray(wirelib.dequantize_slab(q8, s8, fmt8, jnp.float32))
+        assert _rel_err(y8[1:], x[1:]) < 0.06
+
+    def test_quantize_matches_ring_wire_bytes_model(self):
+        from triton_distributed_tpu.tune.perf_model import ring_wire_bytes
+
+        fmt = wirelib.make_wire_format("fp8", 128)
+        assert ring_wire_bytes(128, 8192, 2, "fp8", fmt.chunk_rows) == \
+            fmt.slab_bytes(128, 8192)
+        assert ring_wire_bytes(128, 8192, 2, None) == 128 * 8192 * 2
+
+
+# ------------------------------------------------ XLA ring wire engines
+
+class TestWireOverlapEngines:
+    """fp8/int8-wire AG-GEMM and GEMM-RS vs their bf16-wire twins, at
+    pinned tolerances (the XLA ring engines ship the same lang.wire
+    bytes as the fused kernels and run on any backend)."""
+
+    def _ab(self, m, k, n, seed):
+        a = jax.random.normal(jax.random.PRNGKey(seed), (m, k), jnp.float32)
+        b = jax.random.normal(jax.random.PRNGKey(seed + 1), (k, n), jnp.float32)
+        return a, b
+
+    @pytest.mark.parametrize("w,tol", [("fp8", 0.06), ("int8", 0.02)])
+    def test_ag_gemm_wire_accuracy(self, mesh8, w, tol):
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            ag_gemm,
+        )
+
+        a, b = self._ab(64, 1024, 128, 1)
+        ref = ag_gemm(a, b, mesh8, "x", method=AGGemmMethod.XLA_RING)
+        got = ag_gemm(
+            a, b, mesh8, "x", method=AGGemmMethod.XLA_RING, wire_dtype=w
+        )
+        assert _rel_err(got, ref) < tol
+
+    @pytest.mark.parametrize("w,tol", [("fp8", 0.15), ("int8", 0.04)])
+    def test_gemm_rs_wire_accuracy(self, mesh8, w, tol):
+        from triton_distributed_tpu.kernels.gemm_rs import (
+            GemmRSMethod,
+            gemm_rs,
+        )
+
+        a, b = self._ab(64, 1024, 256, 3)
+        ref = gemm_rs(a, b, mesh8, "x", method=GemmRSMethod.XLA_RING)
+        got = gemm_rs(
+            a, b, mesh8, "x", method=GemmRSMethod.XLA_RING, wire_dtype=w
+        )
+        assert _rel_err(got, ref) < tol
+
+    def test_bf16_wire_is_todays_numerics(self, mesh8):
+        """wire_dtype=None and 'bf16' are the identical program."""
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            ag_gemm,
+        )
+
+        a, b = self._ab(64, 1024, 128, 5)
+        x = ag_gemm(a, b, mesh8, "x", method=AGGemmMethod.XLA_RING)
+        y = ag_gemm(
+            a, b, mesh8, "x", method=AGGemmMethod.XLA_RING, wire_dtype="bf16"
+        )
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_explicit_wire_on_ineligible_slab_raises(self, mesh8):
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            ag_gemm,
+        )
+
+        # 32 cols: the scale plane eats the compression — a pinned wire
+        # format is a contract, so this must raise, not silently demote
+        a, b = self._ab(64, 32, 128, 7)
+        with pytest.raises(ValueError, match="wire"):
+            ag_gemm(
+                a, b, mesh8, "x", method=AGGemmMethod.XLA_RING,
+                wire_dtype="fp8",
+            )
+
+    def test_auto_wire_demotes_to_none_on_ineligible(self, mesh8):
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            resolve_ag_gemm_wire,
+        )
+
+        a, b = self._ab(64, 32, 128, 9)
+        assert resolve_ag_gemm_wire(
+            mesh8, "x", a, b, method=AGGemmMethod.XLA_RING, wire_dtype="auto"
+        ) is None
+
+    def test_naive_engine_never_ships_a_wire(self, mesh8):
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            resolve_ag_gemm_wire,
+        )
+
+        a, b = self._ab(64, 1024, 128, 11)
+        assert resolve_ag_gemm_wire(
+            mesh8, "x", a, b, method=AGGemmMethod.XLA_NAIVE,
+            wire_dtype="fp8",
+        ) is None
+
+    def test_overlap_ctx_wire_forward_only(self, mesh8):
+        """ops.overlap threads ctx.wire_dtype into the forward; the
+        VJP still runs (backward duals ship the bf16 wire)."""
+        from triton_distributed_tpu.kernels.ag_gemm import AGGemmMethod
+        from triton_distributed_tpu.ops.overlap import (
+            ag_gemm,
+            create_ag_gemm_context,
+        )
+
+        ctx = create_ag_gemm_context(
+            mesh8, "x", method=AGGemmMethod.XLA_RING, wire_dtype="fp8",
+        )
+        a, b = self._ab(64, 1024, 128, 13)
+        out, grads = jax.value_and_grad(
+            lambda a, b: jnp.sum(ag_gemm(a, b, ctx) ** 2), argnums=(0, 1)
+        )(a, b)
+        assert np.isfinite(float(out))
+        assert all(np.isfinite(np.asarray(g)).all() for g in grads)
+
+
+# --------------------------------------------- standalone ring wire knobs
+
+class TestStandaloneWire:
+    def test_all_gather_wire_fp8(self, mesh8):
+        from triton_distributed_tpu.kernels.allgather import all_gather
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (64, 1024), jnp.float32)
+        got = all_gather(x, mesh8, "x", wire_dtype="fp8")
+        assert got.shape == x.shape
+        assert _rel_err(got, x) < 0.06
+
+    def test_all_gather_wire_auto_small_stays_exact(self, mesh8):
+        from triton_distributed_tpu.kernels.allgather import all_gather
+
+        # 32 KiB shards sit under the auto threshold → bf16 wire, exact
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, 1024), jnp.float32)
+        got = all_gather(x, mesh8, "x", wire_dtype="auto")
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(x))
+
+    def test_all_gather_explicit_wire_on_1d_raises(self, mesh8):
+        from triton_distributed_tpu.kernels.allgather import all_gather
+
+        with pytest.raises(ValueError, match="wire"):
+            all_gather(jnp.zeros((64,)), mesh8, "x", wire_dtype="fp8")
+
+    @pytest.mark.parametrize("w,tol", [("fp8", 0.15), ("int8", 0.04)])
+    def test_reduce_scatter_wire(self, mesh8, w, tol):
+        from triton_distributed_tpu.kernels.reduce_scatter import (
+            reduce_scatter,
+        )
+
+        y = jax.random.normal(
+            jax.random.PRNGKey(2), (8, 64, 1024), jnp.float32
+        )
+        ref = np.asarray(y).sum(0)
+        got = reduce_scatter(y, mesh8, "x", stacked=True, wire_dtype=w)
+        assert got.shape == ref.shape
+        assert _rel_err(got, ref) < tol
+
+    def test_reduce_scatter_bf16_wire_unchanged(self, mesh8):
+        from triton_distributed_tpu.kernels.reduce_scatter import (
+            reduce_scatter,
+        )
+
+        y = jax.random.normal(jax.random.PRNGKey(3), (8, 16, 64), jnp.float32)
+        a = reduce_scatter(y, mesh8, "x", stacked=True)
+        b = reduce_scatter(y, mesh8, "x", stacked=True, wire_dtype="bf16")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------ wire auto-selection
+
+class TestWireSelection:
+    def test_perf_model_comm_bound_picks_fp8(self):
+        from triton_distributed_tpu.tune.perf_model import (
+            TPU_SPECS,
+            auto_wire_dtype,
+        )
+
+        spec = TPU_SPECS["v5e"]
+        # decode-side small-M small-N shard: the A-slab ring transfer
+        # dwarfs the per-step matmul → compressed wire
+        assert auto_wire_dtype(128, 8192, 512, 2, spec=spec) == "fp8"
+        # the north-star prefill shard is flops-bound → raw wire
+        assert auto_wire_dtype(1024, 8192, 3584, 2, spec=spec) == "bf16"
+
+    def test_topology_standalone_threshold(self):
+        from triton_distributed_tpu.runtime.topology import (
+            auto_allgather_wire,
+        )
+
+        assert auto_allgather_wire(1 << 20) == "fp8"
+        assert auto_allgather_wire(1 << 12) is None
+
+    def test_engine_tuner_keys_include_wire(self, mesh8):
+        """Persisted engine winners must be per-wire-format: the tuner
+        name (the disk key namespace) carries the wire."""
+        from triton_distributed_tpu.kernels.ag_gemm import _engine_tuner
+
+        t_raw = _engine_tuner(mesh8, "x", (), jnp.dtype(jnp.float32), 5,
+                              False, None, None)
+        t_fp8 = _engine_tuner(mesh8, "x", (), jnp.dtype(jnp.float32), 5,
+                              False, None, "fp8")
+        assert t_raw.name != t_fp8.name and "wfp8" in t_fp8.name
+
+    def test_wire_tuner_candidates(self):
+        from triton_distributed_tpu.tune.autotuner import wire_tuner
+
+        t = wire_tuner("t", lambda *a, **k: None)
+        assert t.configs == [
+            {"wire_dtype": "bf16"}, {"wire_dtype": "fp8"}
+        ]
+
+
+# ------------------------------------------------- collective-id rails
+
+class TestCollectiveRails:
+    def test_shipped_rails_match_the_historical_offsets(self):
+        from triton_distributed_tpu.kernels.registry import (
+            rail_collective_id,
+            reserved_rails,
+        )
+
+        rails = reserved_rails()
+        assert rails["ag_gemm.dcn_chunks"] == (64, 32)
+        assert rails["gemm_rs.dcn_chunks"] == (96, 32)
+        # the ledger arithmetic reproduces the old ad-hoc ids exactly
+        assert rail_collective_id("ag_gemm.dcn_chunks", 5, 3) == 5 + 64 + 3
+        assert rail_collective_id("gemm_rs.dcn_chunks", 6, 2) == 6 + 96 + 2
+        assert rail_collective_id("gemm_rs.dcn_chunks", None, 0) is None
+
+    def test_overlapping_reservation_raises(self):
+        from triton_distributed_tpu.kernels import registry
+
+        with pytest.raises(ValueError, match="overlaps"):
+            registry.reserve_collective_rail("rogue.family", 90, 16)
+        assert "rogue.family" not in registry.reserved_rails()
+
+    def test_out_of_range_chunk_raises(self):
+        from triton_distributed_tpu.kernels.registry import (
+            rail_collective_id,
+        )
+
+        with pytest.raises(ValueError, match="reserved length"):
+            rail_collective_id("ag_gemm.dcn_chunks", 5, 32)
+
+    def test_re_reservation_same_range_is_idempotent(self):
+        from triton_distributed_tpu.kernels import registry
+
+        registry.reserve_collective_rail("ag_gemm.dcn_chunks", 64, 32)
+        with pytest.raises(ValueError, match="re-reserved"):
+            registry.reserve_collective_rail("ag_gemm.dcn_chunks", 64, 16)
+
+
+# ---------------------------------------------- fused engines (TPU sim)
+
+@requires_tpu_sim
+class TestFusedWireEngines:
+    """The fused Pallas wire rings, executed on the interpreter mesh
+    (skipped on a jax without the TPU-simulation interpreter — the
+    static protocol twin lives in test_analysis.py)."""
+
+    @pytest.mark.parametrize("w,tol", [("fp8", 0.06), ("int8", 0.02)])
+    def test_fused_ag_gemm_wire(self, mesh8, w, tol):
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            ag_gemm,
+        )
+
+        a = jax.random.normal(jax.random.PRNGKey(1), (64, 1024), jnp.float32)
+        b = jax.random.normal(jax.random.PRNGKey(2), (1024, 128), jnp.float32)
+        ref = np.asarray(jnp.dot(a, b))
+        got = ag_gemm(
+            a, b, mesh8, "x", method=AGGemmMethod.PALLAS_FUSED, wire_dtype=w
+        )
+        assert _rel_err(got, ref) < tol
+
+    @pytest.mark.parametrize("w,tol", [("fp8", 0.15), ("int8", 0.04)])
+    def test_fused_gemm_rs_wire(self, mesh8, w, tol):
+        from triton_distributed_tpu.kernels.gemm_rs import (
+            GemmRSMethod,
+            gemm_rs,
+        )
+
+        a = jax.random.normal(jax.random.PRNGKey(3), (64, 1024), jnp.float32)
+        b = jax.random.normal(jax.random.PRNGKey(4), (1024, 256), jnp.float32)
+        ref = np.asarray(jnp.dot(a, b))
+        got = gemm_rs(
+            a, b, mesh8, "x", method=GemmRSMethod.PALLAS_FUSED, wire_dtype=w
+        )
+        assert _rel_err(got, ref) < tol
+
+    def test_fused_ring_ag_standalone_wire(self, mesh8):
+        from triton_distributed_tpu.kernels.allgather import all_gather
+        from triton_distributed_tpu.runtime import AllGatherMethod
+
+        x = jax.random.normal(jax.random.PRNGKey(5), (64, 1024), jnp.float32)
+        got = all_gather(
+            x, mesh8, "x", method=AllGatherMethod.RING_1D, wire_dtype="fp8"
+        )
+        assert _rel_err(got, x) < 0.06

@@ -3,7 +3,11 @@
 Reference: python/triton_dist/kernels/nvidia/ (see SURVEY.md §2.3).
 """
 
-from triton_distributed_tpu.kernels.ag_gemm import AGGemmMethod, ag_gemm
+from triton_distributed_tpu.kernels.ag_gemm import (
+    AGGemmMethod,
+    ag_gemm,
+    resolve_ag_gemm_wire,
+)
 from triton_distributed_tpu.kernels.all_to_all import all_to_all, all_to_all_xla
 from triton_distributed_tpu.kernels.allgather import (
     PersistentLLAllGather,
@@ -28,7 +32,11 @@ from triton_distributed_tpu.kernels.flash_decode import (
     sp_paged_gqa_fwd_batch_decode_device,
     sp_paged_gqa_fwd_batch_decode_q8,
 )
-from triton_distributed_tpu.kernels.gemm_rs import GemmRSMethod, gemm_rs
+from triton_distributed_tpu.kernels.gemm_rs import (
+    GemmRSMethod,
+    gemm_rs,
+    resolve_gemm_rs_wire,
+)
 from triton_distributed_tpu.kernels.group_gemm import (
     grouped_matmul,
     grouped_matmul_xla,
@@ -60,8 +68,10 @@ __all__ = [
     "all_to_all_xla",
     "ag_gemm",
     "AGGemmMethod",
+    "resolve_ag_gemm_wire",
     "gemm_rs",
     "GemmRSMethod",
+    "resolve_gemm_rs_wire",
     "gqa_fwd_batch_decode",
     "gqa_fwd_batch_decode_xla",
     "paged_gqa_fwd_batch_decode",

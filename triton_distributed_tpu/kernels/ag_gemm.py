@@ -44,7 +44,8 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import lang
 from triton_distributed_tpu.config import fused_vmem_budget, interp_key
-from triton_distributed_tpu.kernels.ring import ag_forward_ring
+from triton_distributed_tpu.kernels.ring import AGWireRefs, ag_forward_ring
+from triton_distributed_tpu.lang import wire as wirelib
 from triton_distributed_tpu.runtime import (
     LinkKind,
     detect_topology,
@@ -203,6 +204,49 @@ def _fused_kernel(
         cp.wait()
 
 
+def _fused_kernel_w(
+    n, axis, mesh_axes, blocks, publish_local, fmt,
+    x_hbm, xq_hbm, xs_hbm, b_hbm,
+    out_hbm, ag_hbm, agq_hbm, ags_hbm,
+    acc_ref, local_sem, send_sem, recv_sem, s_send_sem, s_recv_sem,
+):
+    """Quantized-wire twin of :func:`_fused_kernel`: the ring moves the
+    host-quantized slab (xq/xs, lang.wire layout) plus its scale plane
+    and dequantizes each arrival into the bf16 ``ag_hbm`` workspace
+    before the matmul pipeline consumes it. The local shard never
+    crosses the wire, so it is consumed exact from ``x_hbm``."""
+    me = lang.my_pe(axis)
+    m = x_hbm.shape[0]
+    k = x_hbm.shape[1]
+    nl = b_hbm.shape[1]
+    bm, bk, bn = blocks
+    mb, nb, kb = m // bm, nl // bn, k // bk
+
+    if publish_local:
+        # gathered-A contract: slab ``me`` is the EXACT local slab (it
+        # never rode the wire), same as the raw-wire engine
+        cp = pltpu.make_async_copy(x_hbm, ag_hbm.at[pl.ds(me * m, m)], local_sem)
+        cp.start()
+
+    def consume(s, src, a_hbm, a_row_off):
+        mm_pipeline(
+            mb, nb, kb, bm, bk, bn, acc_ref,
+            m_off=a_row_off // bm, out_m_off=src * mb,
+        )(a_hbm, b_hbm, out_hbm)
+
+    wire = AGWireRefs(
+        fmt=fmt, local_q=xq_hbm, local_s=xs_hbm, agq=agq_hbm, ags=ags_hbm,
+        s_send_sem=s_send_sem, s_recv_sem=s_recv_sem,
+        dequant=wirelib.dequant_pipeline(m, k, fmt),
+    )
+    ag_forward_ring(
+        n, axis, mesh_axes, x_hbm, ag_hbm, m, send_sem, recv_sem, consume,
+        site="ag_gemm", wire=wire,
+    )
+    if publish_local:
+        cp.wait()
+
+
 def _specs(axis, batch_axes, dcn_axis=None):
     """(in_specs, out_specs) for AG-GEMM under shard_map over the full mesh.
 
@@ -224,7 +268,7 @@ def _specs(axis, batch_axes, dcn_axis=None):
 @functools.lru_cache(maxsize=256)
 def _build_fused(
     mesh, axis, batch_axes, a_shape, b_shape, dtype, out_dtype, collective_id,
-    chaos, return_gathered=True, dcn_axis=None,
+    chaos, return_gathered=True, dcn_axis=None, wire=None,
 ):
     """Fused engine. ``dcn_axis`` set = the hierarchical decomposition
     (≡ the reference's inter-node AG-GEMM, allgather.py:291-375): the
@@ -260,8 +304,52 @@ def _build_fused(
         # the barrier semaphore, and Mosaic rejects a collective_id on a
         # kernel that never does (same convention as gemm_rs)
         collective_id = None
+    fmt = None
+    if wire is not None:
+        assert dcn_axis is None, "wire compression is intra-slice only"
+        from triton_distributed_tpu.config import compiling_for_tpu
+
+        wirelib.require_inkernel(wire, "ag_gemm")
+        fmt = wirelib.make_wire_format(
+            wire, slab_rows, strict=compiling_for_tpu()
+        )
+        if fmt is None:
+            raise ValueError(
+                f"ag_gemm wire={wire!r}: slab of {slab_rows} rows admits "
+                "no legal scale chunking; use the bf16 wire"
+            )
 
     def mk_call(m_g, blk, cid):
+        if fmt is not None:
+            nsem = (max(n - 1, 1),)
+            return lang.shmem_call(
+                functools.partial(
+                    _fused_kernel_w, n, axis, mesh.axis_names, blk,
+                    return_gathered, fmt,
+                ),
+                out_shape=[
+                    jax.ShapeDtypeStruct((m_g, n_local), out_dtype),
+                    jax.ShapeDtypeStruct((m_g, k), dtype),      # gathered A
+                    # wire workspaces: quantized slabs + scale planes
+                    jax.ShapeDtypeStruct((m_g, k), fmt.wire_dtype),
+                    jax.ShapeDtypeStruct(
+                        (fmt.chunks(m_g), wirelib.SCALE_LANES), jnp.float32
+                    ),
+                ],
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+                out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+                scratch_shapes=[
+                    pltpu.VMEM((blk[0], blk[2]), jnp.float32),
+                    pltpu.SemaphoreType.DMA,
+                    pltpu.SemaphoreType.DMA(nsem),
+                    pltpu.SemaphoreType.DMA(nsem),
+                    pltpu.SemaphoreType.DMA(nsem),   # scale rail
+                    pltpu.SemaphoreType.DMA(nsem),
+                ],
+                collective_id=cid,
+                vmem_limit_bytes=fused_vmem_budget(),
+                name=f"ag_gemm_fused_{wire}w",
+            )
         return lang.shmem_call(
             functools.partial(
                 _fused_kernel, n, axis, mesh.axis_names, blk, return_gathered
@@ -298,10 +386,19 @@ def _build_fused(
         if dcn_axis is not None and nd > 1 else None
     )
     if dcn_axis is None:
-        body = lang.maybe_instrument(
+        call = lang.maybe_instrument(
             mk_call(m_gathered, blocks, collective_id),
             axis=axis, site="ag_gemm", collective_id=collective_id, n=n,
         )
+        if fmt is None:
+            body = call
+        else:
+            def body(a_loc, b_loc):
+                # quantize the local slab ONCE in XLA (fuses with the
+                # producer); the ring forwards these exact wire bytes
+                aq, asc = wirelib.quantize_slab(a_loc, fmt)
+                out = call(a_loc, aq, asc, b_loc)
+                return out[0], out[1]
     elif chunk_blocks is None:
         call = mk_call(m_gathered, blocks, collective_id)
 
@@ -312,12 +409,15 @@ def _build_fused(
     else:
         # distinct collective_ids per chunk ring: strict per-chunk
         # rendezvous on the barrier semaphore (a skewed neighbor's
-        # chunk-s+1 signal must not satisfy a chunk-s wait); offset into
-        # a high id range so no other kernel family collides
+        # chunk-s+1 signal must not satisfy a chunk-s wait); the offset
+        # range is reserved in the registry's rail ledger (checked
+        # disjoint from every other chunked family)
+        from triton_distributed_tpu.kernels.registry import rail_collective_id
+
         chunk_calls = [
             mk_call(
                 n * m_dev, chunk_blocks,
-                None if collective_id is None else collective_id + 64 + s,
+                rail_collective_id("ag_gemm.dcn_chunks", collective_id, s),
             )
             for s in range(nd)
         ]
@@ -365,37 +465,76 @@ def _build_fused(
     return jax.jit(fn)
 
 
-def ag_gemm_device(a_loc, b_loc, axis, *, out_dtype=None):
+def ag_gemm_device(a_loc, b_loc, axis, *, out_dtype=None, wire=None):
     """Per-device XLA-ring AG-GEMM body — usable inside any shard_map.
 
     ppermute hops overlap the next step's dot via XLA async collective
     permute (the reference's comm-stream/GEMM-stream overlap, expressed
-    through the XLA scheduler instead of streams)."""
+    through the XLA scheduler instead of streams).
+
+    ``wire`` ('fp8'/'int8'): the hops carry the ONCE-quantized slab +
+    per-chunk scales (lang.wire layout — the same bytes the fused wire
+    ring ships) and each arrival is dequantized before its dot; the own
+    shard never crosses the wire and is consumed exact."""
     n = jax.lax.axis_size(axis)
     m_local = a_loc.shape[0]
     out_dtype = out_dtype or a_loc.dtype
     me = jax.lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    fmt = None
+    if wire is not None:
+        from triton_distributed_tpu.config import compiling_for_tpu
 
-    def step(s, carry):
-        a_cur, out = carry
+        fmt = wirelib.make_wire_format(
+            wire, m_local, strict=compiling_for_tpu()
+        )
+
+    out = jnp.zeros((n * m_local, b_loc.shape[1]), out_dtype)
+    if fmt is None:
+        def step(s, carry):
+            a_cur, out = carry
+            src = jax.lax.rem(me + n - s, n)
+            tile = jnp.dot(a_cur, b_loc, preferred_element_type=jnp.float32)
+            out = jax.lax.dynamic_update_slice(
+                out, tile.astype(out_dtype), (src * m_local, 0)
+            )
+            a_next = jax.lax.ppermute(a_cur, axis, perm=perm)
+            return a_next, out
+
+        a_cur, out = jax.lax.fori_loop(0, n - 1, step, (a_loc, out))
+        src = jax.lax.rem(me + 1, n)  # after n-1 hops I hold shard me+1
+        tile = jnp.dot(a_cur, b_loc, preferred_element_type=jnp.float32)
+        return jax.lax.dynamic_update_slice(
+            out, tile.astype(out_dtype), (src * m_local, 0)
+        )
+
+    # quantized wire: own shard exact, remote shards dequantized from the
+    # once-quantized payload + scale plane riding the permute hops
+    tile = jnp.dot(a_loc, b_loc, preferred_element_type=jnp.float32)
+    out = jax.lax.dynamic_update_slice(
+        out, tile.astype(out_dtype), (me * m_local, 0)
+    )
+    q, sc = wirelib.quantize_slab(a_loc, fmt)
+
+    def step_w(s, carry):
+        q_cur, sc_cur, out = carry
+        q_cur = jax.lax.ppermute(q_cur, axis, perm=perm)
+        sc_cur = jax.lax.ppermute(sc_cur, axis, perm=perm)
         src = jax.lax.rem(me + n - s, n)
+        a_cur = wirelib.dequantize_slab(q_cur, sc_cur, fmt, a_loc.dtype)
         tile = jnp.dot(a_cur, b_loc, preferred_element_type=jnp.float32)
         out = jax.lax.dynamic_update_slice(
             out, tile.astype(out_dtype), (src * m_local, 0)
         )
-        a_next = jax.lax.ppermute(a_cur, axis, perm=perm)
-        return a_next, out
+        return q_cur, sc_cur, out
 
-    out = jnp.zeros((n * m_local, b_loc.shape[1]), out_dtype)
-    a_cur, out = jax.lax.fori_loop(0, n - 1, step, (a_loc, out))
-    src = jax.lax.rem(me + 1, n)  # after n-1 hops I hold shard me+1
-    tile = jnp.dot(a_cur, b_loc, preferred_element_type=jnp.float32)
-    return jax.lax.dynamic_update_slice(out, tile.astype(out_dtype), (src * m_local, 0))
+    _, _, out = jax.lax.fori_loop(1, n, step_w, (q, sc, out))
+    return out
 
 
 @functools.lru_cache(maxsize=256)
-def _build_xla_ring(mesh, axis, batch_axes, out_dtype, dcn_axis=None):
+def _build_xla_ring(mesh, axis, batch_axes, out_dtype, dcn_axis=None,
+                    wire=None):
     in_specs, out_specs = _specs(axis, batch_axes, dcn_axis)
 
     def body(a_loc, b_loc):
@@ -403,7 +542,9 @@ def _build_xla_ring(mesh, axis, batch_axes, out_dtype, dcn_axis=None):
             # same rail/ring split as the fused engine: DCN leg via
             # lax, ppermute ring intra-slice over nd× slabs
             a_loc = jax.lax.all_gather(a_loc, dcn_axis, tiled=True)
-        return ag_gemm_device(a_loc, b_loc, axis, out_dtype=out_dtype)
+        return ag_gemm_device(
+            a_loc, b_loc, axis, out_dtype=out_dtype, wire=wire
+        )
 
     fn = jax.shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
@@ -447,15 +588,15 @@ def _build_xla_naive(mesh, axis, batch_axes, out_dtype, dcn_axis=None):
 
 @functools.lru_cache(maxsize=64)
 def _engine_tuner(mesh, axis, batch_axes, out_dtype, collective_id,
-                  return_gathered, dcn_axis=None):
+                  return_gathered, dcn_axis=None, wire=None):
     """Measured engine selection for ``method=None`` (≡ wrapping the op
     in contextual_autotune, reference autotuner.py:97): every engine is
     benchmarked end to end per input shape, the winner persists on disk,
     and the MAX consensus keeps multi-process meshes aligned. Engines
     that cannot build for a shape (e.g. unblockable PALLAS_FUSED) fail
-    to +inf and lose. out_dtype/collective_id are part of the tuner name
-    (and so the cache key): a winner for one out_dtype must not be
-    applied to another it might not even build for."""
+    to +inf and lose. out_dtype/collective_id/wire are part of the tuner
+    name (and so the cache key): a winner for one out_dtype or wire
+    format must not be applied to another it might not even build for."""
     from triton_distributed_tpu.tune.autotuner import method_tuner
 
     def run(a, b, *, method):
@@ -463,13 +604,44 @@ def _engine_tuner(mesh, axis, batch_axes, out_dtype, collective_id,
             a, b, mesh, axis, batch_axes=batch_axes,
             method=AGGemmMethod(method), out_dtype=out_dtype,
             collective_id=collective_id, return_gathered=return_gathered,
-            dcn_axis=dcn_axis,
+            dcn_axis=dcn_axis, wire_dtype=wire,
         )
 
     return method_tuner(
         f"ag_gemm[{dict(mesh.shape)}|{axis}|{batch_axes}|{out_dtype}|"
-        f"{collective_id}|rg{int(return_gathered)}|{dcn_axis}]",
+        f"{collective_id}|rg{int(return_gathered)}|{dcn_axis}|w{wire}]",
         run, AGGemmMethod,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _wire_tuner(mesh, axis, batch_axes, out_dtype, collective_id,
+                return_gathered, dcn_axis=None):
+    """Measured wire-dtype selection for ``wire_dtype='auto'``: the
+    bf16 wire and the fp8 wire are benchmarked end to end and the
+    winner persists (the same thunk-level contract as the engine
+    tuners — a wire format is just another config of the whole op)."""
+    from triton_distributed_tpu.tune.autotuner import wire_tuner
+
+    def run(a, b, *, wire_dtype):
+        # engine pinned to the static heuristic: the wire sweep must
+        # compare wire formats on ONE engine, not recurse into the
+        # engine tuner's own benching mid-measurement
+        dp = mesh_axes_size(mesh, tuple(batch_axes))
+        method = auto_ag_gemm_method(
+            mesh, axis, a, b, dp=dp, dcn_axis=dcn_axis
+        )
+        return ag_gemm(
+            a, b, mesh, axis, batch_axes=batch_axes, method=method,
+            out_dtype=out_dtype, collective_id=collective_id,
+            return_gathered=return_gathered, dcn_axis=dcn_axis,
+            wire_dtype=wire_dtype,
+        )
+
+    return wire_tuner(
+        f"ag_gemm_wire[{dict(mesh.shape)}|{axis}|{batch_axes}|{out_dtype}|"
+        f"{collective_id}|rg{int(return_gathered)}|{dcn_axis}]",
+        run,
     )
 
 
@@ -517,10 +689,81 @@ def auto_ag_gemm_method(mesh, axis, a, b, dp: int = 1,
     return AGGemmMethod.PALLAS_FUSED
 
 
+def resolve_ag_gemm_wire(
+    mesh, axis, a, b, *, batch_axes=(), method=None, wire_dtype=None,
+    dcn_axis: str | None = None, dp: int | None = None,
+) -> str | None:
+    """The wire format :func:`ag_gemm` will ACTUALLY ship for these
+    arguments: None (raw bf16 wire) unless a ring engine runs and the
+    slab admits the lang.wire layout. ``'auto'`` consults the measured
+    wire tuner (when tuning is enabled and args are concrete), else the
+    perf model's comm-bound test — compressed exactly when the bf16
+    ring transfer, not the shard matmul, is the per-step critical path."""
+    from triton_distributed_tpu.config import compiling_for_tpu
+
+    w = wirelib.normalize_wire(wire_dtype)
+    if w is None:
+        return None
+    n = mesh.shape[axis]
+    nd = mesh.shape[dcn_axis] if dcn_axis else 1
+    if dp is None:
+        dp = mesh_axes_size(mesh, tuple(batch_axes))
+    if n * nd == 1:
+        return None
+    if dcn_axis is not None:
+        _warn_once(
+            ("ag_gemm", "wire_dcn"),
+            "ag_gemm: wire compression is intra-slice only; hierarchical "
+            "(dcn_axis) calls ship the bf16 wire",
+        )
+        return None
+    if method == AGGemmMethod.XLA_NAIVE:
+        return None  # no ring — nothing to compress
+    slab_rows = a.shape[0] // (dp * n)
+    k = a.shape[1]
+    strict = compiling_for_tpu()
+    # in-kernel dequant happens only on the fused engine; XLA engines
+    # carry fp8 natively regardless of the Mosaic backend's cast support
+    inkernel = method == AGGemmMethod.PALLAS_FUSED
+    if w == "auto":
+        if not wirelib.wire_blockable(slab_rows, k, "fp8", strict):
+            return None
+        if inkernel and not wirelib.inkernel_wire_ok("fp8"):
+            # no silent numerics switch to int8: auto keeps the exact
+            # wire where the toolchain cannot carry fp8 in-kernel
+            return None
+        from triton_distributed_tpu.tune.autotuner import tuned_method_or_none
+
+        tuned = tuned_method_or_none(
+            lambda: _wire_tuner(
+                mesh, axis, tuple(batch_axes), jnp.dtype(a.dtype), 5,
+                False, dcn_axis,
+            ),
+            a, b, key="wire_dtype",
+        )
+        if tuned is not None:
+            return wirelib.normalize_wire(tuned)
+        from triton_distributed_tpu.tune.perf_model import auto_wire_dtype
+
+        n_local = b.shape[1] // n
+        return wirelib.normalize_wire(auto_wire_dtype(
+            slab_rows, k, n_local, a.dtype.itemsize
+        ))
+    if inkernel:
+        wirelib.require_inkernel(w, "ag_gemm")
+    if not wirelib.wire_blockable(slab_rows, k, w, strict):
+        raise ValueError(
+            f"ag_gemm wire_dtype={w!r}: slab ({slab_rows}, {k}) admits no "
+            "legal wire chunking/blocking (a pinned wire format is a "
+            "contract); use wire_dtype='auto' or the bf16 wire"
+        )
+    return w
+
+
 def resolve_ag_gemm_method(
     a_mesh, axis, a, b, *, batch_axes=(), method=None, out_dtype=None,
     collective_id: int = 5, return_gathered: bool = False,
-    dcn_axis: str | None = None,
+    dcn_axis: str | None = None, wire_dtype=None,
 ) -> AGGemmMethod:
     """The engine :func:`ag_gemm` will ACTUALLY run for these arguments:
     the explicit ``method``, else the tuned winner (when tuning is
@@ -539,7 +782,7 @@ def resolve_ag_gemm_method(
     m = tuned_method_or_none(
         lambda: _engine_tuner(
             a_mesh, axis, batch_axes, jnp.dtype(out_dtype), collective_id,
-            return_gathered, dcn_axis,
+            return_gathered, dcn_axis, wirelib.normalize_wire(wire_dtype),
         ),
         a, b,
     )
@@ -567,8 +810,19 @@ def ag_gemm(
     collective_id: int = 5,
     return_gathered: bool = False,
     dcn_axis: str | None = None,
+    wire_dtype=None,
 ):
     """Fused AllGather(A) @ B for column-parallel TP.
+
+    ``wire_dtype``: what the ring ships (docs/PERF.md "Quantized wire").
+    None/'bf16' — the raw compute dtype (default, today's numerics);
+    'fp8'/'int8' — 1-byte payload + per-chunk f32 scales (lang.wire),
+    quantized once at the source, dequantized on receive before the MXU
+    (own shard consumed exact); 'auto' — the measured wire tuner, else
+    the perf model picks the compressed wire exactly when the bf16 ring
+    transfer is the per-step critical path (comm-bound shapes). With a
+    compressed wire the gathered-A output (``return_gathered``) holds
+    the dequantized remote slabs — inference-grade, like the MoE wire.
 
     ``a``: (M, K) with rows sharded over ``(*batch_axes, axis)`` — each
     device holds an M/(dp·n) row shard; the kernel gathers the ``axis``
@@ -608,16 +862,23 @@ def ag_gemm(
         mesh, axis, a, b, batch_axes=batch_axes, method=method,
         out_dtype=out_dtype, collective_id=collective_id,
         return_gathered=return_gathered, dcn_axis=dcn_axis,
+        wire_dtype=wire_dtype,
+    )
+    wire = resolve_ag_gemm_wire(
+        mesh, axis, a, b, batch_axes=batch_axes, method=method,
+        wire_dtype=wire_dtype, dcn_axis=dcn_axis, dp=dp,
     )
     if method == AGGemmMethod.PALLAS_FUSED:
         fn = _build_fused(
             mesh, axis, batch_axes, a.shape, b.shape, a.dtype, out_dtype,
-            collective_id, interp_key(), return_gathered, dcn_axis,
+            collective_id, interp_key(), return_gathered, dcn_axis, wire,
         )
         out, gathered = fn(a, b)
         return (out, gathered) if return_gathered else out
     if method == AGGemmMethod.XLA_RING:
-        fn = _build_xla_ring(mesh, axis, batch_axes, out_dtype, dcn_axis)
+        fn = _build_xla_ring(
+            mesh, axis, batch_axes, out_dtype, dcn_axis, wire
+        )
     else:
         fn = _build_xla_naive(mesh, axis, batch_axes, out_dtype, dcn_axis)
     out = fn(a, b)

@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import lang
 from triton_distributed_tpu.config import interp_key
+from triton_distributed_tpu.lang import wire as wirelib
 from triton_distributed_tpu.runtime import (
     AllGatherMethod,
     auto_allgather_method,
@@ -69,6 +70,57 @@ def _ring_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
         )
         dma.start()
         dma.wait()  # drains send + the symmetric incoming recv
+
+
+def _ring_ag_kernel_w(
+    n, axis, mesh_axes,
+    x_ref, xq_ref, xs_ref, out_ref, outq_ref, outs_ref,
+    send_sem, recv_sem, s_send_sem, s_recv_sem,
+):
+    """Quantized-wire twin of :func:`_ring_ag_kernel`: the ring forwards
+    the host-quantized slab (1 byte/elem) plus a per-ROW f32 scale plane
+    (lang.wire with chunk_rows=1 — the VMEM-resident engines afford
+    row-granular scales), dequantizing each arrival into ``out_ref``.
+    The own slab is written exact from ``x_ref`` (it never crosses the
+    wire), matching the fused engines' wire contract."""
+    me = lang.my_pe(axis)
+    m = x_ref.shape[0]
+    left, right = ring_neighbors(me, n)
+    left = lang.pe_flat(axis, left, mesh_axes)
+    right = lang.pe_flat(axis, right, mesh_axes)
+
+    out_ref[pl.ds(me * m, m)] = x_ref[:]
+    outq_ref[pl.ds(me * m, m)] = xq_ref[:]
+    outs_ref[pl.ds(me * m, m)] = xs_ref[:]
+    _faults.maybe_corrupt(out_ref, _SITE, me, n, row_off=me * m)
+    lang.neighbor_barrier(axis, left, right, site=_SITE, me=me, n=n)
+
+    for s in range(n - 1):
+        src = jax.lax.rem(me + n - s, n) if s > 0 else me
+        chaos_delay(site=_SITE, step=s, me=me, n=n)
+        dma_q = lang.remote_copy(
+            outq_ref.at[pl.ds(src * m, m)],
+            outq_ref.at[pl.ds(src * m, m)],
+            send_sem.at[s], recv_sem.at[s], right,
+        )
+        dma_s = lang.remote_copy(
+            outs_ref.at[pl.ds(src * m, m)],
+            outs_ref.at[pl.ds(src * m, m)],
+            s_send_sem.at[s], s_recv_sem.at[s], right,
+        )
+        dma_q.start()
+        dma_s.start()
+        dma_q.wait()   # drains send + the symmetric incoming recv
+        dma_s.wait()
+        # the slab that just LANDED came from the left: left's step-s
+        # source, i.e. shard (me-1-s) — dequantize it for the caller
+        # (the wire copy stays resident for the next forward)
+        arr = jax.lax.rem(me + 2 * n - 1 - s, n)
+        q = outq_ref[pl.ds(arr * m, m)]
+        sc = outs_ref[pl.ds(arr * m, m), pl.ds(0, 1)]
+        out_ref[pl.ds(arr * m, m)] = (
+            q.astype(jnp.float32) * sc
+        ).astype(out_ref.dtype)
 
 
 def _ring_bidir_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
@@ -219,17 +271,42 @@ _KERNELS = {
 
 
 @functools.lru_cache(maxsize=256)
-def _build_all_gather(mesh, axis, method, shape, dtype, collective_id, chaos):
+def _build_all_gather(mesh, axis, method, shape, dtype, collective_id, chaos,
+                      wire=None):
     """Compile-once factory: the jitted collective for one (mesh, shape)
     configuration. lru_cache gives call-site reuse — without it every
-    invocation would rebuild pallas_call+shard_map+jit and retrace."""
+    invocation would rebuild pallas_call+shard_map+jit and retrace.
+
+    ``wire`` ('fp8'/'int8'): quantized ring wire (lang.wire, per-row
+    scales). Supported on RING_1D (the Pallas wire kernel) and
+    XLA_FALLBACK (quantize → gather payload+scales → dequantize, the
+    numerics twin that also genuinely halves DCN bytes); the entry
+    demotes other methods to the raw wire."""
     n = mesh.shape[axis]
+    m = shape[0] // n
+    fmt = (
+        wirelib.WireFormat(quant=wire, chunk_rows=1)
+        if wire is not None else None
+    )
     if method == AllGatherMethod.XLA_FALLBACK:
+        if fmt is None:
+            inner = lambda s: jax.lax.all_gather(s, axis, tiled=True)  # noqa: E731
+        else:
+            def inner(s):
+                q, sc = wirelib.quantize_slab(s, fmt)
+                qg = jax.lax.all_gather(q, axis, tiled=True)
+                sg = jax.lax.all_gather(sc, axis, tiled=True)
+                out = wirelib.dequantize_slab(qg, sg, fmt, s.dtype)
+                # own slab exact, like the ring wire kernels
+                me = jax.lax.axis_index(axis)
+                return jax.lax.dynamic_update_slice(
+                    out, s, (me * m,) + (0,) * (s.ndim - 1)
+                )
         # instrumented like the Pallas engines: an XLA collective can
         # wedge too (DCN partner loss), and the watchdog/stall hooks are
         # pure host callbacks — no Pallas machinery needed
         body = lang.maybe_instrument(
-            lambda s: jax.lax.all_gather(s, axis, tiled=True),
+            inner,
             axis=axis, site=_SITE, collective_id=collective_id, n=n,
         )
         fn = jax.shard_map(
@@ -237,6 +314,43 @@ def _build_all_gather(mesh, axis, method, shape, dtype, collective_id, chaos):
             mesh=mesh,
             in_specs=P(axis),
             out_specs=P(None),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    if fmt is not None:
+        assert method == AllGatherMethod.RING_1D, method
+        wirelib.require_inkernel(wire, "all_gather")
+        nsem = max(n - 1, 1)
+        call = lang.shmem_call(
+            functools.partial(_ring_ag_kernel_w, n, axis, mesh.axis_names),
+            out_shape=[
+                jax.ShapeDtypeStruct(shape, dtype),
+                jax.ShapeDtypeStruct(shape, fmt.wire_dtype),
+                jax.ShapeDtypeStruct(
+                    (shape[0], wirelib.SCALE_LANES), jnp.float32
+                ),
+            ],
+            in_specs=lang.vmem_specs(3),
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((nsem,)),
+                pltpu.SemaphoreType.DMA((nsem,)),
+                pltpu.SemaphoreType.DMA((nsem,)),   # scale rail
+                pltpu.SemaphoreType.DMA((nsem,)),
+            ],
+            collective_id=collective_id,
+            name=f"ag_ring_1d_{wire}w",
+        )
+        call = lang.maybe_instrument(
+            call, axis=axis, site=_SITE, collective_id=collective_id, n=n
+        )
+
+        def body(x_loc):
+            q, sc = wirelib.quantize_slab(x_loc, fmt)
+            return call(x_loc, q, sc)[0]
+
+        fn = jax.shard_map(
+            body, mesh=mesh, in_specs=P(axis), out_specs=P(None),
             check_vma=False,
         )
         return jax.jit(fn)
@@ -390,6 +504,46 @@ def _engine_tuner(mesh, axis, collective_id):
     )
 
 
+def _resolve_ag_wire(wire_dtype, method, x, n):
+    """The wire :func:`all_gather` will actually ship: None unless the
+    payload is 2-D, the method carries a wire (RING_1D / XLA_FALLBACK),
+    and the per-row scale plane actually saves bytes. 'auto' defers to
+    :func:`runtime.topology.auto_allgather_wire`; an explicit 'fp8' /
+    'int8' on an ineligible payload raises (pinned = contract)."""
+    w = wirelib.normalize_wire(wire_dtype)
+    if w is None:
+        return None
+    cols = x.shape[-1] if x.ndim == 2 else 0
+    eligible = (
+        x.ndim == 2
+        and method in (AllGatherMethod.RING_1D, AllGatherMethod.XLA_FALLBACK)
+        and x.shape[0] % n == 0
+        and cols * x.dtype.itemsize > cols + wirelib.SCALE_LANES * 4
+    )
+    inkernel = method == AllGatherMethod.RING_1D
+    if w == "auto":
+        if not eligible:
+            return None
+        if inkernel and not wirelib.inkernel_wire_ok("fp8"):
+            return None  # Mosaic lacks in-kernel f8 casts; stay exact
+        from triton_distributed_tpu.runtime.topology import (
+            auto_allgather_wire,
+        )
+
+        shard_bytes = (x.size // n) * x.dtype.itemsize
+        return auto_allgather_wire(shard_bytes)
+    if inkernel:
+        wirelib.require_inkernel(w, "all_gather")
+    if not eligible:
+        raise ValueError(
+            f"all_gather wire_dtype={w!r} needs a 2-D payload with "
+            f"cols·itemsize > cols + {wirelib.SCALE_LANES * 4} on a "
+            "ring_1d/xla method (a pinned wire format is a contract); "
+            f"got shape {x.shape} {x.dtype} on {method}"
+        )
+    return w
+
+
 def all_gather(
     x,
     mesh,
@@ -397,11 +551,18 @@ def all_gather(
     *,
     method: AllGatherMethod | None = None,
     collective_id: int = 2,
+    wire_dtype=None,
 ):
     """AllGather ``x`` (sharded on dim 0 along ``axis``) → replicated full array.
 
     Host entry ≡ reference ``fast_allgather`` dispatcher
     (low_latency_allgather.py:971) + method auto-selection (allgather.py:54-69).
+
+    ``wire_dtype``: quantized ring wire ('fp8'/'int8' — 1-byte payload +
+    per-row f32 scales, own slab exact; 'auto' — compressed above the
+    topology helper's byte threshold). Carried by the RING_1D and
+    XLA_FALLBACK engines; with an explicit compressed wire a bidir/LL
+    method resolution is demoted to RING_1D so the wire request wins.
     """
     n = mesh.shape[axis]
     if n == 1:
@@ -418,6 +579,7 @@ def all_gather(
             fn = _build_all_gather(
                 mesh, axis, method, x.shape, x.dtype, collective_id,
                 interp_key(),
+                wire=_resolve_ag_wire(wire_dtype, method, x, n),
             )
             return fn(x)
         topo = detect_topology(mesh, axis)
@@ -441,6 +603,13 @@ def all_gather(
         # bidir splits dim 1 between the two directions — impossible on
         # rank-1 / single-column inputs; fall back to the plain ring.
         method = AllGatherMethod.RING_1D
+    if wirelib.normalize_wire(wire_dtype) in ("fp8", "int8") and method in (
+        AllGatherMethod.RING_BIDIR, AllGatherMethod.LL_SMALL,
+        AllGatherMethod.LL_PERSIST,
+    ):
+        # an explicit compressed wire outranks the method heuristic —
+        # only the plain ring (and the XLA fallback) carry the wire
+        method = AllGatherMethod.RING_1D
     if method == AllGatherMethod.LL_PERSIST:
         if isinstance(x, jax.core.Tracer) or x.ndim != 2:
             # the persistent workspace is module state — unreachable from
@@ -453,7 +622,8 @@ def all_gather(
                 collective_id,
             )(x)
     fn = _build_all_gather(
-        mesh, axis, method, x.shape, x.dtype, collective_id, interp_key()
+        mesh, axis, method, x.shape, x.dtype, collective_id, interp_key(),
+        wire=_resolve_ag_wire(wire_dtype, method, x, n),
     )
     return fn(x)
 

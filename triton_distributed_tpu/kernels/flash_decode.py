@@ -801,6 +801,38 @@ def quantize_kv(x):
     return q, s
 
 
+def _mh_q8_vmem_plan(hkv, s_len, block_k, d, n_bufs, multihead):
+    """(n_bufs, vmem_limit, multihead) for the multihead-q8 decode grid.
+
+    The VMEM residents are the int8 KV slot buffers (2 · n_bufs · Hkv ·
+    block_k · d) AND the grid-pipelined (1, Hkv, 1, S) f32 scale planes
+    — 2 planes (K and V) × 2 Mosaic pipeline buffers × Hkv·S·4 B, which
+    grow linearly in per-shard S and previously ate the fixed 8 MB
+    headroom silently (ADVICE r5: compilation failures from ~64k
+    per-shard S). Budgeting: shallower KV buffering first; then a
+    scoped vmem_limit that counts BOTH terms; above the per-shard-S
+    threshold where even minimal buffering cannot fit the configured
+    budget, fall back to the per-(b, h) grid (multihead=False), whose
+    scale blocks are Hkv× smaller."""
+    from triton_distributed_tpu.config import fused_vmem_budget
+
+    def kv_bytes(nb):
+        return 2 * nb * hkv * block_k * d
+
+    scale_bytes = 2 * 2 * hkv * s_len * 4
+    while multihead and n_bufs > 2 and \
+            kv_bytes(n_bufs) + scale_bytes > 12 * 1024 * 1024:
+        n_bufs -= 1
+    vmem_limit = None
+    if multihead and kv_bytes(n_bufs) + scale_bytes > 12 * 1024 * 1024:
+        vmem_limit = kv_bytes(n_bufs) + scale_bytes + 8 * 1024 * 1024
+        if vmem_limit > fused_vmem_budget():
+            # per-shard S too large for the multihead grid at any depth
+            multihead = False
+            vmem_limit = None
+    return n_bufs, vmem_limit, multihead
+
+
 def _q8_auto_block_k(batch, hkv, s_len):
     """Block size for the int8 walk — the r4 heuristic (half capacity
     clamped to [1024, 4096]) re-validated round 5 by a PAIRED sweep at
@@ -865,17 +897,9 @@ def gqa_fwd_batch_decode_q8(
     qg = q.reshape(batch, hkv, g, d).astype(jnp.bfloat16)
     ks4 = k_scale.astype(jnp.float32).reshape(batch, hkv, 1, s_len)
     vs4 = v_scale.astype(jnp.float32).reshape(batch, hkv, 1, s_len)
-    # multihead KV slots are Hkv× bigger: keep them within the default
-    # 16 MB scoped-VMEM limit (shallower buffering first, then raise
-    # the limit — a bk=2048 four-deep config measured 84 KB over it)
-    def _kv_bytes(nb):
-        return 2 * nb * hkv * block_k * d
-
-    while multihead and n_bufs > 2 and _kv_bytes(n_bufs) > 12 * 1024 * 1024:
-        n_bufs -= 1
-    vmem_limit = None
-    if multihead and _kv_bytes(n_bufs) > 12 * 1024 * 1024:
-        vmem_limit = _kv_bytes(n_bufs) + 8 * 1024 * 1024
+    n_bufs, vmem_limit, multihead = _mh_q8_vmem_plan(
+        hkv, s_len, block_k, d, n_bufs, multihead
+    )
     if multihead:
         kernel = functools.partial(
             _decode_kernel_dyn_mh, scale, soft_cap, block_k, n_bufs,

@@ -46,7 +46,8 @@ from triton_distributed_tpu.kernels.ag_gemm import (
     mm_pipeline,
     pick_mm_blocks,
 )
-from triton_distributed_tpu.kernels.ring import reduce_ring
+from triton_distributed_tpu.kernels.ring import RSWireRefs, reduce_ring
+from triton_distributed_tpu.lang import wire as wirelib
 from triton_distributed_tpu.runtime import (
     LinkKind,
     detect_topology,
@@ -119,6 +120,42 @@ def _fused_kernel(
     )
 
 
+def _fused_kernel_w(
+    n, axis, mesh_axes, blocks, fmt,
+    a_hbm, b_hbm, out_hbm, w0, w1,
+    wq0, wq1, ws0, ws1, rq0, rq1, rs0, rs1,
+    acc_ref, send_sem, recv_sem, ack_sem, s_send_sem, s_recv_sem,
+):
+    """Quantized-wire twin of :func:`_fused_kernel`: each hop's freshly
+    computed partial is quantized to the lang.wire layout before its
+    RDMA, and the receive side dequant-accumulates in f32 (one rounding
+    per hop — the RS-side contract that keeps reduction error bounded).
+    The bf16 recv slabs of the raw engine are gone; the wire lands in
+    the 1-byte rq slabs + rs scale planes."""
+    m_local = out_hbm.shape[0]
+    n_out = out_hbm.shape[1]
+    k = a_hbm.shape[1]
+    bm, bk, bn = blocks
+    mb, nb, kb = m_local // bm, n_out // bn, k // bk
+
+    def partial_into(dst, dst_ref):
+        mm_pipeline(mb, nb, kb, bm, bk, bn, acc_ref, m_off=dst * mb, out_m_off=0)(
+            a_hbm, b_hbm, dst_ref
+        )
+
+    wire = RSWireRefs(
+        fmt=fmt, wq=(wq0, wq1), ws=(ws0, ws1), rq=(rq0, rq1), rs=(rs0, rs1),
+        s_send_sem=s_send_sem, s_recv_sem=s_recv_sem,
+        quantize=wirelib.quant_pipeline(m_local, n_out, fmt),
+        dequant_add=wirelib.dequant_add_pipeline(m_local, n_out, fmt),
+    )
+    reduce_ring(
+        n, axis, mesh_axes, out_hbm, (w0, w1), (None, None),
+        send_sem, recv_sem, ack_sem, partial_into, None,
+        site="gemm_rs", wire=wire,
+    )
+
+
 def _specs(axis, batch_axes, dcn_axis=None):
     """(in_specs, out_specs) for GEMM-RS under shard_map over the full mesh.
 
@@ -142,7 +179,7 @@ def _specs(axis, batch_axes, dcn_axis=None):
 @functools.lru_cache(maxsize=256)
 def _build_fused(
     mesh, axis, batch_axes, a_shape, b_shape, dtype, out_dtype, collective_id,
-    chaos, dcn_axis=None,
+    chaos, dcn_axis=None, wire=None,
 ):
     """Fused engine. ``dcn_axis`` set = hierarchical (≡ the reference's
     inter-node GEMM-RS, reduce_scatter.py:524-545): the fused ring
@@ -177,9 +214,54 @@ def _build_fused(
 
     if n == 1:
         collective_id = None  # degenerate path uses no barrier semaphore
+    fmt = None
+    if wire is not None:
+        assert dcn_axis is None, "wire compression is intra-slice only"
+        from triton_distributed_tpu.config import compiling_for_tpu
+
+        wirelib.require_inkernel(wire, "gemm_rs")
+        fmt = wirelib.make_wire_format(
+            wire, m_local, strict=compiling_for_tpu()
+        )
+        if fmt is None:
+            raise ValueError(
+                f"gemm_rs wire={wire!r}: slab of {m_local} rows admits no "
+                "legal scale chunking; use the bf16 wire"
+            )
 
     def mk_call(n_cols, blk, cid):
         slab = jax.ShapeDtypeStruct((m_local, n_cols), out_dtype)
+        if fmt is not None:
+            qslab = jax.ShapeDtypeStruct((m_local, n_cols), fmt.wire_dtype)
+            sslab = jax.ShapeDtypeStruct(
+                (fmt.chunks(m_local), wirelib.SCALE_LANES), jnp.float32
+            )
+            return lang.shmem_call(
+                functools.partial(
+                    _fused_kernel_w, n, axis, mesh.axis_names, blk, fmt
+                ),
+                # out + bf16 work pair + quantized work/scale pairs +
+                # quantized recv/scale pairs (HBM workspaces as outputs)
+                out_shape=[slab, slab, slab,
+                           qslab, qslab, sslab, sslab,
+                           qslab, qslab, sslab, sslab],
+                in_specs=[
+                    pl.BlockSpec(memory_space=pl.ANY),
+                    pl.BlockSpec(memory_space=pl.ANY),
+                ],
+                out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 11,
+                scratch_shapes=[
+                    pltpu.VMEM((blk[0], blk[2]), jnp.float32),
+                    pltpu.SemaphoreType.DMA((2,)),
+                    pltpu.SemaphoreType.DMA((2,)),
+                    pltpu.SemaphoreType.REGULAR,
+                    pltpu.SemaphoreType.DMA((2,)),   # scale rail
+                    pltpu.SemaphoreType.DMA((2,)),
+                ],
+                collective_id=cid,
+                vmem_limit_bytes=fused_vmem_budget(),
+                name=f"gemm_rs_fused_{wire}w",
+            )
         return lang.shmem_call(
             functools.partial(_fused_kernel, n, axis, mesh.axis_names, blk),
             # work/recv ring slabs are HBM workspaces (Mosaic supports
@@ -239,11 +321,15 @@ def _build_fused(
         nc = n_out // n_chunks
         # distinct collective_ids per chunk ring: strict per-chunk
         # rendezvous (a skewed neighbor's chunk-c+1 signal must not
-        # satisfy a chunk-c wait); offset past ag_gemm's +64 rail range
+        # satisfy a chunk-c wait); the offset range is reserved in the
+        # registry's rail ledger, so disjointness from every other
+        # chunked family is checked, not maintained by comment
+        from triton_distributed_tpu.kernels.registry import rail_collective_id
+
         chunk_calls = [
             mk_call(
                 nc, chunk_blocks,
-                None if collective_id is None else collective_id + 96 + s,
+                rail_collective_id("gemm_rs.dcn_chunks", collective_id, s),
             )
             for s in range(n_chunks)
         ]
@@ -289,16 +375,28 @@ def _build_fused(
     return jax.jit(fn)
 
 
-def gemm_rs_device(a_loc, b_loc, axis, *, out_dtype=None):
+def gemm_rs_device(a_loc, b_loc, axis, *, out_dtype=None, wire=None):
     """Per-device XLA-ring GEMM-RS body — usable inside any shard_map.
 
     The accumulator flows leftward around the ring while the next
-    destination's partial matmul runs, overlapped by XLA async permute."""
+    destination's partial matmul runs, overlapped by XLA async permute.
+
+    ``wire`` ('fp8'/'int8'): each hop's partial sum is quantized to the
+    lang.wire layout before its permute and dequant-accumulated in f32
+    on arrival — the same per-hop requantization semantics (and byte
+    counts) as the fused wire ring."""
     n = jax.lax.axis_size(axis)
     out_dtype = out_dtype or a_loc.dtype
     m_local = a_loc.shape[0] // n
     me = jax.lax.axis_index(axis)
     perm = [(i, (i - 1) % n) for i in range(n)]
+    fmt = None
+    if wire is not None:
+        from triton_distributed_tpu.config import compiling_for_tpu
+
+        fmt = wirelib.make_wire_format(
+            wire, m_local, strict=compiling_for_tpu()
+        )
 
     def partial(dst):
         rows = jax.lax.dynamic_slice(
@@ -308,20 +406,36 @@ def gemm_rs_device(a_loc, b_loc, axis, *, out_dtype=None):
             out_dtype
         )
 
-    def step(s, acc):
-        acc = jax.lax.ppermute(acc, axis, perm=perm)
-        return acc + partial(jax.lax.rem(me + 2 + s, n))
+    if fmt is None:
+        def step(s, acc):
+            acc = jax.lax.ppermute(acc, axis, perm=perm)
+            return acc + partial(jax.lax.rem(me + 2 + s, n))
+
+        acc = partial(jax.lax.rem(me + 1, n))
+        return jax.lax.fori_loop(0, n - 1, step, acc)
+
+    def step_w(s, acc):
+        q, sc = wirelib.quantize_slab(acc, fmt)
+        q = jax.lax.ppermute(q, axis, perm=perm)
+        sc = jax.lax.ppermute(sc, axis, perm=perm)
+        arrived = wirelib.dequantize_slab(q, sc, fmt, jnp.float32)
+        return (
+            arrived + partial(jax.lax.rem(me + 2 + s, n)).astype(jnp.float32)
+        ).astype(out_dtype)
 
     acc = partial(jax.lax.rem(me + 1, n))
-    return jax.lax.fori_loop(0, n - 1, step, acc)
+    return jax.lax.fori_loop(0, n - 1, step_w, acc)
 
 
 @functools.lru_cache(maxsize=256)
-def _build_xla_ring(mesh, axis, batch_axes, out_dtype, dcn_axis=None):
+def _build_xla_ring(mesh, axis, batch_axes, out_dtype, dcn_axis=None,
+                    wire=None):
     in_specs, out_specs = _specs(axis, batch_axes, dcn_axis)
 
     def body(a_loc, b_loc):
-        part = gemm_rs_device(a_loc, b_loc, axis, out_dtype=out_dtype)
+        part = gemm_rs_device(
+            a_loc, b_loc, axis, out_dtype=out_dtype, wire=wire
+        )
         if dcn_axis is not None:
             part = jax.lax.psum_scatter(
                 part, dcn_axis, scatter_dimension=0, tiled=True
@@ -354,23 +468,48 @@ def _build_xla_naive(mesh, axis, batch_axes, out_dtype, dcn_axis=None):
 
 @functools.lru_cache(maxsize=64)
 def _engine_tuner(mesh, axis, batch_axes, out_dtype, collective_id,
-                  dcn_axis=None):
+                  dcn_axis=None, wire=None):
     """Measured engine selection for ``method=None`` (see
-    ag_gemm._engine_tuner for the contract incl. why out_dtype and
-    collective_id belong in the name/key)."""
+    ag_gemm._engine_tuner for the contract incl. why out_dtype,
+    collective_id and wire belong in the name/key)."""
     from triton_distributed_tpu.tune.autotuner import method_tuner
 
     def run(a, b, *, method):
         return gemm_rs(
             a, b, mesh, axis, batch_axes=batch_axes,
             method=GemmRSMethod(method), out_dtype=out_dtype,
-            collective_id=collective_id, dcn_axis=dcn_axis,
+            collective_id=collective_id, dcn_axis=dcn_axis, wire_dtype=wire,
         )
 
     return method_tuner(
         f"gemm_rs[{dict(mesh.shape)}|{axis}|{batch_axes}|{out_dtype}|"
-        f"{collective_id}|{dcn_axis}]",
+        f"{collective_id}|{dcn_axis}|w{wire}]",
         run, GemmRSMethod,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _wire_tuner(mesh, axis, batch_axes, out_dtype, collective_id,
+                dcn_axis=None):
+    """Measured wire-dtype selection for ``wire_dtype='auto'`` (see
+    ag_gemm._wire_tuner)."""
+    from triton_distributed_tpu.tune.autotuner import wire_tuner
+
+    def run(a, b, *, wire_dtype):
+        dp = mesh_axes_size(mesh, tuple(batch_axes))
+        method = auto_gemm_rs_method(
+            mesh, axis, a, b, dp=dp, dcn_axis=dcn_axis
+        )
+        return gemm_rs(
+            a, b, mesh, axis, batch_axes=batch_axes, method=method,
+            out_dtype=out_dtype, collective_id=collective_id,
+            dcn_axis=dcn_axis, wire_dtype=wire_dtype,
+        )
+
+    return wire_tuner(
+        f"gemm_rs_wire[{dict(mesh.shape)}|{axis}|{batch_axes}|{out_dtype}|"
+        f"{collective_id}|{dcn_axis}]",
+        run,
     )
 
 
@@ -416,9 +555,77 @@ def auto_gemm_rs_method(mesh, axis, a, b, dp: int = 1,
     return GemmRSMethod.PALLAS_FUSED
 
 
+def resolve_gemm_rs_wire(
+    mesh, axis, a, b, *, batch_axes=(), method=None, wire_dtype=None,
+    out_dtype=None, dcn_axis: str | None = None, dp: int | None = None,
+) -> str | None:
+    """The wire format :func:`gemm_rs` will ACTUALLY ship (mirror of
+    ag_gemm.resolve_ag_gemm_wire): None unless a ring engine runs and
+    the OUTPUT slab — what the reduce ring moves — admits the lang.wire
+    layout; 'auto' consults the measured wire tuner, else the perf
+    model's comm-bound test at the per-step shapes."""
+    from triton_distributed_tpu.config import compiling_for_tpu
+
+    w = wirelib.normalize_wire(wire_dtype)
+    if w is None:
+        return None
+    n = mesh.shape[axis]
+    nd = mesh.shape[dcn_axis] if dcn_axis else 1
+    if dp is None:
+        dp = mesh_axes_size(mesh, tuple(batch_axes))
+    if n * nd == 1:
+        return None
+    if dcn_axis is not None:
+        _warn_once(
+            ("gemm_rs", "wire_dcn"),
+            "gemm_rs: wire compression is intra-slice only; hierarchical "
+            "(dcn_axis) calls ship the bf16 wire",
+        )
+        return None
+    if method == GemmRSMethod.XLA_NAIVE:
+        return None  # psum_scatter — no ring to compress
+    m_local = a.shape[0] // (dp * n)
+    k_local = a.shape[1] // n
+    n_out = b.shape[1]
+    out_itemsize = jnp.dtype(out_dtype or a.dtype).itemsize
+    strict = compiling_for_tpu()
+    inkernel = method == GemmRSMethod.PALLAS_FUSED
+    if w == "auto":
+        if not wirelib.wire_blockable(m_local, n_out, "fp8", strict):
+            return None
+        if inkernel and not wirelib.inkernel_wire_ok("fp8"):
+            return None  # no silent fp8→int8 numerics switch
+        from triton_distributed_tpu.tune.autotuner import tuned_method_or_none
+
+        tuned = tuned_method_or_none(
+            lambda: _wire_tuner(
+                mesh, axis, tuple(batch_axes), jnp.dtype(a.dtype), 6,
+                dcn_axis,
+            ),
+            a, b, key="wire_dtype",
+        )
+        if tuned is not None:
+            return wirelib.normalize_wire(tuned)
+        from triton_distributed_tpu.tune.perf_model import auto_wire_dtype
+
+        return wirelib.normalize_wire(auto_wire_dtype(
+            m_local, k_local, n_out, out_itemsize,
+            slab_bytes=m_local * n_out * out_itemsize,
+        ))
+    if inkernel:
+        wirelib.require_inkernel(w, "gemm_rs")
+    if not wirelib.wire_blockable(m_local, n_out, w, strict):
+        raise ValueError(
+            f"gemm_rs wire_dtype={w!r}: slab ({m_local}, {n_out}) admits "
+            "no legal wire chunking/blocking (a pinned wire format is a "
+            "contract); use wire_dtype='auto' or the bf16 wire"
+        )
+    return w
+
+
 def resolve_gemm_rs_method(
     a_mesh, axis, a, b, *, batch_axes=(), method=None, out_dtype=None,
-    collective_id: int = 6, dcn_axis: str | None = None,
+    collective_id: int = 6, dcn_axis: str | None = None, wire_dtype=None,
 ) -> GemmRSMethod:
     """The engine :func:`gemm_rs` will ACTUALLY run for these arguments
     (mirror of ag_gemm.resolve_ag_gemm_method): explicit ``method``,
@@ -434,7 +641,7 @@ def resolve_gemm_rs_method(
     m = tuned_method_or_none(
         lambda: _engine_tuner(
             a_mesh, axis, batch_axes, jnp.dtype(out_dtype), collective_id,
-            dcn_axis,
+            dcn_axis, wirelib.normalize_wire(wire_dtype),
         ),
         a, b,
     )
@@ -459,8 +666,17 @@ def gemm_rs(
     out_dtype=None,
     collective_id: int = 6,
     dcn_axis: str | None = None,
+    wire_dtype=None,
 ):
     """Fused (A @ B) → ReduceScatter for row-parallel TP.
+
+    ``wire_dtype``: what the reduce ring ships (docs/PERF.md "Quantized
+    wire"). None/'bf16' — the raw partials (default, today's numerics);
+    'fp8'/'int8' — each hop's partial quantized to a 1-byte payload +
+    per-chunk f32 scales (lang.wire), dequant-accumulated in f32 on
+    receive so reduction error is one bounded rounding per hop; 'auto'
+    — the measured wire tuner, else the perf model picks the compressed
+    wire exactly on comm-bound shapes. Inference-grade transport.
 
     ``a``: (M, K) with rows sharded over ``batch_axes`` (DP) and cols
     P(axis) — each device holds a K/n column shard. ``b``: (K, N) sharded
@@ -491,14 +707,21 @@ def gemm_rs(
     method = resolve_gemm_rs_method(
         mesh, axis, a, b, batch_axes=batch_axes, method=method,
         out_dtype=out_dtype, collective_id=collective_id, dcn_axis=dcn_axis,
+        wire_dtype=wire_dtype,
+    )
+    wire = resolve_gemm_rs_wire(
+        mesh, axis, a, b, batch_axes=batch_axes, method=method,
+        wire_dtype=wire_dtype, out_dtype=out_dtype, dcn_axis=dcn_axis, dp=dp,
     )
     if method == GemmRSMethod.PALLAS_FUSED:
         fn = _build_fused(
             mesh, axis, batch_axes, a.shape, b.shape, a.dtype, out_dtype,
-            collective_id, interp_key(), dcn_axis,
+            collective_id, interp_key(), dcn_axis, wire,
         )
     elif method == GemmRSMethod.XLA_RING:
-        fn = _build_xla_ring(mesh, axis, batch_axes, out_dtype, dcn_axis)
+        fn = _build_xla_ring(
+            mesh, axis, batch_axes, out_dtype, dcn_axis, wire
+        )
     else:
         fn = _build_xla_naive(mesh, axis, batch_axes, out_dtype, dcn_axis)
     return fn(a, b)

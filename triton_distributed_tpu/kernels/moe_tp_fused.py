@@ -43,7 +43,13 @@ from triton_distributed_tpu import lang
 from triton_distributed_tpu.config import fused_vmem_budget
 from triton_distributed_tpu.kernels.ag_gemm import _divisor_block
 from triton_distributed_tpu.kernels.gemm_rs import ew_add_pipeline
-from triton_distributed_tpu.kernels.ring import ag_forward_ring, reduce_ring
+from triton_distributed_tpu.kernels.ring import (
+    AGWireRefs,
+    RSWireRefs,
+    ag_forward_ring,
+    reduce_ring,
+)
+from triton_distributed_tpu.lang import wire as wirelib
 
 
 def pick_gg_blocks(block_m: int, cap: int, k: int, nl: int, itemsize: int):
@@ -141,6 +147,41 @@ def ag_group_gemm_kernel(
     )
 
 
+def ag_group_gemm_kernel_w(
+    n, axis, mesh_axes, blocks, fmt,
+    be_ref, xs_hbm, xq_hbm, xsc_hbm, w_hbm,
+    out_hbm, ag_hbm, agq_hbm, ags_hbm,
+    acc_ref, send_sem, recv_sem, s_send_sem, s_recv_sem,
+):
+    """Quantized-wire twin of :func:`ag_group_gemm_kernel`: the sorted
+    token slabs ride the ring as host-quantized fp8/int8 + per-chunk
+    scales (lang.wire) and each arrival is dequantized into the bf16
+    workspace before its grouped-GEMM pipeline (local slab exact)."""
+    cap = xs_hbm.shape[0]
+    k = xs_hbm.shape[1]
+    nl = w_hbm.shape[2]
+    bm, bk, bn = blocks
+    mb, nb, kb = cap // bm, nl // bn, k // bk
+
+    def consume(s, src, a_hbm, a_row_off):
+        gmm_pipeline(
+            mb, nb, kb, blocks, acc_ref,
+            lambda i, src=src: be_ref[src, i],
+            a_m_off=a_row_off // bm,
+            out_m_off=src * mb,
+        )(a_hbm, w_hbm, out_hbm)
+
+    wire = AGWireRefs(
+        fmt=fmt, local_q=xq_hbm, local_s=xsc_hbm, agq=agq_hbm, ags=ags_hbm,
+        s_send_sem=s_send_sem, s_recv_sem=s_recv_sem,
+        dequant=wirelib.dequant_pipeline(cap, k, fmt),
+    )
+    ag_forward_ring(
+        n, axis, mesh_axes, xs_hbm, ag_hbm, cap, send_sem, recv_sem, consume,
+        site="moe_tp", wire=wire,
+    )
+
+
 def moe_reduce_rs_kernel(
     n, axis, mesh_axes, blocks,
     be_ref, y_hbm, w_hbm, out_hbm, w0, w1, r0, r1,
@@ -178,11 +219,92 @@ def moe_reduce_rs_kernel(
     )
 
 
+def moe_reduce_rs_kernel_w(
+    n, axis, mesh_axes, blocks, fmt,
+    be_ref, y_hbm, w_hbm, out_hbm, w0, w1,
+    wq0, wq1, ws0, ws1, rq0, rq1, rs0, rs1,
+    acc_ref, send_sem, recv_sem, ack_sem, s_send_sem, s_recv_sem,
+):
+    """Quantized-wire twin of :func:`moe_reduce_rs_kernel` (same per-hop
+    quantize / f32 dequant-accumulate contract as gemm_rs's wire)."""
+    cap = out_hbm.shape[0]
+    h = out_hbm.shape[1]
+    fl = y_hbm.shape[1]
+    bm, bk, bn = blocks
+    mb, nb, kb = cap // bm, h // bn, fl // bk
+
+    def partial_into(dst, dst_ref):
+        gmm_pipeline(
+            mb, nb, kb, blocks, acc_ref,
+            lambda i, dst=dst: be_ref[dst, i],
+            a_m_off=dst * mb,
+        )(y_hbm, w_hbm, dst_ref)
+
+    wire = RSWireRefs(
+        fmt=fmt, wq=(wq0, wq1), ws=(ws0, ws1), rq=(rq0, rq1), rs=(rs0, rs1),
+        s_send_sem=s_send_sem, s_recv_sem=s_recv_sem,
+        quantize=wirelib.quant_pipeline(cap, h, fmt),
+        dequant_add=wirelib.dequant_add_pipeline(cap, h, fmt),
+    )
+    reduce_ring(
+        n, axis, mesh_axes, out_hbm, (w0, w1), (None, None),
+        send_sem, recv_sem, ack_sem, partial_into, None,
+        site="moe_tp", wire=wire,
+    )
+
+
+def _wire_fmt(wire, rows):
+    if wire is None:
+        return None
+    from triton_distributed_tpu.config import compiling_for_tpu
+
+    wirelib.require_inkernel(wire, "moe_tp")
+    fmt = wirelib.make_wire_format(wire, rows, strict=compiling_for_tpu())
+    if fmt is None:
+        raise ValueError(
+            f"moe_tp wire={wire!r}: slab of {rows} rows admits no legal "
+            "scale chunking; use the bf16 wire"
+        )
+    return fmt
+
+
 def build_ag_group_gemm_call(
     n, mesh_axes, axis, cap, k, nl, e, blocks, dtype, collective_id,
+    wire=None,
 ):
     """pallas_call for :func:`ag_group_gemm_kernel` (per-device, for use
-    inside shard_map)."""
+    inside shard_map). ``wire``: 'fp8'/'int8' switches to the
+    quantized-wire kernel — the caller then passes the host-quantized
+    (xq, xsc) pair after the sorted slab."""
+    fmt = _wire_fmt(wire, cap)
+    if fmt is not None:
+        nsem = (max(n - 1, 1),)
+        return lang.shmem_call(
+            functools.partial(
+                ag_group_gemm_kernel_w, n, axis, mesh_axes, blocks, fmt
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((n * cap, nl), dtype),
+                jax.ShapeDtypeStruct((n * cap, k), dtype),   # bf16 workspace
+                jax.ShapeDtypeStruct((n * cap, k), fmt.wire_dtype),
+                jax.ShapeDtypeStruct(
+                    (n * fmt.chunks(cap), wirelib.SCALE_LANES), jnp.float32
+                ),
+            ],
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+            + [pl.BlockSpec(memory_space=pl.ANY)] * 4,
+            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+            scratch_shapes=[
+                pltpu.VMEM((blocks[0], blocks[2]), jnp.float32),
+                pltpu.SemaphoreType.DMA(nsem),
+                pltpu.SemaphoreType.DMA(nsem),
+                pltpu.SemaphoreType.DMA(nsem),   # scale rail
+                pltpu.SemaphoreType.DMA(nsem),
+            ],
+            collective_id=None if n == 1 else collective_id,
+            vmem_limit_bytes=fused_vmem_budget(),
+            name=f"ag_group_gemm_fused_{wire}w",
+        )
     return lang.shmem_call(
         functools.partial(ag_group_gemm_kernel, n, axis, mesh_axes, blocks),
         out_shape=[
@@ -210,9 +332,42 @@ def build_ag_group_gemm_call(
 
 def build_moe_reduce_rs_call(
     n, mesh_axes, axis, cap, fl, h, e, blocks, dtype, collective_id,
+    wire=None,
 ):
-    """pallas_call for :func:`moe_reduce_rs_kernel` (per-device)."""
+    """pallas_call for :func:`moe_reduce_rs_kernel` (per-device).
+    ``wire``: 'fp8'/'int8' switches to the quantized-wire reduce ring."""
     slab = jax.ShapeDtypeStruct((cap, h), dtype)
+    fmt = _wire_fmt(wire, cap)
+    if fmt is not None:
+        qslab = jax.ShapeDtypeStruct((cap, h), fmt.wire_dtype)
+        sslab = jax.ShapeDtypeStruct(
+            (fmt.chunks(cap), wirelib.SCALE_LANES), jnp.float32
+        )
+        return lang.shmem_call(
+            functools.partial(
+                moe_reduce_rs_kernel_w, n, axis, mesh_axes, blocks, fmt
+            ),
+            out_shape=[slab, slab, slab,
+                       qslab, qslab, sslab, sslab,
+                       qslab, qslab, sslab, sslab],
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 11,
+            scratch_shapes=[
+                pltpu.VMEM((blocks[0], blocks[2]), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR,
+                pltpu.SemaphoreType.DMA((2,)),   # scale rail
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            collective_id=None if n == 1 else collective_id,
+            vmem_limit_bytes=fused_vmem_budget(),
+            name=f"moe_reduce_rs_fused_{wire}w",
+        )
     return lang.shmem_call(
         functools.partial(moe_reduce_rs_kernel, n, axis, mesh_axes, blocks),
         out_shape=[slab, slab, slab, slab, slab],

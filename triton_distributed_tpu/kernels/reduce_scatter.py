@@ -17,6 +17,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -24,6 +25,7 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import lang
 from triton_distributed_tpu.config import fused_vmem_budget, interp_key
+from triton_distributed_tpu.lang import wire as wirelib
 from triton_distributed_tpu.runtime import ring_neighbors
 from triton_distributed_tpu.utils.testing import chaos_delay
 
@@ -96,6 +98,68 @@ def _ring_rs_kernel(n, axis, mesh_axes, x_ref, out_ref, acc_ref, recv_ref, send_
         recv_sem,
         ack_sem,
     )
+
+
+def _ring_rs_kernel_w(
+    n, axis, mesh_axes, quant,
+    x_ref, out_ref,
+    acc_ref, qbuf_ref, sbuf_ref, recvq_ref, recvs_ref,
+    send_sem, recv_sem, s_send_sem, s_recv_sem, ack_sem,
+):
+    """Quantized-wire twin of :func:`_ring_rs_kernel` (VMEM-resident):
+    each hop's partial accumulation is quantized per ROW (lang.wire,
+    chunk_rows=1) into the 1-byte ``qbuf`` + f32 scale plane and both
+    rails flow leftward; the receive side dequant-accumulates in f32.
+    Same ack-credit flow control as ring_reduce_core (a sender may not
+    rewrite a recv slot its receiver hasn't folded)."""
+    me = lang.my_pe(axis)
+    m = out_ref.shape[0]
+    qmax = 448.0 if quant == "fp8" else 127.0
+    left, right = ring_neighbors(me, n)
+    left = lang.pe_flat(axis, left, mesh_axes)
+    right = lang.pe_flat(axis, right, mesh_axes)
+
+    lang.neighbor_barrier(axis, left, right, site="reduce_scatter", me=me, n=n)
+    acc_ref[:] = x_ref[pl.ds(jax.lax.rem(me + 1, n) * m, m)]
+
+    for s in range(n - 1):
+        chaos_delay(site="reduce_scatter", step=s, me=me, n=n)
+        if s >= 2:
+            pltpu.semaphore_wait(ack_sem, 1)
+        # per-row symmetric quantization of the outgoing partial
+        af = acc_ref[:].astype(jnp.float32)
+        amax = jnp.max(jnp.abs(af), axis=1, keepdims=True)
+        scale = jnp.maximum(amax, 1e-12) / qmax
+        q = af / scale
+        if quant == "int8":
+            q = jnp.clip(jnp.round(q), -127, 127)
+        qbuf_ref[:] = q.astype(qbuf_ref.dtype)
+        sbuf_ref[:] = jnp.broadcast_to(
+            scale, (m, wirelib.SCALE_LANES)
+        ).astype(jnp.float32)
+        dma_q = lang.remote_copy(
+            qbuf_ref, recvq_ref.at[s % 2],
+            send_sem.at[s % 2], recv_sem.at[s % 2], left,
+        )
+        dma_s = lang.remote_copy(
+            sbuf_ref, recvs_ref.at[s % 2],
+            s_send_sem.at[s % 2], s_recv_sem.at[s % 2], left,
+        )
+        dma_q.start()
+        dma_s.start()
+        nxt = jax.lax.rem(me + 2 + s, n)
+        partial = x_ref[pl.ds(nxt * m, m)]
+        dma_q.wait()   # send drained (qbuf reusable) + arrival landed
+        dma_s.wait()
+        acc_ref[:] = (
+            recvq_ref[s % 2].astype(jnp.float32)
+            * recvs_ref[s % 2, :, pl.ds(0, 1)]
+            + partial.astype(jnp.float32)
+        ).astype(acc_ref.dtype)
+        lang.signal_op(ack_sem, 1, pe=right)
+
+    out_ref[:] = acc_ref[:]
+    pltpu.semaphore_wait(ack_sem, min(2, n - 1))
 
 
 def _rs_stream_kernel(
@@ -178,8 +242,36 @@ def _streamable(m_local: int, cols: int, itemsize: int) -> bool:
     )
 
 
+def _resolve_rs_wire(wire_dtype, rows, cols, n, itemsize):
+    """The wire :func:`reduce_scatter` will actually ship: None unless
+    the payload reshapes to 2-D columns wide enough that the per-row
+    scale plane saves bytes. 'auto' uses the standalone-ring byte
+    threshold (a reduce ring is pure comm, like a gather)."""
+    w = wirelib.normalize_wire(wire_dtype)
+    if w is None:
+        return None
+    eligible = rows % n == 0 and cols * itemsize > cols + wirelib.SCALE_LANES * 4
+    if w == "auto":
+        if not eligible:
+            return None
+        from triton_distributed_tpu.runtime.topology import (
+            auto_allgather_wire,
+        )
+
+        return auto_allgather_wire((rows // n) * cols * itemsize)
+    if not eligible:
+        raise ValueError(
+            f"reduce_scatter wire_dtype={w!r} needs a 2-D-reshapeable "
+            f"payload with cols·itemsize > cols + "
+            f"{wirelib.SCALE_LANES * 4} (a pinned wire format is a "
+            f"contract); got rows={rows} cols={cols} itemsize={itemsize}"
+        )
+    return w
+
+
 def reduce_scatter(
-    x, mesh, axis: str = "x", *, stacked: bool = False, collective_id: int = 3
+    x, mesh, axis: str = "x", *, stacked: bool = False, collective_id: int = 3,
+    wire_dtype=None,
 ):
     """ReduceScatter: sums per-device (M, ...) contributions and scatters the
     row-shards along ``axis``.
@@ -193,22 +285,54 @@ def reduce_scatter(
     the HBM-streaming ring (no VMEM cap — activation-scale payloads;
     trailing dims ride as a free 2D view of the contiguous array).
 
+    ``wire_dtype``: quantized ring wire ('fp8'/'int8' — per-hop
+    quantized partials with per-row f32 scales, f32 dequant-accumulate;
+    'auto' — compressed above the standalone-ring byte threshold).
+    Carried by the VMEM ring and the XLA twin; the HBM-streaming engine
+    ships bf16 (use gemm_rs's fused wire for streaming-scale slabs).
+
     Host entry ≡ reference ``reduce_scatter_2d_op`` (reduce_scatter.py:863).
     """
     from triton_distributed_tpu.config import pallas_collectives_available
 
-    if not pallas_collectives_available():
-        # off-TPU without the TPU-simulation interpreter: degrade to the
-        # XLA-native psum_scatter twin
-        return reduce_scatter_xla(x, mesh, axis, stacked=stacked)
     n = mesh.shape[axis]
     full_shape = x.shape[1:] if stacked else x.shape
+    rows = full_shape[0]
+    cols = int(np.prod(full_shape[1:], dtype=np.int64)) if len(full_shape) > 1 else 1
+    if not pallas_collectives_available():
+        # off-TPU without the TPU-simulation interpreter: degrade to the
+        # XLA-native twin (which carries the wire too)
+        if n == 1:
+            return x[0] if stacked else x
+        return reduce_scatter_xla(
+            x, mesh, axis, stacked=stacked,
+            wire_dtype=_resolve_rs_wire(
+                wire_dtype, rows, cols, n, x.dtype.itemsize
+            ),
+        )
     if n == 1:
         return x[0] if stacked else x
     assert full_shape[0] % n == 0, f"dim0 {full_shape[0]} not divisible by {n}"
     local_shape = (full_shape[0] // n,) + tuple(full_shape[1:])
-    rows = full_shape[0]
-    cols = int(np.prod(full_shape[1:], dtype=np.int64)) if len(full_shape) > 1 else 1
+    wire = _resolve_rs_wire(wire_dtype, rows, cols, n, x.dtype.itemsize)
+    if wire == "fp8" and not wirelib.inkernel_wire_ok("fp8"):
+        # the Pallas VMEM ring dequantizes in-kernel; this Mosaic lacks
+        # the f8 casts — explicit fp8 raises, auto stays exact
+        if wirelib.normalize_wire(wire_dtype) == "fp8":
+            wirelib.require_inkernel("fp8", "reduce_scatter")
+        wire = None
+    if wire is not None:
+        # the wire ring is VMEM-resident; its working set is ~half the
+        # bf16 ring's (1-byte recv slots), so the same fit gate applies
+        if _vmem_ring_fits(n, local_shape, x.dtype.itemsize):
+            x2d = x.reshape(((n,) if stacked else ()) + (rows, cols))
+            fn = _build_reduce_scatter_w(
+                mesh, axis, (rows, cols), x.dtype, stacked, collective_id,
+                interp_key(), wire,
+            )
+            return fn(x2d).reshape(full_shape)
+        _warn_rs_wire_once()
+        wire = None
     if not _vmem_ring_fits(n, local_shape, x.dtype.itemsize) and _streamable(
         rows // n, cols, x.dtype.itemsize
     ):
@@ -223,6 +347,66 @@ def reduce_scatter(
         interp_key(),
     )
     return fn(x)
+
+
+_rs_wire_warned = [False]
+
+
+def _warn_rs_wire_once():
+    if not _rs_wire_warned[0]:
+        _rs_wire_warned[0] = True
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "reduce_scatter: payload exceeds the VMEM ring; the "
+            "HBM-streaming engine ships the bf16 wire (use gemm_rs's "
+            "fused wire for streaming-scale quantized reductions)"
+        )
+
+
+@functools.lru_cache(maxsize=256)
+def _build_reduce_scatter_w(mesh, axis, full_shape, dtype, stacked,
+                            collective_id, chaos, wire):
+    """Quantized-wire VMEM reduce ring (2-D payloads; per-row scales)."""
+    wirelib.require_inkernel(wire, "reduce_scatter")
+    n = mesh.shape[axis]
+    m_local = full_shape[0] // n
+    cols = full_shape[1]
+    wdt = jnp.dtype(
+        jnp.float8_e4m3fn if wire == "fp8" else jnp.int8
+    )
+    call = lang.shmem_call(
+        functools.partial(_ring_rs_kernel_w, n, axis, mesh.axis_names, wire),
+        out_shape=jax.ShapeDtypeStruct((m_local, cols), dtype),
+        in_specs=lang.vmem_specs(1),
+        scratch_shapes=[
+            pltpu.VMEM((m_local, cols), dtype),                   # acc
+            pltpu.VMEM((m_local, cols), wdt),                     # qbuf
+            pltpu.VMEM((m_local, wirelib.SCALE_LANES), jnp.float32),
+            pltpu.VMEM((2, m_local, cols), wdt),                  # recv q
+            pltpu.VMEM((2, m_local, wirelib.SCALE_LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),                        # scale rail
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        collective_id=collective_id,
+        name=f"rs_ring_{wire}w",
+    )
+    call = lang.maybe_instrument(
+        call, axis=axis, site="reduce_scatter", collective_id=collective_id,
+        n=n,
+    )
+    body = (lambda s: call(s[0])) if stacked else call
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=P(axis) if stacked else P(None),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=256)
@@ -260,12 +444,54 @@ def _build_reduce_scatter(mesh, axis, full_shape, dtype, stacked, collective_id,
     return jax.jit(fn)
 
 
-def reduce_scatter_xla(x, mesh, axis: str = "x", *, stacked: bool = False):
-    """lax.psum_scatter reference implementation (correctness baseline)."""
+def reduce_scatter_xla(x, mesh, axis: str = "x", *, stacked: bool = False,
+                       wire_dtype=None):
+    """lax.psum_scatter reference implementation (correctness baseline).
 
-    def body(s):
-        s = s[0] if stacked else s
-        return jax.lax.psum_scatter(s, axis, scatter_dimension=0, tiled=True)
+    ``wire_dtype`` ('fp8'/'int8'): a manual ppermute reduce ring whose
+    hops carry per-row-quantized partials (lang.wire, chunk_rows=1) —
+    the numerics twin of the Pallas wire ring, and a genuine byte saver
+    on DCN where psum_scatter cannot compress."""
+    wire = wirelib.normalize_wire(wire_dtype)
+    assert wire != "auto", "resolve 'auto' at the reduce_scatter entry"
+    n = mesh.shape[axis]
+    full_shape = x.shape[1:] if stacked else x.shape
+    rows = full_shape[0]
+    cols = int(np.prod(full_shape[1:], dtype=np.int64)) if len(full_shape) > 1 else 1
+    if wire is not None:
+        fmt = wirelib.WireFormat(quant=wire, chunk_rows=1)
+        m_local = rows // n
+
+        def body(s):
+            s = s[0] if stacked else s
+            s2 = s.reshape(rows, cols)
+            me = jax.lax.axis_index(axis)
+            perm = [(i, (i - 1) % n) for i in range(n)]
+
+            def stripe(i):
+                return jax.lax.dynamic_slice(
+                    s2, (i * m_local, 0), (m_local, cols)
+                )
+
+            def step(h, acc):
+                q, sc = wirelib.quantize_slab(acc, fmt)
+                q = jax.lax.ppermute(q, axis, perm=perm)
+                sc = jax.lax.ppermute(sc, axis, perm=perm)
+                arrived = wirelib.dequantize_slab(q, sc, fmt, jnp.float32)
+                nxt = jax.lax.rem(me + 2 + h, n)
+                return (arrived + stripe(nxt).astype(jnp.float32)).astype(
+                    s.dtype
+                )
+
+            acc = stripe(jax.lax.rem(me + 1, n))
+            acc = jax.lax.fori_loop(0, n - 1, step, acc)
+            return acc.reshape((m_local,) + tuple(full_shape[1:]))
+    else:
+        def body(s):
+            s = s[0] if stacked else s
+            return jax.lax.psum_scatter(
+                s, axis, scatter_dimension=0, tiled=True
+            )
 
     fn = jax.shard_map(
         body,

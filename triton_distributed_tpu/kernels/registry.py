@@ -28,6 +28,69 @@ from dataclasses import dataclass, field
 import numpy as np
 
 
+# ------------------------------------------------- collective-id offset rails
+#
+# Chunked engines (ag_gemm's DCN rail, gemm_rs's column chunks) need one
+# DISTINCT collective_id per chunk ring — a skewed neighbor's chunk-s+1
+# barrier signal must not satisfy a chunk-s wait. Offsets used to be
+# allocated ad hoc (+64 here, +96 there) with disjointness maintained by
+# comment (ADVICE r5); this ledger makes it a checked invariant: every
+# rail reserves an [offset, offset+length) range at import, overlapping
+# reservations raise immediately, and the id arithmetic goes through
+# :func:`rail_collective_id` so no call site can silently stray outside
+# its reservation.
+
+_RAILS: dict = {}
+
+
+def reserve_collective_rail(name: str, offset: int, length: int) -> None:
+    """Reserve the offset range [offset, offset+length) for ``name``'s
+    per-chunk collective ids. Overlap with any existing reservation is a
+    programming error and raises at import time — the static twin of the
+    SL005 runtime-collision rule."""
+    assert length > 0
+    for other, (off, ln) in _RAILS.items():
+        if other == name:
+            continue
+        if offset < off + ln and off < offset + length:
+            raise ValueError(
+                f"collective-id rail {name!r} [{offset}, {offset + length}) "
+                f"overlaps {other!r} [{off}, {off + ln}) — chunk barriers "
+                "of the two families would satisfy each other's rendezvous"
+            )
+    prev = _RAILS.get(name)
+    if prev is not None and prev != (offset, length):
+        raise ValueError(
+            f"collective-id rail {name!r} re-reserved with a different "
+            f"range: {prev} vs {(offset, length)}"
+        )
+    _RAILS[name] = (offset, length)
+
+
+def rail_collective_id(name: str, collective_id, chunk: int):
+    """The collective_id of chunk ring ``chunk`` on rail ``name``
+    (None passes through — the degenerate no-barrier path)."""
+    off, length = _RAILS[name]
+    if not 0 <= chunk < length:
+        raise ValueError(
+            f"rail {name!r}: chunk {chunk} outside the reserved length "
+            f"{length} — widen the reservation, don't improvise offsets"
+        )
+    return None if collective_id is None else collective_id + off + chunk
+
+
+def reserved_rails() -> dict:
+    """Snapshot of the ledger (name → (offset, length)), for tests."""
+    return dict(_RAILS)
+
+
+#: the rails the fused engines ship with. Bases are the op entries'
+#: default collective_ids (single digits), so offsets start high enough
+#: that base ids can never land inside a rail.
+reserve_collective_rail("ag_gemm.dcn_chunks", 64, 32)
+reserve_collective_rail("gemm_rs.dcn_chunks", 96, 32)
+
+
 @dataclass(frozen=True)
 class KernelFamily:
     """One analyzable kernel family.
@@ -54,6 +117,12 @@ class KernelFamily:
 
 _F32 = np.dtype(np.float32)
 _I32 = np.dtype(np.int32)
+
+
+def _f8():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.float8_e4m3fn)
 
 
 # ----------------------------------------------------------------- builders
@@ -118,7 +187,7 @@ def _a2a(mesh, n, token):
     )
 
 
-def _ag_gemm(mesh, n, token):
+def _ag_gemm(mesh, n, token, wire=None):
     import jax.numpy as jnp
 
     from triton_distributed_tpu.kernels.ag_gemm import _build_fused
@@ -126,11 +195,11 @@ def _ag_gemm(mesh, n, token):
     _build_fused(
         mesh, "x", (), (16 * n, 128), (128, 64 * n),
         jnp.dtype(jnp.float32), jnp.dtype(jnp.float32), 5, token,
-        return_gathered=True,
+        return_gathered=True, wire=wire,
     )
 
 
-def _gemm_rs(mesh, n, token):
+def _gemm_rs(mesh, n, token, wire=None):
     import jax.numpy as jnp
 
     from triton_distributed_tpu.kernels.gemm_rs import _build_fused
@@ -138,7 +207,118 @@ def _gemm_rs(mesh, n, token):
     _build_fused(
         mesh, "x", (), (16 * n, 128 * n), (128 * n, 64),
         jnp.dtype(jnp.float32), jnp.dtype(jnp.float32), 6, token,
+        wire=wire,
     )
+
+
+def _ag_ring_w(mesh, n, token):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.kernels.allgather import _build_all_gather
+    from triton_distributed_tpu.runtime import AllGatherMethod
+
+    # wider lint columns than the raw twin: the standalone rings carry
+    # PER-ROW scale planes (512 B/row), which only compress when the
+    # row payload dwarfs them — exactly the entry's eligibility gate
+    _build_all_gather(
+        mesh, "x", AllGatherMethod.RING_1D, (8 * n, 2048),
+        jnp.dtype(jnp.float32), 2, token, wire="fp8",
+    )
+
+
+def _rs_ring_w(mesh, n, token):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.kernels.reduce_scatter import (
+        _build_reduce_scatter_w,
+    )
+
+    _build_reduce_scatter_w(
+        mesh, "x", (8 * n, 2048), jnp.dtype(jnp.float32), False, 3, token,
+        "fp8",
+    )
+
+
+#: lint geometry for the moe_tp fused pair: 8-row routing blocks, 16-row
+#: per-shard sorted slabs, tiny K/N/F/H of 128, 2 experts per rank.
+_MOE_TP_GEOM = dict(bm=8, cap=16, k=128, nl=128, fl=128, h=128, e=2)
+
+
+def _moe_tp_blocks():
+    from triton_distributed_tpu.kernels.moe_tp_fused import pick_gg_blocks
+
+    g = _MOE_TP_GEOM
+    return pick_gg_blocks(g["bm"], g["cap"], g["k"], g["nl"], 4)
+
+
+def _moe_ag_gg(wire):
+    def build(mesh, n, token):
+        import jax.numpy as jnp
+
+        from triton_distributed_tpu.kernels.moe_tp_fused import (
+            build_ag_group_gemm_call,
+        )
+
+        g = _MOE_TP_GEOM
+        build_ag_group_gemm_call(
+            n, ("x",), "x", g["cap"], g["k"], g["nl"], g["e"],
+            _moe_tp_blocks(), jnp.dtype(jnp.float32), 13, wire=wire,
+        )
+        _capture_token(token)
+
+    return build
+
+
+def _moe_rs(wire):
+    def build(mesh, n, token):
+        import jax.numpy as jnp
+
+        from triton_distributed_tpu.kernels.moe_tp_fused import (
+            build_moe_reduce_rs_call,
+        )
+
+        g = _MOE_TP_GEOM
+        build_moe_reduce_rs_call(
+            n, ("x",), "x", g["cap"], g["fl"], g["h"], g["e"],
+            _moe_tp_blocks(), jnp.dtype(jnp.float32), 12, wire=wire,
+        )
+        _capture_token(token)
+
+    return build
+
+
+def _capture_token(token):
+    """The moe_tp builders are not lru-cached (shmem_call is constructed
+    directly), so the freshness token is consumed here only to keep the
+    build signature uniform."""
+    del token
+
+
+def _moe_ag_gg_shapes(wire):
+    def in_shapes(n):
+        g = _MOE_TP_GEOM
+        shapes = [
+            ((n, g["cap"] // g["bm"]), _I32),          # be (SMEM)
+            ((g["cap"], g["k"]), _F32),                # sorted slab
+        ]
+        if wire:
+            shapes += [
+                ((g["cap"], g["k"]), _f8()),           # quantized slab
+                ((1, 128), _F32),                      # scale plane
+            ]
+        shapes.append(((g["e"], g["k"], g["nl"]), _F32))   # expert weights
+        return shapes
+
+    return in_shapes
+
+
+def _moe_rs_shapes(n):
+    g = _MOE_TP_GEOM
+    return [
+        ((n, g["cap"] // g["bm"]), _I32),              # be (SMEM)
+        ((n * g["cap"], g["fl"]), _F32),               # per-shard sorted y
+        ((g["e"], g["fl"], g["h"]), _F32),             # expert weights
+    ]
 
 
 #: lint geometry for the chunked MoE a2a: 8-row alignment tiles, 1 chunk
@@ -249,11 +429,56 @@ def families() -> dict:
             lambda n: [((16, 128), _F32), ((128, 64), _F32)],
         ),
         KernelFamily(
+            # quantized-wire twin: payload rides as fp8 + a per-chunk f32
+            # scale plane; shmemlint checks the changed byte counts and
+            # the scale rail's semaphore protocol alongside the original
+            "ag_gemm.fused_fp8w", "ag_gemm", "ag_gemm_fused_fp8w",
+            lambda mesh, n, token: _ag_gemm(mesh, n, token, wire="fp8"),
+            lambda n: [((16, 128), _F32), ((16, 128), _f8()),
+                       ((1, 128), _F32), ((128, 64), _F32)],
+        ),
+        KernelFamily(
             "gemm_rs.fused", "gemm_rs", "gemm_rs_fused",
             _gemm_rs,
             # A rows are unsharded (each device holds all M rows of its
             # K-column shard); B is row-sharded
             lambda n: [((16 * n, 128), _F32), ((128, 64), _F32)],
+        ),
+        KernelFamily(
+            "gemm_rs.fused_fp8w", "gemm_rs", "gemm_rs_fused_fp8w",
+            lambda mesh, n, token: _gemm_rs(mesh, n, token, wire="fp8"),
+            lambda n: [((16 * n, 128), _F32), ((128, 64), _F32)],
+        ),
+        KernelFamily(
+            "allgather.ring_1d_fp8w", "allgather", "ag_ring_1d_fp8w",
+            _ag_ring_w,
+            lambda n: [((8, 2048), _F32), ((8, 2048), _f8()),
+                       ((8, 128), _F32)],
+        ),
+        KernelFamily(
+            "reduce_scatter.ring_fp8w", "reduce_scatter", "rs_ring_fp8w",
+            _rs_ring_w,
+            lambda n: [((8 * n, 2048), _F32)],
+        ),
+        KernelFamily(
+            "moe_tp.ag_group_gemm", "moe_tp", "ag_group_gemm_fused",
+            _moe_ag_gg(None),
+            _moe_ag_gg_shapes(None),
+        ),
+        KernelFamily(
+            "moe_tp.ag_group_gemm_fp8w", "moe_tp", "ag_group_gemm_fused_fp8w",
+            _moe_ag_gg("fp8"),
+            _moe_ag_gg_shapes("fp8"),
+        ),
+        KernelFamily(
+            "moe_tp.reduce_rs", "moe_tp", "moe_reduce_rs_fused",
+            _moe_rs(None),
+            _moe_rs_shapes,
+        ),
+        KernelFamily(
+            "moe_tp.reduce_rs_fp8w", "moe_tp", "moe_reduce_rs_fused_fp8w",
+            _moe_rs("fp8"),
+            _moe_rs_shapes,
         ),
         KernelFamily(
             "moe_dispatch.a2a", "moe_dispatch", "moe_chunked_a2a",

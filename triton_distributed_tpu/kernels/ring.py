@@ -21,9 +21,19 @@ counts match for every shard, so a reconstructed descriptor's
 ``wait_recv`` releases exactly when the incoming payload is resident
 (the dl.wait + consume_token of allgather_gemm.py:224-227, done by
 hardware).
+
+Both harnesses optionally run a QUANTIZED wire (``wire=`` —
+:class:`AGWireRefs` / :class:`RSWireRefs`, layout in ``lang.wire``):
+the payload slab ships as fp8/int8 with a per-chunk f32 scale plane on
+a parallel DMA rail, halving wire bytes on comm-bound shapes. The AG
+ring quantizes once at the source and forwards the quantized bytes
+unchanged (receivers dequantize before consuming); the reduce ring
+re-quantizes each hop's fresh partial and dequant-accumulates in f32.
 """
 
 from __future__ import annotations
+
+from dataclasses import dataclass
 
 import jax
 from jax.experimental import pallas as pl
@@ -34,9 +44,68 @@ from triton_distributed_tpu.runtime import ring_neighbors
 from triton_distributed_tpu.utils.testing import chaos_delay
 
 
+@dataclass
+class AGWireRefs:
+    """Quantized-wire rail of :func:`ag_forward_ring` (lang.wire layout:
+    fp8/int8 payload slabs + per-chunk f32 scale planes, each moved by
+    its own RDMA so the receive wait covers payload AND scales).
+
+    The ring then forwards the QUANTIZED bytes unchanged (quantize once
+    at the source — no per-hop requantization on the AG side) and
+    dequantizes each arrival into the bf16 workspace before the caller's
+    ``consume`` streams it through the MXU."""
+
+    fmt: object          # lang.wire.WireFormat
+    local_q: object      # (slab_rows, k) wire-dtype local slab (input)
+    local_s: object      # (chunks, 128) f32 local scales (input)
+    agq: object          # (n·slab_rows, k) wire workspace
+    ags: object          # (n·chunks, 128) f32 scale workspace
+    s_send_sem: object   # (n-1,) DMA sems, scale rail
+    s_recv_sem: object
+    dequant: object      # callable(q_hbm, s_hbm, dst_hbm) — lang.wire
+
+
+@dataclass
+class RSWireRefs:
+    """Quantized-wire rail of :func:`reduce_ring`. Unlike the AG side,
+    every hop's payload is a NEW partial sum, so the sender quantizes
+    its work slab per hop and the receiver dequant-accumulates in f32
+    (error is one rounding per hop, bounded, not compounding)."""
+
+    fmt: object          # lang.wire.WireFormat
+    wq: tuple            # double-buffered quantized work slabs
+    ws: tuple            # their scale planes
+    rq: tuple            # double-buffered quantized recv slabs
+    rs: tuple            # their scale planes
+    s_send_sem: object   # (2,) DMA sems, scale rail
+    s_recv_sem: object
+    quantize: object     # callable(src_hbm, q_hbm, s_hbm) — lang.wire
+    dequant_add: object  # callable(a_hbm, q_hbm, s_hbm, dst_hbm)
+
+
+class _DualDMA:
+    """A payload RDMA and its scale-rail twin driven as one handle."""
+
+    def __init__(self, payload, scales):
+        self._h = (payload, scales)
+
+    def start(self):
+        for h in self._h:
+            h.start()
+        return self
+
+    def wait_recv(self):
+        for h in self._h:
+            h.wait_recv()
+
+    def wait_send(self):
+        for h in self._h:
+            h.wait_send()
+
+
 def ag_forward_ring(
     n, axis, mesh_axes, local_hbm, ag_hbm, slab_rows, send_sem, recv_sem,
-    consume, *, site=None,
+    consume, *, site=None, wire: AGWireRefs | None = None,
 ):
     """Run the AG forward ring; ``consume(s, src, a_hbm, a_row_off)``
     computes over shard ``src`` (rows ``[a_row_off, a_row_off+slab_rows)``
@@ -61,17 +130,44 @@ def ag_forward_ring(
 
     lang.neighbor_barrier(axis, left, right, site=site, me=me, n=n)
 
-    def fwd(src, slot, from_local):
-        src_ref = local_hbm if from_local else ag_hbm.at[
-            pl.ds(src * slab_rows, slab_rows)
-        ]
-        return lang.remote_copy(
-            src_ref,
-            ag_hbm.at[pl.ds(src * slab_rows, slab_rows)],
-            send_sem.at[slot],
-            recv_sem.at[slot],
-            right,
-        )
+    if wire is None:
+        def fwd(src, slot, from_local):
+            src_ref = local_hbm if from_local else ag_hbm.at[
+                pl.ds(src * slab_rows, slab_rows)
+            ]
+            return lang.remote_copy(
+                src_ref,
+                ag_hbm.at[pl.ds(src * slab_rows, slab_rows)],
+                send_sem.at[slot],
+                recv_sem.at[slot],
+                right,
+            )
+    else:
+        ch = wire.fmt.chunks(slab_rows)
+
+        def fwd(src, slot, from_local):
+            # two rails, one handle: the quantized payload slab and its
+            # scale plane — the receive wait releases only when BOTH
+            # have landed, so dequant/forward never read torn wire data
+            q_src = wire.local_q if from_local else wire.agq.at[
+                pl.ds(src * slab_rows, slab_rows)
+            ]
+            s_src = wire.local_s if from_local else wire.ags.at[
+                pl.ds(src * ch, ch)
+            ]
+            return _DualDMA(
+                lang.remote_copy(
+                    q_src,
+                    wire.agq.at[pl.ds(src * slab_rows, slab_rows)],
+                    send_sem.at[slot], recv_sem.at[slot], right,
+                ),
+                lang.remote_copy(
+                    s_src,
+                    wire.ags.at[pl.ds(src * ch, ch)],
+                    wire.s_send_sem.at[slot], wire.s_recv_sem.at[slot],
+                    right,
+                ),
+            )
 
     for s in range(n):
         src = jax.lax.rem(me + n - s, n) if s > 0 else me
@@ -83,6 +179,16 @@ def ag_forward_ring(
         if s == 0:
             consume(s, src, local_hbm, 0)
         else:
+            if wire is not None:
+                # arrived wire slab → bf16 workspace, then the MXU
+                # consumes it exactly like the raw-wire path (the
+                # forward above already moved the quantized bytes on)
+                ch = wire.fmt.chunks(slab_rows)
+                wire.dequant(
+                    wire.agq.at[pl.ds(src * slab_rows, slab_rows)],
+                    wire.ags.at[pl.ds(src * ch, ch)],
+                    ag_hbm.at[pl.ds(src * slab_rows, slab_rows)],
+                )
             consume(s, src, ag_hbm, src * slab_rows)
     for s in range(n - 1):
         src = jax.lax.rem(me + n - s, n) if s > 0 else me
@@ -91,7 +197,7 @@ def ag_forward_ring(
 
 def reduce_ring(
     n, axis, mesh_axes, out_hbm, work, recv, send_sem, recv_sem, ack_sem,
-    partial_into, fold, *, site=None,
+    partial_into, fold, *, site=None, wire: RSWireRefs | None = None,
 ):
     """Run the compute-into-the-ring reduce.
 
@@ -111,10 +217,25 @@ def reduce_ring(
         partial_into(0, out_hbm)
         return
 
-    def ring_dma(slot):
-        return lang.remote_copy(
-            work[slot], recv[slot], send_sem.at[slot], recv_sem.at[slot], left
-        )
+    if wire is None:
+        def ring_dma(slot):
+            return lang.remote_copy(
+                work[slot], recv[slot], send_sem.at[slot], recv_sem.at[slot],
+                left,
+            )
+    else:
+        def ring_dma(slot):
+            return _DualDMA(
+                lang.remote_copy(
+                    wire.wq[slot], wire.rq[slot],
+                    send_sem.at[slot], recv_sem.at[slot], left,
+                ),
+                lang.remote_copy(
+                    wire.ws[slot], wire.rs[slot],
+                    wire.s_send_sem.at[slot], wire.s_recv_sem.at[slot],
+                    left,
+                ),
+            )
 
     lang.neighbor_barrier(axis, left, right, site=site, me=me, n=n)
     # my contribution to shard (me+1), the first one I forward
@@ -126,6 +247,10 @@ def reduce_ring(
         if s >= 2:
             # left must have folded my slot (s-2) before I rewrite it
             pltpu.semaphore_wait(ack_sem, 1)
+        if wire is not None:
+            # fresh partial → wire format; the wait_send at step s-1 (or
+            # the ack above) already freed wq/ws[slot] for rewriting
+            wire.quantize(work[slot], wire.wq[slot], wire.ws[slot])
         dma = ring_dma(slot)
         dma.start()
         # produce my contribution to the next destination while the
@@ -137,7 +262,13 @@ def reduce_ring(
         dma.wait_recv()
         # received: partial sum of shard (me+2+s) accumulated so far by
         # the ring to my right; fold in my own contribution.
-        fold(work[1 - slot], recv[slot], out_hbm if s == n - 2 else work[1 - slot])
+        dst = out_hbm if s == n - 2 else work[1 - slot]
+        if wire is None:
+            fold(work[1 - slot], recv[slot], dst)
+        else:
+            wire.dequant_add(
+                work[1 - slot], wire.rq[slot], wire.rs[slot], dst
+            )
         lang.signal_op(ack_sem, 1, pe=right, site=site, me=me, n=n)
 
     ring_dma((n - 2) % 2).wait_send()

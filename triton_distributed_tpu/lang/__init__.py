@@ -33,6 +33,7 @@ from triton_distributed_tpu.lang.launch import (
     shmem_call,
     vmem_specs,
 )
+from triton_distributed_tpu.lang import wire  # noqa: F401  (lang.wire — pack/unpack+scales)
 
 __all__ = [
     "my_pe",

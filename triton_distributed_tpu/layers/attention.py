@@ -176,7 +176,8 @@ class SpGQAFlashDecodeAttention:
         )
 
 
-def append_kv(k_cache, v_cache, kv_lens, k_new, v_new, kv_layout="bhsd"):
+def append_kv(k_cache, v_cache, kv_lens, k_new, v_new, kv_layout="bhsd",
+              k_quant=None, v_quant=None):
     """Append one decode step's K/V at each batch row's current length.
 
     k_cache/v_cache: (B, Hkv, S, D) [``kv_layout="bhsd"``, native
@@ -193,12 +194,17 @@ def append_kv(k_cache, v_cache, kv_lens, k_new, v_new, kv_layout="bhsd"):
 
     INT8 caches (``{"q", "scale"}`` dicts, bhsd only): the new rows are
     quantized per (b, h) — one f32 scale per appended D-row — and both
-    planes are scattered.
+    planes are scattered. ``k_quant``/``v_quant``: optional already-
+    computed ``(int8 values, f32 scales)`` pairs (from
+    :func:`~triton_distributed_tpu.kernels.flash_decode.quantize_kv`);
+    passing them makes the cached token BIT-IDENTICAL to whatever the
+    caller attended — re-quantizing a dequantized bf16 round-trip can
+    shift ints by 1 LSB (ADVICE r5).
     """
     if isinstance(k_cache, dict):
         assert kv_layout == "bhsd", "int8 caches are bhsd-native"
-        kq_new, ks_new = quantize_kv(k_new)    # (B, Hkv, D) → + (B, Hkv)
-        vq_new, vs_new = quantize_kv(v_new)
+        kq_new, ks_new = k_quant if k_quant is not None else quantize_kv(k_new)
+        vq_new, vs_new = v_quant if v_quant is not None else quantize_kv(v_new)
         b = k_cache["q"].shape[0]
         heads = jnp.arange(k_cache["q"].shape[1])
         bi = jnp.arange(b)[:, None]
@@ -232,7 +238,8 @@ def append_kv(k_cache, v_cache, kv_lens, k_new, v_new, kv_layout="bhsd"):
     return k_cache, v_cache, kv_lens + 1
 
 
-def paged_append_kv(k_pool, v_pool, block_table, kv_lens, k_new, v_new):
+def paged_append_kv(k_pool, v_pool, block_table, kv_lens, k_new, v_new,
+                    k_quant=None, v_quant=None):
     """Append one decode step's K/V into PAGE POOLS at each row's
     current length — the paged twin of :func:`append_kv` (≡ the
     reference kernels writing through the block table,
@@ -274,8 +281,10 @@ def paged_append_kv(k_pool, v_pool, block_table, kv_lens, k_new, v_new):
     hi = heads[None, :]
     oi = off[:, None]
     if isinstance(k_pool, dict):
-        kq_new, ks_new = quantize_kv(k_new)     # (B, Hkv, D) → + (B, Hkv)
-        vq_new, vs_new = quantize_kv(v_new)
+        # pre-quantized pairs keep the cache bit-identical to what the
+        # caller attended (see append_kv)
+        kq_new, ks_new = k_quant if k_quant is not None else quantize_kv(k_new)
+        vq_new, vs_new = v_quant if v_quant is not None else quantize_kv(v_new)
         k_pool = {
             "q": k_pool["q"].at[pi, hi, oi].set(kq_new),
             "scale": k_pool["scale"].at[pi, hi, oi].set(ks_new),

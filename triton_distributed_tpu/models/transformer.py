@@ -1103,19 +1103,23 @@ class Transformer:
             # with a cache-sized copy pass; measured ~170 µs/step at
             # the serving shape). The append below only feeds the NEXT
             # step and schedules independently.
+            kv_quant = None
             if isinstance(ck, dict):
                 # int8 cache: every LATER step reads this token's
                 # quantized form — attend it quantized NOW too, so the
                 # step's logits are bit-consistent with re-running
                 # attention over the appended quantized cache. The
-                # append below re-quantizes to the SAME ints (the row
-                # max maps to exactly ±127, so the scale is preserved).
+                # append below receives the SAME (q, scale) pairs the
+                # attention saw (re-quantizing the bf16 round-trip can
+                # shift the recomputed ints by 1 LSB — ADVICE r5), so
+                # the claimed bit-consistency is exact, not approximate.
                 from triton_distributed_tpu.kernels.flash_decode import (
                     quantize_kv,
                 )
 
                 kq8, ks8 = quantize_kv(k)
                 vq8, vs8 = quantize_kv(v)
+                kv_quant = ((kq8, ks8), (vq8, vs8))
                 k = (kq8.astype(jnp.float32) * ks8[..., None]).astype(k.dtype)
                 v = (vq8.astype(jnp.float32) * vs8[..., None]).astype(v.dtype)
             o_c, lse_c = self._sp_attn.partials(
@@ -1130,12 +1134,20 @@ class Transformer:
                 jnp.stack([lse_c, lse_new]),
                 out_dtype=o_c.dtype,
             )
+            kq_pair = kv_quant[0] if kv_quant is not None else None
+            vq_pair = kv_quant[1] if kv_quant is not None else None
             if block_table is None:
-                ck, cv, _ = append_kv(ck, cv, kv_lens, k, v, kv_layout="bhsd")
+                ck, cv, _ = append_kv(
+                    ck, cv, kv_lens, k, v, kv_layout="bhsd",
+                    k_quant=kq_pair, v_quant=vq_pair,
+                )
             else:
                 from triton_distributed_tpu.layers import paged_append_kv
 
-                ck, cv, _ = paged_append_kv(ck, cv, block_table, kv_lens, k, v)
+                ck, cv, _ = paged_append_kv(
+                    ck, cv, block_table, kv_lens, k, v,
+                    k_quant=kq_pair, v_quant=vq_pair,
+                )
             new_caches.append((ck, cv))
             o = self._dmm(o.reshape(b, c.q_dim), blk["wo"])
             x = x + o

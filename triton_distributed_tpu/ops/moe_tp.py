@@ -68,6 +68,13 @@ class MoETPContext:
     rs_collective_id: int = 12
     ag_collective_id: int = 13
     batch_axes: tuple = ()          # extra (DP) axes sharding token rows
+    # Quantized ring wire for the OVERLAPPED engines (lang.wire):
+    # 'fp8'/'int8' ships the sorted token slabs (AG side, quantized once
+    # at the source) and the per-hop partials (RS side, f32 dequant-
+    # accumulate) as 1-byte payloads + per-chunk scales. None → bf16
+    # wire. Explicit opt-in (no 'auto' here — the MoE context is static
+    # configuration, like its quant= twin on the EP transport).
+    wire_dtype: str | None = None
 
     @property
     def row_spec(self):
@@ -262,6 +269,7 @@ def _build_gather_sorted(ctx: MoETPContext, m_shard: int):
 @functools.lru_cache(maxsize=64)
 def _build_ag_gg_fused(ctx: MoETPContext, cap_s, k, nl_local):
     from triton_distributed_tpu.kernels.moe_tp_fused import (
+        _wire_fmt,
         build_ag_group_gemm_call,
     )
 
@@ -269,9 +277,20 @@ def _build_ag_gg_fused(ctx: MoETPContext, cap_s, k, nl_local):
     call = build_ag_group_gemm_call(
         ctx.tp, ctx.mesh.axis_names, ctx.axis, cap_s, k, nl_local,
         ctx.num_experts, blocks, jnp.dtype(ctx.dtype), ctx.ag_collective_id,
+        wire=ctx.wire_dtype,
     )
+    if ctx.wire_dtype is None:
+        body = lambda be, xs, w: call(be, xs, w)[0]  # noqa: E731
+    else:
+        from triton_distributed_tpu.lang import wire as wirelib
+
+        fmt = _wire_fmt(ctx.wire_dtype, cap_s)
+
+        def body(be, xs, w):
+            xq, xsc = wirelib.quantize_slab(xs, fmt)
+            return call(be, xs, xq, xsc, w)[0]
     fn = jax.shard_map(
-        lambda be, xs, w: call(be, xs, w)[0],
+        body,
         mesh=ctx.mesh,
         in_specs=(P(), P(ctx.axis), P(None, None, ctx.axis)),
         out_specs=P(None, ctx.axis),
@@ -309,6 +328,7 @@ def _build_moe_rs_fused(ctx: MoETPContext, cap_s, fl_local, h):
     call = build_moe_reduce_rs_call(
         ctx.tp, ctx.mesh.axis_names, ctx.axis, cap_s, fl_local, h,
         ctx.num_experts, blocks, jnp.dtype(ctx.dtype), ctx.rs_collective_id,
+        wire=ctx.wire_dtype,
     )
     fn = jax.shard_map(
         lambda be, y, w: call(be, y, w)[0],

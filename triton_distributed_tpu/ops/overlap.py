@@ -63,6 +63,12 @@ class OverlapContext:
     method: object = None          # AGGemmMethod / GemmRSMethod / None=auto
     out_dtype: object = None
     collective_id: int = 8
+    # Quantized ring wire for the FORWARD op (lang.wire): None/'bf16',
+    # 'fp8', 'int8', or 'auto' (perf-model/tuner comm-bound selection).
+    # The backward duals always ship the bf16 wire — gradient rings stay
+    # exact; the compressed wire is a forward/inference transport, like
+    # the MoE A2A's quant= knob.
+    wire_dtype: object = None
     # ag_gemm training: keep the forward engine's gathered-A output as
     # the VJP residual so the weight gradient is gather-free (see module
     # docstring). tp× residual memory for A; disable to re-gather in bwd.
@@ -163,6 +169,7 @@ def ag_gemm(a, b, ctx: OverlapContext):
         a, b, ctx.mesh, ctx.axis,
         batch_axes=ctx.batch_axes, method=ctx.method,
         out_dtype=ctx.out_dtype, collective_id=ctx.collective_id,
+        wire_dtype=ctx.wire_dtype,
     )
 
 
@@ -205,7 +212,7 @@ def _ag_gemm_fwd(a, b, ctx):
             # _fused_forward) — a tuner pick here could silently be XLA
             method=AGGemmMethod.PALLAS_FUSED,
             out_dtype=ctx.out_dtype, collective_id=ctx.collective_id,
-            return_gathered=True,
+            return_gathered=True, wire_dtype=ctx.wire_dtype,
         )
         return out, (a_full, b)
     return ag_gemm(a, b, ctx), (a, b)
@@ -242,6 +249,7 @@ def gemm_rs(a, b, ctx: OverlapContext):
         a, b, ctx.mesh, ctx.axis,
         batch_axes=ctx.batch_axes, method=ctx.method,
         out_dtype=ctx.out_dtype, collective_id=ctx.collective_id,
+        wire_dtype=ctx.wire_dtype,
     )
 
 

@@ -172,6 +172,68 @@ def leaf_range(args, selector) -> range:
     return found
 
 
+def selfcheck() -> None:
+    """Pin the jax/XLA introspection formats the guards depend on.
+
+    The guards read two PRIVATE surfaces — the executable's
+    ``_kept_var_idx`` set (which argument leaves survived
+    ``keep_unused=False``) and the optimized HLO header's
+    ``input_output_alias={...}`` table — and a jax/XLA version change
+    renaming the attribute or reformatting the table would otherwise
+    surface as spurious "dropped donation" / "resharding" errors on
+    correct programs. This self-test runs two trivially known programs
+    through the real pipeline and raises one CLEAR diagnostic when the
+    expectations no longer hold (ADVICE r5); call it from CI (the
+    test-suite does) or before trusting a guard verdict on a new jax.
+    """
+    import jax.numpy as jnp
+
+    # 1) a donated, genuinely-aliasable argument must round-trip
+    #    through input_output_aliased_params
+    f = jax.jit(lambda s, x: (s + x, jnp.float32(0.0)), donate_argnums=(0,))
+    x = jnp.zeros((128, 128), jnp.float32)
+    y = jnp.ones((128, 128), jnp.float32)
+    compiled = f.lower(x, y).compile()
+    aliased = input_output_aliased_params(compiled)
+    if 0 not in aliased:
+        raise AssertionError(
+            "shardguard.selfcheck: a trivially-donated jit argument did "
+            "not appear in the parsed input_output_alias table "
+            f"(got {aliased!r}) — the optimized-HLO header format has "
+            "drifted; update shardguard._ALIAS_ENTRY/_alias_table_text "
+            "before trusting assert_args_aliased on this jax"
+        )
+    if assert_args_aliased(compiled, (x, y), lambda a: a[0]) is not None:
+        raise AssertionError("assert_args_aliased returned unexpectedly")
+
+    # 2) an UNUSED argument leaf must be visibly dropped from the kept
+    #    set (or all leaves reported kept — the documented best-effort
+    #    fallback when the private attr is absent), and the kept/
+    #    sharding pairing must stay consistent
+    g = jax.jit(lambda used, unused: used * 2.0)
+    compiled2 = g.lower(x, x).compile()
+    kept = _kept_indices(compiled2, 2)
+    flat_sh = jax.tree_util.tree_leaves(
+        compiled2.input_shardings[0],
+        is_leaf=lambda s: isinstance(s, jax.sharding.Sharding),
+    )
+    if len(kept) != len(flat_sh):
+        raise AssertionError(
+            "shardguard.selfcheck: the kept-argument set "
+            f"({kept!r}) does not line up with the compiled parameter "
+            f"shardings ({len(flat_sh)} entries) — the _kept_var_idx "
+            "attribute has drifted; _leaf_pairs would misattribute "
+            "shardings to the wrong leaves on this jax"
+        )
+    # the consistency check above is the load-bearing one; additionally
+    # pin today's exact behavior so a silent semantic change is visible
+    if kept not in ([0], [0, 1]):
+        raise AssertionError(
+            f"shardguard.selfcheck: unexpected kept set {kept!r} for a "
+            "2-arg program with one unused arg"
+        )
+
+
 def assert_args_aliased(compiled, args, selector, *, min_bytes=0):
     """Assert every leaf of ``selector(args)`` (≥ ``min_bytes``) is
     input/output-aliased in ``compiled`` — i.e. its donation survived

@@ -96,6 +96,24 @@ def auto_allgather_method(
     return AllGatherMethod.RING_1D
 
 
+def auto_allgather_wire(
+    nbytes_per_shard: int, threshold: int = 1 << 18
+) -> str | None:
+    """Wire dtype for a standalone AG ring when the caller says 'auto'
+    (the wire twin of :func:`auto_allgather_method`): 'fp8' above the
+    byte threshold, None below it.
+
+    A standalone gather is pure comm, so compression always shortens the
+    transfer — the gate is the fixed cost side: below ~256 KiB/shard the
+    ring is latency-bound (the LL-push regime) and the quantize /
+    dequantize passes plus the second scale-rail DMA per hop cost more
+    than the saved wire time. int8 is never auto-picked: same bytes as
+    fp8, strictly worse numerics (an explicit int8 wire is for int8-MXU
+    consumers). The fused engines make the richer compute-vs-comm call
+    in ``tune.perf_model.auto_wire_dtype``."""
+    return "fp8" if nbytes_per_shard >= threshold else None
+
+
 def mesh_axes_size(mesh, axes) -> int:
     """Product of mesh extents over ``axes`` (e.g. total DP degree)."""
     size = 1

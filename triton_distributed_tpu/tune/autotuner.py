@@ -331,16 +331,33 @@ def method_tuner(name, run, methods, *, warmup=1, iters=3, rounds=3):
     )
 
 
-def tuned_method_or_none(tuner_factory, *args):
+def wire_tuner(name, run, *, warmup=1, iters=3, rounds=3):
+    """Wire-dtype selection tuner for ``wire_dtype='auto'``: the raw
+    bf16 wire vs the fp8 wire, benched end to end with the same paired
+    snake-order methodology as :func:`method_tuner` (wire gains on
+    comm-bound shapes are tens of percent, but on compute-bound shapes
+    the two are within the run-to-run spread — the rounds protocol is
+    what keeps a noise artifact from pinning the lossy wire). int8 is
+    deliberately NOT a candidate: it is never faster than fp8 (same
+    byte count) and strictly worse numerically — it stays an explicit
+    opt-in for int8-MXU consumers."""
+    return ContextualAutoTuner(
+        run, [{"wire_dtype": "bf16"}, {"wire_dtype": "fp8"}],
+        name=name, warmup=warmup, iters=iters, rounds=rounds,
+    )
+
+
+def tuned_method_or_none(tuner_factory, *args, key="method"):
     """The ``method=None`` dispatch shared by the op entries: consult the
     measured tuner when tuning is enabled AND the call carries concrete
     arrays (args[0] is probed: benching needs real execution, and inside
     a larger jit the args are tracers so the caller's static heuristic
-    applies). Returns the winning method string or None."""
+    applies). Returns the winning config's ``key`` entry or None
+    (``key='wire_dtype'`` reuses the same dispatch for the wire tuners)."""
     from triton_distributed_tpu.config import autotune_enabled
 
     if autotune_enabled() and not isinstance(args[0], jax.core.Tracer):
-        return tuner_factory().pick(*args)["method"]
+        return tuner_factory().pick(*args)[key]
     return None
 
 

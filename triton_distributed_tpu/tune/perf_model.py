@@ -94,3 +94,43 @@ def overlap_efficiency(compute_ms: float, comm_ms: float) -> float:
     if comm_ms <= 0:
         return 1.0
     return min(compute_ms, comm_ms) / comm_ms
+
+
+# ------------------------------------------------------- wire-bytes term
+#
+# The streaming rings can ship fp8/int8 payloads with per-chunk f32
+# scales (lang.wire): the model needs the true wire byte count (payload
+# + scale planes) and a comm-bound test so the op entries can pick the
+# wire dtype analytically when no measured winner exists.
+
+def ring_wire_bytes(rows: int, cols: int, itemsize: int,
+                    wire: str | None = None, chunk_rows: int = 64) -> int:
+    """Bytes ONE ring slab puts on the wire: the raw (rows, cols)
+    payload at ``itemsize``, or the compressed lang.wire layout — 1-byte
+    elements plus one (128·4 B) scale row per ``chunk_rows`` rows."""
+    if wire in (None, "bf16"):
+        return rows * cols * itemsize
+    chunks = -(-rows // max(1, chunk_rows))
+    return rows * cols + chunks * 128 * 4
+
+
+def ring_wire_ms(slab_bytes: int, spec: TpuSpec | None = None) -> float:
+    """One unidirectional ring-step transfer over a single ICI link."""
+    spec = spec or detect_spec()
+    return slab_bytes / (spec.ici_gbps * 1e9) * 1e3
+
+
+def auto_wire_dtype(slab_rows: int, k: int, n_cols: int, itemsize: int,
+                    *, slab_bytes: int | None = None,
+                    spec: TpuSpec | None = None) -> str:
+    """'fp8' when the ring is comm-bound at these per-step shapes —
+    i.e. the bf16 slab transfer (``slab_bytes``, default the A slab
+    rows×k) outlasts the per-step shard matmul the ring hides it under
+    — else 'bf16'. Compressing a compute-bound ring buys nothing
+    (overlap is already 100%) and costs accuracy, so the selector only
+    reaches for the 1-byte wire where it widens the overlap range."""
+    spec = spec or detect_spec()
+    compute_ms = estimate_gemm_ms(slab_rows, k, n_cols, spec)
+    if slab_bytes is None:
+        slab_bytes = slab_rows * k * itemsize
+    return "fp8" if ring_wire_ms(slab_bytes, spec) > compute_ms else "bf16"
