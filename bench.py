@@ -234,9 +234,11 @@ def _parse_args(argv=None):
     )
     ap.add_argument(
         "--lint", action="store_true",
-        help="run shmemlint over the benched kernel families BEFORE any "
-        "timing; abort (exit 2) on protocol errors so a broken "
-        "semaphore protocol fails in seconds instead of hanging the "
+        help="run shmemlint (protocol SL001-007, delivery/wire dataflow "
+        "SL008-010) plus the Mosaic-compat pre-flight (MC001-003) over "
+        "the benched kernel families BEFORE any timing; abort (exit 2) "
+        "on errors so a broken protocol — or a kernel Mosaic would "
+        "reject mid-run — fails in seconds instead of hanging the "
         "timed run",
     )
     ap.add_argument(
@@ -251,17 +253,29 @@ def _parse_args(argv=None):
 
 
 def _run_lint() -> None:
-    """bench --lint: static protocol pass over the benched kernel set."""
+    """bench --lint: static protocol + dataflow + Mosaic-compat passes
+    over the benched kernel set (exit 2 on errors — unchanged
+    contract; the dataflow rules ride inside lint_all, the pre-flight
+    is its own sweep)."""
     from triton_distributed_tpu.analysis import lint as shmemlint
-    from triton_distributed_tpu.analysis.findings import Severity
+    from triton_distributed_tpu.analysis import mosaic_compat
+    from triton_distributed_tpu.analysis.findings import (
+        Severity,
+        rule_counts,
+    )
 
     findings = shmemlint.lint_all(n=8)
+    mc, report = mosaic_compat.preflight_all(n=8)
+    findings += mc
     for f in findings:
         print(json.dumps({"lint": f.to_json()}), file=sys.stderr, flush=True)
     errs = sum(f.severity >= Severity.ERROR for f in findings)
     print(
         json.dumps({"metric": "shmemlint", "errors": errs,
-                    "findings": len(findings)}),
+                    "findings": len(findings),
+                    "rule_counts": rule_counts(findings),
+                    "mosaic_scanned": len(report["scanned"]),
+                    "mosaic_refused": len(report["refused"])}),
         file=sys.stderr, flush=True,
     )
     if errs:

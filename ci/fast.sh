@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# fast: the < 5-minute tier-1 subset (ROADMAP CI-budget item, closed
+# round 7).
+#
+# Runs the `fast`-marked modules — the static analysis suite
+# (shmemlint + the Mosaic-compat pre-flight), the fault engine, the
+# host-level runtime/topology logic, the wire-layout/XLA-twin tests,
+# the lang-layer slices, and the tools — everything that answers
+# "did I just break a protocol, a contract, or the host plumbing?"
+# without paying for the interpreted model/serving suites. Use it as
+# the inner-loop gate; the full tier-1 run remains the merge gate.
+#
+#   ci/fast.sh              # the subset
+#   ci/fast.sh -x -k wire   # extra pytest args pass through
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'fast and not slow' \
+  -p no:cacheprovider "$@"

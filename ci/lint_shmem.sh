@@ -8,13 +8,20 @@
 # minutes later (or not at all on a jax without the TPU-simulation
 # interpreter, where the dynamic race passes are skipped entirely).
 #
-# Two legs, mirroring the satellite contract in docs/ANALYSIS.md:
+# Three legs, mirroring the satellite contract in docs/ANALYSIS.md:
 #   1. the `analysis`-marked pytest subset (rule fixtures + API surface);
-#   2. the CLI over every registered kernel family on an 8-rank mesh
-#      (exits nonzero on any ERROR-severity finding).
+#   2. the CLI over every registered kernel family on an 8-rank mesh —
+#      protocol (SL001-007) AND data correctness (SL008-010: delivery
+#      contracts, wire-rail consistency, stale-scale reads);
+#   3. the Mosaic-compat pre-flight (MC001-003): each family's kernel
+#      jaxpr, built for hardware, scanned for constructs this
+#      toolchain's Mosaic rejects — seconds-fast compile-shaped
+#      coverage now that the full AOT suite is slow-marked.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q -m analysis \
   -p no:cacheprovider "$@"
 JAX_PLATFORMS=cpu python -m triton_distributed_tpu.analysis.lint --mesh 8
+JAX_PLATFORMS=cpu python -m triton_distributed_tpu.analysis.mosaic_compat \
+  --mesh 8

@@ -16,14 +16,25 @@ Everything here is static — no interpreter, no devices, no mesh: these
 tests run identically on the 2-vCPU CI runner and a TPU host.
 """
 
+import json
+
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.analysis
+pytestmark = [pytest.mark.analysis, pytest.mark.fast]
 
-from triton_distributed_tpu.analysis import events, fixtures
+from triton_distributed_tpu.analysis import (
+    dataflow,
+    events,
+    fixtures,
+    mosaic_compat,
+)
 from triton_distributed_tpu.analysis.checks import simulate
-from triton_distributed_tpu.analysis.findings import RULES, Severity
+from triton_distributed_tpu.analysis.findings import (
+    RULES,
+    SCHEMA_VERSION,
+    Severity,
+)
 from triton_distributed_tpu.analysis.lint import (
     _cross_family_checks,
     analyze_family,
@@ -49,8 +60,15 @@ def _analyze_fixture(fx, n=8, site="fixture"):
 
 class TestRegistryClean:
     def test_all_registered_families_lint_clean_mesh8(self):
-        """ISSUE acceptance: the full registry on --mesh 8, no findings."""
+        """ISSUE acceptance: the full registry on --mesh 8 — protocol
+        (SL001-007), delivery contracts (SL008) and wire rails
+        (SL009/SL010) — no findings."""
         findings = lint_all(n=8)
+        assert findings == [], [f.format() for f in findings]
+
+    def test_all_registered_families_lint_clean_mesh4(self):
+        """ISSUE acceptance: same at --mesh 4."""
+        findings = lint_all(n=4)
         assert findings == [], [f.format() for f in findings]
 
     def test_registry_clean_on_odd_mesh(self):
@@ -150,6 +168,210 @@ class TestSeededFixtures:
         assert "big_ref" in f.message
 
 
+# ---------------------------------------------------- dataflow provenance
+
+def _analyze_df_fixture(fx, n=8):
+    spec, in_shapes, contract = fx()
+    return analyze_spec(
+        spec, in_shapes(n), n, kernel_name=fx.__name__, site="fixture",
+        contract=contract,
+    )
+
+
+class TestDataflowProvenance:
+    """The symbolic payload-provenance engine itself — guard against a
+    vacuously-clean pass."""
+
+    def test_gather_provenance_single_marker_per_source(self):
+        """The ring AG's workspace must end with each source's marker on
+        exactly its slab, on every rank (not all-zeros, not mixed)."""
+        from triton_distributed_tpu.analysis.checks import simulate
+
+        rec, _ = analyze_family(families()["allgather.ring_1d"], 4)
+        sim = simulate(rec)
+        st = dataflow._State(rec)
+        st.seed_inputs()
+        dataflow._replay(rec, sim, st)
+        for rank in range(4):
+            c = st.get(rank, "out_ref")["contrib"]
+            for s in range(4):
+                slab = c[s * 8:(s + 1) * 8]
+                assert (slab == np.int64(1) << (4 * s)).all(), (rank, s)
+
+    def test_reduce_provenance_full_fold_mask(self):
+        """gemm_rs's output: every element exactly one contribution per
+        rank (the 0x1111 nibble mask at n=4)."""
+        from triton_distributed_tpu.analysis.checks import simulate
+
+        rec, _ = analyze_family(families()["gemm_rs.fused"], 4)
+        sim = simulate(rec)
+        st = dataflow._State(rec)
+        st.seed_inputs()
+        dataflow._replay(rec, sim, st)
+        for rank in range(4):
+            assert (st.get(rank, "out_hbm")["contrib"] == 0x1111).all()
+
+    def test_wire_families_record_quant_dequant_events(self):
+        """The wire hooks feed the evaluator: AG-side rings record
+        dequants, RS-side rings record per-hop quantize + fused
+        dequant-accumulate."""
+        rec, _ = analyze_family(families()["ag_gemm.fused_fp8w"], 4)
+        deq = [e for e in rec.events(events.DequantEvent)]
+        assert deq and all(e.add_region is None for e in deq)
+        rec, _ = analyze_family(families()["gemm_rs.fused_fp8w"], 4)
+        assert any(True for _ in rec.events(events.QuantEvent))
+        assert all(
+            e.add_region is not None
+            for e in rec.events(events.DequantEvent)
+        )
+
+    def test_wire_dst_ends_dequantized_never_quantized(self):
+        """No registry family may leave raw wire bytes in its contract
+        destination (the SL008 wire leg, asserted on the state)."""
+        from triton_distributed_tpu.analysis.checks import simulate
+
+        for name in ("ag_gemm.fused_fp8w", "reduce_scatter.ring_fp8w"):
+            fam = families()[name]
+            rec, _ = analyze_family(fam, 4)
+            sim = simulate(rec)
+            st = dataflow._State(rec)
+            st.seed_inputs()
+            dataflow._replay(rec, sim, st)
+            dst = dataflow._resolve_dst(rec, fam.contract.dst)
+            for rank in range(4):
+                wire = st.get(rank, dst)["wire"]
+                assert not (wire == dataflow.QUANTIZED).any(), (name, rank)
+                assert (wire == dataflow.DEQUANTIZED).any(), (name, rank)
+
+
+class TestSeededDataflowFixtures:
+    """Each data-correctness rule pinned by a deliberately broken kernel
+    that is PROTOCOL-CLEAN — the whole point: every semaphore balances
+    and SL001-SL007 stay silent, yet the delivered bytes are wrong."""
+
+    def test_skipped_chunk_is_sl008_only(self):
+        rec, findings = _analyze_df_fixture(fixtures.skipped_chunk)
+        assert _rules(findings) == ["SL008"], [f.format() for f in findings]
+        f = next(f for f in findings if "never delivered" in f.message)
+        assert f.severity == Severity.ERROR
+        assert f.site == "fixture"
+        assert len(f.ranks) >= 1
+        # every rank is missing a chunk
+        assert {fd.ranks[0] for fd in findings
+                if "of source rank" in fd.message} == set(range(8))
+
+    def test_dup_chunk_reports_duplicate_and_loss(self):
+        rec, findings = _analyze_df_fixture(fixtures.dup_chunk)
+        assert _rules(findings) == ["SL008"], [f.format() for f in findings]
+        msgs = " | ".join(f.message for f in findings)
+        assert "duplicated" in msgs
+        assert "never delivered" in msgs
+        # the duplicate names both the holder and source rank 0
+        f = next(f for f in findings if "duplicated" in f.message)
+        assert 0 in f.ranks
+
+    def test_scale_on_payload_sem_is_sl009(self):
+        rec, findings = _analyze_df_fixture(fixtures.scale_on_payload_sem)
+        assert _rules(findings) == ["SL009"], [f.format() for f in findings]
+        f = findings[0]
+        assert "payload rail's semaphore" in f.message
+        assert f.sem and "recv_sem" in f.sem
+        assert len(f.ranks) == 2
+
+    def test_stale_scale_is_sl010(self):
+        rec, findings = _analyze_df_fixture(fixtures.stale_scale)
+        assert _rules(findings) == ["SL010"], [f.format() for f in findings]
+        f = findings[0]
+        assert "scale group" in f.message
+        assert f.site == "fixture"
+        assert len(f.ranks) == 1
+
+    def test_contract_on_unknown_ref_is_loud(self):
+        spec, in_shapes, _ = fixtures.skipped_chunk()
+        with pytest.raises(KeyError, match="no_such_buffer"):
+            analyze_spec(
+                spec, in_shapes(4), 4, kernel_name="fx", site="fixture",
+                contract=dataflow.DeliveryContract(
+                    kind="gather", dst="no_such_buffer"
+                ),
+            )
+
+
+# ------------------------------------------------------ mosaic pre-flight
+
+class TestMosaicCompat:
+    def test_registry_preflight_clean(self):
+        """ISSUE acceptance: every family passes MC001-MC003 — scanned
+        under the hardware build config, or refusing cleanly under the
+        pinned-fp8 wire contract (the contract fires before Mosaic
+        would)."""
+        findings, report = mosaic_compat.preflight_all(n=4)
+        assert findings == [], [f.format() for f in findings]
+        assert set(report["scanned"]) | set(report["refused"]) == set(
+            families()
+        )
+        # the fp8-pinned wire twins are exactly the clean refusals
+        assert all("fp8w" in name for name in report["refused"])
+        assert report["refused"], "no family exercised the wire contract"
+
+    def test_preflight_is_seconds_fast(self):
+        """The pre-flight must stay tier-1-cheap (< 60 s is the
+        acceptance bound; warm it runs in single-digit seconds)."""
+        import time
+
+        t0 = time.time()
+        mosaic_compat.preflight_all(n=4, kernels=["allgather"])
+        assert time.time() - t0 < 60
+
+    def test_f8_cast_fixture_flagged(self):
+        spec, in_shapes = fixtures.f8_inkernel_cast()
+        f = mosaic_compat.preflight_spec(
+            spec, in_shapes(4), 4, kernel_name="fx_f8", site="fixture"
+        )
+        assert _rules(f) == ["MC001"]
+        assert "16-bit to 32-bit" in f[0].message
+
+    def test_scalar_shape_cast_fixture_flagged(self):
+        spec, in_shapes = fixtures.scalar_shape_cast()
+        f = mosaic_compat.preflight_spec(
+            spec, in_shapes(4), 4, kernel_name="fx_sc", site="fixture"
+        )
+        assert _rules(f) == ["MC002"]
+
+    def test_subbyte_broadcast_fixture_flagged(self):
+        spec, in_shapes = fixtures.subbyte_broadcast()
+        f = mosaic_compat.preflight_spec(
+            spec, in_shapes(4), 4, kernel_name="fx_sb", site="fixture"
+        )
+        assert _rules(f) == ["MC003"]
+
+    def test_fp8_wire_family_flags_mc001_when_forced(self, monkeypatch):
+        """The KNOWN f8-cast construct, on a real registry family: with
+        the toolchain override asserting in-kernel f8 support, the fp8
+        wire twin builds — and the pre-flight still flags the extf cast
+        this Mosaic rejects (the finding the 8-minute AOT suite would
+        otherwise be the first to see)."""
+        monkeypatch.setenv("TDTPU_WIRE_FP8_INKERNEL", "1")
+        status, f = mosaic_compat.preflight_family(
+            families()["ag_gemm.fused_fp8w"], 4
+        )
+        assert status == "scanned"
+        assert "MC001" in _rules(f)
+
+    def test_clean_kernels_not_flagged(self):
+        """int8 widening and the (1, 128) scale-row idiom must NOT trip
+        the scan — the non-wire and int8-capable families are clean."""
+        status, f = mosaic_compat.preflight_family(
+            families()["gemm_rs.fused"], 4
+        )
+        assert status == "scanned" and f == []
+
+    def test_mosaic_cli(self):
+        assert mosaic_compat.main(
+            ["--mesh", "4", "--kernel", "allgather.ring_1d"]
+        ) == 0
+
+
 # ------------------------------------------------------------------ the CLI
 
 class TestCLI:
@@ -161,6 +383,24 @@ class TestCLI:
     def test_cli_kernel_filter_and_json(self, capsys):
         assert lint_main(["--mesh", "4", "--kernel", "allgather",
                           "--json"]) == 0
+
+    def test_cli_json_schema_version_and_rule_counts(self, capsys):
+        """Satellite contract: --json emits a schema_version header and
+        a per-rule-count summary (machine-readable, all rules present
+        with zeros)."""
+        assert lint_main(["--mesh", "4", "--kernel", "allgather.ring_1d",
+                          "--json"]) == 0
+        lines = [json.loads(l) for l in
+                 capsys.readouterr().out.strip().splitlines()]
+        assert lines[0]["schema_version"] == SCHEMA_VERSION
+        assert "allgather.ring_1d" in lines[0]["families"]
+        assert set(lines[-1]["rule_counts"]) == set(RULES)
+        assert lines[-1]["errors"] == 0
+
+    def test_cli_mosaic_flag(self, capsys):
+        assert lint_main(["--mesh", "4", "--kernel", "allgather.ring_1d",
+                          "--mosaic"]) == 0
+        assert "mosaic-compat" in capsys.readouterr().err
 
     def test_cli_rejects_trivial_mesh(self):
         with pytest.raises(SystemExit):
@@ -190,7 +430,9 @@ class TestEventModel:
         """Rule ids are load-bearing (docs, suppressions, this file):
         removing or renumbering one is a breaking change."""
         assert set(RULES) == {
-            "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007"
+            "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007",
+            "SL008", "SL009", "SL010",
+            "MC001", "MC002", "MC003",
         }
 
     def test_ring_trace_targets_right_neighbor(self):

@@ -43,6 +43,9 @@ from triton_distributed_tpu.runtime import (
 from triton_distributed_tpu.runtime import faults, watchdog
 from triton_distributed_tpu.utils import assert_allclose
 
+#: tier-1 fast subset (ci/fast.sh): the fault-engine half of the robustness story
+pytestmark = pytest.mark.fast
+
 
 @pytest.fixture(autouse=True)
 def _clean_fault_state():

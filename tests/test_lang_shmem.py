@@ -7,6 +7,7 @@ producer-consumer (tutorials/01-distributed-notify-wait.py), ring put
 """
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental.pallas import tpu as pltpu
@@ -14,6 +15,9 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import lang
 from triton_distributed_tpu.utils import assert_allclose
+
+#: tier-1 fast subset (ci/fast.sh): the minimal lang-layer slices
+pytestmark = pytest.mark.fast
 
 
 def test_ring_put(mesh8):

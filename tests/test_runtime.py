@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from triton_distributed_tpu import runtime
 from triton_distributed_tpu.runtime import (
@@ -11,6 +12,9 @@ from triton_distributed_tpu.runtime import (
     detect_topology,
 )
 from triton_distributed_tpu.runtime.topology import LinkKind
+
+#: tier-1 fast subset (ci/fast.sh): pure host-level runtime logic
+pytestmark = pytest.mark.fast
 
 
 def test_initialize_distributed_single_host():

@@ -6,6 +6,7 @@ slice must degenerate cleanly (nnodes==1 specialization, SURVEY.md §4).
 """
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -16,6 +17,9 @@ from triton_distributed_tpu.runtime import (
     is_dcn_axis,
     num_slices,
 )
+
+#: tier-1 fast subset (ci/fast.sh): mesh construction, no kernels
+pytestmark = pytest.mark.fast
 
 
 class TestHybridMesh:

@@ -25,6 +25,9 @@ from triton_distributed_tpu.tools import (
     moe_align_block_size_host,
 )
 
+#: tier-1 fast subset (ci/fast.sh): AOT metadata + profiler merge, no collectives
+pytestmark = pytest.mark.fast
+
 
 class TestAot:
     def test_roundtrip(self, tmp_path):

@@ -27,6 +27,9 @@ from conftest import requires_tpu_sim
 
 from triton_distributed_tpu.lang import wire as wirelib
 
+#: tier-1 fast subset (ci/fast.sh): XLA wire twins and layout math
+pytestmark = pytest.mark.fast
+
 
 def _rel_err(got, ref):
     ref = np.asarray(ref, np.float64)

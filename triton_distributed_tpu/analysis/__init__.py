@@ -23,11 +23,19 @@ Layout:
 * :mod:`checks`    — the checker passes (cross-rank replay simulation
   with vector clocks, then the SL-rule checks over the result).
 * :mod:`findings`  — finding model, severities, the SL001… rule catalog.
+* :mod:`dataflow`  — symbolic payload-provenance replay: delivery
+  contracts (SL008), wire-rail consistency (SL009), stale-scale reads
+  (SL010) — a schedule can be semaphore-clean and still deliver wrong
+  bytes; this pass is what catches that.
+* :mod:`mosaic_compat` — the seconds-fast Mosaic pre-flight (MC001–
+  MC003): each family's kernel jaxpr, built for hardware, scanned for
+  constructs this toolchain's Mosaic backend rejects.
 * :mod:`lint`      — public API (:func:`lint.lint_family`,
   :func:`lint.lint_all`) and the CLI
   (``python -m triton_distributed_tpu.analysis.lint``).
 * :mod:`fixtures`  — deliberately broken kernels (missing wait, credit
-  imbalance, deadlock, barrier misuse) pinning each rule forever.
+  imbalance, deadlock, barrier misuse, skipped/dup delivery, mispaired
+  wire rails, Mosaic-rejected constructs) pinning each rule forever.
 
 The kernel families under analysis are declared in
 :mod:`triton_distributed_tpu.kernels.registry`.
@@ -35,6 +43,7 @@ The kernel families under analysis are declared in
 
 from triton_distributed_tpu.analysis.findings import (
     RULES,
+    SCHEMA_VERSION,
     Finding,
     Severity,
 )
@@ -43,9 +52,12 @@ __all__ = [
     "Finding",
     "Severity",
     "RULES",
+    "SCHEMA_VERSION",
+    "DeliveryContract",
     "lint_all",
     "lint_family",
     "lint_mesh",
+    "preflight_all",
 ]
 
 
@@ -57,4 +69,12 @@ def __getattr__(name):
         from triton_distributed_tpu.analysis import lint
 
         return getattr(lint, name)
+    if name == "preflight_all":
+        from triton_distributed_tpu.analysis import mosaic_compat
+
+        return mosaic_compat.preflight_all
+    if name == "DeliveryContract":
+        from triton_distributed_tpu.analysis import dataflow
+
+        return dataflow.DeliveryContract
     raise AttributeError(name)

@@ -48,6 +48,38 @@ def _as_int(x) -> int:
 
 # ------------------------------------------------------------------- refs
 
+class TaggedArray(np.ndarray):
+    """Value read out of an :class:`AbsRef`, remembering *where* it was
+    read from so a subsequent store can be recognized as a copy
+    (``dst[...] = src[...]``) or a two-operand fold
+    (``dst[...] = a[...] + b[...]``) — the provenance edges the SL008
+    delivery pass follows. Any other arithmetic strips the tag: the
+    result is then locally computed data, which is exactly what the
+    dataflow model wants."""
+
+    def __array_finalize__(self, obj):
+        # never inherit a tag through views/copies/astype — a tag is
+        # only valid on the exact array a read returned
+        self.src_region = None
+        self.add_srcs = None
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        tags = [getattr(i, "src_region", None) for i in inputs]
+        plain = tuple(
+            i.view(np.ndarray) if isinstance(i, TaggedArray) else i
+            for i in inputs
+        )
+        out = getattr(ufunc, method)(*plain, **kwargs)
+        if (
+            ufunc is np.add and method == "__call__" and len(inputs) == 2
+            and all(t is not None for t in tags)
+            and isinstance(out, np.ndarray)
+        ):
+            out = out.view(TaggedArray)
+            out.add_srcs = (tags[0], tags[1])
+        return out
+
+
 class AbsRef:
     """Ref stand-in with numpy storage. Views (``.at[...]`` and the
     evaluator's slicing) share the parent storage and keep ROOT-buffer
@@ -86,13 +118,20 @@ class AbsRef:
         view = self._slice(idx)
         if self.rec is not None:
             self.rec.emit(ev.ReadEvent(region=view.region()))
-        out = np.array(view.data)         # copy — refs are mutable
+        out = np.array(view.data).view(TaggedArray)  # copy — refs mutable
+        out.src_region = view.region()
         return out
 
     def __setitem__(self, idx, value):
         view = self._slice(idx)
+        copy_src = getattr(value, "src_region", None)
+        add_srcs = getattr(value, "add_srcs", None)
+        if np.shape(value) != view.data.shape:
+            copy_src = add_srcs = None    # broadcast/partial store: no edge
         if self.rec is not None:
-            self.rec.emit(ev.WriteEvent(region=view.region()))
+            self.rec.emit(ev.WriteEvent(
+                region=view.region(), copy_src=copy_src, add_srcs=add_srcs,
+            ))
         view.data[...] = np.broadcast_to(
             np.asarray(value, dtype=self.data.dtype), view.data.shape
         )
@@ -420,10 +459,15 @@ def build_refs(launch, in_shapes, rec: ev.Recorder, init=None):
             specs.append(("ref", tuple(s.shape), np.dtype(s.dtype), space))
 
     names = _ref_names(launch.kernel, len(specs))
+    n_in = len(in_shapes)
     refs, vmem, breakdown = [], 0, []
     for i, (name, (kind, shape, dtype, space)) in enumerate(
         zip(names, specs)
     ):
+        rec.ref_meta.setdefault(name, ev.RefMeta(
+            shape=tuple(shape), dtype=dtype, space=space,
+            is_input=(kind == "ref" and i < n_in), index=i,
+        ))
         if kind == "sem":
             refs.append(AbsSem(name, shape))
             continue

@@ -68,6 +68,10 @@ class SimResult:
     guarantee: dict             # (src, src_idx) -> (dst, wait_idx, wait_ctr)
     local_access: list          # per rank: [(idx, ctr, vc, region, kind)]
     blocked: list               # [(rank, WaitEvent)]
+    schedule: list              # [(rank, event)] in replay execution order
+                                # — one feasible cross-rank linearization
+                                # (respects every wait); the dataflow
+                                # pass replays provenance along it
 
 
 def _deliveries(e):
@@ -103,6 +107,11 @@ def simulate(rec: ev.Recorder) -> SimResult:
     remote_writes: list = []
     guarantee: dict = {}
     local_access: list = [[] for _ in range(n)]
+    schedule: list = []
+
+    def access(r, e, region, kind):
+        if region is not None:
+            local_access[r].append((e.idx, e.ctr, e.vc, region, kind))
 
     def execute(r, e):
         clocks[r][r] += 1
@@ -130,6 +139,21 @@ def simulate(rec: ev.Recorder) -> SimResult:
         elif isinstance(e, (ev.ReadEvent, ev.WriteEvent)):
             kind = "r" if isinstance(e, ev.ReadEvent) else "w"
             local_access[r].append((e.idx, e.ctr, e.vc, e.region, kind))
+        elif isinstance(e, ev.QuantEvent):
+            # the wire events stand in for the pipeline's hull accesses
+            # (lang.wire skips the value-level pipeline under a recorder)
+            access(r, e, e.src_region, "r")
+            access(r, e, e.q_region, "w")
+            access(r, e, e.s_region, "w")
+        elif isinstance(e, ev.DequantEvent):
+            access(r, e, e.q_region, "r")
+            access(r, e, e.s_region, "r")
+            access(r, e, e.add_region, "r")
+            access(r, e, e.dst_region, "w")
+        elif isinstance(e, ev.AddEvent):
+            access(r, e, e.a_region, "r")
+            access(r, e, e.b_region, "r")
+            access(r, e, e.dst_region, "w")
 
     def try_wait(r, e) -> bool:
         k = (r, e.key)
@@ -181,6 +205,7 @@ def simulate(rec: ev.Recorder) -> SimResult:
                         break
                 else:
                     execute(r, e)
+                schedule.append((r, e))
                 pcs[r] += 1
                 progress = True
 
@@ -201,6 +226,7 @@ def simulate(rec: ev.Recorder) -> SimResult:
         guarantee=guarantee,
         local_access=local_access,
         blocked=blocked,
+        schedule=schedule,
     )
 
 
@@ -437,13 +463,24 @@ def _check_vmem(rec) -> list:
     )]
 
 
-def check_family(rec: ev.Recorder) -> list:
-    """All per-family passes over one recorded kernel family."""
+def check_family(rec: ev.Recorder, contract=None) -> list:
+    """All per-family passes over one recorded kernel family.
+
+    ``contract`` (a :class:`~triton_distributed_tpu.analysis.dataflow.
+    DeliveryContract`, usually from the kernel registry) additionally
+    runs the data-correctness passes: SL008 delivery completeness
+    against the contract, SL009/SL010 wire-rail consistency. The wire
+    passes run whenever the traces carry a quantized rail, contract or
+    not — a protocol can be semaphore-clean and still deliver the wrong
+    bytes, which is exactly what these passes exist to catch."""
+    from triton_distributed_tpu.analysis import dataflow
+
     sim = simulate(rec)
     findings = _check_barriers(rec) + _check_vmem(rec)
     if sim.completed:
         findings += _check_balance(rec, sim)
         findings += _check_hazards(rec, sim)
+        findings += dataflow.check_dataflow(rec, sim, contract)
     else:
         findings += _check_blocked(rec, sim)
     return findings

@@ -1,0 +1,547 @@
+"""Symbolic payload-provenance dataflow over the replayed traces.
+
+The protocol passes (checks.py) prove the *semaphore* story; a ring
+schedule can pass every credit check and still deliver the wrong bytes
+— skip a chunk with an off-by-one hop count, land one chunk twice,
+fold a contribution into a reduction zero or two times, or dequantize
+hop h's slab with hop h-1's scale plane. This pass replays the same
+cross-rank schedule the simulator produced and tracks, per element of
+every root buffer, a symbolic provenance tuple:
+
+* ``contrib`` — a nibble-packed count of contributions per SOURCE rank
+  (int64, 4 bits per rank: copies move it, folds add it, computed
+  writes reset it to the writing rank's own marker);
+* ``wire`` — raw / quantized / dequantized;
+* ``scale`` — the quantization group id of quantized bytes, and of the
+  group a scale plane currently holds (every QuantEvent and every
+  quantized input pair is its own group);
+* ``hop`` — how many remote DMAs the bytes have ridden.
+
+At quiescence the declared :class:`DeliveryContract` (the registry is
+the table that drives this) is checked against the destination buffer:
+
+* **gather/permute** — every rank holds every source's payload exactly
+  once (duplicates and omissions are both SL008, even when all
+  semaphores balance);
+* **reduce** — every output element is the multiset-reduction of ONE
+  contribution per rank (a missing or double-folded rank is SL008);
+* any raw quantized bytes surviving in the destination are SL008.
+
+Independent of the contract, quantized wire rails are checked for
+payload/scale consistency: every 1-byte payload RDMA must be paired
+with a lang.wire-shaped scale-plane RDMA to the same peer on its OWN
+semaphores (SL009), scale planes must be consumed only under a wait
+that vouches for their arrival (SL009), and a dequant must consume the
+scale group its payload was quantized under (SL010).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from triton_distributed_tpu.analysis import events as ev
+from triton_distributed_tpu.analysis.findings import Finding
+
+#: wire states
+RAW, QUANTIZED, DEQUANTIZED = 0, 1, 2
+
+#: contribution-count nibble width: 4 bits per source rank in an int64
+#: bounds the analyzable mesh (16 ranks, far above the lint meshes)
+_NIBBLE = 4
+MAX_RANKS = 64 // _NIBBLE
+
+
+@dataclass(frozen=True)
+class DeliveryContract:
+    """What a kernel family promises to have delivered at termination.
+
+    ``kind``: 'gather' (AG family — every rank ends holding every
+    source chunk), 'reduce' (RS family — each output element is one
+    contribution per rank, folded exactly once), or 'permute'
+    (all-to-all — each source's designated chunk lands exactly once).
+    ``dst``: the destination root buffer, by kernel-parameter name or
+    positional ref index. ``payload_per_src``: elements each source
+    must deliver into dst (callable of the mesh size; default
+    ``dst_elems // n``). ``full``: every dst element must be covered
+    (False for capacity-padded transports like the MoE a2a, where
+    unused slot rows legitimately stay unwritten).
+    ``own_absent_ok``: a gather destination may legitimately omit the
+    local rank's own chunk (kernels that consume it straight from the
+    input and never publish it, e.g. the moe_tp AG workspace).
+    """
+
+    kind: str
+    dst: object
+    payload_per_src: object = None
+    full: bool = True
+    own_absent_ok: bool = False
+
+
+# ------------------------------------------------------------- replay state
+
+class _State:
+    """Per-(rank, root) provenance arrays, lazily materialized."""
+
+    def __init__(self, rec):
+        self.rec = rec
+        self._arr: dict = {}
+
+    def get(self, rank, root):
+        key = (rank, root)
+        st = self._arr.get(key)
+        if st is None:
+            meta = self.rec.ref_meta.get(root)
+            if meta is None or meta.dtype is None:
+                return None
+            shape = meta.shape
+            st = self._arr[key] = {
+                "contrib": np.zeros(shape, np.int64),
+                "wire": np.zeros(shape, np.int8),
+                "scale": np.zeros(shape, np.int32),
+                "hop": np.zeros(shape, np.int16),
+                "last_put": np.full(shape, -1, np.int32),
+            }
+        return st
+
+    def seed_inputs(self):
+        """Inputs are the provenance sources: rank r's input payload is
+        marked as r's contribution. 1-byte inputs are pre-quantized wire
+        payloads; each (q, s) input pair forms its own per-rank scale
+        group (token), so cross-rank or cross-pair dequants mismatch."""
+        token = [0]
+        tokens = {}
+        for rank in range(self.rec.n):
+            for root, meta in self.rec.ref_meta.items():
+                if not meta.is_input or meta.dtype is None:
+                    continue
+                st = self.get(rank, root)
+                st["contrib"][...] = np.int64(1) << (_NIBBLE * rank)
+                if meta.dtype.itemsize == 1:
+                    token[0] += 1
+                    tokens[(rank, root)] = token[0]
+                    st["wire"][...] = QUANTIZED
+                    st["scale"][...] = token[0]
+        # the scale plane paired with a quantized input is, by the
+        # lang.wire calling convention, the f32 input that follows it
+        order = [r for r, m in self.rec.ref_meta.items() if m.is_input]
+        for rank in range(self.rec.n):
+            for i, root in enumerate(order):
+                tok = tokens.get((rank, root))
+                if tok is None or i + 1 >= len(order):
+                    continue
+                nxt = self.rec.ref_meta[order[i + 1]]
+                if nxt.dtype is not None and nxt.dtype == np.dtype(np.float32):
+                    self.get(rank, order[i + 1])["scale"][...] = tok
+        self._next_token = token[0]
+
+    def fresh_token(self) -> int:
+        self._next_token += 1
+        return self._next_token
+
+
+def _slices(region: ev.Region):
+    return tuple(slice(lo, hi) for lo, hi in zip(region.lo, region.hi))
+
+
+def _region_elems(region: ev.Region) -> int:
+    n = 1
+    for lo, hi in zip(region.lo, region.hi):
+        n *= hi - lo
+    return n
+
+
+def _copy(dst_st, dst_region, src_st, src_region, *, hop_inc=0,
+          put_id=None):
+    if dst_st is None or src_st is None:
+        return
+    ds, ss = _slices(dst_region), _slices(src_region)
+    for k in ("contrib", "wire", "scale", "hop"):
+        src = src_st[k][ss]
+        if src.shape != dst_st[k][ds].shape:
+            src = src.reshape(dst_st[k][ds].shape)
+        dst_st[k][ds] = src
+    if hop_inc:
+        dst_st["hop"][ds] += hop_inc
+    if put_id is not None:
+        dst_st["last_put"][ds] = put_id
+
+
+def _own(st, region, rank):
+    if st is None:
+        return
+    s = _slices(region)
+    st["contrib"][s] = np.int64(1) << (_NIBBLE * rank)
+    st["wire"][s] = RAW
+    st["scale"][s] = 0
+    st["hop"][s] = 0
+    st["last_put"][s] = -1
+
+
+def _uniq_scale(st, region):
+    vals = np.unique(st["scale"][_slices(region)])
+    return [int(v) for v in vals if v != 0]
+
+
+# ------------------------------------------------------------------ replay
+
+def _replay(rec, sim, state: _State):
+    """Apply provenance transfer along the simulator's schedule.
+
+    The mid-replay checks live here because they need the *at-the-time*
+    state: SL010 compares the scale group a dequant consumes against
+    the group its payload was quantized under, and the SL009 ordering
+    leg asks whether the scale plane's most recent landing was vouched
+    for by a completed wait BEFORE the dequant — both answers change as
+    double-buffered slots are reused."""
+    kernel, site = rec.info.kernel, rec.info.site
+    findings: list = []
+    puts: list = []
+    reported = set()
+
+    def check_scale_ordering(rank, e, s_st):
+        ids = np.unique(s_st["last_put"][_slices(e.s_region)])
+        for pid in (int(v) for v in ids if v >= 0):
+            put = puts[pid]
+            g = sim.guarantee.get((put.rank, put.idx))
+            if g is not None and g[0] == rank and g[1] < e.idx:
+                continue
+            sig = ("SL009-unordered", e.s_region.ref, rank)
+            if sig in reported:
+                continue
+            reported.add(sig)
+            findings.append(Finding(
+                "SL009", kernel,
+                f"rank {rank} consumes the scale plane {e.s_region} "
+                f"(landed by rank {put.rank}'s RDMA) with no completed "
+                "wait vouching for the scale rail's arrival — the "
+                "dequant can read a half-landed plane",
+                site=site, ranks=(rank, put.rank),
+                sem=_fmt_key(put.recv_key) if put.recv_key else None,
+                phase=e.phase,
+            ))
+
+    for rank, e in sim.schedule:
+        if isinstance(e, ev.WriteEvent):
+            st = state.get(rank, e.region.ref)
+            if e.copy_src is not None:
+                _copy(st, e.region, state.get(rank, e.copy_src.ref),
+                      e.copy_src)
+            elif e.add_srcs is not None:
+                _fold(state, rank, e.region, e.add_srcs[0], e.add_srcs[1])
+            else:
+                _own(st, e.region, rank)
+        elif isinstance(e, ev.PutEvent):
+            put_id = len(puts)
+            puts.append(e)
+            _copy(
+                state.get(e.dst_rank, e.dst_region.ref), e.dst_region,
+                state.get(rank, e.src_region.ref), e.src_region,
+                hop_inc=0 if e.local else 1,
+                put_id=None if e.local else put_id,
+            )
+        elif isinstance(e, ev.QuantEvent):
+            tok = state.fresh_token()
+            src_st = state.get(rank, e.src_region.ref)
+            q_st = state.get(rank, e.q_region.ref)
+            s_st = state.get(rank, e.s_region.ref)
+            if q_st is not None and src_st is not None:
+                _copy(q_st, e.q_region, src_st, e.src_region)
+                qs = _slices(e.q_region)
+                q_st["wire"][qs] = QUANTIZED
+                q_st["scale"][qs] = tok
+            if s_st is not None:
+                ss = _slices(e.s_region)
+                _own(s_st, e.s_region, rank)
+                s_st["scale"][ss] = tok
+        elif isinstance(e, ev.DequantEvent):
+            q_st = state.get(rank, e.q_region.ref)
+            s_st = state.get(rank, e.s_region.ref)
+            dst_st = state.get(rank, e.dst_region.ref)
+            if s_st is not None:
+                check_scale_ordering(rank, e, s_st)
+            needed = _uniq_scale(q_st, e.q_region) if q_st else []
+            held = _uniq_scale(s_st, e.s_region) if s_st else []
+            if (sorted(needed) != sorted(held) or len(needed) > 1) and (
+                ("SL010", e.q_region.ref, e.idx) not in reported
+            ):
+                reported.add(("SL010", e.q_region.ref, e.idx))
+                findings.append(Finding(
+                    "SL010", kernel,
+                    f"rank {rank} dequantizes {e.q_region} (scale group"
+                    f"{'s' if len(needed) != 1 else ''} {needed or '?'})"
+                    f" with the scale plane {e.s_region} holding group"
+                    f"{'s' if len(held) != 1 else ''} {held or '?'} — "
+                    "payload and scales come from different "
+                    "quantizations (a stale double-buffer slot or a "
+                    "mispaired rail); the dequantized values are "
+                    "silently wrong",
+                    site=site, ranks=(rank,), phase=e.phase,
+                ))
+            if e.add_region is not None and dst_st is not None:
+                _fold(state, rank, e.dst_region, e.q_region, e.add_region)
+            elif dst_st is not None and q_st is not None:
+                _copy(dst_st, e.dst_region, q_st, e.q_region)
+            if dst_st is not None:
+                ds = _slices(e.dst_region)
+                w = dst_st["wire"][ds]
+                dst_st["wire"][ds] = np.where(w == QUANTIZED, DEQUANTIZED, w)
+                dst_st["scale"][ds] = 0
+        elif isinstance(e, ev.AddEvent):
+            _fold(state, rank, e.dst_region, e.a_region, e.b_region)
+    return puts, findings
+
+
+def _fold(state: _State, rank, dst_region, a_region, b_region):
+    """dst = a + b: contribution nibbles ADD (that is how double-folds
+    become visible); a quantized operand stays quantized in the result
+    (folding raw wire bytes without a dequant is itself a bug the
+    contract check then surfaces)."""
+    dst_st = state.get(rank, dst_region.ref)
+    a_st = state.get(rank, a_region.ref)
+    b_st = state.get(rank, b_region.ref)
+    if dst_st is None or a_st is None or b_st is None:
+        return
+    ds = _slices(dst_region)
+    shape = dst_st["contrib"][ds].shape
+
+    def pick(st, region, k):
+        v = st[k][_slices(region)]
+        return v.reshape(shape) if v.shape != shape else v
+
+    dst_st["contrib"][ds] = (
+        pick(a_st, a_region, "contrib") + pick(b_st, b_region, "contrib")
+    )
+    aw, bw = pick(a_st, a_region, "wire"), pick(b_st, b_region, "wire")
+    dst_st["wire"][ds] = np.where(
+        (aw == QUANTIZED) | (bw == QUANTIZED), QUANTIZED, np.maximum(aw, bw)
+    )
+    dst_st["scale"][ds] = 0
+    dst_st["hop"][ds] = np.maximum(
+        pick(a_st, a_region, "hop"), pick(b_st, b_region, "hop")
+    )
+    dst_st["last_put"][ds] = -1
+
+
+# --------------------------------------------------------------- SL009 rails
+
+def _check_rail_pairing(rec) -> list:
+    """Structural payload/scale rail pairing (SL009): every non-local
+    1-byte-payload RDMA must be immediately followed (before any wait —
+    the _DualDMA discipline) by a lang.wire-shaped f32 scale-plane RDMA
+    to the same peer, on its OWN semaphores."""
+    from triton_distributed_tpu.lang import wire as wirelib
+
+    findings: list = []
+    kernel, site = rec.info.kernel, rec.info.site
+    reported = set()
+
+    def itemsize(region):
+        meta = rec.ref_meta.get(region.ref)
+        return meta.dtype.itemsize if meta and meta.dtype is not None else 0
+
+    def report(rule_sig, f):
+        if rule_sig not in reported:
+            reported.add(rule_sig)
+            findings.append(f)
+
+    for r in range(rec.n):
+        trace = rec.traces[r]
+        for i, e in enumerate(trace):
+            if not (isinstance(e, ev.PutEvent) and not e.local
+                    and itemsize(e.src_region) == 1):
+                continue
+            partner = None
+            for e2 in trace[i + 1:]:
+                if isinstance(e2, ev.WaitEvent):
+                    break
+                if (isinstance(e2, ev.PutEvent) and not e2.local
+                        and e2.dst_rank == e.dst_rank
+                        and itemsize(e2.src_region) == 4):
+                    partner = e2
+                    break
+            if partner is None:
+                report(("nopair", e.src_region.ref, e.dst_rank), Finding(
+                    "SL009", kernel,
+                    f"rank {r} forwards the quantized payload "
+                    f"{e.src_region} to rank {e.dst_rank} with no paired "
+                    "scale-plane RDMA before the next wait — the "
+                    "receiver has bytes it cannot dequantize",
+                    site=site, ranks=(r, e.dst_rank),
+                    sem=_fmt_key(e.recv_key) if e.recv_key else None,
+                    phase=e.phase,
+                ))
+                continue
+            if (e.recv_key is not None and e.recv_key == partner.recv_key) \
+                    or (e.send_key == partner.send_key):
+                report(("sharedsem", e.src_region.ref), Finding(
+                    "SL009", kernel,
+                    f"rank {r}'s scale rail ({partner.src_region}) is "
+                    "signaled on the payload rail's semaphore "
+                    f"({_fmt_key(e.recv_key or e.send_key)}): credits "
+                    "count, they don't tag — a scale arrival can "
+                    "release the payload wait (or vice versa) while the "
+                    "other rail is still in flight",
+                    site=site, ranks=(r, e.dst_rank),
+                    sem=_fmt_key(e.recv_key or e.send_key), phase=e.phase,
+                ))
+            q_shape = _plane_shape(e.src_region)
+            s_shape = _plane_shape(partner.src_region)
+            q_rows = 1
+            for d in q_shape[:-1]:
+                q_rows *= d
+            if not wirelib.paired_scale_ok(q_rows, s_shape):
+                report(("layout", e.src_region.ref), Finding(
+                    "SL009", kernel,
+                    f"scale plane {partner.src_region} paired with "
+                    f"payload {e.src_region} drifts from the lang.wire "
+                    f"layout contract ({q_rows} payload rows need a "
+                    f"(rows/chunk_rows, {wirelib.SCALE_LANES}) f32 "
+                    "plane whose rows divide them)",
+                    site=site, ranks=(r,), phase=e.phase,
+                ))
+    return findings
+
+
+def _fmt_key(key) -> str:
+    name, slot = key
+    return name + (str(list(slot)) if slot else "")
+
+
+def _plane_shape(region: ev.Region) -> tuple:
+    """Region extents with leading unit dims squeezed (a scalar-indexed
+    slot of a double-buffered root keeps the root's rank; the wire
+    layout contract is over the 2-D slab it selects)."""
+    dims = [hi - lo for lo, hi in zip(region.lo, region.hi)]
+    while len(dims) > 2 and dims[0] == 1:
+        dims.pop(0)
+    return tuple(dims)
+
+
+# ----------------------------------------------------------- SL008 contract
+
+def _resolve_dst(rec, dst):
+    if isinstance(dst, int):
+        for root, meta in rec.ref_meta.items():
+            if meta.index == dst:
+                return root
+        raise KeyError(f"no ref at position {dst}")
+    if dst not in rec.ref_meta:
+        raise KeyError(
+            f"contract dst {dst!r} is not a ref of kernel "
+            f"{rec.info.kernel!r} (refs: {list(rec.ref_meta)})"
+        )
+    return dst
+
+
+def _bbox(mask) -> str:
+    idx = np.argwhere(mask)
+    lo, hi = idx.min(axis=0), idx.max(axis=0) + 1
+    return "[" + ",".join(f"{a}:{b}" for a, b in zip(lo, hi)) + "]"
+
+
+def _check_contract(rec, state: _State, contract: DeliveryContract) -> list:
+    findings: list = []
+    kernel, site = rec.info.kernel, rec.info.site
+    n = rec.n
+    dst = _resolve_dst(rec, contract.dst)
+    meta = rec.ref_meta[dst]
+    dst_elems = int(np.prod(meta.shape))
+    expect = (
+        contract.payload_per_src(n) if contract.payload_per_src
+        else dst_elems // n
+    )
+    full_mask = sum(np.int64(1) << (_NIBBLE * s) for s in range(n))
+    for rank in range(n):
+        st = state.get(rank, dst)
+        c = st["contrib"]
+        if (st["wire"] == QUANTIZED).any():
+            findings.append(Finding(
+                "SL008", kernel,
+                f"rank {rank}'s {dst} region "
+                f"{dst}{_bbox(st['wire'] == QUANTIZED)} still holds RAW "
+                "quantized wire bytes at termination — delivered "
+                "without a dequantize",
+                site=site, ranks=(rank,),
+            ))
+        if contract.kind == "reduce":
+            bad = c != full_mask
+            if bad.any():
+                missing, dup = [], []
+                for s in range(n):
+                    nib = (c >> (_NIBBLE * s)) & 0xF
+                    if (nib == 0).any():
+                        missing.append(s)
+                    if (nib > 1).any():
+                        dup.append(s)
+                findings.append(Finding(
+                    "SL008", kernel,
+                    f"rank {rank}'s reduction output {dst}{_bbox(bad)} "
+                    "is not the exact one-contribution-per-rank fold: "
+                    + (f"rank(s) {missing} never folded in" if missing
+                       else "")
+                    + ("; " if missing and dup else "")
+                    + (f"rank(s) {dup} folded more than once" if dup
+                       else ""),
+                    site=site, ranks=(rank,),
+                ))
+            continue
+        # gather / permute: every element single-sourced, per-src counts
+        single = np.zeros(meta.shape, bool)
+        for s in range(n):
+            marker = np.int64(1) << (_NIBBLE * s)
+            hits = c == marker
+            single |= hits
+            got = int(hits.sum())
+            want = expect
+            if s == rank and contract.own_absent_ok and got == 0:
+                continue
+            if got != want:
+                kind = ("missing" if got < want else "duplicated")
+                findings.append(Finding(
+                    "SL008", kernel,
+                    f"rank {rank} holds {got} element(s) of source rank "
+                    f"{s}'s payload in {dst}, expected {want} — chunk "
+                    f"{kind} "
+                    + (f"(region {dst}{_bbox(hits)})" if got else
+                       "(never delivered)"),
+                    site=site, ranks=(rank, s),
+                ))
+        mixed = (c != 0) & ~single
+        if mixed.any():
+            findings.append(Finding(
+                "SL008", kernel,
+                f"rank {rank}'s {dst}{_bbox(mixed)} holds elements with "
+                "mixed or repeated source contributions — overlapping "
+                "deliveries landed in one region",
+                site=site, ranks=(rank,),
+            ))
+        if contract.full:
+            empty = c == 0
+            if contract.own_absent_ok:
+                pass  # per-src counts above already police coverage
+            elif empty.any():
+                findings.append(Finding(
+                    "SL008", kernel,
+                    f"rank {rank}'s {dst}{_bbox(empty)} was never "
+                    "written by any source — the gather terminated "
+                    "with a hole",
+                    site=site, ranks=(rank,),
+                ))
+    return findings
+
+
+# ------------------------------------------------------------------- entry
+
+def check_dataflow(rec, sim, contract: DeliveryContract | None) -> list:
+    """The SL008/SL009/SL010 passes over one completed replay."""
+    if rec.n > MAX_RANKS:
+        return []
+    state = _State(rec)
+    state.seed_inputs()
+    _puts, findings = _replay(rec, sim, state)
+    findings += _check_rail_pairing(rec)
+    if contract is not None:
+        findings += _check_contract(rec, state, contract)
+    return findings

@@ -71,7 +71,16 @@ class ReadEvent(Event):
 
 @dataclass
 class WriteEvent(Event):
+    """A local store. ``copy_src`` is set when the stored value was read
+    verbatim out of another ref region on the same rank (the evaluator's
+    tagged reads detect ``dst[...] = src[...]``); ``add_srcs`` when it
+    was the elementwise sum of two such reads (the VMEM ring fold).
+    Either gives the dataflow pass (SL008) a provenance edge; a plain
+    write is locally computed data."""
+
     region: Region = None
+    copy_src: Region = None
+    add_srcs: tuple = None      # (Region, Region) for dst = a + b
 
 
 @dataclass
@@ -102,6 +111,43 @@ class WaitEvent(Event):
 
 
 @dataclass
+class QuantEvent(Event):
+    """A wire quantization: ``src`` → 1-byte payload ``q`` + f32 scale
+    plane ``s`` (lang.wire layout). Each QuantEvent is its own scale
+    group; the dataflow pass tags the q and s regions with the event's
+    identity so a later dequant can be checked for pairing (SL010)."""
+
+    src_region: Region = None
+    q_region: Region = None
+    s_region: Region = None
+    chunk_rows: int = 1
+
+
+@dataclass
+class DequantEvent(Event):
+    """A wire dequantization (``add_region`` None) or fused
+    dequant-accumulate (``dst = add + q·s``): the provenance of ``q``
+    flows to ``dst`` and the scale group held by ``s`` must match the
+    group ``q`` was quantized under (SL010)."""
+
+    q_region: Region = None
+    s_region: Region = None
+    dst_region: Region = None
+    add_region: Region = None
+
+
+@dataclass
+class AddEvent(Event):
+    """A streamed elementwise fold ``dst = a + b`` (the HBM ring folds'
+    ew_add_pipeline). Provenance of both operands accumulates into
+    ``dst`` — the edge the reduce-contract check (SL008) rides."""
+
+    a_region: Region = None
+    b_region: Region = None
+    dst_region: Region = None
+
+
+@dataclass
 class BarrierEvent(Event):
     collective_id: object = None
 
@@ -112,6 +158,19 @@ class FenceEvent(Event):
 
 
 # ----------------------------------------------------------------- recorder
+
+@dataclass(frozen=True)
+class RefMeta:
+    """Static facts about one root buffer, captured at ref construction
+    (abstract.build_refs): the dataflow pass needs shapes to materialize
+    provenance state and dtypes to recognize wire payload rails."""
+
+    shape: tuple
+    dtype: object           # np.dtype (None for semaphores)
+    space: str
+    is_input: bool
+    index: int              # position in the kernel's ref list
+
 
 @dataclass
 class LaunchInfo:
@@ -140,6 +199,9 @@ class Recorder:
         self.traces: list[list[Event]] = [[] for _ in range(self.n)]
         self._phase = 0
         self.barrier_sem_used = False
+        #: root ref name -> RefMeta, in kernel-signature order (filled by
+        #: abstract.build_refs; identical across ranks by SPMD symmetry)
+        self.ref_meta: dict = {}
 
     def emit(self, ev: Event) -> Event:
         assert self.me is not None, "recorder has no current rank"

@@ -10,6 +10,11 @@ from __future__ import annotations
 import enum
 from dataclasses import dataclass, field
 
+#: version of the machine-readable finding schema (``--json`` output and
+#: :meth:`Finding.to_json`). Bump when a field is added/renamed so
+#: downstream consumers (CI dashboards, bench parsers) can dispatch.
+SCHEMA_VERSION = 2
+
 
 class Severity(enum.IntEnum):
     INFO = 0
@@ -65,6 +70,56 @@ RULES = {
         "waited — the kernel can exit with the transfer in flight "
         "(missing quiet()/wait_send())",
     ),
+    "SL008": (
+        "delivery-incompleteness",
+        Severity.ERROR,
+        "the kernel terminates without satisfying its declared delivery "
+        "contract: a gather/permute destination missing a source chunk "
+        "or holding one twice, a reduction folding a rank's contribution "
+        "zero or multiple times, or raw quantized wire bytes left in the "
+        "output — caught even when every semaphore balances",
+    ),
+    "SL009": (
+        "wire-rail-divergence",
+        Severity.ERROR,
+        "the quantized payload rail and its scale-plane rail diverge: a "
+        "payload RDMA with no paired scale RDMA, the two rails guarded "
+        "by the same semaphore credits (a scale arrival can release the "
+        "payload wait), a scale plane whose layout drifts from the "
+        "lang.wire contract, or a scale plane consumed before its "
+        "arrival is ordered",
+    ),
+    "SL010": (
+        "stale-scale-read",
+        Severity.ERROR,
+        "a dequantize consumes a scale plane from a different "
+        "quantization than its payload slab (e.g. hop h's bytes "
+        "dequantized with hop h-1's scales in a double-buffered "
+        "workspace) — silently wrong values, no protocol violation",
+    ),
+    "MC001": (
+        "mosaic-f8-cast",
+        Severity.ERROR,
+        "the kernel body casts to/from an 8-bit float inside the Pallas "
+        "kernel; this toolchain's Mosaic backend rejects f8 extensions "
+        "('Only 16-bit to 32-bit extensions supported') — carry int8 "
+        "in-kernel or dequantize on the XLA side",
+    ),
+    "MC002": (
+        "mosaic-scalar-shape-cast",
+        Severity.ERROR,
+        "the kernel body collapses a loaded (1, 1) float vector to a "
+        "scalar (jnp.reshape(x, ()) / x[0, 0] on a loaded block); "
+        "Mosaic rejects the vector<1x1> -> scalar shape_cast — keep a "
+        "(1, lanes) row and broadcast instead (the scale-plane idiom)",
+    ),
+    "MC003": (
+        "mosaic-subbyte-broadcast",
+        Severity.ERROR,
+        "the kernel body broadcasts a sub-byte (4-bit) vector; this "
+        "Mosaic backend has no layout for sub-byte broadcasts — widen "
+        "to int8 before broadcasting",
+    ),
 }
 
 
@@ -112,6 +167,7 @@ class Finding:
 
     def to_json(self) -> dict:
         return {
+            "schema_version": SCHEMA_VERSION,
             "rule": self.rule,
             "slug": self.slug,
             "severity": self.severity.name.lower(),
@@ -122,6 +178,15 @@ class Finding:
             "phase": self.phase,
             "message": self.message,
         }
+
+
+def rule_counts(findings) -> dict:
+    """Per-rule finding counts (every catalog rule, zero included) —
+    the ``--json`` summary object's payload."""
+    counts = {rule: 0 for rule in RULES}
+    for f in findings:
+        counts[f.rule] += 1
+    return counts
 
 
 def worst(findings) -> Severity | None:

@@ -20,9 +20,16 @@ from __future__ import annotations
 import numpy as np
 
 from triton_distributed_tpu import lang
+from triton_distributed_tpu.lang import wire as wirelib
 from triton_distributed_tpu.lang.launch import LaunchSpec
 
 _F32 = np.dtype(np.float32)
+
+
+def _f8():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.float8_e4m3fn)
 
 
 def _spec(kernel, name, out_shapes=(), scratch=(), collective_id=None,
@@ -216,6 +223,253 @@ def vmem_overcommit(axis="x"):
             collective_id=None,
             vmem_limit_bytes=16 * 1024,   # 16 KiB budget vs ~40 KiB set
         ),
+        lambda n: [((8, 128), _F32)],
+    )
+
+
+def skipped_chunk(axis="x"):
+    """An AG ring one hop SHORT (``range(n - 2)`` instead of
+    ``n - 1``): every semaphore balances — each step is a matched
+    start/wait pair — but each rank terminates missing exactly one
+    source's chunk. Undetectable by the protocol rules by construction;
+    SL008 against the declared gather contract."""
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem):
+        import jax
+        from jax.experimental import pallas as pl
+
+        me = lang.my_pe(axis)
+        n = lang.n_pes(axis)
+        m = x_ref.shape[0]
+
+        out_ref[pl.ds(me * m, m)] = x_ref[:]
+        lang.barrier_all(axis)
+        for s in range(n - 2):             # BUG: one ring hop short
+            src = jax.lax.rem(me + n - s, n) if s > 0 else me
+            dma = lang.remote_copy(
+                out_ref.at[pl.ds(src * m, m)],
+                out_ref.at[pl.ds(src * m, m)],
+                send_sem.at[s], recv_sem.at[s], (me + 1) % n,
+            )
+            dma.start()
+            dma.wait()
+
+    return (
+        _spec(
+            kernel, "fixture_skipped_chunk",
+            out_shapes=[((8 * 8, 128), _F32)],
+            scratch=_sems((8,), (8,)),
+            collective_id=46,
+        ),
+        lambda n: [((8, 128), _F32)],
+        DeliveryContract(kind="gather", dst="out_ref"),
+    )
+
+
+def dup_chunk(axis="x"):
+    """A correct LL-push allgather followed by rank 0 RE-delivering its
+    shard into slot 1 on every peer — the duplicate overwrites source
+    1's chunk. Every semaphore balances (the dup arrivals are waited),
+    every landing is barrier-ordered; the data is still wrong: source 0
+    held twice, source 1 lost. SL008."""
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem, dsend_sem, drecv_sem):
+        from jax.experimental import pallas as pl
+
+        me = lang.my_pe(axis)
+        n = lang.n_pes(axis)
+        m = x_ref.shape[0]
+
+        out_ref[pl.ds(me * m, m)] = x_ref[:]
+        lang.barrier_all(axis)
+        handles = []
+        for i in range(n - 1):
+            peer = (me + 1 + i) % n
+            handles.append(lang.putmem_signal_nbi_block(
+                out_ref.at[pl.ds(me * m, m)], x_ref,
+                send_sem.at[i], recv_sem.at[i], peer,
+            ))
+        lang.quiet(*handles)
+        for h in handles:
+            h.wait_recv()
+        lang.barrier_all(axis)
+        if me == 0:
+            # BUG: shard 0 delivered AGAIN, into slot 1, on every peer
+            dups = [
+                lang.putmem_signal_nbi_block(
+                    out_ref.at[pl.ds(1 * m, m)], x_ref,
+                    dsend_sem.at[i], drecv_sem.at[i], i + 1,
+                )
+                for i in range(n - 1)
+            ]
+            lang.quiet(*dups)
+        else:
+            lang.signal_wait_until(drecv_sem.at[me - 1], 1)
+
+    return (
+        _spec(
+            kernel, "fixture_dup_chunk",
+            out_shapes=[((8 * 8, 128), _F32)],
+            scratch=_sems((8,), (8,), (8,), (8,)),
+            collective_id=47,
+        ),
+        lambda n: [((8, 128), _F32)],
+        DeliveryContract(kind="gather", dst="out_ref"),
+    )
+
+
+def scale_on_payload_sem(axis="x"):
+    """A quantized one-hop wire whose scale rail is signaled on the
+    PAYLOAD's recv semaphore. The credits balance (the receiver waits
+    twice), but credits count — they don't tag: the payload wait can be
+    released by the scale arrival while the 1-byte slab is still in
+    flight. SL009."""
+
+    def kernel(x_ref, xq_ref, xs_ref, out_ref, outq_ref, outs_ref,
+               send_sem, recv_sem, s_send_sem):
+        me = lang.my_pe(axis)
+        n = lang.n_pes(axis)
+
+        lang.barrier_all(axis)
+        peer = (me + 1) % n
+        dq = lang.remote_copy(
+            xq_ref, outq_ref, send_sem.at[0], recv_sem.at[0], peer
+        )
+        # BUG: the scale rail rides the payload's recv semaphore
+        dsc = lang.remote_copy(
+            xs_ref, outs_ref, s_send_sem.at[0], recv_sem.at[0], peer
+        )
+        dq.start()
+        dsc.start()
+        dq.wait()
+        dsc.wait_send()
+        lang.signal_wait_until(recv_sem.at[0], 1)   # the second credit
+        wirelib.dequant_rows_into(out_ref, outq_ref, outs_ref)
+
+    return (
+        _spec(
+            kernel, "fixture_scale_on_payload_sem",
+            out_shapes=[((8, 2048), _F32), ((8, 2048), _f8()),
+                        ((8, 128), _F32)],
+            scratch=_sems((1,), (1,), (1,)),
+            collective_id=48,
+        ),
+        lambda n: [((8, 2048), _F32), ((8, 2048), _f8()),
+                   ((8, 128), _F32)],
+        None,
+    )
+
+
+def stale_scale(axis="x"):
+    """Two correctly-railed quantized hops into a double-buffered
+    workspace; the receiver then dequantizes slot 0's payload with slot
+    1's scale plane. Protocol-clean, rails paired, values silently
+    wrong. SL010."""
+
+    def kernel(x_ref, out_ref, qbuf_ref, sbuf_ref, recvq_ref, recvs_ref,
+               send_sem, recv_sem, s_send_sem, s_recv_sem):
+        me = lang.my_pe(axis)
+        n = lang.n_pes(axis)
+
+        lang.barrier_all(axis)
+        peer = (me + 1) % n
+        for slot in range(2):
+            wirelib.quant_rows_into(
+                qbuf_ref.at[slot], sbuf_ref.at[slot], x_ref, "fp8"
+            )
+            dq = lang.remote_copy(
+                qbuf_ref.at[slot], recvq_ref.at[slot],
+                send_sem.at[slot], recv_sem.at[slot], peer,
+            )
+            dsc = lang.remote_copy(
+                sbuf_ref.at[slot], recvs_ref.at[slot],
+                s_send_sem.at[slot], s_recv_sem.at[slot], peer,
+            )
+            dq.start()
+            dsc.start()
+            dq.wait()
+            dsc.wait()
+        # BUG: slot 0's bytes, slot 1's scales
+        wirelib.dequant_rows_into(
+            out_ref, recvq_ref.at[0], recvs_ref.at[1]
+        )
+
+    from jax.experimental.pallas import tpu as pltpu
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    f8 = jnp.dtype(ml_dtypes.float8_e4m3fn)
+    return (
+        _spec(
+            kernel, "fixture_stale_scale",
+            out_shapes=[((8, 2048), _F32)],
+            scratch=[
+                pltpu.VMEM((2, 8, 2048), f8),            # qbuf
+                pltpu.VMEM((2, 8, 128), jnp.float32),    # sbuf
+                pltpu.VMEM((2, 8, 2048), f8),            # recvq
+                pltpu.VMEM((2, 8, 128), jnp.float32),    # recvs
+            ] + _sems((2,), (2,), (2,), (2,)),
+            collective_id=49,
+        ),
+        lambda n: [((8, 2048), _F32)],
+        None,
+    )
+
+
+# ------------------------------------------------ Mosaic-compat fixtures
+#
+# These are consumed by analysis.mosaic_compat.preflight_spec (real jax
+# tracing, not the abstract evaluator): each kernel contains exactly one
+# construct this toolchain's Mosaic backend rejects.
+
+def f8_inkernel_cast(axis="x"):
+    """arith.extf f8E4M3FN → f32 inside the kernel ('Only 16-bit to
+    32-bit extensions supported'). MC001."""
+
+    def kernel(xq_ref, out_ref):
+        import jax.numpy as jnp
+
+        out_ref[...] = xq_ref[...].astype(jnp.float32) * 2.0
+
+    return (
+        _spec(kernel, "fixture_f8_cast", out_shapes=[((8, 128), _F32)]),
+        lambda n: [((8, 128), _f8())],
+    )
+
+
+def scalar_shape_cast(axis="x"):
+    """A loaded (1, 1) float block collapsed to a scalar — the
+    vector<1x1> → scalar shape_cast Mosaic rejects. MC002."""
+
+    def kernel(x_ref, out_ref):
+        import jax.numpy as jnp
+
+        blk = x_ref[...]
+        s = jnp.reshape(blk[0:1, 0:1], ())    # BUG: scalar shape_cast
+        out_ref[...] = blk * s
+
+    return (
+        _spec(kernel, "fixture_scalar_cast", out_shapes=[((8, 128), _F32)]),
+        lambda n: [((8, 128), _F32)],
+    )
+
+
+def subbyte_broadcast(axis="x"):
+    """An int4 vector broadcast — no sub-byte broadcast layout in this
+    Mosaic backend. MC003."""
+
+    def kernel(x_ref, out_ref):
+        import jax.numpy as jnp
+
+        nib = jnp.broadcast_to(
+            jnp.zeros((1, 1), jnp.int4), x_ref.shape
+        )
+        out_ref[...] = x_ref[...] + nib.astype(jnp.float32)
+
+    return (
+        _spec(kernel, "fixture_subbyte", out_shapes=[((8, 128), _F32)]),
         lambda n: [((8, 128), _F32)],
     )
 

@@ -47,19 +47,22 @@ def lint_mesh(n: int = 8, axis: str = "x"):
 
 
 def analyze_spec(spec, in_shapes, n, *, kernel_name, site=None, init=None,
-                 axis="x", mesh_axes=("x",)):
+                 axis="x", mesh_axes=("x",), contract=None):
     """Symbolically execute one captured/hand-built LaunchSpec and run
-    the checker passes. Returns (recorder, findings)."""
+    the checker passes — protocol (SL001–SL007), wire-rail consistency
+    (SL009/SL010), and, when a ``contract`` is given, delivery
+    completeness (SL008). Returns (recorder, findings)."""
     rec = abstract.run_symbolic(
         spec, in_shapes, n, axis=axis, mesh_axes=mesh_axes, init=init,
         kernel_name=kernel_name, site=site,
     )
-    return rec, checks.check_family(rec)
+    return rec, checks.check_family(rec, contract=contract)
 
 
 def analyze_family(fam, n: int = 8, mesh=None):
     """Build one registry family over an abstract mesh, read back the
-    captured LaunchSpec, and analyze it. Returns (recorder, findings)."""
+    captured LaunchSpec, and analyze it (the family's declared delivery
+    contract drives the SL008 pass). Returns (recorder, findings)."""
     from triton_distributed_tpu.lang.launch import captured_launch
 
     mesh = mesh if mesh is not None else lint_mesh(n, fam.axis)
@@ -75,6 +78,7 @@ def analyze_family(fam, n: int = 8, mesh=None):
         kernel_name=fam.name, site=fam.site,
         init=fam.init(n) if fam.init else None,
         axis=fam.axis, mesh_axes=fam.mesh_axes,
+        contract=fam.contract,
     )
 
 
@@ -167,7 +171,14 @@ def main(argv=None) -> int:
                     metavar="RULE",
                     help="demote RULE (e.g. SL007) to info severity")
     ap.add_argument("--json", action="store_true",
-                    help="one JSON object per finding on stdout")
+                    help="one JSON object per line on stdout: a "
+                    "schema_version header, each finding, and a "
+                    "rule_counts summary")
+    ap.add_argument("--mosaic", action="store_true",
+                    help="also run the Mosaic-compat pre-flight (rules "
+                    "MC001-MC003: trace each family's kernel jaxpr and "
+                    "scan for constructs this toolchain's Mosaic "
+                    "rejects)")
     ap.add_argument("--list", action="store_true",
                     help="list registered kernel families and exit")
     args = ap.parse_args(argv)
@@ -183,18 +194,47 @@ def main(argv=None) -> int:
         return 0
 
     findings = lint_all(n=args.mesh, kernels=args.kernel, allow=args.allow)
+    if args.mosaic:
+        from triton_distributed_tpu.analysis import mosaic_compat
+
+        mc, report = mosaic_compat.preflight_all(
+            n=args.mesh, kernels=args.kernel
+        )
+        findings += _apply_allow(mc, args.allow)
+        if not args.json:
+            print(
+                "mosaic-compat: "
+                f"{len(report['scanned'])} scanned, "
+                f"{len(report['refused'])} refused cleanly "
+                f"({sorted(report['refused'])})",
+                file=sys.stderr,
+            )
     checked = sorted(
         name for name in families()
         if not args.kernel or any(k in name for k in args.kernel)
     )
+    errs = sum(f.severity >= Severity.ERROR for f in findings)
+    warns = sum(f.severity == Severity.WARNING for f in findings)
     if args.json:
+        from triton_distributed_tpu.analysis.findings import (
+            SCHEMA_VERSION,
+            rule_counts,
+        )
+
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION, "mesh": args.mesh,
+            "families": checked,
+        }))
         for f in findings:
             print(json.dumps(f.to_json()))
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "rule_counts": rule_counts(findings),
+            "errors": errs, "warnings": warns,
+        }))
     else:
         for f in sorted(findings, key=lambda f: -f.severity):
             print(f.format())
-        errs = sum(f.severity >= Severity.ERROR for f in findings)
-        warns = sum(f.severity == Severity.WARNING for f in findings)
         print(
             f"shmemlint: {len(checked)} kernel families on a "
             f"{args.mesh}-rank mesh: {errs} error(s), {warns} warning(s)",

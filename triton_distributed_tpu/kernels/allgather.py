@@ -116,11 +116,11 @@ def _ring_ag_kernel_w(
         # source, i.e. shard (me-1-s) — dequantize it for the caller
         # (the wire copy stays resident for the next forward)
         arr = jax.lax.rem(me + 2 * n - 1 - s, n)
-        q = outq_ref[pl.ds(arr * m, m)]
-        sc = outs_ref[pl.ds(arr * m, m), pl.ds(0, 1)]
-        out_ref[pl.ds(arr * m, m)] = (
-            q.astype(jnp.float32) * sc
-        ).astype(out_ref.dtype)
+        wirelib.dequant_rows_into(
+            out_ref.at[pl.ds(arr * m, m)],
+            outq_ref.at[pl.ds(arr * m, m)],
+            outs_ref.at[pl.ds(arr * m, m)],
+        )
 
 
 def _ring_bidir_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):

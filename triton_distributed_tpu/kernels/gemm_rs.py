@@ -72,7 +72,11 @@ class GemmRSMethod(enum.Enum):
 def ew_add_pipeline(m, n, itemsize):
     """Tiled elementwise-add pipeline over HBM refs: dst = a + b.
     Blocks stream through VMEM double-buffered; used to fold a received
-    ring partial into the locally computed one."""
+    ring partial into the locally computed one. Under an active
+    shmemlint recorder the fold is recorded as an AddEvent — the
+    provenance edge the SL008 reduce-contract pass accumulates — and
+    the value-level pipeline is skipped (evaluator pipelines only ever
+    recorded access hulls)."""
     from triton_distributed_tpu.config import compiling_for_tpu
 
     bm = _divisor_block(m, 512, 8 * (4 // itemsize), compiling_for_tpu())
@@ -82,9 +86,23 @@ def ew_add_pipeline(m, n, itemsize):
         o_ref[...] = a_ref[...] + b_ref[...]
 
     spec = pl.BlockSpec((bm, bn), lambda i, j: (i, j))
-    return pltpu.emit_pipeline(
+    pipe = pltpu.emit_pipeline(
         inner, grid=(m // bm, n // bn), in_specs=[spec, spec], out_specs=[spec]
     )
+
+    def run(a_hbm, b_hbm, o_hbm):
+        from triton_distributed_tpu.analysis import events
+
+        rec = events.active_recorder()
+        if rec is not None:
+            rec.emit(events.AddEvent(
+                a_region=a_hbm.region(), b_region=b_hbm.region(),
+                dst_region=o_hbm.region(),
+            ))
+            return
+        pipe(a_hbm, b_hbm, o_hbm)
+
+    return run
 
 
 def _fused_kernel(

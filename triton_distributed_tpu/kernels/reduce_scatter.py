@@ -114,7 +114,6 @@ def _ring_rs_kernel_w(
     rewrite a recv slot its receiver hasn't folded)."""
     me = lang.my_pe(axis)
     m = out_ref.shape[0]
-    qmax = 448.0 if quant == "fp8" else 127.0
     left, right = ring_neighbors(me, n)
     left = lang.pe_flat(axis, left, mesh_axes)
     right = lang.pe_flat(axis, right, mesh_axes)
@@ -127,16 +126,7 @@ def _ring_rs_kernel_w(
         if s >= 2:
             pltpu.semaphore_wait(ack_sem, 1)
         # per-row symmetric quantization of the outgoing partial
-        af = acc_ref[:].astype(jnp.float32)
-        amax = jnp.max(jnp.abs(af), axis=1, keepdims=True)
-        scale = jnp.maximum(amax, 1e-12) / qmax
-        q = af / scale
-        if quant == "int8":
-            q = jnp.clip(jnp.round(q), -127, 127)
-        qbuf_ref[:] = q.astype(qbuf_ref.dtype)
-        sbuf_ref[:] = jnp.broadcast_to(
-            scale, (m, wirelib.SCALE_LANES)
-        ).astype(jnp.float32)
+        wirelib.quant_rows_into(qbuf_ref, sbuf_ref, acc_ref, quant)
         dma_q = lang.remote_copy(
             qbuf_ref, recvq_ref.at[s % 2],
             send_sem.at[s % 2], recv_sem.at[s % 2], left,
@@ -148,14 +138,12 @@ def _ring_rs_kernel_w(
         dma_q.start()
         dma_s.start()
         nxt = jax.lax.rem(me + 2 + s, n)
-        partial = x_ref[pl.ds(nxt * m, m)]
         dma_q.wait()   # send drained (qbuf reusable) + arrival landed
         dma_s.wait()
-        acc_ref[:] = (
-            recvq_ref[s % 2].astype(jnp.float32)
-            * recvs_ref[s % 2, :, pl.ds(0, 1)]
-            + partial.astype(jnp.float32)
-        ).astype(acc_ref.dtype)
+        wirelib.dequant_add_rows_into(
+            acc_ref, recvq_ref.at[s % 2], recvs_ref.at[s % 2],
+            x_ref.at[pl.ds(nxt * m, m)],
+        )
         lang.signal_op(ack_sem, 1, pe=right)
 
     out_ref[:] = acc_ref[:]

@@ -103,6 +103,14 @@ class KernelFamily:
     pairs; ``init(n)`` optionally seeds ref contents by name or
     positional index (count-carrying protocols need representative
     values to steer their receive loops).
+
+    ``contract`` declares the family's DELIVERY contract (gather /
+    reduce / all-to-all permutation — see ``analysis.dataflow.
+    DeliveryContract``): what the destination buffer must provably hold
+    at termination. The SL008 pass is driven entirely by this table —
+    a family with no contract still gets the protocol and wire-rail
+    passes, but delivery completeness is only as strong as what is
+    declared here.
     """
 
     name: str
@@ -113,6 +121,7 @@ class KernelFamily:
     init: callable = None
     axis: str = "x"
     mesh_axes: tuple = ("x",)
+    contract: object = None
 
 
 _F32 = np.dtype(np.float32)
@@ -382,51 +391,78 @@ def _moe_init(know_recv):
     return init
 
 
-#: every analyzable kernel family, keyed by registry name.
+#: every analyzable kernel family, keyed by registry name. Each family
+#: declares its DELIVERY contract (the SL008 table): what the kernel
+#: must provably have delivered when every semaphore has balanced.
 def families() -> dict:
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
     from triton_distributed_tpu.runtime import AllGatherMethod
+
+    def gather(dst, **kw):
+        return DeliveryContract(kind="gather", dst=dst, **kw)
+
+    def reduce(dst, **kw):
+        return DeliveryContract(kind="reduce", dst=dst, **kw)
+
+    #: the chunked MoE a2a is capacity-padded: with the seeded routing
+    #: (1 chunk per peer) each source delivers exactly one chunk of
+    #: chunk_u·a rows into its slot; the rest of the slot stays empty.
+    _g = _MOE_GEOM
+    moe_contract = DeliveryContract(
+        kind="permute", dst=6,        # dst_tok, behind the *refs splat
+        payload_per_src=lambda n: _g["chunk_u"] * _g["a"] * _g["hidden"],
+        full=False,
+    )
 
     fams = [
         KernelFamily(
             "allgather.ring_1d", "allgather", "ag_ring_1d",
             _ag(AllGatherMethod.RING_1D),
             lambda n: [((8, 128), _F32)],
+            contract=gather("out_ref"),
         ),
         KernelFamily(
             "allgather.ring_bidir", "allgather", "ag_ring_bidir",
             _ag(AllGatherMethod.RING_BIDIR),
             lambda n: [((8, 128), _F32)],
+            contract=gather("out_ref"),
         ),
         KernelFamily(
             "allgather.ll_small", "allgather", "ag_ll_small",
             _ag(AllGatherMethod.LL_SMALL),
             lambda n: [((8, 128), _F32)],
+            contract=gather("out_ref"),
         ),
         KernelFamily(
             "allgather.ll_persist", "allgather", "ag_ll_persist",
             _ag_ll_persist,
             lambda n: [((1,), _I32), ((8, 128), _F32),
                        ((2 * n * 8, 128), _F32)],
+            contract=gather("out_ref"),
         ),
         KernelFamily(
             "reduce_scatter.ring", "reduce_scatter", "rs_ring",
             _rs_ring,
             lambda n: [((8 * n, 128), _F32)],
+            contract=reduce("out_ref"),
         ),
         KernelFamily(
             "reduce_scatter.stream", "reduce_scatter", "rs_ring_stream",
             _rs_stream,
             lambda n: [((8 * n, 128), _F32)],
+            contract=reduce("out_hbm"),
         ),
         KernelFamily(
             "all_to_all.dense", "all_to_all", "a2a_dense",
             _a2a,
             lambda n: [((8 * n, 128), _F32)],
+            contract=DeliveryContract(kind="permute", dst="out_ref"),
         ),
         KernelFamily(
             "ag_gemm.fused", "ag_gemm", "ag_gemm_fused",
             _ag_gemm,
             lambda n: [((16, 128), _F32), ((128, 64), _F32)],
+            contract=gather("ag_hbm"),
         ),
         KernelFamily(
             # quantized-wire twin: payload rides as fp8 + a per-chunk f32
@@ -436,6 +472,7 @@ def families() -> dict:
             lambda mesh, n, token: _ag_gemm(mesh, n, token, wire="fp8"),
             lambda n: [((16, 128), _F32), ((16, 128), _f8()),
                        ((1, 128), _F32), ((128, 64), _F32)],
+            contract=gather("ag_hbm"),
         ),
         KernelFamily(
             "gemm_rs.fused", "gemm_rs", "gemm_rs_fused",
@@ -443,54 +480,66 @@ def families() -> dict:
             # A rows are unsharded (each device holds all M rows of its
             # K-column shard); B is row-sharded
             lambda n: [((16 * n, 128), _F32), ((128, 64), _F32)],
+            contract=reduce("out_hbm"),
         ),
         KernelFamily(
             "gemm_rs.fused_fp8w", "gemm_rs", "gemm_rs_fused_fp8w",
             lambda mesh, n, token: _gemm_rs(mesh, n, token, wire="fp8"),
             lambda n: [((16 * n, 128), _F32), ((128, 64), _F32)],
+            contract=reduce("out_hbm"),
         ),
         KernelFamily(
             "allgather.ring_1d_fp8w", "allgather", "ag_ring_1d_fp8w",
             _ag_ring_w,
             lambda n: [((8, 2048), _F32), ((8, 2048), _f8()),
                        ((8, 128), _F32)],
+            contract=gather("out_ref"),
         ),
         KernelFamily(
             "reduce_scatter.ring_fp8w", "reduce_scatter", "rs_ring_fp8w",
             _rs_ring_w,
             lambda n: [((8 * n, 2048), _F32)],
+            contract=reduce("out_ref"),
         ),
         KernelFamily(
             "moe_tp.ag_group_gemm", "moe_tp", "ag_group_gemm_fused",
             _moe_ag_gg(None),
             _moe_ag_gg_shapes(None),
+            # no local-slab publish: slab `me` is consumed straight from
+            # the sorted input and legitimately absent from the workspace
+            contract=gather("ag_hbm", own_absent_ok=True),
         ),
         KernelFamily(
             "moe_tp.ag_group_gemm_fp8w", "moe_tp", "ag_group_gemm_fused_fp8w",
             _moe_ag_gg("fp8"),
             _moe_ag_gg_shapes("fp8"),
+            contract=gather("ag_hbm", own_absent_ok=True),
         ),
         KernelFamily(
             "moe_tp.reduce_rs", "moe_tp", "moe_reduce_rs_fused",
             _moe_rs(None),
             _moe_rs_shapes,
+            contract=reduce("out_hbm"),
         ),
         KernelFamily(
             "moe_tp.reduce_rs_fp8w", "moe_tp", "moe_reduce_rs_fused_fp8w",
             _moe_rs("fp8"),
             _moe_rs_shapes,
+            contract=reduce("out_hbm"),
         ),
         KernelFamily(
             "moe_dispatch.a2a", "moe_dispatch", "moe_chunked_a2a",
             _moe_a2a(False, 10),
             _moe_in_shapes,
             init=_moe_init(False),
+            contract=moe_contract,
         ),
         KernelFamily(
             "moe_combine.a2a", "moe_dispatch", "moe_chunked_a2a",
             _moe_a2a(True, 11),
             _moe_in_shapes,
             init=_moe_init(True),
+            contract=moe_contract,
         ),
     ]
     return {f.name: f for f in fams}

@@ -56,6 +56,34 @@ _WDT = {"fp8": jnp.float8_e4m3fn, "int8": jnp.int8}
 SCALE_LANES = 128
 
 
+def _lint_recorder():
+    """The active shmemlint recorder, or None (the overwhelmingly
+    common case). The wire transforms are hookable the same way the
+    ``lang.shmem`` primitives are: under symbolic execution they emit
+    Quant/Dequant events carrying their ref regions (the provenance
+    edges the SL008–SL010 data-correctness passes replay) instead of
+    running the value-level pipelines."""
+    from triton_distributed_tpu.analysis import events
+
+    return events.active_recorder()
+
+
+def paired_scale_ok(q_rows: int, s_shape: tuple) -> bool:
+    """THE wire layout contract, exported for the static checker: a
+    payload slab of ``q_rows`` rows pairs with an ``(s_rows,
+    SCALE_LANES)`` f32 scale plane whose rows evenly chunk the payload
+    (chunk_rows = q_rows / s_rows). shmemlint's SL009 validates every
+    payload/scale RDMA pair against this instead of re-deriving layout
+    from kernel internals."""
+    if len(s_shape) != 2:
+        return False
+    s_rows, s_cols = s_shape
+    return (
+        s_cols == SCALE_LANES and s_rows > 0 and q_rows > 0
+        and q_rows % s_rows == 0
+    )
+
+
 def normalize_wire(wire_dtype) -> str | None:
     """Canonical wire spelling: None for raw bf16 wire, 'fp8'/'int8'
     for compressed, 'auto' passed through for the selectors."""
@@ -222,6 +250,15 @@ def quant_pipeline(rows: int, cols: int, fmt: WireFormat):
     )
 
     def run(src_hbm, q_hbm, s_hbm):
+        rec = _lint_recorder()
+        if rec is not None:
+            from triton_distributed_tpu.analysis import events as ev
+
+            rec.emit(ev.QuantEvent(
+                src_region=src_hbm.region(), q_region=q_hbm.region(),
+                s_region=s_hbm.region(), chunk_rows=fmt.chunk_rows,
+            ))
+            return
         scale_pipe(src_hbm, s_hbm)
         quant_pipe(src_hbm, s_hbm, q_hbm)
 
@@ -243,7 +280,7 @@ def dequant_pipeline(rows: int, cols: int, fmt: WireFormat):
             q_ref[...].astype(jnp.float32) * s_ref[:, :bn]
         ).astype(o_ref.dtype)
 
-    return pltpu.emit_pipeline(
+    pipe = pltpu.emit_pipeline(
         inner,
         grid=(ch, cols // bn),
         in_specs=[
@@ -252,6 +289,20 @@ def dequant_pipeline(rows: int, cols: int, fmt: WireFormat):
         ],
         out_specs=[pl.BlockSpec((fmt.chunk_rows, bn), lambda i, j: (i, j))],
     )
+
+    def run(q_hbm, s_hbm, dst_hbm):
+        rec = _lint_recorder()
+        if rec is not None:
+            from triton_distributed_tpu.analysis import events as ev
+
+            rec.emit(ev.DequantEvent(
+                q_region=q_hbm.region(), s_region=s_hbm.region(),
+                dst_region=dst_hbm.region(),
+            ))
+            return
+        pipe(q_hbm, s_hbm, dst_hbm)
+
+    return run
 
 
 def dequant_add_pipeline(rows: int, cols: int, fmt: WireFormat):
@@ -273,7 +324,7 @@ def dequant_add_pipeline(rows: int, cols: int, fmt: WireFormat):
         ).astype(o_ref.dtype)
 
     spec = pl.BlockSpec((fmt.chunk_rows, bn), lambda i, j: (i, j))
-    return pltpu.emit_pipeline(
+    pipe = pltpu.emit_pipeline(
         inner,
         grid=(ch, cols // bn),
         in_specs=[
@@ -283,6 +334,94 @@ def dequant_add_pipeline(rows: int, cols: int, fmt: WireFormat):
         ],
         out_specs=[pl.BlockSpec((fmt.chunk_rows, bn), lambda i, j: (i, j))],
     )
+
+    def run(a_hbm, q_hbm, s_hbm, dst_hbm):
+        rec = _lint_recorder()
+        if rec is not None:
+            from triton_distributed_tpu.analysis import events as ev
+
+            rec.emit(ev.DequantEvent(
+                q_region=q_hbm.region(), s_region=s_hbm.region(),
+                dst_region=dst_hbm.region(), add_region=a_hbm.region(),
+            ))
+            return
+        pipe(a_hbm, q_hbm, s_hbm, dst_hbm)
+
+    return run
+
+
+# ------------------------------------------------- VMEM-resident helpers
+#
+# The standalone ring kernels (allgather._ring_ag_kernel_w,
+# reduce_scatter._ring_rs_kernel_w) keep whole slabs VMEM-resident and
+# (de)quantize with direct ref arithmetic rather than streamed
+# pipelines. Routing that arithmetic through these helpers keeps ONE
+# implementation of the per-row wire math and gives shmemlint the same
+# Quant/Dequant provenance events the pipelines emit.
+
+def quant_rows_into(q_ref, s_ref, src_ref, quant: str):
+    """Per-row symmetric quantization (chunk_rows=1) of a VMEM slab:
+    ``q = src / scale``, ``s`` the lane-replicated f32 scale plane."""
+    rec = _lint_recorder()
+    if rec is not None:
+        from triton_distributed_tpu.analysis import events as ev
+
+        rec.emit(ev.QuantEvent(
+            src_region=src_ref.region(), q_region=q_ref.region(),
+            s_region=s_ref.region(), chunk_rows=1,
+        ))
+        return
+    af = src_ref[...].astype(jnp.float32)
+    amax = jnp.max(jnp.abs(af), axis=1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / _QMAX[quant]
+    q = af / scale
+    if quant == "int8":
+        q = jnp.clip(jnp.round(q), -127, 127)
+    q_ref[...] = q.astype(q_ref.dtype)
+    s_ref[...] = jnp.broadcast_to(
+        scale, (af.shape[0], SCALE_LANES)
+    ).astype(jnp.float32)
+
+
+def dequant_rows_into(dst_ref, q_ref, s_ref):
+    """Per-row dequant of a VMEM slab: ``dst = q · s[:, :1]`` (the
+    scale is lane-replicated, column 0 suffices)."""
+    from jax.experimental import pallas as pl
+
+    rec = _lint_recorder()
+    if rec is not None:
+        from triton_distributed_tpu.analysis import events as ev
+
+        rec.emit(ev.DequantEvent(
+            q_region=q_ref.region(), s_region=s_ref.region(),
+            dst_region=dst_ref.region(),
+        ))
+        return
+    sc = s_ref[:, pl.ds(0, 1)]
+    dst_ref[...] = (
+        q_ref[...].astype(jnp.float32) * sc
+    ).astype(dst_ref.dtype)
+
+
+def dequant_add_rows_into(dst_ref, q_ref, s_ref, add_ref):
+    """Fused per-row dequant-accumulate: ``dst = add + q · s[:, :1]``
+    in f32 (the RS-ring fold — one rounding per hop)."""
+    from jax.experimental import pallas as pl
+
+    rec = _lint_recorder()
+    if rec is not None:
+        from triton_distributed_tpu.analysis import events as ev
+
+        rec.emit(ev.DequantEvent(
+            q_region=q_ref.region(), s_region=s_ref.region(),
+            dst_region=dst_ref.region(), add_region=add_ref.region(),
+        ))
+        return
+    sc = s_ref[:, pl.ds(0, 1)]
+    dst_ref[...] = (
+        q_ref[...].astype(jnp.float32) * sc
+        + add_ref[...].astype(jnp.float32)
+    ).astype(dst_ref.dtype)
 
 
 def inkernel_wire_ok(quant: str) -> bool:
