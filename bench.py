@@ -235,7 +235,7 @@ def _parse_args(argv=None):
     ap.add_argument(
         "--lint", action="store_true",
         help="run shmemlint (protocol SL001-007, delivery/wire dataflow "
-        "SL008-010) plus the Mosaic-compat pre-flight (MC001-003) over "
+        "SL008-010) plus the Mosaic-compat pre-flight (MC001-004) over "
         "the benched kernel families BEFORE any timing; abort (exit 2) "
         "on errors so a broken protocol — or a kernel Mosaic would "
         "reject mid-run — fails in seconds instead of hanging the "
@@ -547,6 +547,12 @@ def _bench_wire_rings(mesh, n, on_tpu, spec):
     m_ns, k_ns, nl_ns = 8192, 8192, 28672 // tp
     slab_ns = m_ns // tp
 
+    from triton_distributed_tpu.tune.perf_model import (
+        dequant_pass_ms,
+        estimate_s8_gemm_ms,
+        int8_mxu_step_ratio,
+    )
+
     fmt = wirelib.make_wire_format("fp8", slab_cb, strict=False)
     bf16_bytes = slab_cb * k_cb * 2
     fp8_bytes = fmt.slab_bytes(slab_cb, k_cb)
@@ -563,6 +569,24 @@ def _bench_wire_rings(mesh, n, on_tpu, spec):
         ),
         "auto_pick_comm_bound": auto_wire_dtype(slab_cb, k_cb, nl_cb, 2, spec=spec),
         "auto_pick_north_star": auto_wire_dtype(slab_ns, k_ns, nl_ns, 2, spec=spec),
+        # int8→MXU (round 8): the dequant-free consumer vs
+        # dequant-then-matmul on the same int8 wire — the skipped
+        # per-arrival pass plus the s8×s8 MXU rate, per ring step
+        "auto_pick_comm_bound_wq_int8": auto_wire_dtype(
+            slab_cb, k_cb, nl_cb, 2, spec=spec, consumer_wq="int8"
+        ),
+        "auto_pick_north_star_wq_int8": auto_wire_dtype(
+            slab_ns, k_ns, nl_ns, 2, spec=spec, consumer_wq="int8"
+        ),
+        "int8_mxu_skipped_dequant_ms": round(
+            dequant_pass_ms(slab_cb, k_cb, 2, spec), 5
+        ),
+        "int8_mxu_step_ms": round(
+            estimate_s8_gemm_ms(slab_cb, k_cb, nl_cb, spec), 5
+        ),
+        "int8_mxu_vs_dequant_step_ratio": round(
+            int8_mxu_step_ratio(slab_cb, k_cb, nl_cb, spec), 3
+        ),
         "config": (
             f"comm-bound M={m_cb} K={k_cb} N/tp={nl_cb} tp={tp} "
             f"(slab {slab_cb}×{k_cb}) vs north-star M={m_ns}"
@@ -585,15 +609,24 @@ def _bench_wire_rings(mesh, n, on_tpu, spec):
         ag_gemm(a, b, mesh, "x", method=AGGemmMethod.XLA_RING), np.float32
     )
     scale = float(np.abs(ref).max()) or 1.0
-    for w in ("fp8", "int8"):
+    pair = {}
+    for w in ("fp8", "int8", "int8-mxu"):
         got = np.asarray(
             ag_gemm(a, b, mesh, "x", method=AGGemmMethod.XLA_RING,
                     wire_dtype=w),
             np.float32,
         )
-        out[f"ag_{w}_rel_err"] = round(
+        pair[w] = got
+        key = w.replace("-", "_")
+        out[f"ag_{key}_rel_err"] = round(
             float(np.abs(got - ref).max()) / scale, 5
         )
+    # the paired row the acceptance pins: epilogue-folded dequant vs
+    # the dequant-then-matmul twin on the SAME int8 wire bytes (their
+    # gap is pure weight-quantization error, bounded by ~1/127)
+    out["ag_int8_mxu_vs_dequant_delta"] = round(
+        float(np.abs(pair["int8-mxu"] - pair["int8"]).max()) / scale, 5
+    )
     a2 = jax.random.normal(jax.random.PRNGKey(23), (ma, ka), jnp.bfloat16)
     b2 = jax.random.normal(jax.random.PRNGKey(24), (ka, na), jnp.bfloat16)
     ref2 = np.asarray(
@@ -608,6 +641,64 @@ def _bench_wire_rings(mesh, n, on_tpu, spec):
         )
         out[f"rs_{w}_rel_err"] = round(
             float(np.abs(got - ref2).max()) / scale2, 5
+        )
+
+    # rs_ring_stream wire row (round 8): the standalone RS's
+    # HBM-streaming engine now carries the quantized wire; off-TPU the
+    # entry degrades to the byte-identical XLA twin, so this measures
+    # the same per-hop quantize / f32 dequant-accumulate numerics the
+    # streaming kernel ships on chip
+    from triton_distributed_tpu.kernels.reduce_scatter import (
+        reduce_scatter,
+    )
+
+    ys = jax.random.normal(
+        jax.random.PRNGKey(27), (n, 32 * n, 2048), jnp.bfloat16
+    )
+    ref_s = np.asarray(ys, np.float32).sum(0)
+    scale_s = float(np.abs(ref_s).max()) or 1.0
+    got_s = np.asarray(
+        reduce_scatter(ys, mesh, "x", stacked=True, wire_dtype="int8"),
+        np.float32,
+    )
+    out["rs_stream_int8_rel_err"] = round(
+        float(np.abs(got_s - ref_s).max()) / scale_s, 5
+    )
+
+    # DCN rail row (round 8): hierarchical ag_gemm at dcn_axis>1 — the
+    # rail legs (the slowest transport) ship the quantized payload +
+    # scale planes; measured against the raw-rail twin on a 2×(n/2)
+    # mesh (the rail machinery is link-agnostic, so the numbers are the
+    # DCN numerics even off a real multi-slice pod)
+    if n >= 4 and n % 2 == 0:
+        from jax.sharding import Mesh
+
+        mesh2 = Mesh(
+            np.asarray(mesh.devices).reshape(2, n // 2), ("rail", "x")
+        )
+        tp2, nd2 = n // 2, 2
+        md, kd, nld = 32 * tp2 * nd2, 2048, 64 * tp2 * nd2
+        ad = jax.random.normal(jax.random.PRNGKey(28), (md, kd), jnp.bfloat16)
+        bd = jax.random.normal(jax.random.PRNGKey(29), (kd, nld), jnp.bfloat16)
+        ref_d = np.asarray(
+            ag_gemm(ad, bd, mesh2, "x", dcn_axis="rail",
+                    method=AGGemmMethod.XLA_RING),
+            np.float32,
+        )
+        got_d = np.asarray(
+            ag_gemm(ad, bd, mesh2, "x", dcn_axis="rail",
+                    method=AGGemmMethod.XLA_RING, wire_dtype="fp8"),
+            np.float32,
+        )
+        out["dcn_rail_fp8_rel_err"] = round(
+            float(np.abs(got_d - ref_d).max())
+            / (float(np.abs(ref_d).max()) or 1.0),
+            5,
+        )
+        m_dev = md // (tp2 * nd2)
+        fmt_d = wirelib.make_wire_format("fp8", m_dev, strict=False)
+        out["dcn_rail_wire_reduction"] = round(
+            m_dev * kd * 2 / fmt_d.slab_bytes(m_dev, kd), 3
         )
 
     if on_tpu and n > 1:
@@ -647,6 +738,17 @@ def _bench_wire_rings(mesh, n, on_tpu, spec):
         )
         out["fused_int8_vs_bf16_ratio"] = round(ratio, 4)
         out["fused_int8_vs_bf16_iqr"] = [round(v, 4) for v in iqr]
+        # int8-mxu vs dequant-then-matmul, paired on the SAME wire: the
+        # measured counterpart of int8_mxu_vs_dequant_step_ratio above
+        mxc = _build_fused(
+            mesh, "x", (), av.shape, bv.shape, jnp.dtype(dtype),
+            jnp.dtype(dtype), 5, False, False, None, "int8-mxu",
+        )
+        _, _, ratio_mx, iqr_mx = bench_paired(
+            mk(comp), mk(mxc), (av, bv), lo=8, hi=40, reps=11
+        )
+        out["fused_int8mxu_vs_int8_ratio"] = round(ratio_mx, 4)
+        out["fused_int8mxu_vs_int8_iqr"] = [round(v, 4) for v in iqr_mx]
     return out
 
 

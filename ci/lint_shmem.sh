@@ -13,7 +13,7 @@
 #   2. the CLI over every registered kernel family on an 8-rank mesh —
 #      protocol (SL001-007) AND data correctness (SL008-010: delivery
 #      contracts, wire-rail consistency, stale-scale reads);
-#   3. the Mosaic-compat pre-flight (MC001-003): each family's kernel
+#   3. the Mosaic-compat pre-flight (MC001-004): each family's kernel
 #      jaxpr, built for hardware, scanned for constructs this
 #      toolchain's Mosaic rejects — seconds-fast compile-shaped
 #      coverage now that the full AOT suite is slow-marked.

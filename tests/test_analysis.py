@@ -286,6 +286,72 @@ class TestSeededDataflowFixtures:
         assert f.site == "fixture"
         assert len(f.ranks) == 1
 
+    def test_scale_fold_omitted_is_sl009(self):
+        """The int8→MXU consumer bug (round 8): rails correctly paired,
+        semaphores balanced, but the epilogue never folds the scale —
+        the s8×s8 product is stored unrescaled. SL009 with rank + site."""
+        rec, findings = _analyze_df_fixture(fixtures.scale_fold_omitted)
+        assert _rules(findings) == ["SL009"], [f.format() for f in findings]
+        f = findings[0]
+        assert "NO scale folded" in f.message
+        assert f.site == "fixture"
+        assert len(f.ranks) == 1
+        # every rank consumes unrescaled — one finding each
+        assert {fd.ranks[0] for fd in findings} == set(range(8))
+
+    def test_serialized_ring_is_sl011_with_projection(self):
+        """The hop-critical-path feed-in (ROADMAP PR-4 follow-on): a
+        protocol-clean, delivery-complete gather whose deepest chain
+        rides n hops instead of n-1 — flagged with the perf model's
+        projected wall-clock regression in the message."""
+        rec, findings = _analyze_df_fixture(fixtures.serialized_ring)
+        assert _rules(findings) == ["SL011"], [f.format() for f in findings]
+        f = findings[0]
+        assert "8 remote hops" in f.message and "ring-optimal <= 7" in f.message
+        assert "ms critical path" in f.message
+        assert f.site == "fixture"
+
+    def test_epilogue_consume_families_flow(self):
+        """The int8→MXU registry families record epilogue DequantEvents
+        (q + scale regions, no dst copy) and their contract destination
+        — the WIRE workspace itself — ends fully consumed: every
+        arrival flipped to DEQUANTIZED by the epilogue fold, never raw."""
+        from triton_distributed_tpu.analysis.checks import simulate
+
+        for name in ("ag_gemm.fused_int8mxw",
+                     "moe_tp.ag_group_gemm_int8mxw"):
+            fam = families()[name]
+            rec, findings = analyze_family(fam, 4)
+            assert findings == [], [f.format() for f in findings]
+            eps = [e for e in rec.events(events.DequantEvent) if e.epilogue]
+            assert eps and all(e.s_region is not None for e in eps), name
+            sim = simulate(rec)
+            st = dataflow._State(rec)
+            st.seed_inputs()
+            dataflow._replay(rec, sim, st)
+            dst = dataflow._resolve_dst(rec, fam.contract.dst)
+            for rank in range(4):
+                wire = st.get(rank, dst)["wire"]
+                assert not (wire == dataflow.QUANTIZED).any(), (name, rank)
+                assert (wire == dataflow.DEQUANTIZED).any(), (name, rank)
+
+    def test_hop_histogram_ring_depth(self):
+        """The per-element hop counters behind SL011: a clean 4-rank AG
+        ring tops out at exactly n-1 = 3 hops."""
+        from triton_distributed_tpu.analysis.checks import simulate
+
+        fam = families()["allgather.ring_1d"]
+        rec, _ = analyze_family(fam, 4)
+        sim = simulate(rec)
+        st = dataflow._State(rec)
+        st.seed_inputs()
+        dataflow._replay(rec, sim, st)
+        hist = dataflow.hop_histogram(
+            rec, st, dataflow._resolve_dst(rec, fam.contract.dst)
+        )
+        assert max(hist) == 3
+        assert dataflow._check_hop_depth(rec, st, fam.contract) == []
+
     def test_contract_on_unknown_ref_is_loud(self):
         spec, in_shapes, _ = fixtures.skipped_chunk()
         with pytest.raises(KeyError, match="no_such_buffer"):
@@ -431,8 +497,8 @@ class TestEventModel:
         removing or renumbering one is a breaking change."""
         assert set(RULES) == {
             "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007",
-            "SL008", "SL009", "SL010",
-            "MC001", "MC002", "MC003",
+            "SL008", "SL009", "SL010", "SL011",
+            "MC001", "MC002", "MC003", "MC004",
         }
 
     def test_ring_trace_targets_right_neighbor(self):
@@ -530,6 +596,32 @@ class TestWirePayloadBytes:
         expect = 3 * (rows * cols + rows * 128 * 4)   # 1-byte + scales
         assert w_bytes == expect
         assert w_bytes * 2 <= raw
+
+    def test_rs_stream_wire_under_raw_bytes(self):
+        """The HBM-streaming RS wire (round 8): per-hop quantized ring
+        slabs + per-chunk scale planes, well under half the raw f32
+        ring traffic the base streaming family ships."""
+        rec_b, f_b = analyze_family(families()["reduce_scatter.stream"], 4)
+        rec_w, f_w = analyze_family(
+            families()["reduce_scatter.stream_int8w"], 4
+        )
+        assert f_b == [] and f_w == [], (
+            [x.format() for x in f_b + f_w]
+        )
+        w = _remote_put_bytes(rec_w)
+        # lint geometry differs (128 vs 2048 cols) — compare per-element
+        b_per = _remote_put_bytes(rec_b) / (3 * 8 * 128)
+        w_per = w / (3 * 8 * 2048)
+        assert w_per * 2 <= b_per, (b_per, w_per)
+
+    def test_int8_mxu_wire_ships_compressed_and_never_dequantizes(self):
+        """The dequant-free consumer's traffic is the int8 wire layout
+        (identical rails to the dequant twin) — the difference is all on
+        the consume side, checked by the epilogue-event tests above."""
+        rec_b, _ = analyze_family(families()["ag_gemm.fused"], 4)
+        rec_w, f_w = analyze_family(families()["ag_gemm.fused_int8mxw"], 4)
+        assert f_w == [], [x.format() for x in f_w]
+        assert _remote_put_bytes(rec_w) * 2 <= _remote_put_bytes(rec_b)
 
     def test_ag_gemm_wire_bytes_match_the_layout_exactly(self):
         from triton_distributed_tpu.lang import wire as wirelib

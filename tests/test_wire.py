@@ -223,6 +223,240 @@ class TestWireOverlapEngines:
         assert all(np.isfinite(np.asarray(g)).all() for g in grads)
 
 
+# ------------------------------------------------ int8→MXU consumer wire
+
+class TestInt8MXU:
+    """ISSUE 5 acceptance: the dequant-free 'int8-mxu' wire — identical
+    int8 rails, consumed by an s8×s8→s32 matmul with the chunk·channel
+    scales folded in the accumulator epilogue. Pinned here: tolerance
+    against the dequant-then-matmul twin (incl. the outlier-slab worst
+    case), knob plumbing, the jaxpr proof that no per-arrival dequant
+    pass exists in the traced fused kernel, and the auto-selection
+    contract (int8-mxu on the comm-bound wq=int8 config, bf16 on the
+    north-star)."""
+
+    def _ab(self, m, k, n, seed):
+        a = jax.random.normal(jax.random.PRNGKey(seed), (m, k), jnp.float32)
+        b = jax.random.normal(jax.random.PRNGKey(seed + 1), (k, n), jnp.float32)
+        return a, b
+
+    def test_normalize_and_payload(self):
+        assert wirelib.normalize_wire("int8-mxu") == "int8-mxu"
+        assert wirelib.wire_payload("int8-mxu") == "int8"
+        assert wirelib.wire_payload("fp8") == "fp8"
+        assert wirelib.wire_payload(None) is None
+
+    def test_quantize_cols_roundtrip(self):
+        b = jax.random.normal(jax.random.PRNGKey(3), (256, 128), jnp.float32)
+        bq, bs = wirelib.quantize_cols(b)
+        assert bq.dtype == jnp.int8 and bs.shape == (1, 128)
+        assert _rel_err(bq.astype(jnp.float32) * bs, b) < 0.02
+
+    def test_ag_gemm_int8_mxu_accuracy(self, mesh8):
+        """Output within pinned tolerance of BOTH the exact result and
+        the dequant-then-matmul twin on the same wire (the twin gap is
+        pure per-channel weight-quant error, ≲1/127 per element)."""
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            ag_gemm,
+        )
+
+        a, b = self._ab(64, 1024, 128, 21)
+        ref = ag_gemm(a, b, mesh8, "x", method=AGGemmMethod.XLA_RING)
+        mx = ag_gemm(
+            a, b, mesh8, "x", method=AGGemmMethod.XLA_RING,
+            wire_dtype="int8-mxu",
+        )
+        twin = ag_gemm(
+            a, b, mesh8, "x", method=AGGemmMethod.XLA_RING,
+            wire_dtype="int8",
+        )
+        assert _rel_err(mx, ref) < 0.04
+        assert _rel_err(mx, np.asarray(twin)) < 0.03
+
+    def test_outlier_slab_worst_case_vs_twin(self, mesh8):
+        """One huge activation row inflates its chunk scale identically
+        for both int8 consumers — the epilogue fold must not amplify
+        the documented per-chunk-scale worst case beyond the twin's."""
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            ag_gemm,
+        )
+
+        a = np.random.default_rng(5).normal(size=(64, 1024)).astype(np.float32)
+        a[0, :] *= 1000.0                       # the outlier row
+        a = jnp.asarray(a)
+        b = jax.random.normal(jax.random.PRNGKey(6), (1024, 128), jnp.float32)
+        mx = ag_gemm(
+            a, b, mesh8, "x", method=AGGemmMethod.XLA_RING,
+            wire_dtype="int8-mxu",
+        )
+        twin = ag_gemm(
+            a, b, mesh8, "x", method=AGGemmMethod.XLA_RING,
+            wire_dtype="int8",
+        )
+        assert np.isfinite(np.asarray(mx)).all()
+        assert _rel_err(mx, np.asarray(twin)) < 0.03
+
+    def test_explicit_on_ineligible_slab_raises(self, mesh8):
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            ag_gemm,
+        )
+
+        a, b = self._ab(64, 32, 128, 23)   # scale plane eats compression
+        with pytest.raises(ValueError, match="wire"):
+            ag_gemm(
+                a, b, mesh8, "x", method=AGGemmMethod.XLA_RING,
+                wire_dtype="int8-mxu",
+            )
+
+    def test_resolve_explicit_and_auto_wq(self, mesh8):
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            resolve_ag_gemm_wire,
+        )
+
+        a, b = self._ab(64, 1024, 128, 25)
+        assert resolve_ag_gemm_wire(
+            mesh8, "x", a, b, method=AGGemmMethod.XLA_RING,
+            wire_dtype="int8-mxu",
+        ) == "int8-mxu"
+        # auto + declared int8 weight intent on a comm-bound shard
+        assert resolve_ag_gemm_wire(
+            mesh8, "x", a, b, method=AGGemmMethod.XLA_RING,
+            wire_dtype="auto", wq="int8",
+        ) == "int8-mxu"
+        # auto without the intent never silently picks int8 numerics
+        assert resolve_ag_gemm_wire(
+            mesh8, "x", a, b, method=AGGemmMethod.XLA_RING,
+            wire_dtype="auto",
+        ) in (None, "fp8")
+
+    def test_toolchain_gate_demotes_auto_and_refuses_pinned(
+        self, mesh8, monkeypatch
+    ):
+        """TDTPU_WIRE_INT8_MXU=0: auto+wq demotes to the
+        dequant-then-matmul int8 wire on the fused engine (not a
+        numerics-class switch — the caller declared int8); an explicit
+        pinned 'int8-mxu' refuses with the canonical diagnostic."""
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            resolve_ag_gemm_wire,
+        )
+
+        monkeypatch.setenv("TDTPU_WIRE_INT8_MXU", "0")
+        a, b = self._ab(64, 1024, 128, 27)
+        assert resolve_ag_gemm_wire(
+            mesh8, "x", a, b, method=AGGemmMethod.PALLAS_FUSED,
+            wire_dtype="auto", wq="int8",
+        ) == "int8"
+        with pytest.raises(ValueError, match="in-kernel s8"):
+            resolve_ag_gemm_wire(
+                mesh8, "x", a, b, method=AGGemmMethod.PALLAS_FUSED,
+                wire_dtype="int8-mxu",
+            )
+
+    def test_wire_tuner_mxu_candidates(self):
+        from triton_distributed_tpu.tune.autotuner import wire_tuner
+
+        t = wire_tuner("t", lambda *a, **k: None, mxu=True)
+        assert {"wire_dtype": "int8-mxu"} in t.configs
+        t2 = wire_tuner("t2", lambda *a, **k: None)
+        assert {"wire_dtype": "int8-mxu"} not in t2.configs
+
+    def test_perf_model_projects_the_win(self):
+        """Acceptance: the perf model projects int8→MXU as a per-step
+        win on the comm-bound bench config (skipped dequant pass + the
+        s8×s8 MXU rate), and auto picks it exactly there."""
+        from triton_distributed_tpu.tune.perf_model import (
+            TPU_SPECS,
+            auto_wire_dtype,
+            dequant_pass_ms,
+            int8_mxu_step_ratio,
+        )
+
+        spec = TPU_SPECS["v5e"]
+        assert int8_mxu_step_ratio(128, 8192, 512, spec) > 1.0
+        assert dequant_pass_ms(128, 8192, 2, spec) > 0.0
+        assert auto_wire_dtype(
+            128, 8192, 512, 2, spec=spec, consumer_wq="int8"
+        ) == "int8-mxu"
+        # the north-star prefill shard stays on the exact wire
+        assert auto_wire_dtype(
+            1024, 8192, 3584, 2, spec=spec, consumer_wq="int8"
+        ) == "bf16"
+        # no declared intent → fp8, as before
+        assert auto_wire_dtype(128, 8192, 512, 2, spec=spec) == "fp8"
+
+    def test_fused_kernel_jaxpr_has_no_dequant_pass(self):
+        """THE acceptance assertion: the traced int8-mxu fused kernel
+        contains an s8×s8→s32 dot and NO int8→float convert (the
+        signature of a per-arrival dequant pass) — the wire provably
+        ends at the MXU. The dequant twin is the positive control."""
+        from triton_distributed_tpu.analysis import mosaic_compat
+        from triton_distributed_tpu.kernels.registry import families
+
+        kjs = mosaic_compat.trace_family_kernels(
+            families()["ag_gemm.fused_int8mxw"], 4
+        )
+        assert kjs
+        casts, s8_dots = [], 0
+        for kj in kjs:
+            casts += mosaic_compat.i8_to_float_casts(kj)
+            for eqn in mosaic_compat._walk_jaxprs(kj):
+                if eqn.primitive.name != "dot_general":
+                    continue
+                dts = [str(v.aval.dtype) for v in eqn.invars[:2]]
+                if dts == ["int8", "int8"]:
+                    s8_dots += 1
+                    assert "int32" in str(eqn.outvars[0].aval.dtype)
+        assert s8_dots >= 1
+        assert casts == [], casts
+        # positive control: the grouped int8-mxu family likewise
+        kjs = mosaic_compat.trace_family_kernels(
+            families()["moe_tp.ag_group_gemm_int8mxw"], 4
+        )
+        assert all(
+            mosaic_compat.i8_to_float_casts(kj) == [] for kj in kjs
+        )
+
+    def test_mc004_flags_f32_accumulate_of_int8(self):
+        """The deny-list leg: an s8 dot asking for a float accumulator
+        is MC004 (what this Mosaic actually rejects)."""
+        import jax as _jax
+        from triton_distributed_tpu.analysis import mosaic_compat
+
+        def bad(aq, bq):
+            return jax.lax.dot_general(
+                aq, bq, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        jaxpr = _jax.make_jaxpr(bad)(
+            jnp.zeros((8, 128), jnp.int8), jnp.zeros((128, 64), jnp.int8)
+        )
+        f = mosaic_compat.scan_kernel_jaxpr(jaxpr.jaxpr, "fixture")
+        assert [x.rule for x in f] == ["MC004"]
+
+    def test_moe_tp_context_int8_mxu_builds(self, mesh8):
+        """Knob plumbing: MoETPContext(wire_dtype='int8-mxu') reaches
+        the grouped epilogue consumer's builder (the fused engines
+        themselves need the TPU-sim interpreter; their protocol twin is
+        the registry family)."""
+        from triton_distributed_tpu.kernels.moe_tp_fused import (
+            build_ag_group_gemm_call,
+            pick_gg_blocks,
+        )
+
+        blocks = pick_gg_blocks(8, 16, 128, 128, 4)
+        call = build_ag_group_gemm_call(
+            8, ("x",), "x", 16, 128, 128, 2, blocks,
+            jnp.dtype(jnp.float32), 13, wire="int8-mxu",
+        )
+        assert call is not None
+
+
 # --------------------------------------------- standalone ring wire knobs
 
 class TestStandaloneWire:
@@ -271,6 +505,159 @@ class TestStandaloneWire:
         a = reduce_scatter(y, mesh8, "x", stacked=True)
         b = reduce_scatter(y, mesh8, "x", stacked=True, wire_dtype="bf16")
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------------------------- streaming-RS wire (round 8)
+
+class TestStreamRSWire:
+    """The last bf16 leg of the standalone RS family: rs_ring_stream's
+    quantized wire. The Pallas streaming engine needs the TPU-sim
+    interpreter (its protocol twin is the reduce_scatter.stream_int8w
+    registry family in test_analysis.py); what runs on any backend here
+    is the entry routing, the builder, and the byte-identical XLA-twin
+    numerics."""
+
+    def test_stream_wire_builder_constructs(self, mesh8):
+        from triton_distributed_tpu.kernels.reduce_scatter import (
+            _build_rs_stream_w,
+        )
+
+        fn = _build_rs_stream_w(
+            mesh8, "x", 64, 2048, jnp.dtype(jnp.float32), True, 3,
+            ("test", 0), "int8",
+        )
+        assert fn is not None
+
+    def test_resolve_maps_int8_mxu_to_payload(self):
+        from triton_distributed_tpu.kernels.reduce_scatter import (
+            _resolve_rs_wire,
+        )
+
+        # a reduce ring has no MXU consumer: the epilogue wire carries
+        # its int8 payload
+        assert _resolve_rs_wire("int8-mxu", 64, 2048, 8, 4) == "int8"
+
+    @pytest.mark.parametrize("w,tol", [("fp8", 0.15), ("int8", 0.04)])
+    def test_streaming_scale_payload_accuracy(self, mesh8, w, tol):
+        """A payload sized past the VMEM ring: off-TPU the entry
+        degrades to the XLA twin carrying the same wire; the reduction
+        stays within the pinned RS tolerances."""
+        from triton_distributed_tpu.kernels.reduce_scatter import (
+            reduce_scatter,
+        )
+
+        y = jax.random.normal(
+            jax.random.PRNGKey(8), (8, 256, 2048), jnp.float32
+        )
+        ref = np.asarray(y).sum(0)
+        got = reduce_scatter(y, mesh8, "x", stacked=True, wire_dtype=w)
+        assert got.shape == ref.shape
+        assert _rel_err(got, ref) < tol
+
+
+# ------------------------------------------------ DCN rail wire (round 8)
+
+class TestDCNRailWire:
+    """The hierarchical engines' DCN rail legs — the slowest transport
+    in the system — now ship the quantized payload + scale planes
+    (runtime.multislice.dcn_wire_*). The rail machinery is
+    link-agnostic, so the 2×4 CPU mesh exercises the exact multi-slice
+    numerics."""
+
+    def _ab(self, m, k, n, seed):
+        a = jax.random.normal(jax.random.PRNGKey(seed), (m, k), jnp.float32)
+        b = jax.random.normal(jax.random.PRNGKey(seed + 1), (k, n), jnp.float32)
+        return a, b
+
+    def test_hier_ag_gemm_rail_wire_accuracy(self, mesh2x4):
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            ag_gemm,
+        )
+
+        a, b = self._ab(64, 1024, 128, 31)
+        ref = ag_gemm(
+            a, b, mesh2x4, "tp", dcn_axis="dp",
+            method=AGGemmMethod.XLA_RING,
+        )
+        got = ag_gemm(
+            a, b, mesh2x4, "tp", dcn_axis="dp",
+            method=AGGemmMethod.XLA_RING, wire_dtype="fp8",
+        )
+        assert _rel_err(got, np.asarray(ref)) < 0.08
+
+    def test_hier_gemm_rs_rail_wire_accuracy(self, mesh2x4):
+        from triton_distributed_tpu.kernels.gemm_rs import (
+            GemmRSMethod,
+            gemm_rs,
+        )
+
+        a, b = self._ab(64, 1024, 256, 33)
+        ref = gemm_rs(
+            a, b, mesh2x4, "tp", dcn_axis="dp",
+            method=GemmRSMethod.XLA_RING,
+        )
+        got = gemm_rs(
+            a, b, mesh2x4, "tp", dcn_axis="dp",
+            method=GemmRSMethod.XLA_RING, wire_dtype="int8",
+        )
+        assert _rel_err(got, np.asarray(ref)) < 0.06
+
+    def test_resolve_hier_returns_rail_payload(self, mesh2x4):
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            resolve_ag_gemm_wire,
+        )
+
+        a, b = self._ab(64, 1024, 128, 35)
+        # explicit wires resolve to the rail payload; int8-mxu demotes
+        # to int8 (the rail dequantizes before any MXU)
+        assert resolve_ag_gemm_wire(
+            mesh2x4, "tp", a, b, method=AGGemmMethod.XLA_RING,
+            wire_dtype="int8-mxu", dcn_axis="dp",
+        ) == "int8"
+        assert resolve_ag_gemm_wire(
+            mesh2x4, "tp", a, b, method=AGGemmMethod.XLA_RING,
+            wire_dtype="fp8", dcn_axis="dp",
+        ) == "fp8"
+
+    def test_auto_rail_wire_compresses_big_payloads_only(self, mesh2x4):
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            resolve_ag_gemm_wire,
+        )
+
+        big_a, big_b = self._ab(512, 2048, 128, 37)
+        assert resolve_ag_gemm_wire(
+            mesh2x4, "tp", big_a, big_b, method=AGGemmMethod.XLA_RING,
+            wire_dtype="auto", dcn_axis="dp",
+        ) == "fp8"
+        small_a, small_b = self._ab(64, 256, 128, 39)
+        assert resolve_ag_gemm_wire(
+            mesh2x4, "tp", small_a, small_b, method=AGGemmMethod.XLA_RING,
+            wire_dtype="auto", dcn_axis="dp",
+        ) is None
+
+    def test_dcn_wire_reduce_scatter_helper(self, mesh8):
+        """The shared rail body (also the gemm_rs degradation twin's
+        ring): per-hop quantized ppermute reduce over any axis."""
+        from jax.sharding import PartitionSpec as P
+
+        from triton_distributed_tpu.runtime.multislice import (
+            dcn_wire_reduce_scatter,
+        )
+
+        fmt = wirelib.make_wire_format("int8", 8)
+        x = jax.random.normal(jax.random.PRNGKey(9), (64, 256), jnp.float32)
+
+        fn = jax.shard_map(
+            lambda s: dcn_wire_reduce_scatter(s, "x", 8, fmt),
+            mesh=mesh8, in_specs=P(None), out_specs=P("x"),
+            check_vma=False,
+        )
+        got = np.asarray(jax.jit(fn)(x))
+        ref = np.asarray(x) * 8
+        assert _rel_err(got, ref) < 0.04
 
 
 # ------------------------------------------------------ wire auto-selection

@@ -257,8 +257,30 @@ def _replay(rec, sim, state: _State):
                 s_st["scale"][ss] = tok
         elif isinstance(e, ev.DequantEvent):
             q_st = state.get(rank, e.q_region.ref)
+            if e.s_region is None:
+                # an epilogue consume that never folds a scale: the
+                # s8×s8 product is stored unrescaled — wire-rail
+                # divergence on the consumer side (the payload stays
+                # QUANTIZED, so the contract pass also sees raw bytes)
+                sig = ("SL009-nofold", e.q_region.ref, rank)
+                if sig not in reported:
+                    reported.add(sig)
+                    findings.append(Finding(
+                        "SL009", kernel,
+                        f"rank {rank} consumes the quantized payload "
+                        f"{e.q_region} in an MXU accumulator epilogue "
+                        "with NO scale folded — the s8×s8 product is "
+                        "never rescaled by its chunk scale and the "
+                        "stored values are off by the quantization "
+                        "scale (scale-fold omitted)",
+                        site=site, ranks=(rank,), phase=e.phase,
+                    ))
+                continue
             s_st = state.get(rank, e.s_region.ref)
-            dst_st = state.get(rank, e.dst_region.ref)
+            dst_st = (
+                state.get(rank, e.dst_region.ref)
+                if e.dst_region is not None else None
+            )
             if s_st is not None:
                 check_scale_ordering(rank, e, s_st)
             needed = _uniq_scale(q_st, e.q_region) if q_st else []
@@ -279,6 +301,23 @@ def _replay(rec, sim, state: _State):
                     "silently wrong",
                     site=site, ranks=(rank,), phase=e.phase,
                 ))
+            if e.epilogue:
+                # int8→MXU consumption: the payload bytes stay
+                # physically quantized where they are, but the scale
+                # fold in the accumulator epilogue IS their dequant —
+                # mark the consumed region dequantized IN PLACE so the
+                # contract pass (SL008 raw-bytes leg) treats the
+                # delivery as complete; the matmul output is locally
+                # computed data.
+                if q_st is not None:
+                    qs = _slices(e.q_region)
+                    w = q_st["wire"][qs]
+                    q_st["wire"][qs] = np.where(
+                        w == QUANTIZED, DEQUANTIZED, w
+                    )
+                if dst_st is not None:
+                    _own(dst_st, e.dst_region, rank)
+                continue
             if e.add_region is not None and dst_st is not None:
                 _fold(state, rank, e.dst_region, e.q_region, e.add_region)
             elif dst_st is not None and q_st is not None:
@@ -532,10 +571,67 @@ def _check_contract(rec, state: _State, contract: DeliveryContract) -> list:
     return findings
 
 
+# --------------------------------------------------------- SL011 hop depth
+
+def hop_histogram(rec, state: _State, dst) -> dict:
+    """Per-element remote-hop histogram of the contract destination
+    across all ranks: {hop_count: elements}. The raw material of the
+    critical-path feed-in (tune.perf_model.hop_critical_path_ms)."""
+    hist: dict = {}
+    for rank in range(rec.n):
+        st = state.get(rank, dst)
+        if st is None:
+            continue
+        vals, counts = np.unique(st["hop"], return_counts=True)
+        for v, c in zip(vals, counts):
+            hist[int(v)] = hist.get(int(v), 0) + int(c)
+    return hist
+
+
+def _check_hop_depth(rec, state: _State, contract) -> list:
+    """SL011: the delivery schedule's critical path, measured in remote
+    hops, against the ring-optimal depth. A ring of n ranks delivers
+    every chunk (and every reduction contribution) in ≤ n-1 sequential
+    hops; a schedule whose deepest chain exceeds that has serialized or
+    detoured its transfers — the per-element hop counters the replay
+    already tracks, fed into the perf model as a pre-hardware wall-clock
+    check (ROADMAP PR-4 follow-on)."""
+    from triton_distributed_tpu.tune.perf_model import (
+        hop_critical_path_ms,
+        ring_depth_regression,
+    )
+
+    dst = _resolve_dst(rec, contract.dst)
+    hist = hop_histogram(rec, state, dst)
+    if not hist:
+        return []
+    max_hop = max(hist)
+    meta = rec.ref_meta[dst]
+    itemsize = meta.dtype.itemsize if meta.dtype is not None else 4
+    hop_bytes = (int(np.prod(meta.shape)) // max(rec.n, 1)) * itemsize
+    reg = ring_depth_regression(max_hop, rec.n, hop_bytes)
+    if reg is None:
+        return []
+    excess, excess_ms = reg
+    return [Finding(
+        "SL011", rec.info.kernel,
+        f"the deepest delivery chain into {dst} rides {max_hop} remote "
+        f"hops on a {rec.n}-rank mesh (ring-optimal <= {rec.n - 1}): "
+        f"{excess} excess sequential hop(s) — the schedule serializes "
+        "or detours transfers, projected "
+        f"+{excess_ms:.4f} ms critical path per collective at "
+        f"{hop_bytes} B/hop (total chain "
+        f"{hop_critical_path_ms(max_hop, hop_bytes):.4f} ms, "
+        "tune.perf_model.hop_critical_path_ms)",
+        site=rec.info.site,
+    )]
+
+
 # ------------------------------------------------------------------- entry
 
 def check_dataflow(rec, sim, contract: DeliveryContract | None) -> list:
-    """The SL008/SL009/SL010 passes over one completed replay."""
+    """The SL008/SL009/SL010 data-correctness passes plus the SL011
+    hop-critical-path check over one completed replay."""
     if rec.n > MAX_RANKS:
         return []
     state = _State(rec)
@@ -544,4 +640,5 @@ def check_dataflow(rec, sim, contract: DeliveryContract | None) -> list:
     findings += _check_rail_pairing(rec)
     if contract is not None:
         findings += _check_contract(rec, state, contract)
+        findings += _check_hop_depth(rec, state, contract)
     return findings

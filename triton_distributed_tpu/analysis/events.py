@@ -128,12 +128,20 @@ class DequantEvent(Event):
     """A wire dequantization (``add_region`` None) or fused
     dequant-accumulate (``dst = add + q·s``): the provenance of ``q``
     flows to ``dst`` and the scale group held by ``s`` must match the
-    group ``q`` was quantized under (SL010)."""
+    group ``q`` was quantized under (SL010).
+
+    ``epilogue=True`` is the int8→MXU consumption edge: the payload is
+    fed to the MXU AS int8 and its scale is folded into the f32/s32
+    accumulator epilogue — the bytes in ``q`` stay physically quantized
+    but are vouched-consumed, so the dataflow pass marks them
+    dequantized in place (and ``s_region=None`` on an epilogue event is
+    the scale-fold-omitted bug, SL009)."""
 
     q_region: Region = None
     s_region: Region = None
     dst_region: Region = None
     add_region: Region = None
+    epilogue: bool = False
 
 
 @dataclass

@@ -97,6 +97,15 @@ RULES = {
         "dequantized with hop h-1's scales in a double-buffered "
         "workspace) — silently wrong values, no protocol violation",
     ),
+    "SL011": (
+        "hop-critical-path",
+        Severity.ERROR,
+        "the deepest delivery chain into the contract destination rides "
+        "more remote hops than the ring-optimal n-1 — the schedule "
+        "serializes or detours transfers; the replay's per-element hop "
+        "counters are fed to tune.perf_model.hop_critical_path_ms to "
+        "project the wall-clock regression before any hardware run",
+    ),
     "MC001": (
         "mosaic-f8-cast",
         Severity.ERROR,
@@ -119,6 +128,16 @@ RULES = {
         "the kernel body broadcasts a sub-byte (4-bit) vector; this "
         "Mosaic backend has no layout for sub-byte broadcasts — widen "
         "to int8 before broadcasting",
+    ),
+    "MC004": (
+        "mosaic-s8-dot-accumulator",
+        Severity.ERROR,
+        "an in-kernel dot over 1-byte operands with an unsupported "
+        "accumulator form: int8 dots must run the native s8*s8->s32 "
+        "path (preferred_element_type=int32, scales folded on the "
+        "accumulator afterwards), and fp8 operands have no MXU form on "
+        "this toolchain at all — quantize the scale fold into the "
+        "epilogue, don't ask the MXU for a float accumulate of int8",
     ),
 }
 

@@ -418,6 +418,91 @@ def stale_scale(axis="x"):
     )
 
 
+def scale_fold_omitted(axis="x"):
+    """An int8→MXU consumer whose epilogue NEVER folds the scale: the
+    rails are correctly paired (payload + scale plane on their own
+    semaphores — the SL009 structural legs stay silent), every
+    semaphore balances, but the arriving s8 slab is fed to the MXU and
+    stored without its chunk-scale rescale. The values are silently off
+    by the quantization scale. SL009 (scale-fold omitted), with rank +
+    site diagnostics."""
+
+    def kernel(xq_ref, xs_ref, out_ref, outq_ref, outs_ref,
+               send_sem, recv_sem, s_send_sem, s_recv_sem):
+        me = lang.my_pe(axis)
+        n = lang.n_pes(axis)
+
+        lang.barrier_all(axis)
+        peer = (me + 1) % n
+        dq = lang.remote_copy(
+            xq_ref, outq_ref, send_sem.at[0], recv_sem.at[0], peer
+        )
+        dsc = lang.remote_copy(
+            xs_ref, outs_ref, s_send_sem.at[0], s_recv_sem.at[0], peer
+        )
+        dq.start()
+        dsc.start()
+        dq.wait()
+        dsc.wait()
+        # BUG: the s8×s8 pipeline consumes the payload with NO scale
+        # plane — the epilogue stores the unrescaled accumulator
+        wirelib.epilogue_consume(outq_ref, None, out_ref)
+
+    return (
+        _spec(
+            kernel, "fixture_scale_fold_omitted",
+            out_shapes=[((8, 128), _F32), ((8, 2048), np.dtype(np.int8)),
+                        ((8, 128), _F32)],
+            scratch=_sems((1,), (1,), (1,), (1,)),
+            collective_id=50,
+        ),
+        lambda n: [((8, 2048), np.dtype(np.int8)), ((8, 128), _F32)],
+        None,
+    )
+
+
+def serialized_ring(axis="x"):
+    """A gather ring that runs ``n`` hops instead of ``n-1`` — every
+    chunk is still delivered exactly once everywhere (the extra lap
+    re-delivers each rank's OWN shard on top of its already-correct
+    local copy), every semaphore balances, SL008 is clean... but the
+    deepest delivery chain is now ``n`` sequential hops. The hop
+    counters the replay tracks expose the detour and the perf model
+    prices it: SL011."""
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem):
+        import jax
+        from jax.experimental import pallas as pl
+
+        me = lang.my_pe(axis)
+        n = lang.n_pes(axis)
+        m = x_ref.shape[0]
+
+        out_ref[pl.ds(me * m, m)] = x_ref[:]
+        lang.barrier_all(axis)
+        for s in range(n):                 # BUG: one lap too many
+            src = jax.lax.rem(me + n - s, n) if s > 0 else me
+            dma = lang.remote_copy(
+                out_ref.at[pl.ds(src * m, m)],
+                out_ref.at[pl.ds(src * m, m)],
+                send_sem.at[s], recv_sem.at[s], (me + 1) % n,
+            )
+            dma.start()
+            dma.wait()
+
+    return (
+        _spec(
+            kernel, "fixture_serialized_ring",
+            out_shapes=[((8 * 8, 128), _F32)],
+            scratch=_sems((8,), (8,)),
+            collective_id=51,
+        ),
+        lambda n: [((8, 128), _F32)],
+        DeliveryContract(kind="gather", dst="out_ref"),
+    )
+
+
 # ------------------------------------------------ Mosaic-compat fixtures
 #
 # These are consumed by analysis.mosaic_compat.preflight_spec (real jax

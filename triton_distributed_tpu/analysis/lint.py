@@ -176,7 +176,7 @@ def main(argv=None) -> int:
                     "rule_counts summary")
     ap.add_argument("--mosaic", action="store_true",
                     help="also run the Mosaic-compat pre-flight (rules "
-                    "MC001-MC003: trace each family's kernel jaxpr and "
+                    "MC001-MC004: trace each family's kernel jaxpr and "
                     "scan for constructs this toolchain's Mosaic "
                     "rejects)")
     ap.add_argument("--list", action="store_true",

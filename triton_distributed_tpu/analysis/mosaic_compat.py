@@ -18,7 +18,18 @@ for the constructs this toolchain's Mosaic backend is KNOWN to reject:
 * **MC002** — collapsing a loaded ``(1, 1)`` float vector to a scalar
   (the ``vector.shape_cast 1x1 → scalar`` Mosaic rejects — the reason
   lang.wire keeps lane-replicated ``(1, 128)`` scale rows);
-* **MC003** — broadcasting a sub-byte (4-bit) vector.
+* **MC003** — broadcasting a sub-byte (4-bit) vector;
+* **MC004** — a dot over 1-byte operands with an unsupported
+  accumulator form. The int8→MXU consumers (ag_gemm/moe_tp
+  ``wire_dtype='int8-mxu'``) ride the NATIVE s8×s8→s32 path — proven
+  on this toolchain by the W8A8 grouped GEMM running on chip
+  (kernels/group_gemm, round 5) and re-verified by this pre-flight's
+  force-compile scan of those families; what Mosaic rejects is asking
+  the MXU for a FLOAT accumulate of int8 operands, or any fp8 dot
+  (no f8 MXU form here, see MC001). A family whose builder refuses
+  cleanly under ``lang.wire.require_mxu`` (TDTPU_WIRE_INT8_MXU=0) is a
+  pass — the contract fires before Mosaic ever would, mirroring the
+  MC001 fp8 handling.
 
 A family whose builder REFUSES cleanly under the hardware contract
 (``require_inkernel`` raising for a pinned fp8 wire) is a pass: the
@@ -43,10 +54,11 @@ from triton_distributed_tpu.analysis.findings import Finding
 
 _TOKENS = itertools.count()
 
-#: substring of the canonical clean-refusal diagnostic
-#: (lang.wire.require_inkernel) — a build that raises it never reaches
-#: Mosaic, so there is nothing to scan and nothing to flag.
-_CLEAN_REFUSAL = "in-kernel f8"
+#: substrings of the canonical clean-refusal diagnostics
+#: (lang.wire.require_inkernel / require_mxu) — a build that raises one
+#: never reaches Mosaic, so there is nothing to scan and nothing to
+#: flag.
+_CLEAN_REFUSALS = ("in-kernel f8", "in-kernel s8")
 
 
 @contextlib.contextmanager
@@ -102,7 +114,7 @@ def _kernel_jaxprs(jaxpr):
 
 
 def scan_kernel_jaxpr(kjaxpr, kernel_name, site=None) -> list:
-    """MC001–MC003 over one kernel jaxpr."""
+    """MC001–MC004 over one kernel jaxpr."""
     findings = []
     seen = set()
 
@@ -143,7 +155,51 @@ def scan_kernel_jaxpr(kjaxpr, kernel_name, site=None) -> list:
                     f"in-kernel broadcast of sub-byte dtype {dt}: this "
                     "Mosaic backend has no sub-byte broadcast layout — "
                     "widen to int8 first")
+        elif name == "dot_general" and len(eqn.invars) >= 2 and eqn.outvars:
+            dts = [getattr(v.aval, "dtype", None) for v in eqn.invars[:2]]
+            out_dt = getattr(eqn.outvars[0].aval, "dtype", None)
+            onebyte = [
+                d for d in dts
+                if d is not None and getattr(d, "itemsize", 0) == 1
+            ]
+            if len(onebyte) == 2:
+                if any(_is_f8(d) for d in onebyte):
+                    add("MC004",
+                        f"in-kernel dot over fp8 operands ({dts[0]} x "
+                        f"{dts[1]}): this Mosaic has no f8 MXU form — "
+                        "carry int8 (the s8*s8->s32 path) or keep fp8 "
+                        "on the XLA engines")
+                elif "int32" not in str(out_dt):
+                    add("MC004",
+                        f"in-kernel s8 dot accumulating to {out_dt}: "
+                        "Mosaic lowers int8 dots only on the native "
+                        "s8*s8->s32 path — set preferred_element_type="
+                        "int32 and fold the scales on the accumulator "
+                        "in the epilogue (the lang.wire int8-mxu "
+                        "contract)")
     return findings
+
+
+def i8_to_float_casts(kjaxpr) -> list:
+    """Every in-kernel ``convert_element_type`` that widens an int8
+    array to a float type — the signature of a per-arrival DEQUANT
+    pass. The int8→MXU acceptance check (tests/test_wire.py) asserts
+    this list is EMPTY for the ``*_int8mxw`` families' traced kernels:
+    their wire ends at the s8×s8 dot, whose only float conversion is
+    the s32 accumulator's epilogue widening."""
+    out = []
+    for eqn in _walk_jaxprs(kjaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        if not (eqn.invars and eqn.outvars):
+            continue
+        src = getattr(eqn.invars[0].aval, "dtype", None)
+        dst = getattr(eqn.outvars[0].aval, "dtype", None)
+        if (src is not None and dst is not None
+                and "int8" in str(src) and "float" in str(dst)):
+            out.append((str(src), str(dst),
+                        tuple(getattr(eqn.invars[0].aval, "shape", ()))))
+    return out
 
 
 # ------------------------------------------------------------------ tracing
@@ -197,22 +253,18 @@ def preflight_spec(spec, in_shapes, n, *, kernel_name, site=None,
     return findings
 
 
-def preflight_family(fam, n: int = 8):
-    """Build one registry family FOR HARDWARE and scan its kernel.
-    Returns (status, findings): status 'scanned', or 'refused' when the
-    builder raised the canonical pinned-wire contract error (a pass —
-    the contract fires before Mosaic ever would)."""
+def trace_family_kernels(fam, n: int = 8) -> list:
+    """Build one registry family FOR HARDWARE and return its traced
+    kernel jaxprs — the raw material of the deny-list scan, and of
+    ad-hoc jaxpr assertions in tests (e.g. the int8→MXU acceptance
+    check that no per-arrival dequant pass exists in the traced
+    kernel). Raises the builder's clean-refusal ValueError through."""
     from triton_distributed_tpu.lang.launch import captured_launch
     from triton_distributed_tpu.analysis.lint import lint_mesh
 
     with _force_compile():
         mesh = lint_mesh(n, fam.axis)
-        try:
-            fam.build(mesh, n, ("mosaic_compat", next(_TOKENS)))
-        except ValueError as e:
-            if _CLEAN_REFUSAL in str(e):
-                return "refused", []
-            raise
+        fam.build(mesh, n, ("mosaic_compat", next(_TOKENS)))
         spec = captured_launch(fam.launch_name)
         if spec is None:
             raise RuntimeError(
@@ -221,8 +273,22 @@ def preflight_family(fam, n: int = 8):
             )
         jaxpr = trace_spec(spec, fam.in_shapes(n), n, mesh=mesh,
                            axis=fam.axis)
+    return _kernel_jaxprs(jaxpr.jaxpr)
+
+
+def preflight_family(fam, n: int = 8):
+    """Build one registry family FOR HARDWARE and scan its kernel.
+    Returns (status, findings): status 'scanned', or 'refused' when the
+    builder raised a canonical pinned-wire contract error (a pass —
+    the contract fires before Mosaic ever would)."""
+    try:
+        kernel_jaxprs = trace_family_kernels(fam, n)
+    except ValueError as e:
+        if any(s in str(e) for s in _CLEAN_REFUSALS):
+            return "refused", []
+        raise
     findings = []
-    for kj in _kernel_jaxprs(jaxpr.jaxpr):
+    for kj in kernel_jaxprs:
         findings += scan_kernel_jaxpr(kj, fam.name, site=fam.site)
     return "scanned", findings
 
@@ -268,7 +334,7 @@ def main(argv=None) -> int:
         description="Mosaic-compat pre-flight: trace each registered "
         "kernel family's jaxpr (built for hardware) and scan for "
         "constructs this toolchain's Mosaic backend rejects "
-        "(MC001-MC003)",
+        "(MC001-MC004)",
     )
     ap.add_argument("--mesh", type=int, default=8, metavar="N")
     ap.add_argument("--kernel", action="append", default=None,

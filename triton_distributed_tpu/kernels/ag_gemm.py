@@ -162,6 +162,65 @@ def mm_pipeline(mb, nb, kb, bm, bk, bn, acc_ref, *, m_off=0, n_off=0, out_m_off=
     )
 
 
+def mm_q8_pipeline(mb, nb, kb, bm, bk, bn):
+    """Tiled s8×s8 matmul pipeline with the wire scales folded into the
+    accumulator epilogue — the dequant-free int8-MXU consumer. Operates
+    over pre-sliced HBM refs ``(aq, asc, bq, bsc, out)``: aq the
+    (mb·bm, kb·bk) int8 wire slab, asc its (mb, SCALE_LANES) scale
+    plane (the int8-mxu wire pins ``chunk_rows == bm`` so row-block i's
+    scale is exactly plane row i), bq/bsc the per-out-channel quantized
+    weight (lang.wire.quantize_cols). The MXU runs its native s8×s8→s32
+    path (2× the bf16 rate on v5e — the W8A8 grouped-GEMM measurement,
+    kernels/group_gemm.py) and the rank-1 ``a_scale[chunk]·b_scale[n]``
+    correction lands on the s32 accumulator at the last K step — exact,
+    both scales are constant over the K reduction, the same epilogue
+    shape as group_gemm's dequant epilogue. No per-arrival dequant pass
+    runs and no bf16 copy of the slab ever exists."""
+
+    def mk(acc_ref):
+        def inner(aq_ref, as_ref, bq_ref, bs_ref, o_ref):
+            @pl.when(pl.program_id(2) == 0)
+            def _():
+                acc_ref[...] = jnp.zeros_like(acc_ref)
+
+            acc_ref[...] += jax.lax.dot_general(
+                aq_ref[...], bq_ref[...],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+
+            @pl.when(pl.program_id(2) == kb - 1)
+            def _():
+                # (1,1) chunk scale × (1,bn) channel scales → (1,bn),
+                # sublane-broadcast onto the (bm,bn) accumulator (the
+                # lane-replicated scale-plane idiom — never a scalar)
+                o_ref[...] = (
+                    acc_ref[...].astype(jnp.float32)
+                    * (as_ref[:, :1] * bs_ref[...])
+                ).astype(o_ref.dtype)
+
+        return pltpu.emit_pipeline(
+            inner,
+            grid=(mb, nb, kb),
+            in_specs=[
+                pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+                pl.BlockSpec(
+                    (1, wirelib.SCALE_LANES), lambda i, j, kk: (i, 0)
+                ),
+                pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+                pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
+            ],
+            out_specs=[pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))],
+        )
+
+    def run(acc_ref, aq_hbm, as_hbm, bq_hbm, bs_hbm, out_hbm):
+        if wirelib.epilogue_consume(aq_hbm, as_hbm, out_hbm):
+            return  # symbolic: the provenance edge replaces the pipeline
+        mk(acc_ref)(aq_hbm, as_hbm, bq_hbm, bs_hbm, out_hbm)
+
+    return run
+
+
 # ----------------------------------------------------------- fused engine
 
 
@@ -247,6 +306,48 @@ def _fused_kernel_w(
         cp.wait()
 
 
+def _fused_kernel_mx(
+    n, axis, mesh_axes, blocks, fmt,
+    xq_hbm, xs_hbm, bq_hbm, bs_hbm,
+    out_hbm, agq_hbm, ags_hbm,
+    acc_ref, send_sem, recv_sem, s_send_sem, s_recv_sem,
+):
+    """int8→MXU twin of :func:`_fused_kernel_w`: the ring moves the
+    host-quantized slab + scale plane exactly like the int8 wire, but
+    the wire ends AT THE MXU — every slab (the local one included, for
+    uniform numerics against the per-channel-quantized weight) streams
+    through the s8×s8 pipeline with the chunk scale folded into the
+    accumulator epilogue. There is no per-arrival dequant pass, no bf16
+    gathered workspace, and arrival traffic through VMEM is halved
+    (1-byte A tiles)."""
+    m = xq_hbm.shape[0]
+    k = xq_hbm.shape[1]
+    nl = bq_hbm.shape[1]
+    bm, bk, bn = blocks
+    mb, nb, kb = m // bm, nl // bn, k // bk
+    pipe = mm_q8_pipeline(mb, nb, kb, bm, bk, bn)
+
+    def consume(s, src, a_hbm, a_row_off):
+        del a_hbm, a_row_off  # int8 wire refs replace the bf16 workspace
+        if s == 0:
+            q_slab, s_rows = xq_hbm, xs_hbm
+        else:
+            q_slab = agq_hbm.at[pl.ds(src * m, m)]
+            s_rows = ags_hbm.at[pl.ds(src * mb, mb)]
+        pipe(acc_ref, q_slab, s_rows, bq_hbm, bs_hbm,
+             out_hbm.at[pl.ds(src * m, m)])
+
+    wire = AGWireRefs(
+        fmt=fmt, local_q=xq_hbm, local_s=xs_hbm, agq=agq_hbm, ags=ags_hbm,
+        s_send_sem=s_send_sem, s_recv_sem=s_recv_sem,
+        dequant=None,   # the epilogue IS the dequant
+    )
+    ag_forward_ring(
+        n, axis, mesh_axes, xq_hbm, agq_hbm, m, send_sem, recv_sem, consume,
+        site="ag_gemm", wire=wire,
+    )
+
+
 def _specs(axis, batch_axes, dcn_axis=None):
     """(in_specs, out_specs) for AG-GEMM under shard_map over the full mesh.
 
@@ -305,8 +406,25 @@ def _build_fused(
         # kernel that never does (same convention as gemm_rs)
         collective_id = None
     fmt = None
-    if wire is not None:
-        assert dcn_axis is None, "wire compression is intra-slice only"
+    rail_fmt = None
+    mx = wire == "int8-mxu"
+    m_dev = m_gathered // (n * nd)
+    if wire is not None and dcn_axis is not None:
+        # hierarchical: the wire rides the DCN RAIL legs (XLA-side
+        # quant/dequant around the ppermute fetches / serial gather —
+        # Mosaic cast support is irrelevant there); the intra-slice
+        # Pallas rings stay on the raw wire. int8-mxu demotes to its
+        # int8 payload: the rail dequantizes before any ring consumes.
+        rail_fmt = wirelib.make_wire_format(
+            wirelib.wire_payload(wire), m_dev, strict=False
+        )
+        mx = False
+    elif mx:
+        wirelib.require_mxu("ag_gemm")
+        # one scale row per mm row-block: the epilogue's (1, 128) scale
+        # operand then indexes plane row i for A row-block i directly
+        fmt = wirelib.WireFormat(quant="int8", chunk_rows=blocks[0])
+    elif wire is not None:
         from triton_distributed_tpu.config import compiling_for_tpu
 
         wirelib.require_inkernel(wire, "ag_gemm")
@@ -320,6 +438,34 @@ def _build_fused(
             )
 
     def mk_call(m_g, blk, cid):
+        if mx:
+            nsem = (max(n - 1, 1),)
+            return lang.shmem_call(
+                functools.partial(
+                    _fused_kernel_mx, n, axis, mesh.axis_names, blk, fmt,
+                ),
+                out_shape=[
+                    jax.ShapeDtypeStruct((m_g, n_local), out_dtype),
+                    # the wire workspace IS the gathered representation:
+                    # no bf16 twin exists — arrival HBM/VMEM is halved
+                    jax.ShapeDtypeStruct((m_g, k), fmt.wire_dtype),
+                    jax.ShapeDtypeStruct(
+                        (m_g // blk[0], wirelib.SCALE_LANES), jnp.float32
+                    ),
+                ],
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+                out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+                scratch_shapes=[
+                    pltpu.VMEM((blk[0], blk[2]), jnp.int32),  # s32 acc
+                    pltpu.SemaphoreType.DMA(nsem),
+                    pltpu.SemaphoreType.DMA(nsem),
+                    pltpu.SemaphoreType.DMA(nsem),   # scale rail
+                    pltpu.SemaphoreType.DMA(nsem),
+                ],
+                collective_id=cid,
+                vmem_limit_bytes=fused_vmem_budget(),
+                name="ag_gemm_fused_int8mxw",
+            )
         if fmt is not None:
             nsem = (max(n - 1, 1),)
             return lang.shmem_call(
@@ -380,7 +526,6 @@ def _build_fused(
     in_specs, out_specs = _specs(axis, batch_axes, dcn_axis)
     ba = tuple(batch_axes)
     ag_spec = P(ba if ba else None, None)
-    m_dev = m_gathered // (n * nd)
     chunk_blocks = (
         pick_mm_blocks(m_dev, k, n_local, dtype.itemsize)
         if dcn_axis is not None and nd > 1 else None
@@ -392,6 +537,22 @@ def _build_fused(
         )
         if fmt is None:
             body = call
+        elif mx:
+            def body(a_loc, b_loc):
+                # both operands quantized ONCE in XLA (fuse with their
+                # producers); the kernel consumes wire bytes end to end
+                aq, asc = wirelib.quantize_slab(a_loc, fmt)
+                bq, bsc = wirelib.quantize_cols(b_loc)
+                out, agq, ags = call(aq, asc, bq, bsc)
+                if not return_gathered:
+                    # the gathered output is dead to the caller — hand
+                    # back the wire workspace untouched (no dequant ever)
+                    return out, agq
+                g = wirelib.dequantize_slab(agq, ags, fmt, dtype)
+                me = jax.lax.axis_index(axis)
+                return out, jax.lax.dynamic_update_slice(
+                    g, a_loc, (me * slab_rows, 0)
+                )
         else:
             def body(a_loc, b_loc):
                 # quantize the local slab ONCE in XLA (fuses with the
@@ -404,8 +565,17 @@ def _build_fused(
 
         def body(a_loc, b_loc):
             # serial rail fallback: gather my axis-position's rows across
-            # slices (axis-major rows → the railed slab is contiguous)
-            return call(jax.lax.all_gather(a_loc, dcn_axis, tiled=True), b_loc)
+            # slices (axis-major rows → the railed slab is contiguous),
+            # over the quantized rail when the wire is on
+            if rail_fmt is None:
+                ag = jax.lax.all_gather(a_loc, dcn_axis, tiled=True)
+            else:
+                from triton_distributed_tpu.runtime.multislice import (
+                    dcn_wire_all_gather,
+                )
+
+                ag = dcn_wire_all_gather(a_loc, dcn_axis, rail_fmt)
+            return call(ag, b_loc)
     else:
         # distinct collective_ids per chunk ring: strict per-chunk
         # rendezvous on the barrier semaphore (a skewed neighbor's
@@ -425,14 +595,23 @@ def _build_fused(
         def body(a_loc, b_loc):
             my = jax.lax.axis_index(dcn_axis)
             # nd−1 independent rail fetches, all issued before any ring:
-            # chunk s holds slice (my − s)'s rows
-            chunks = [a_loc] + [
-                jax.lax.ppermute(
-                    a_loc, dcn_axis,
-                    [(i, (i + s) % nd) for i in range(nd)],
+            # chunk s holds slice (my − s)'s rows. With the rail wire on,
+            # each fetch moves the once-quantized payload + scale plane
+            # (≈2× fewer DCN bytes) and dequantizes on arrival.
+            if rail_fmt is not None:
+                from triton_distributed_tpu.runtime.multislice import (
+                    dcn_wire_fetches,
                 )
-                for s in range(1, nd)
-            ]
+
+                chunks = dcn_wire_fetches(a_loc, dcn_axis, nd, rail_fmt)
+            else:
+                chunks = [a_loc] + [
+                    jax.lax.ppermute(
+                        a_loc, dcn_axis,
+                        [(i, (i + s) % nd) for i in range(nd)],
+                    )
+                    for s in range(1, nd)
+                ]
             pieces = [
                 chunk_calls[s](chunks[s], b_loc) for s in range(nd)
             ]
@@ -475,12 +654,19 @@ def ag_gemm_device(a_loc, b_loc, axis, *, out_dtype=None, wire=None):
     ``wire`` ('fp8'/'int8'): the hops carry the ONCE-quantized slab +
     per-chunk scales (lang.wire layout — the same bytes the fused wire
     ring ships) and each arrival is dequantized before its dot; the own
-    shard never crosses the wire and is consumed exact."""
+    shard never crosses the wire and is consumed exact.
+
+    ``wire='int8-mxu'``: the standalone AG→matmul twin of the fused
+    int8→MXU engine — identical rails, but every arriving slab (and the
+    local one, for uniform numerics) feeds an s8×s8→s32 dot against the
+    per-out-channel-quantized B with the chunk·channel scale product
+    folded onto the accumulator; no dequantized copy of A ever exists."""
     n = jax.lax.axis_size(axis)
     m_local = a_loc.shape[0]
     out_dtype = out_dtype or a_loc.dtype
     me = jax.lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    mx = wire == "int8-mxu"
     fmt = None
     if wire is not None:
         from triton_distributed_tpu.config import compiling_for_tpu
@@ -488,6 +674,40 @@ def ag_gemm_device(a_loc, b_loc, axis, *, out_dtype=None, wire=None):
         fmt = wirelib.make_wire_format(
             wire, m_local, strict=compiling_for_tpu()
         )
+    if mx and fmt is not None:
+        bq, bs = wirelib.quantize_cols(b_loc)
+        q, sc = wirelib.quantize_slab(a_loc, fmt)
+        # per-row expand of the lane-replicated chunk scales (XLA side —
+        # the fused kernel instead pins chunk_rows == block_m)
+        row_scale = jnp.repeat(sc[:, :1], fmt.chunk_rows, axis=0)
+
+        def s8_tile(q_cur, rs_cur):
+            acc = jax.lax.dot_general(
+                q_cur, bq, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            return (acc.astype(jnp.float32) * rs_cur * bs).astype(out_dtype)
+
+        out = jnp.zeros((n * m_local, b_loc.shape[1]), out_dtype)
+        out = jax.lax.dynamic_update_slice(
+            out, s8_tile(q, row_scale), (me * m_local, 0)
+        )
+
+        def step_mx(s, carry):
+            q_cur, sc_cur, out = carry
+            q_cur = jax.lax.ppermute(q_cur, axis, perm=perm)
+            sc_cur = jax.lax.ppermute(sc_cur, axis, perm=perm)
+            src = jax.lax.rem(me + n - s, n)
+            rs_cur = jnp.repeat(sc_cur[:, :1], fmt.chunk_rows, axis=0)
+            out = jax.lax.dynamic_update_slice(
+                out, s8_tile(q_cur, rs_cur), (src * m_local, 0)
+            )
+            return q_cur, sc_cur, out
+
+        _, _, out = jax.lax.fori_loop(1, n, step_mx, (q, sc, out))
+        return out
+    if mx:
+        fmt = None  # no legal chunking: stay on the exact wire
 
     out = jnp.zeros((n * m_local, b_loc.shape[1]), out_dtype)
     if fmt is None:
@@ -540,8 +760,22 @@ def _build_xla_ring(mesh, axis, batch_axes, out_dtype, dcn_axis=None,
     def body(a_loc, b_loc):
         if dcn_axis is not None:
             # same rail/ring split as the fused engine: DCN leg via
-            # lax, ppermute ring intra-slice over nd× slabs
-            a_loc = jax.lax.all_gather(a_loc, dcn_axis, tiled=True)
+            # lax, ppermute ring intra-slice over nd× slabs — with the
+            # wire on, the rail leg ships the quantized payload too
+            w_rail = wirelib.wire_payload(wire)
+            rail_fmt = (
+                wirelib.make_wire_format(w_rail, a_loc.shape[0],
+                                         strict=False)
+                if w_rail is not None else None
+            )
+            if rail_fmt is not None:
+                from triton_distributed_tpu.runtime.multislice import (
+                    dcn_wire_all_gather,
+                )
+
+                a_loc = dcn_wire_all_gather(a_loc, dcn_axis, rail_fmt)
+            else:
+                a_loc = jax.lax.all_gather(a_loc, dcn_axis, tiled=True)
         return ag_gemm_device(
             a_loc, b_loc, axis, out_dtype=out_dtype, wire=wire
         )
@@ -616,11 +850,14 @@ def _engine_tuner(mesh, axis, batch_axes, out_dtype, collective_id,
 
 @functools.lru_cache(maxsize=64)
 def _wire_tuner(mesh, axis, batch_axes, out_dtype, collective_id,
-                return_gathered, dcn_axis=None):
+                return_gathered, dcn_axis=None, wq=None):
     """Measured wire-dtype selection for ``wire_dtype='auto'``: the
     bf16 wire and the fp8 wire are benchmarked end to end and the
     winner persists (the same thunk-level contract as the engine
-    tuners — a wire format is just another config of the whole op)."""
+    tuners — a wire format is just another config of the whole op).
+    ``wq='int8'`` adds the dequant-free 'int8-mxu' candidate (the
+    caller's weight intent is what makes its numerics acceptable) and
+    is part of the tuner name, so winners never leak across intents."""
     from triton_distributed_tpu.tune.autotuner import wire_tuner
 
     def run(a, b, *, wire_dtype):
@@ -640,8 +877,8 @@ def _wire_tuner(mesh, axis, batch_axes, out_dtype, collective_id,
 
     return wire_tuner(
         f"ag_gemm_wire[{dict(mesh.shape)}|{axis}|{batch_axes}|{out_dtype}|"
-        f"{collective_id}|rg{int(return_gathered)}|{dcn_axis}]",
-        run,
+        f"{collective_id}|rg{int(return_gathered)}|{dcn_axis}|wq{wq}]",
+        run, mxu=(wq == "int8"),
     )
 
 
@@ -692,13 +929,21 @@ def auto_ag_gemm_method(mesh, axis, a, b, dp: int = 1,
 def resolve_ag_gemm_wire(
     mesh, axis, a, b, *, batch_axes=(), method=None, wire_dtype=None,
     dcn_axis: str | None = None, dp: int | None = None,
+    wq: str | None = None,
 ) -> str | None:
     """The wire format :func:`ag_gemm` will ACTUALLY ship for these
     arguments: None (raw bf16 wire) unless a ring engine runs and the
     slab admits the lang.wire layout. ``'auto'`` consults the measured
     wire tuner (when tuning is enabled and args are concrete), else the
     perf model's comm-bound test — compressed exactly when the bf16
-    ring transfer, not the shard matmul, is the per-step critical path."""
+    ring transfer, not the shard matmul, is the per-step critical path,
+    and picking the dequant-free ``'int8-mxu'`` consumer wire there
+    when the caller declared an int8 weight intent (``wq='int8'``).
+
+    Hierarchical (``dcn_axis``) calls resolve the wire for the DCN RAIL
+    legs (the payload format the ppermute fetches ship; 'int8-mxu'
+    demotes to its 'int8' payload — the rail dequantizes before any MXU
+    sees it)."""
     from triton_distributed_tpu.config import compiling_for_tpu
 
     w = wirelib.normalize_wire(wire_dtype)
@@ -710,47 +955,74 @@ def resolve_ag_gemm_wire(
         dp = mesh_axes_size(mesh, tuple(batch_axes))
     if n * nd == 1:
         return None
-    if dcn_axis is not None:
-        _warn_once(
-            ("ag_gemm", "wire_dcn"),
-            "ag_gemm: wire compression is intra-slice only; hierarchical "
-            "(dcn_axis) calls ship the bf16 wire",
-        )
-        return None
     if method == AGGemmMethod.XLA_NAIVE:
         return None  # no ring — nothing to compress
-    slab_rows = a.shape[0] // (dp * n)
     k = a.shape[1]
+    if dcn_axis is not None:
+        # the DCN rail wire: XLA-side quant/dequant around the rail legs
+        # — runs on any backend, so only payload-layout eligibility gates
+        m_dev = a.shape[0] // (dp * n * nd)
+        if w == "auto":
+            if not wirelib.wire_blockable(m_dev, k, "fp8", False):
+                return None
+            from triton_distributed_tpu.runtime.topology import (
+                auto_allgather_wire,
+            )
+
+            # a DCN leg is always comm-bound relative to ICI; compress
+            # whenever the payload clears the fixed-cost threshold
+            return auto_allgather_wire(m_dev * k * a.dtype.itemsize)
+        payload = wirelib.wire_payload(w)
+        if not wirelib.wire_blockable(m_dev, k, payload, False):
+            raise ValueError(
+                f"ag_gemm wire_dtype={w!r}: DCN rail slab ({m_dev}, {k}) "
+                "admits no legal wire chunking (a pinned wire format is "
+                "a contract); use wire_dtype='auto' or the bf16 wire"
+            )
+        return payload
+    slab_rows = a.shape[0] // (dp * n)
     strict = compiling_for_tpu()
-    # in-kernel dequant happens only on the fused engine; XLA engines
-    # carry fp8 natively regardless of the Mosaic backend's cast support
+    # in-kernel wire consumption happens only on the fused engine; XLA
+    # engines carry fp8 / s8 dots natively regardless of Mosaic support
     inkernel = method == AGGemmMethod.PALLAS_FUSED
     if w == "auto":
         if not wirelib.wire_blockable(slab_rows, k, "fp8", strict):
-            return None
-        if inkernel and not wirelib.inkernel_wire_ok("fp8"):
-            # no silent numerics switch to int8: auto keeps the exact
-            # wire where the toolchain cannot carry fp8 in-kernel
             return None
         from triton_distributed_tpu.tune.autotuner import tuned_method_or_none
 
         tuned = tuned_method_or_none(
             lambda: _wire_tuner(
                 mesh, axis, tuple(batch_axes), jnp.dtype(a.dtype), 5,
-                False, dcn_axis,
+                False, dcn_axis, wq,
             ),
             a, b, key="wire_dtype",
         )
         if tuned is not None:
-            return wirelib.normalize_wire(tuned)
-        from triton_distributed_tpu.tune.perf_model import auto_wire_dtype
+            w = wirelib.normalize_wire(tuned)
+        else:
+            from triton_distributed_tpu.tune.perf_model import (
+                auto_wire_dtype,
+            )
 
-        n_local = b.shape[1] // n
-        return wirelib.normalize_wire(auto_wire_dtype(
-            slab_rows, k, n_local, a.dtype.itemsize
-        ))
+            n_local = b.shape[1] // n
+            w = wirelib.normalize_wire(auto_wire_dtype(
+                slab_rows, k, n_local, a.dtype.itemsize, consumer_wq=wq,
+            ))
+        if w == "int8-mxu" and inkernel and not wirelib.inkernel_s8_dot_ok():
+            # the caller already declared int8 numerics (wq='int8'), so
+            # demoting to the dequant-then-matmul int8 wire is not a
+            # silent numerics-class switch — only the MXU feed changes
+            w = "int8"
+        if w == "fp8" and inkernel and not wirelib.inkernel_wire_ok("fp8"):
+            # no silent numerics switch to int8: auto keeps the exact
+            # wire where the toolchain cannot carry fp8 in-kernel
+            return None
+        return w
     if inkernel:
-        wirelib.require_inkernel(w, "ag_gemm")
+        if w == "int8-mxu":
+            wirelib.require_mxu("ag_gemm")
+        else:
+            wirelib.require_inkernel(w, "ag_gemm")
     if not wirelib.wire_blockable(slab_rows, k, w, strict):
         raise ValueError(
             f"ag_gemm wire_dtype={w!r}: slab ({slab_rows}, {k}) admits no "
@@ -811,6 +1083,7 @@ def ag_gemm(
     return_gathered: bool = False,
     dcn_axis: str | None = None,
     wire_dtype=None,
+    wq: str | None = None,
 ):
     """Fused AllGather(A) @ B for column-parallel TP.
 
@@ -818,11 +1091,22 @@ def ag_gemm(
     None/'bf16' — the raw compute dtype (default, today's numerics);
     'fp8'/'int8' — 1-byte payload + per-chunk f32 scales (lang.wire),
     quantized once at the source, dequantized on receive before the MXU
-    (own shard consumed exact); 'auto' — the measured wire tuner, else
-    the perf model picks the compressed wire exactly when the bf16 ring
-    transfer is the per-step critical path (comm-bound shapes). With a
-    compressed wire the gathered-A output (``return_gathered``) holds
-    the dequantized remote slabs — inference-grade, like the MoE wire.
+    (own shard consumed exact); 'int8-mxu' — the DEQUANT-FREE consumer
+    wire: identical int8 rails, but every slab (local included) feeds
+    the MXU's native s8×s8→s32 path against the per-out-channel
+    quantized B, with the chunk·channel scale product folded into the
+    accumulator epilogue — no per-arrival dequant pass, half the
+    arrival VMEM, 2× the MXU rate; 'auto' — the measured wire tuner,
+    else the perf model picks the compressed wire exactly when the bf16
+    ring transfer is the per-step critical path (comm-bound shapes),
+    preferring 'int8-mxu' there when ``wq='int8'``. With a compressed
+    wire the gathered-A output (``return_gathered``) holds the
+    dequantized remote slabs — inference-grade, like the MoE wire.
+
+    ``wq``: the caller's weight-quantization intent ('int8' or None).
+    It does not change B's storage here (pass already-quantized weights
+    to the serving paths for that); it licenses the auto selector to
+    pick 'int8-mxu', whose epilogue quantizes B per out-channel.
 
     ``a``: (M, K) with rows sharded over ``(*batch_axes, axis)`` — each
     device holds an M/(dp·n) row shard; the kernel gathers the ``axis``
@@ -866,7 +1150,7 @@ def ag_gemm(
     )
     wire = resolve_ag_gemm_wire(
         mesh, axis, a, b, batch_axes=batch_axes, method=method,
-        wire_dtype=wire_dtype, dcn_axis=dcn_axis, dp=dp,
+        wire_dtype=wire_dtype, dcn_axis=dcn_axis, dp=dp, wq=wq,
     )
     if method == AGGemmMethod.PALLAS_FUSED:
         fn = _build_fused(

@@ -233,13 +233,25 @@ def _build_fused(
     if n == 1:
         collective_id = None  # degenerate path uses no barrier semaphore
     fmt = None
-    if wire is not None:
-        assert dcn_axis is None, "wire compression is intra-slice only"
+    rail_fmt = None
+    if wire is not None and dcn_axis is not None:
+        # hierarchical: the wire rides the DCN LEG (the quantized
+        # ppermute reduce ring replacing psum_scatter — XLA-side
+        # quant/dequant, any backend); intra-slice rings stay raw.
+        # The rail reduces (m_local, ·) partials in nd stripes of
+        # m_local/nd rows each.
+        if m_local % nd == 0:
+            rail_fmt = wirelib.make_wire_format(
+                wirelib.wire_payload(wire), m_local // nd, strict=False
+            )
+    elif wire is not None:
         from triton_distributed_tpu.config import compiling_for_tpu
 
-        wirelib.require_inkernel(wire, "gemm_rs")
+        wirelib.require_inkernel(
+            wirelib.wire_payload(wire), "gemm_rs"
+        )
         fmt = wirelib.make_wire_format(
-            wire, m_local, strict=compiling_for_tpu()
+            wirelib.wire_payload(wire), m_local, strict=compiling_for_tpu()
         )
         if fmt is None:
             raise ValueError(
@@ -278,7 +290,7 @@ def _build_fused(
                 ],
                 collective_id=cid,
                 vmem_limit_bytes=fused_vmem_budget(),
-                name=f"gemm_rs_fused_{wire}w",
+                name=f"gemm_rs_fused_{wirelib.wire_payload(wire)}w",
             )
         return lang.shmem_call(
             functools.partial(_fused_kernel, n, axis, mesh.axis_names, blk),
@@ -331,9 +343,19 @@ def _build_fused(
         call = mk_call(n_out, blocks, collective_id)
 
         def body(a, b):
-            # serial DCN leg fallback (no admissible column chunking)
+            # serial DCN leg fallback (no admissible column chunking) —
+            # quantized rail when the wire is on
+            part = call(a, b)[0]
+            if rail_fmt is not None:
+                from triton_distributed_tpu.runtime.multislice import (
+                    dcn_wire_reduce_scatter,
+                )
+
+                return dcn_wire_reduce_scatter(
+                    part, dcn_axis, nd, rail_fmt
+                )
             return jax.lax.psum_scatter(
-                call(a, b)[0], dcn_axis, scatter_dimension=0, tiled=True
+                part, dcn_axis, scatter_dimension=0, tiled=True
             )
     else:
         nc = n_out // n_chunks
@@ -358,7 +380,17 @@ def _build_fused(
             # async-converts collective-permute — a sync psum_scatter
             # would serialize the whole leg (verified in the compiled
             # schedule), while these hops get start/done windows the
-            # next chunk's Mosaic call slots into
+            # next chunk's Mosaic call slots into. With the rail wire
+            # on, each hop moves the per-hop-quantized partial + scale
+            # plane (~2× fewer DCN bytes, f32 dequant-accumulate).
+            if rail_fmt is not None:
+                from triton_distributed_tpu.runtime.multislice import (
+                    dcn_wire_reduce_scatter,
+                )
+
+                return dcn_wire_reduce_scatter(
+                    part, dcn_axis, nd, rail_fmt
+                )
             me = jax.lax.axis_index(dcn_axis)
             m_s = part.shape[0] // nd
             perm = [(i, (i - 1) % nd) for i in range(nd)]
@@ -452,12 +484,31 @@ def _build_xla_ring(mesh, axis, batch_axes, out_dtype, dcn_axis=None,
 
     def body(a_loc, b_loc):
         part = gemm_rs_device(
-            a_loc, b_loc, axis, out_dtype=out_dtype, wire=wire
+            a_loc, b_loc, axis, out_dtype=out_dtype,
+            wire=wirelib.wire_payload(wire),
         )
         if dcn_axis is not None:
-            part = jax.lax.psum_scatter(
-                part, dcn_axis, scatter_dimension=0, tiled=True
+            nd = jax.lax.axis_size(dcn_axis)
+            w_rail = wirelib.wire_payload(wire)
+            rail_fmt = (
+                wirelib.make_wire_format(
+                    w_rail, part.shape[0] // nd, strict=False
+                )
+                if w_rail is not None and part.shape[0] % nd == 0
+                else None
             )
+            if rail_fmt is not None:
+                from triton_distributed_tpu.runtime.multislice import (
+                    dcn_wire_reduce_scatter,
+                )
+
+                part = dcn_wire_reduce_scatter(
+                    part, dcn_axis, nd, rail_fmt
+                )
+            else:
+                part = jax.lax.psum_scatter(
+                    part, dcn_axis, scatter_dimension=0, tiled=True
+                )
         return part
 
     fn = jax.shard_map(
@@ -584,7 +635,9 @@ def resolve_gemm_rs_wire(
     model's comm-bound test at the per-step shapes."""
     from triton_distributed_tpu.config import compiling_for_tpu
 
-    w = wirelib.normalize_wire(wire_dtype)
+    # a reduce ring accumulates — 'int8-mxu' has no MXU consumer here
+    # and resolves to its int8 payload wire
+    w = wirelib.wire_payload(wirelib.normalize_wire(wire_dtype))
     if w is None:
         return None
     n = mesh.shape[axis]
@@ -593,15 +646,32 @@ def resolve_gemm_rs_wire(
         dp = mesh_axes_size(mesh, tuple(batch_axes))
     if n * nd == 1:
         return None
-    if dcn_axis is not None:
-        _warn_once(
-            ("gemm_rs", "wire_dcn"),
-            "gemm_rs: wire compression is intra-slice only; hierarchical "
-            "(dcn_axis) calls ship the bf16 wire",
-        )
-        return None
     if method == GemmRSMethod.XLA_NAIVE:
         return None  # psum_scatter — no ring to compress
+    if dcn_axis is not None:
+        # the DCN rail wire: the quantized ppermute reduce ring replaces
+        # psum_scatter on the leg (XLA-side — any backend); intra-slice
+        # Pallas rings stay raw
+        m_s = a.shape[0] // (dp * n * nd * nd)
+        n_out = b.shape[1]
+        if a.shape[0] % (dp * n * nd * nd) or not wirelib.wire_blockable(
+            max(m_s, 1), n_out, "fp8", False
+        ):
+            if w == "auto":
+                return None
+            raise ValueError(
+                f"gemm_rs wire_dtype={w!r}: DCN rail stripe admits no "
+                "legal wire chunking (a pinned wire format is a "
+                "contract); use wire_dtype='auto' or the bf16 wire"
+            )
+        if w == "auto":
+            from triton_distributed_tpu.runtime.topology import (
+                auto_allgather_wire,
+            )
+
+            out_itemsize = jnp.dtype(out_dtype or a.dtype).itemsize
+            return auto_allgather_wire(m_s * n_out * out_itemsize)
+        return w
     m_local = a.shape[0] // (dp * n)
     k_local = a.shape[1] // n
     n_out = b.shape[1]

@@ -111,6 +111,64 @@ def gmm_pipeline(mb, nb, kb, blocks, acc_ref, expert_of_block, *,
     )
 
 
+def gmm_q8_pipeline(mb, nb, kb, blocks, expert_of_block):
+    """s8×s8 grouped-matmul pipeline with the wire scales folded into
+    the epilogue — the grouped twin of ag_gemm.mm_q8_pipeline, which is
+    itself the exact epilogue shape of group_gemm._ggemm_q8a_kernel:
+    the arriving int8 token slab multiplies the per-(expert,
+    out-channel) quantized weight on the MXU's native s8×s8→s32 path,
+    and the rank-1 ``chunk_scale[m]·w_scale[e, n]`` correction lands on
+    the s32 accumulator at the last K step. Operates over pre-sliced
+    HBM refs (aq, asc, wq, wsc, out); the int8-mxu wire pins
+    ``chunk_rows == bm`` so A row-block i's scale is plane row i."""
+    bm, bk, bn = blocks
+
+    def mk(acc_ref):
+        def inner(aq_ref, as_ref, wq_ref, ws_ref, o_ref):
+            @pl.when(pl.program_id(2) == 0)
+            def _():
+                acc_ref[...] = jnp.zeros_like(acc_ref)
+
+            acc_ref[...] += jax.lax.dot_general(
+                aq_ref[...], wq_ref[0],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+
+            @pl.when(pl.program_id(2) == kb - 1)
+            def _():
+                o_ref[...] = (
+                    acc_ref[...].astype(jnp.float32)
+                    * (as_ref[:, :1] * ws_ref[0])
+                ).astype(o_ref.dtype)
+
+        return pltpu.emit_pipeline(
+            inner,
+            grid=(mb, nb, kb),
+            in_specs=[
+                pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+                pl.BlockSpec(
+                    (1, wirelib.SCALE_LANES), lambda i, j, kk: (i, 0)
+                ),
+                pl.BlockSpec(
+                    (1, bk, bn),
+                    lambda i, j, kk: (expert_of_block(i), kk, j),
+                ),
+                pl.BlockSpec(
+                    (1, 1, bn), lambda i, j, kk: (expert_of_block(i), 0, j)
+                ),
+            ],
+            out_specs=[pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))],
+        )
+
+    def run(acc_ref, aq_hbm, as_hbm, wq_hbm, ws_hbm, out_hbm):
+        if wirelib.epilogue_consume(aq_hbm, as_hbm, out_hbm):
+            return  # symbolic: the provenance edge replaces the pipeline
+        mk(acc_ref)(aq_hbm, as_hbm, wq_hbm, ws_hbm, out_hbm)
+
+    return run
+
+
 def ag_group_gemm_kernel(
     n, axis, mesh_axes, blocks,
     be_ref, xs_hbm, w_hbm, out_hbm, ag_hbm,
@@ -179,6 +237,48 @@ def ag_group_gemm_kernel_w(
     ag_forward_ring(
         n, axis, mesh_axes, xs_hbm, ag_hbm, cap, send_sem, recv_sem, consume,
         site="moe_tp", wire=wire,
+    )
+
+
+def ag_group_gemm_kernel_mx(
+    n, axis, mesh_axes, blocks, fmt,
+    be_ref, xq_hbm, xsc_hbm, wq_hbm, wsc_hbm,
+    out_hbm, agq_hbm, ags_hbm,
+    acc_ref, send_sem, recv_sem, s_send_sem, s_recv_sem,
+):
+    """int8→MXU twin of :func:`ag_group_gemm_kernel_w`: the sorted token
+    slabs ride the ring as int8 + per-chunk scales and every arriving
+    slab (the local one included) streams straight through the s8×s8
+    grouped-GEMM pipeline against the per-(expert, out-channel)
+    quantized weights — the per-arrival dequant pass and the bf16
+    gathered workspace are gone; scales fold in the accumulator
+    epilogue (group_gemm's W8A8 shape)."""
+    cap = xq_hbm.shape[0]
+    k = xq_hbm.shape[1]
+    nl = wq_hbm.shape[2]
+    bm, bk, bn = blocks
+    mb, nb, kb = cap // bm, nl // bn, k // bk
+
+    def consume(s, src, a_hbm, a_row_off):
+        del a_hbm, a_row_off
+        if s == 0:
+            q_slab, s_rows = xq_hbm, xsc_hbm
+        else:
+            q_slab = agq_hbm.at[pl.ds(src * cap, cap)]
+            s_rows = ags_hbm.at[pl.ds(src * mb, mb)]
+        gmm_q8_pipeline(
+            mb, nb, kb, blocks, lambda i, src=src: be_ref[src, i]
+        )(acc_ref, q_slab, s_rows, wq_hbm, wsc_hbm,
+          out_hbm.at[pl.ds(src * cap, cap)])
+
+    wire = AGWireRefs(
+        fmt=fmt, local_q=xq_hbm, local_s=xsc_hbm, agq=agq_hbm, ags=ags_hbm,
+        s_send_sem=s_send_sem, s_recv_sem=s_recv_sem,
+        dequant=None,   # the grouped-GEMM epilogue IS the dequant
+    )
+    ag_forward_ring(
+        n, axis, mesh_axes, xq_hbm, agq_hbm, cap, send_sem, recv_sem,
+        consume, site="moe_tp", wire=wire,
     )
 
 
@@ -253,11 +353,18 @@ def moe_reduce_rs_kernel_w(
     )
 
 
-def _wire_fmt(wire, rows):
+def _wire_fmt(wire, rows, block_m=None):
     if wire is None:
         return None
     from triton_distributed_tpu.config import compiling_for_tpu
 
+    if wire == "int8-mxu":
+        # the epilogue consumer pins one scale row per routing block so
+        # the grouped pipeline's scale operand indexes plane row i for
+        # A row-block i (block_m always divides cap_s)
+        wirelib.require_mxu("moe_tp")
+        assert block_m is not None and rows % block_m == 0
+        return wirelib.WireFormat(quant="int8", chunk_rows=block_m)
     wirelib.require_inkernel(wire, "moe_tp")
     fmt = wirelib.make_wire_format(wire, rows, strict=compiling_for_tpu())
     if fmt is None:
@@ -275,8 +382,40 @@ def build_ag_group_gemm_call(
     """pallas_call for :func:`ag_group_gemm_kernel` (per-device, for use
     inside shard_map). ``wire``: 'fp8'/'int8' switches to the
     quantized-wire kernel — the caller then passes the host-quantized
-    (xq, xsc) pair after the sorted slab."""
-    fmt = _wire_fmt(wire, cap)
+    (xq, xsc) pair after the sorted slab; 'int8-mxu' to the
+    dequant-free epilogue consumer — the caller passes (xq, xsc) plus
+    the per-(expert, out-channel) quantized weight pair (wq, wsc) and
+    NO bf16 slab at all."""
+    fmt = _wire_fmt(wire, cap, blocks[0])
+    if wire == "int8-mxu":
+        nsem = (max(n - 1, 1),)
+        mb = cap // blocks[0]
+        return lang.shmem_call(
+            functools.partial(
+                ag_group_gemm_kernel_mx, n, axis, mesh_axes, blocks, fmt
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((n * cap, nl), dtype),
+                # the int8 wire workspace IS the gathered representation
+                jax.ShapeDtypeStruct((n * cap, k), fmt.wire_dtype),
+                jax.ShapeDtypeStruct(
+                    (n * mb, wirelib.SCALE_LANES), jnp.float32
+                ),
+            ],
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+            + [pl.BlockSpec(memory_space=pl.ANY)] * 4,
+            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+            scratch_shapes=[
+                pltpu.VMEM((blocks[0], blocks[2]), jnp.int32),  # s32 acc
+                pltpu.SemaphoreType.DMA(nsem),
+                pltpu.SemaphoreType.DMA(nsem),
+                pltpu.SemaphoreType.DMA(nsem),   # scale rail
+                pltpu.SemaphoreType.DMA(nsem),
+            ],
+            collective_id=None if n == 1 else collective_id,
+            vmem_limit_bytes=fused_vmem_budget(),
+            name="ag_group_gemm_fused_int8mxw",
+        )
     if fmt is not None:
         nsem = (max(n - 1, 1),)
         return lang.shmem_call(
@@ -335,9 +474,11 @@ def build_moe_reduce_rs_call(
     wire=None,
 ):
     """pallas_call for :func:`moe_reduce_rs_kernel` (per-device).
-    ``wire``: 'fp8'/'int8' switches to the quantized-wire reduce ring."""
+    ``wire``: 'fp8'/'int8' switches to the quantized-wire reduce ring
+    ('int8-mxu' carries its int8 payload — a reduce ring has no MXU
+    consumer to fold scales into)."""
     slab = jax.ShapeDtypeStruct((cap, h), dtype)
-    fmt = _wire_fmt(wire, cap)
+    fmt = _wire_fmt(wirelib.wire_payload(wire), cap)
     if fmt is not None:
         qslab = jax.ShapeDtypeStruct((cap, h), fmt.wire_dtype)
         sslab = jax.ShapeDtypeStruct(
@@ -366,7 +507,7 @@ def build_moe_reduce_rs_call(
             ],
             collective_id=None if n == 1 else collective_id,
             vmem_limit_bytes=fused_vmem_budget(),
-            name=f"moe_reduce_rs_fused_{wire}w",
+            name=f"moe_reduce_rs_fused_{wirelib.wire_payload(wire)}w",
         )
     return lang.shmem_call(
         functools.partial(moe_reduce_rs_kernel, n, axis, mesh_axes, blocks),

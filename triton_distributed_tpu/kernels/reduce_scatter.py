@@ -177,6 +177,97 @@ def _rs_stream_kernel(
     )
 
 
+def _rs_stream_kernel_w(
+    n, axis, mesh_axes, fmt,
+    x_hbm, out_hbm, w0, w1,
+    wq0, wq1, ws0, ws1, rq0, rq1, rs0, rs1,
+    copy_sem, send_sem, recv_sem, ack_sem, s_send_sem, s_recv_sem,
+):
+    """Quantized-wire twin of :func:`_rs_stream_kernel` — the last bf16
+    leg of the standalone RS family (ROADMAP PR-3 follow-on): the
+    HBM-streaming reduce ring now ships each hop's partial as a 1-byte
+    payload + per-chunk f32 scale plane (the fused gemm_rs wire
+    kernel's exact shape: per-hop quant_pipeline into the wq/ws rails,
+    f32 dequant-accumulate on receive — one bounded rounding per hop).
+    The bf16 recv slabs are gone; arrivals land in the 1-byte rq slabs."""
+    from triton_distributed_tpu.kernels.ring import RSWireRefs, reduce_ring
+
+    m = out_hbm.shape[0]
+    cols = out_hbm.shape[1]
+
+    def partial_into(dst, dst_ref):
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(dst * m, m)], dst_ref, copy_sem
+        )
+        cp.start()
+        cp.wait()
+
+    wire = RSWireRefs(
+        fmt=fmt, wq=(wq0, wq1), ws=(ws0, ws1), rq=(rq0, rq1), rs=(rs0, rs1),
+        s_send_sem=s_send_sem, s_recv_sem=s_recv_sem,
+        quantize=wirelib.quant_pipeline(m, cols, fmt),
+        dequant_add=wirelib.dequant_add_pipeline(m, cols, fmt),
+    )
+    reduce_ring(
+        n, axis, mesh_axes, out_hbm, (w0, w1), (None, None),
+        send_sem, recv_sem, ack_sem, partial_into, None, wire=wire,
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _build_rs_stream_w(mesh, axis, rows, cols, dtype, stacked,
+                       collective_id, ikey, wire):
+    """Quantized-wire HBM-streaming reduce ring (2-D payloads, per-chunk
+    scales — the lang.wire streaming layout of the fused gemm_rs wire)."""
+    from triton_distributed_tpu.config import compiling_for_tpu
+
+    wirelib.require_inkernel(wire, "reduce_scatter")
+    n = mesh.shape[axis]
+    m_local = rows // n
+    fmt = wirelib.make_wire_format(wire, m_local, strict=compiling_for_tpu())
+    assert fmt is not None, (wire, m_local)   # gated by the entry
+    slab = jax.ShapeDtypeStruct((m_local, cols), dtype)
+    qslab = jax.ShapeDtypeStruct((m_local, cols), fmt.wire_dtype)
+    sslab = jax.ShapeDtypeStruct(
+        (fmt.chunks(m_local), wirelib.SCALE_LANES), jnp.float32
+    )
+    call = lang.shmem_call(
+        functools.partial(
+            _rs_stream_kernel_w, n, axis, mesh.axis_names, fmt
+        ),
+        # out + bf16 work pair + quantized work/scale + recv/scale pairs
+        # (HBM workspaces ride as ANY outputs — Mosaic has no HBM scratch)
+        out_shape=[slab, slab, slab,
+                   qslab, qslab, sslab, sslab,
+                   qslab, qslab, sslab, sslab],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 11,
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+            pltpu.SemaphoreType.DMA((2,)),   # scale rail
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        collective_id=collective_id,
+        name=f"rs_ring_stream_{wire}w",
+    )
+    call = lang.maybe_instrument(
+        call, axis=axis, site="reduce_scatter", collective_id=collective_id,
+        n=n,
+    )
+    body = (lambda s: call(s[0])[0]) if stacked else (lambda s: call(s)[0])
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=P(axis) if stacked else P(None),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
 @functools.lru_cache(maxsize=256)
 def _build_rs_stream(mesh, axis, rows, cols, dtype, stacked, collective_id, ikey):
     n = mesh.shape[axis]
@@ -234,8 +325,10 @@ def _resolve_rs_wire(wire_dtype, rows, cols, n, itemsize):
     """The wire :func:`reduce_scatter` will actually ship: None unless
     the payload reshapes to 2-D columns wide enough that the per-row
     scale plane saves bytes. 'auto' uses the standalone-ring byte
-    threshold (a reduce ring is pure comm, like a gather)."""
-    w = wirelib.normalize_wire(wire_dtype)
+    threshold (a reduce ring is pure comm, like a gather). 'int8-mxu'
+    carries its int8 payload — a reduce ring accumulates, it has no MXU
+    consumer to fold scales into."""
+    w = wirelib.wire_payload(wirelib.normalize_wire(wire_dtype))
     if w is None:
         return None
     eligible = rows % n == 0 and cols * itemsize > cols + wirelib.SCALE_LANES * 4
@@ -274,10 +367,12 @@ def reduce_scatter(
     trailing dims ride as a free 2D view of the contiguous array).
 
     ``wire_dtype``: quantized ring wire ('fp8'/'int8' — per-hop
-    quantized partials with per-row f32 scales, f32 dequant-accumulate;
-    'auto' — compressed above the standalone-ring byte threshold).
-    Carried by the VMEM ring and the XLA twin; the HBM-streaming engine
-    ships bf16 (use gemm_rs's fused wire for streaming-scale slabs).
+    quantized partials with f32 scales, f32 dequant-accumulate; 'auto'
+    — compressed above the standalone-ring byte threshold). Carried by
+    the VMEM ring (per-row scales), the HBM-streaming engine (per-chunk
+    scales via the fused gemm_rs wire pipelines — round 8) and the XLA
+    twin; only payloads too ragged to stream fall back to the bf16
+    wire.
 
     Host entry ≡ reference ``reduce_scatter_2d_op`` (reduce_scatter.py:863).
     """
@@ -319,6 +414,21 @@ def reduce_scatter(
                 interp_key(), wire,
             )
             return fn(x2d).reshape(full_shape)
+        from triton_distributed_tpu.config import compiling_for_tpu
+
+        if _streamable(rows // n, cols, x.dtype.itemsize) and \
+                wirelib.wire_blockable(
+                    rows // n, cols, wire, compiling_for_tpu()
+                ):
+            # activation-scale payloads: the HBM-streaming wire ring
+            # (per-hop quant pipelines + scale rail, the fused gemm_rs
+            # wire shape — the last bf16 leg of the standalone RS)
+            x2d = x.reshape(((n,) if stacked else ()) + (rows, cols))
+            fn = _build_rs_stream_w(
+                mesh, axis, rows, cols, x.dtype, stacked, collective_id,
+                interp_key(), wire,
+            )
+            return fn(x2d).reshape(full_shape)
         _warn_rs_wire_once()
         wire = None
     if not _vmem_ring_fits(n, local_shape, x.dtype.itemsize) and _streamable(
@@ -346,9 +456,8 @@ def _warn_rs_wire_once():
         import logging
 
         logging.getLogger(__name__).warning(
-            "reduce_scatter: payload exceeds the VMEM ring; the "
-            "HBM-streaming engine ships the bf16 wire (use gemm_rs's "
-            "fused wire for streaming-scale quantized reductions)"
+            "reduce_scatter: payload exceeds the VMEM ring and admits "
+            "no streaming wire blocking; shipping the bf16 wire"
         )
 
 

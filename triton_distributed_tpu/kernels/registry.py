@@ -126,6 +126,7 @@ class KernelFamily:
 
 _F32 = np.dtype(np.float32)
 _I32 = np.dtype(np.int32)
+_I8 = np.dtype(np.int8)
 
 
 def _f8():
@@ -183,6 +184,21 @@ def _rs_stream(mesh, n, token):
 
     _build_rs_stream(
         mesh, "x", 8 * n, 128, jnp.dtype(jnp.float32), False, 3, token
+    )
+
+
+def _rs_stream_w(mesh, n, token):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.kernels.reduce_scatter import (
+        _build_rs_stream_w,
+    )
+
+    # wide lint columns: the streaming wire's per-chunk scale planes
+    # only compress when the chunk payload dwarfs them (entry gate)
+    _build_rs_stream_w(
+        mesh, "x", 8 * n, 2048, jnp.dtype(jnp.float32), False, 3, token,
+        "int8",
     )
 
 
@@ -306,6 +322,16 @@ def _capture_token(token):
 def _moe_ag_gg_shapes(wire):
     def in_shapes(n):
         g = _MOE_TP_GEOM
+        if wire == "int8-mxu":
+            # no bf16 slab at all: quantized tokens + per-routing-block
+            # scale plane + per-(expert, out-channel) quantized weights
+            return [
+                ((n, g["cap"] // g["bm"]), _I32),      # be (SMEM)
+                ((g["cap"], g["k"]), _I8),             # quantized slab
+                ((g["cap"] // g["bm"], 128), _F32),    # scale plane
+                ((g["e"], g["k"], g["nl"]), _I8),      # quantized weights
+                ((g["e"], 1, g["nl"]), _F32),          # weight scales
+            ]
         shapes = [
             ((n, g["cap"] // g["bm"]), _I32),          # be (SMEM)
             ((g["cap"], g["k"]), _F32),                # sorted slab
@@ -475,6 +501,22 @@ def families() -> dict:
             contract=gather("ag_hbm"),
         ),
         KernelFamily(
+            # dequant-free int8→MXU twin: identical int8 rails, but the
+            # contract destination is the WIRE workspace itself — every
+            # arriving slab must be epilogue-consumed (the provenance
+            # edge lang.wire.epilogue_consume emits flips it to
+            # dequantized; raw bytes left over are SL008, a consume
+            # without the scale fold is SL009)
+            "ag_gemm.fused_int8mxw", "ag_gemm", "ag_gemm_fused_int8mxw",
+            lambda mesh, n, token: _ag_gemm(mesh, n, token,
+                                            wire="int8-mxu"),
+            lambda n: [((16, 128), _I8), ((1, 128), _F32),
+                       ((128, 64), _I8), ((1, 64), _F32)],
+            # no local-slab publish: the local slab is consumed straight
+            # from the quantized input and never enters the workspace
+            contract=gather("agq_hbm", own_absent_ok=True),
+        ),
+        KernelFamily(
             "gemm_rs.fused", "gemm_rs", "gemm_rs_fused",
             _gemm_rs,
             # A rows are unsharded (each device holds all M rows of its
@@ -502,6 +544,17 @@ def families() -> dict:
             contract=reduce("out_ref"),
         ),
         KernelFamily(
+            # the HBM-streaming RS's quantized wire (the last bf16 leg
+            # of the standalone RS family): per-hop quant pipelines +
+            # scale rail, dequant-accumulate in f32 — the fused gemm_rs
+            # wire protocol on the streaming engine
+            "reduce_scatter.stream_int8w", "reduce_scatter",
+            "rs_ring_stream_int8w",
+            _rs_stream_w,
+            lambda n: [((8 * n, 2048), _F32)],
+            contract=reduce("out_hbm"),
+        ),
+        KernelFamily(
             "moe_tp.ag_group_gemm", "moe_tp", "ag_group_gemm_fused",
             _moe_ag_gg(None),
             _moe_ag_gg_shapes(None),
@@ -514,6 +567,16 @@ def families() -> dict:
             _moe_ag_gg("fp8"),
             _moe_ag_gg_shapes("fp8"),
             contract=gather("ag_hbm", own_absent_ok=True),
+        ),
+        KernelFamily(
+            # dequant-free int8→MXU grouped twin: sorted int8 slabs feed
+            # the s8×s8 grouped GEMM against per-(expert, out-channel)
+            # quantized weights; the wire workspace is the contract dst
+            "moe_tp.ag_group_gemm_int8mxw", "moe_tp",
+            "ag_group_gemm_fused_int8mxw",
+            _moe_ag_gg("int8-mxu"),
+            _moe_ag_gg_shapes("int8-mxu"),
+            contract=gather("agq_hbm", own_absent_ok=True),
         ),
         KernelFamily(
             "moe_tp.reduce_rs", "moe_tp", "moe_reduce_rs_fused",

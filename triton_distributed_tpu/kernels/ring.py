@@ -62,7 +62,12 @@ class AGWireRefs:
     ags: object          # (n·chunks, 128) f32 scale workspace
     s_send_sem: object   # (n-1,) DMA sems, scale rail
     s_recv_sem: object
-    dequant: object      # callable(q_hbm, s_hbm, dst_hbm) — lang.wire
+    #: callable(q_hbm, s_hbm, dst_hbm) — lang.wire — or None for the
+    #: int8-MXU consumers: the caller's ``consume`` feeds the arrived
+    #: quantized slab straight to the MXU and folds the scale in its
+    #: accumulator epilogue, so there is no per-arrival dequant pass
+    #: (and no bf16 workspace write) at all.
+    dequant: object
 
 
 @dataclass
@@ -179,10 +184,13 @@ def ag_forward_ring(
         if s == 0:
             consume(s, src, local_hbm, 0)
         else:
-            if wire is not None:
+            if wire is not None and wire.dequant is not None:
                 # arrived wire slab → bf16 workspace, then the MXU
                 # consumes it exactly like the raw-wire path (the
-                # forward above already moved the quantized bytes on)
+                # forward above already moved the quantized bytes on).
+                # dequant=None = the int8-MXU wire: consume reads the
+                # quantized slab directly and the scale fold happens in
+                # its accumulator epilogue — the dequant pass is GONE.
                 ch = wire.fmt.chunks(slab_rows)
                 wire.dequant(
                     wire.agq.at[pl.ds(src * slab_rows, slab_rows)],

@@ -45,9 +45,12 @@ from dataclasses import dataclass
 import jax.numpy as jnp
 
 #: accepted wire_dtype spellings. None and "bf16" both mean "raw wire"
-#: (ship the compute dtype, today's behavior); "auto" defers to the
-#: perf-model / autotuner selection at the op entry.
-WIRE_DTYPES = (None, "bf16", "fp8", "int8", "auto")
+#: (ship the compute dtype, today's behavior); "int8-mxu" ships the
+#: int8 payload AND ends the wire at the MXU — the consumer runs an
+#: s8×s8→s32 matmul on the arriving slab and folds the chunk scale into
+#: the f32 accumulator epilogue (no per-arrival dequant pass); "auto"
+#: defers to the perf-model / autotuner selection at the op entry.
+WIRE_DTYPES = (None, "bf16", "fp8", "int8", "int8-mxu", "auto")
 
 _QMAX = {"fp8": 448.0, "int8": 127.0}
 _WDT = {"fp8": jnp.float8_e4m3fn, "int8": jnp.int8}
@@ -86,14 +89,25 @@ def paired_scale_ok(q_rows: int, s_shape: tuple) -> bool:
 
 def normalize_wire(wire_dtype) -> str | None:
     """Canonical wire spelling: None for raw bf16 wire, 'fp8'/'int8'
-    for compressed, 'auto' passed through for the selectors."""
+    for compressed, 'int8-mxu' for the epilogue-dequant consumer wire,
+    'auto' passed through for the selectors."""
     if wire_dtype in (None, "bf16"):
         return None
-    if wire_dtype in ("fp8", "int8", "auto"):
+    if wire_dtype in ("fp8", "int8", "int8-mxu", "auto"):
         return wire_dtype
     raise ValueError(
         f"wire_dtype must be one of {WIRE_DTYPES}, got {wire_dtype!r}"
     )
+
+
+def wire_payload(wire: str | None) -> str | None:
+    """The PAYLOAD format a wire spelling puts on the rails. 'int8-mxu'
+    ships byte-identical rails to 'int8' — the difference is entirely on
+    the consumer side (epilogue-folded dequant instead of a dequant
+    pass) — so ops with no MXU consumer (standalone AG/RS rings, the
+    DCN rail legs, which dequantize before any compute) carry it as a
+    plain int8 wire."""
+    return "int8" if wire == "int8-mxu" else wire
 
 
 @dataclass(frozen=True)
@@ -145,7 +159,7 @@ def make_wire_format(quant: str, rows: int, *, strict: bool = False,
     cr = chunk_rows or pick_chunk_rows(rows, strict)
     if cr is None or rows % cr:
         return None
-    return WireFormat(quant=quant, chunk_rows=cr)
+    return WireFormat(quant=wire_payload(quant), chunk_rows=cr)
 
 
 # ------------------------------------------------------- XLA-side helpers
@@ -461,12 +475,80 @@ def require_inkernel(quant: str, engine: str) -> None:
         )
 
 
+def inkernel_s8_dot_ok() -> bool:
+    """Can a PALLAS kernel on the current toolchain feed int8 operands
+    straight into the MXU (``dot_general`` s8×s8 → s32)?
+
+    This Mosaic backend lowers the native s8×s8→s32 path fine — the
+    W8A8 grouped GEMM (kernels/group_gemm._ggemm_q8a_kernel) runs it on
+    chip at ~2× the bf16 rate (round 5, docs/PERF.md) — so the default
+    is True. ``TDTPU_WIRE_INT8_MXU=0`` force-disables the epilogue-
+    dequant consumers on a toolchain whose Mosaic regresses (the
+    mosaic_compat pre-flight's MC004 scan then also catches the
+    rejected accumulator form at build time)."""
+    import os
+
+    return os.environ.get("TDTPU_WIRE_INT8_MXU") != "0"
+
+
+def require_mxu(engine: str) -> None:
+    """Raise the canonical clean-refusal diagnostic when an EXPLICIT
+    'int8-mxu' wire is pinned but in-kernel s8 MXU consumption is
+    disabled for this toolchain (pinned = contract; the mosaic_compat
+    pre-flight treats this refusal as a pass, mirroring the fp8
+    handling)."""
+    if not inkernel_s8_dot_ok():
+        raise ValueError(
+            f"{engine}: wire_dtype='int8-mxu' requires in-kernel s8 "
+            "MXU dots, disabled for this toolchain "
+            "(TDTPU_WIRE_INT8_MXU=0); use wire_dtype='int8' "
+            "(dequant-then-matmul) or the bf16 wire"
+        )
+
+
+def quantize_cols(b):
+    """(K, N) matmul weight → ((K, N) int8, (1, N) f32 scales):
+    symmetric per-out-channel weight quantization for the int8-MXU
+    consumers (the stationary-operand half of the s8×s8 product; the
+    moving half is the per-chunk wire quantization). Same convention as
+    ``kernels.group_gemm.quantize_grouped_weights`` with E=1, kept 2-D
+    so the (1, bn) scale block is a legal Mosaic operand."""
+    bf = b.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(bf), axis=0, keepdims=True)        # (1, N)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(bf / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def epilogue_consume(q_hbm, s_hbm, out_hbm):
+    """Record (under an active shmemlint recorder) that a quantized
+    payload slab is consumed by an MXU pipeline whose ACCUMULATOR
+    EPILOGUE folds the paired scale plane — the provenance edge that
+    lets the dataflow pass treat the slab as dequantized-on-consume
+    (SL008) while still checking the scale pairing (SL009/SL010).
+    Returns True when an event was emitted (the caller then skips its
+    value-level pipeline). ``s_hbm=None`` records a consume WITHOUT the
+    scale fold — the scale-fold-omitted bug SL009 pins."""
+    rec = _lint_recorder()
+    if rec is None:
+        return False
+    from triton_distributed_tpu.analysis import events as ev
+
+    rec.emit(ev.DequantEvent(
+        q_region=q_hbm.region(),
+        s_region=None if s_hbm is None else s_hbm.region(),
+        dst_region=None if out_hbm is None else out_hbm.region(),
+        epilogue=True,
+    ))
+    return True
+
+
 def wire_blockable(rows: int, cols: int, quant: str, strict: bool) -> bool:
     """Can a (rows, cols) slab carry this wire format at all? (legal
     chunking + lowerable column blocks + the scale overhead actually
     saves bytes — tiny-cols slabs where the 512 B/chunk plane eats the
     compression are rejected rather than silently shipped larger)."""
-    fmt = make_wire_format(quant, rows, strict=strict)
+    fmt = make_wire_format(wire_payload(quant), rows, strict=strict)
     if fmt is None or _wire_cols_block(cols, 1) is None:
         return False
     return fmt.slab_bytes(rows, cols) < rows * cols * 2  # vs bf16 wire

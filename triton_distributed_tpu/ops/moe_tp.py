@@ -71,9 +71,14 @@ class MoETPContext:
     # Quantized ring wire for the OVERLAPPED engines (lang.wire):
     # 'fp8'/'int8' ships the sorted token slabs (AG side, quantized once
     # at the source) and the per-hop partials (RS side, f32 dequant-
-    # accumulate) as 1-byte payloads + per-chunk scales. None → bf16
-    # wire. Explicit opt-in (no 'auto' here — the MoE context is static
-    # configuration, like its quant= twin on the EP transport).
+    # accumulate) as 1-byte payloads + per-chunk scales. 'int8-mxu'
+    # ends the AG wire at the MXU: arriving int8 slabs feed the s8×s8
+    # grouped GEMM against per-(expert, out-channel) quantized weights
+    # with the scales folded in the accumulator epilogue — no
+    # per-arrival dequant pass (the RS side then carries the int8
+    # payload wire). None → bf16 wire. Explicit opt-in (no 'auto' here
+    # — the MoE context is static configuration, like its quant= twin
+    # on the EP transport).
     wire_dtype: str | None = None
 
     @property
@@ -281,6 +286,20 @@ def _build_ag_gg_fused(ctx: MoETPContext, cap_s, k, nl_local):
     )
     if ctx.wire_dtype is None:
         body = lambda be, xs, w: call(be, xs, w)[0]  # noqa: E731
+    elif ctx.wire_dtype == "int8-mxu":
+        from triton_distributed_tpu.kernels.group_gemm import (
+            quantize_grouped_weights,
+        )
+        from triton_distributed_tpu.lang import wire as wirelib
+
+        fmt = _wire_fmt(ctx.wire_dtype, cap_s, blocks[0])
+
+        def body(be, xs, w):
+            # both operands quantized once in XLA; the kernel consumes
+            # wire bytes end to end (scales fold in the GEMM epilogue)
+            xq, xsc = wirelib.quantize_slab(xs, fmt)
+            wq, wsc = quantize_grouped_weights(w, "int8")
+            return call(be, xq, xsc, wq, wsc[:, None, :])[0]
     else:
         from triton_distributed_tpu.lang import wire as wirelib
 

@@ -87,3 +87,94 @@ def is_dcn_axis(mesh: Mesh, axis: str) -> bool:
     remote DMA must not be used there; the op entries fall back to XLA
     collectives (≡ the reference's CommScope INTER_NODE dispatch)."""
     return detect_topology(mesh, axis).link_kind == LinkKind.DCN
+
+
+# -------------------------------------------------- quantized DCN rails
+#
+# The hierarchical engines' DCN legs are the slowest transport in the
+# system, and until round 8 they moved raw bf16 while the intra-slice
+# rings already shipped the compressed wire (ROADMAP PR-3 follow-on).
+# These helpers put the lang.wire layout on the rail legs themselves:
+# XLA-side quantize/dequant around the ``ppermute`` hops, so they run on
+# any backend (DCN has no Pallas reach anyway — Mosaic cast support is
+# irrelevant here) and the bytes crossing DCN drop ~2× (payload at
+# 1 B/elem + the per-chunk f32 scale plane riding the same hop). The
+# XLA-fallback AG compresses DCN the same way (kernels/allgather.py's
+# XLA_FALLBACK wire); this is that trick applied to the chunked rails.
+
+def dcn_wire_fetches(a_loc, dcn_axis: str, nd: int, fmt):
+    """The quantized twin of the hierarchical AG rail: ``nd - 1``
+    independent ``ppermute`` fetches of the OTHER slices' slabs, each
+    hop carrying the once-quantized payload + scale plane and each
+    arrival dequantized back to the compute dtype. Returns the ``nd``
+    chunks in rail order (local slice first, matching the raw rail) —
+    chunk ``s`` holds slice ``(my - s)``'s rows. All fetches are issued
+    up front, so XLA's async collective machinery still flies the DCN
+    legs under whatever consumes chunk 0."""
+    import jax
+
+    from triton_distributed_tpu.lang import wire as wirelib
+
+    q, sc = wirelib.quantize_slab(a_loc, fmt)
+    chunks = [a_loc]
+    for s in range(1, nd):
+        perm = [(i, (i + s) % nd) for i in range(nd)]
+        qg = jax.lax.ppermute(q, dcn_axis, perm=perm)
+        sg = jax.lax.ppermute(sc, dcn_axis, perm=perm)
+        chunks.append(wirelib.dequantize_slab(qg, sg, fmt, a_loc.dtype))
+    return chunks
+
+
+def dcn_wire_all_gather(a_loc, dcn_axis: str, fmt):
+    """Quantized serial rail: gather the once-quantized payload + scale
+    planes across slices and dequantize, with the OWN slab patched back
+    exact (it never crossed DCN) — byte-identical to the XLA-fallback
+    AG wire in kernels/allgather.py."""
+    import jax
+
+    from triton_distributed_tpu.lang import wire as wirelib
+
+    q, sc = wirelib.quantize_slab(a_loc, fmt)
+    qg = jax.lax.all_gather(q, dcn_axis, tiled=True)
+    sg = jax.lax.all_gather(sc, dcn_axis, tiled=True)
+    out = wirelib.dequantize_slab(qg, sg, fmt, a_loc.dtype)
+    me = jax.lax.axis_index(dcn_axis)
+    return jax.lax.dynamic_update_slice(
+        out, a_loc, (me * a_loc.shape[0],) + (0,) * (a_loc.ndim - 1)
+    )
+
+
+def dcn_wire_reduce_scatter(part, dcn_axis: str, nd: int, fmt):
+    """Quantized twin of the hierarchical RS leg's ``psum_scatter``: a
+    manual ``ppermute`` reduce ring whose hops carry per-hop-quantized
+    partials (payload + scale rails) with the f32 dequant-accumulate
+    fold — the RS wire contract (one bounded rounding per hop), the
+    same bytes the fused gemm_rs wire ring ships, now on the DCN rail.
+    ``part``: (rows, cols) partial with rows divisible by ``nd``;
+    returns this slice's (rows/nd, cols) reduced stripe."""
+    import jax
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.lang import wire as wirelib
+
+    me = jax.lax.axis_index(dcn_axis)
+    m_s = part.shape[0] // nd
+    perm = [(i, (i - 1) % nd) for i in range(nd)]
+
+    def stripe(i):
+        return jax.lax.dynamic_slice(
+            part, (i * m_s, 0), (m_s, part.shape[1])
+        )
+
+    def step(h, acc):
+        q, sc = wirelib.quantize_slab(acc, fmt)
+        q = jax.lax.ppermute(q, dcn_axis, perm=perm)
+        sc = jax.lax.ppermute(sc, dcn_axis, perm=perm)
+        arrived = wirelib.dequantize_slab(q, sc, fmt, jnp.float32)
+        nxt = jax.lax.rem(me + 2 + h, nd)
+        return (arrived + stripe(nxt).astype(jnp.float32)).astype(
+            part.dtype
+        )
+
+    acc = stripe(jax.lax.rem(me + 1, nd))
+    return jax.lax.fori_loop(0, nd - 1, step, acc)

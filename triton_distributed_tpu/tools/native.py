@@ -266,17 +266,52 @@ import functools as _functools
 
 
 @_functools.lru_cache(maxsize=128)
-def _xla_ag_gemm_fn(mesh, axis, batch_axes, out_dtype, ikey=None):
+def _xla_ag_gemm_fn(mesh, axis, batch_axes, out_dtype, ikey=None,
+                    wire=None):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from triton_distributed_tpu import lang
+    from triton_distributed_tpu.lang import wire as wirelib
 
     ba = tuple(batch_axes)
+    mx = wire == "int8-mxu"
 
     def body(a_loc, b_loc):
-        a_full = jax.lax.all_gather(a_loc, axis, tiled=True)
+        fmt = (
+            wirelib.make_wire_format(
+                wirelib.wire_payload(wire), a_loc.shape[0], strict=False
+            )
+            if wire is not None else None
+        )
+        if fmt is None:
+            a_full = jax.lax.all_gather(a_loc, axis, tiled=True)
+            return jnp.dot(
+                a_full, b_loc, preferred_element_type=jnp.float32
+            ).astype(out_dtype)
+        # byte-identical lang.wire rails over the XLA gather: the
+        # degradation target preserves the wire layout (and for
+        # int8-mxu the epilogue-fold numerics) so accuracy tests run on
+        # any backend
+        q, sc = wirelib.quantize_slab(a_loc, fmt)
+        qg = jax.lax.all_gather(q, axis, tiled=True)
+        sg = jax.lax.all_gather(sc, axis, tiled=True)
+        if mx:
+            bq, bs = wirelib.quantize_cols(b_loc)
+            row_scale = jnp.repeat(sg[:, :1], fmt.chunk_rows, axis=0)
+            acc = jax.lax.dot_general(
+                qg, bq, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            return (
+                acc.astype(jnp.float32) * row_scale * bs
+            ).astype(out_dtype)
+        a_full = wirelib.dequantize_slab(qg, sg, fmt, a_loc.dtype)
+        me = jax.lax.axis_index(axis)
+        a_full = jax.lax.dynamic_update_slice(
+            a_full, a_loc, (me * a_loc.shape[0], 0)
+        )
         return jnp.dot(
             a_full, b_loc, preferred_element_type=jnp.float32
         ).astype(out_dtype)
@@ -295,39 +330,68 @@ def _xla_ag_gemm_fn(mesh, axis, batch_axes, out_dtype, ikey=None):
     return jax.jit(fn)
 
 
-def xla_ag_gemm(a, b, mesh, axis, *, batch_axes=(), out_dtype=None):
+def xla_ag_gemm(a, b, mesh, axis, *, batch_axes=(), out_dtype=None,
+                wire_dtype=None):
     """AllGather(A) @ B via plain XLA — the ag_gemm degradation target.
     Same layout contract as ``kernels.ag_gemm`` (rows sharded over
-    ``(*batch_axes, axis)``, B cols sharded over ``axis``)."""
+    ``(*batch_axes, axis)``, B cols sharded over ``axis``).
+    ``wire_dtype`` ('fp8'/'int8'/'int8-mxu'): the degraded path keeps
+    shipping the byte-identical lang.wire payload+scale rails — and for
+    'int8-mxu' the epilogue-fold numerics — so a demotion never changes
+    the wire format mid-flight."""
     import jax.numpy as jnp
 
     from triton_distributed_tpu.config import interp_key
+    from triton_distributed_tpu.lang import wire as wirelib
 
     out_dtype = jnp.dtype(out_dtype or a.dtype)
     return _xla_ag_gemm_fn(
-        mesh, axis, tuple(batch_axes), out_dtype, interp_key()
+        mesh, axis, tuple(batch_axes), out_dtype, interp_key(),
+        wirelib.normalize_wire(wire_dtype),
     )(a, b)
 
 
 @_functools.lru_cache(maxsize=128)
-def _xla_gemm_rs_fn(mesh, axis, batch_axes, out_dtype, ikey=None):
+def _xla_gemm_rs_fn(mesh, axis, batch_axes, out_dtype, ikey=None,
+                    wire=None):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from triton_distributed_tpu import lang
+    from triton_distributed_tpu.lang import wire as wirelib
 
     ba = tuple(batch_axes)
+    n = mesh.shape[axis]
 
     def body(a_loc, b_loc):
         part = jnp.dot(a_loc, b_loc, preferred_element_type=jnp.float32)
+        fmt = (
+            wirelib.make_wire_format(
+                wirelib.wire_payload(wire), part.shape[0] // n,
+                strict=False,
+            )
+            if wire is not None and part.shape[0] % n == 0 else None
+        )
+        if fmt is not None:
+            # quantized ppermute reduce ring — the same per-hop
+            # payload+scale rails and f32 dequant-accumulate as the
+            # Pallas wire ring (runtime.multislice shares the body with
+            # the hierarchical DCN legs)
+            from triton_distributed_tpu.runtime.multislice import (
+                dcn_wire_reduce_scatter,
+            )
+
+            return dcn_wire_reduce_scatter(
+                part.astype(out_dtype), axis, n, fmt
+            )
         return jax.lax.psum_scatter(
             part, axis, scatter_dimension=0, tiled=True
         ).astype(out_dtype)
 
     body = lang.maybe_instrument(
         body, axis=axis, site="gemm_rs", collective_id="xla_fallback",
-        n=mesh.shape[axis],
+        n=n,
     )
     fn = jax.shard_map(
         body,
@@ -339,14 +403,19 @@ def _xla_gemm_rs_fn(mesh, axis, batch_axes, out_dtype, ikey=None):
     return jax.jit(fn)
 
 
-def xla_gemm_rs(a, b, mesh, axis, *, batch_axes=(), out_dtype=None):
+def xla_gemm_rs(a, b, mesh, axis, *, batch_axes=(), out_dtype=None,
+                wire_dtype=None):
     """(A @ B) → ReduceScatter via plain XLA — the gemm_rs degradation
-    target. Same layout contract as ``kernels.gemm_rs``."""
+    target. Same layout contract as ``kernels.gemm_rs``. ``wire_dtype``
+    keeps the demoted path on the byte-identical quantized reduce ring
+    (per-hop payload+scale rails, f32 dequant-accumulate)."""
     import jax.numpy as jnp
 
     from triton_distributed_tpu.config import interp_key
+    from triton_distributed_tpu.lang import wire as wirelib
 
     out_dtype = jnp.dtype(out_dtype or a.dtype)
     return _xla_gemm_rs_fn(
-        mesh, axis, tuple(batch_axes), out_dtype, interp_key()
+        mesh, axis, tuple(batch_axes), out_dtype, interp_key(),
+        wirelib.normalize_wire(wire_dtype),
     )(a, b)

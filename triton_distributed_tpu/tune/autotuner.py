@@ -331,18 +331,24 @@ def method_tuner(name, run, methods, *, warmup=1, iters=3, rounds=3):
     )
 
 
-def wire_tuner(name, run, *, warmup=1, iters=3, rounds=3):
+def wire_tuner(name, run, *, warmup=1, iters=3, rounds=3, mxu=False):
     """Wire-dtype selection tuner for ``wire_dtype='auto'``: the raw
     bf16 wire vs the fp8 wire, benched end to end with the same paired
     snake-order methodology as :func:`method_tuner` (wire gains on
     comm-bound shapes are tens of percent, but on compute-bound shapes
     the two are within the run-to-run spread — the rounds protocol is
-    what keeps a noise artifact from pinning the lossy wire). int8 is
-    deliberately NOT a candidate: it is never faster than fp8 (same
-    byte count) and strictly worse numerically — it stays an explicit
-    opt-in for int8-MXU consumers."""
+    what keeps a noise artifact from pinning the lossy wire). The plain
+    int8 wire is deliberately NOT a candidate: it is never faster than
+    fp8 (same byte count) and strictly worse numerically — it stays an
+    explicit opt-in. ``mxu=True`` (the caller declared int8 weight
+    numerics, ``wq='int8'``) adds the dequant-free 'int8-mxu' candidate,
+    which CAN beat fp8: same wire bytes, no per-arrival dequant pass,
+    and the shard matmul at the s8×s8 MXU rate."""
+    configs = [{"wire_dtype": "bf16"}, {"wire_dtype": "fp8"}]
+    if mxu:
+        configs.append({"wire_dtype": "int8-mxu"})
     return ContextualAutoTuner(
-        run, [{"wire_dtype": "bf16"}, {"wire_dtype": "fp8"}],
+        run, configs,
         name=name, warmup=warmup, iters=iters, rounds=rounds,
     )
 

@@ -27,14 +27,25 @@ class TpuSpec:
     hbm_gbps: float          # HBM bandwidth, per chip
     ici_gbps: float          # ICI bandwidth per link, per direction
     ici_links: int           # torus links per chip
+    int8_tops: float = 0.0   # peak s8×s8→s32 MXU rate (0 = no speedup)
+
+    @property
+    def s8_tops(self) -> float:
+        """Effective int8 MXU rate: the native path where the datasheet
+        lists one, else the bf16 rate (int8 then buys bytes, not
+        FLOPs)."""
+        return self.int8_tops or self.bf16_tflops
 
 
 # Public datasheet numbers (cloud.google.com/tpu/docs/system-architecture).
+# int8 TOPS: the native s8×s8→s32 path — ~2× the bf16 rate on v5e/v5p/
+# v6e (the W8A8 grouped GEMM measured 320–350 TOP/s on a v5e against the
+# 394 peak, kernels/group_gemm.py); v4 has no separate int8 path.
 TPU_SPECS = {
     "v4": TpuSpec("v4", 275.0, 1228.0, 50.0, 6),
-    "v5e": TpuSpec("v5e", 197.0, 819.0, 50.0, 4),
-    "v5p": TpuSpec("v5p", 459.0, 2765.0, 100.0, 6),
-    "v6e": TpuSpec("v6e", 918.0, 1640.0, 100.0, 4),
+    "v5e": TpuSpec("v5e", 197.0, 819.0, 50.0, 4, int8_tops=394.0),
+    "v5p": TpuSpec("v5p", 459.0, 2765.0, 100.0, 6, int8_tops=918.0),
+    "v6e": TpuSpec("v6e", 918.0, 1640.0, 100.0, 4, int8_tops=1836.0),
 }
 _DEFAULT = TPU_SPECS["v5e"]
 
@@ -61,6 +72,43 @@ def estimate_gemm_ms(m: int, k: int, n: int, spec: TpuSpec | None = None,
     bytes_moved = 2 * (m * k + k * n + m * n)
     mem_ms = bytes_moved / (spec.hbm_gbps * 1e9) * 1e3
     return max(flops_ms, mem_ms)
+
+
+def estimate_s8_gemm_ms(m: int, k: int, n: int, spec: TpuSpec | None = None,
+                        efficiency: float = 0.75) -> float:
+    """Speed-of-light s8×s8→s32 matmul time: the int8-MXU twin of
+    :func:`estimate_gemm_ms` — 1-byte operands halve the HBM traffic
+    and the native int8 path runs at ``spec.s8_tops``."""
+    spec = spec or detect_spec()
+    flops_ms = (2 * m * k * n) / (spec.s8_tops * 1e12 * efficiency) * 1e3
+    bytes_moved = (m * k + k * n) + 2 * m * n   # s8 in, bf16-ish out
+    mem_ms = bytes_moved / (spec.hbm_gbps * 1e9) * 1e3
+    return max(flops_ms, mem_ms)
+
+
+def dequant_pass_ms(rows: int, cols: int, out_itemsize: int = 2,
+                    spec: TpuSpec | None = None) -> float:
+    """Cost of one per-arrival dequant pass over a wire slab: read the
+    1-byte payload (+ scale plane, negligible), write the widened copy —
+    pure HBM traffic, the VPU multiply is free under it. This is the
+    SKIPPED-PASS term of the int8→MXU model: the epilogue-folded
+    consumer never runs this pass (and never re-reads the widened copy
+    either, which :func:`estimate_gemm_ms`'s A-term would charge)."""
+    spec = spec or detect_spec()
+    return rows * cols * (1 + out_itemsize) / (spec.hbm_gbps * 1e9) * 1e3
+
+
+def int8_mxu_step_ratio(slab_rows: int, k: int, n_cols: int,
+                        spec: TpuSpec | None = None) -> float:
+    """Projected per-ring-step speedup of the dequant-free int8→MXU
+    consumer over dequant-then-matmul on the same int8 wire:
+    (dequant pass + bf16 shard matmul) / s8×s8 shard matmul. > 1 means
+    the perf model projects the epilogue path as a win."""
+    spec = spec or detect_spec()
+    legacy = dequant_pass_ms(slab_rows, k, 2, spec) + estimate_gemm_ms(
+        slab_rows, k, n_cols, spec
+    )
+    return legacy / estimate_s8_gemm_ms(slab_rows, k, n_cols, spec)
 
 
 def estimate_all_gather_ms(shard_bytes: int, n: int,
@@ -122,15 +170,54 @@ def ring_wire_ms(slab_bytes: int, spec: TpuSpec | None = None) -> float:
 
 def auto_wire_dtype(slab_rows: int, k: int, n_cols: int, itemsize: int,
                     *, slab_bytes: int | None = None,
-                    spec: TpuSpec | None = None) -> str:
+                    spec: TpuSpec | None = None,
+                    consumer_wq: str | None = None) -> str:
     """'fp8' when the ring is comm-bound at these per-step shapes —
     i.e. the bf16 slab transfer (``slab_bytes``, default the A slab
     rows×k) outlasts the per-step shard matmul the ring hides it under
     — else 'bf16'. Compressing a compute-bound ring buys nothing
     (overlap is already 100%) and costs accuracy, so the selector only
-    reaches for the 1-byte wire where it widens the overlap range."""
+    reaches for the 1-byte wire where it widens the overlap range.
+
+    ``consumer_wq='int8'``: the consumer has declared int8 weight
+    numerics, so on comm-bound shapes the selector picks the
+    DEQUANT-FREE 'int8-mxu' wire instead of fp8 — same wire bytes, but
+    the per-arrival dequant pass disappears and the shard matmul runs
+    at the s8×s8 MXU rate (both terms the step-ratio model above
+    projects as a win exactly where the wire engages)."""
     spec = spec or detect_spec()
     compute_ms = estimate_gemm_ms(slab_rows, k, n_cols, spec)
     if slab_bytes is None:
         slab_bytes = slab_rows * k * itemsize
-    return "fp8" if ring_wire_ms(slab_bytes, spec) > compute_ms else "bf16"
+    if ring_wire_ms(slab_bytes, spec) <= compute_ms:
+        return "bf16"
+    return "int8-mxu" if consumer_wq == "int8" else "fp8"
+
+
+# ------------------------------------------------ hop critical-path term
+#
+# The dataflow pass (analysis/dataflow.py) counts, per element of every
+# contract destination, how many remote DMAs the bytes rode. Feeding
+# that histogram back here turns it into a pre-hardware critical-path
+# check: a ring of n ranks delivers every chunk in ≤ n-1 hops, so a
+# schedule whose max hop count exceeds that has serialized (or detoured)
+# its transfers — visible as wall-clock before any chip run (ROADMAP
+# PR-4 follow-on, closed round 8: lint rule SL011).
+
+def hop_critical_path_ms(max_hop: int, hop_bytes: int,
+                         spec: TpuSpec | None = None) -> float:
+    """Wire time of the LONGEST delivery chain: ``max_hop`` sequential
+    ring-step transfers of ``hop_bytes`` each (hops on one chain cannot
+    overlap each other — each forwards what the previous delivered)."""
+    return max_hop * ring_wire_ms(hop_bytes, spec)
+
+
+def ring_depth_regression(max_hop: int, n: int, hop_bytes: int,
+                          spec: TpuSpec | None = None):
+    """None when the observed max hop count is within the ring-optimal
+    n-1; else (excess_hops, excess_ms) — the critical-path regression a
+    serialized/detoured schedule pays per collective."""
+    if max_hop <= max(n - 1, 1):
+        return None
+    excess = max_hop - (n - 1)
+    return excess, hop_critical_path_ms(excess, hop_bytes, spec)
