@@ -242,6 +242,15 @@ def _parse_args(argv=None):
         "timed run",
     )
     ap.add_argument(
+        "--dryrun", action="store_true",
+        help="hardware-free engine exercise: run ONLY the "
+        "serving_continuous bench at interpreter-tiny shapes (whatever "
+        "the platform) and exit — with --faults, the fault plan is "
+        "active inside the ragged kernel and the scheduler's "
+        "eviction/degradation behavior runs under it (the robustness "
+        "follow-on: chaos-line replay without a TPU)",
+    )
+    ap.add_argument(
         "--faults", default=None, metavar="SPEC",
         help="replay a nightly chaos line on real hardware: a "
         "(seed, faults) spec, e.g. \"seed=7; Delay(site=allgather, "
@@ -304,6 +313,19 @@ def main(argv=None) -> None:
             json.dumps({"metric": "fault_replay", "plan": repr(plan)}),
             file=sys.stderr, flush=True,
         )
+
+    if args.dryrun:
+        from triton_distributed_tpu.tune.perf_model import detect_spec
+
+        devs = jax.devices()
+        mesh = Mesh(np.asarray(devs), ("x",))
+        out = _bench_serving_continuous(
+            mesh, len(devs), jax.default_backend() == "tpu",
+            detect_spec(), tiny=True,
+        )
+        out["faults"] = args.faults
+        print(json.dumps(out), flush=True)
+        return
 
     from triton_distributed_tpu.kernels.ag_gemm import (
         _build_fused,
@@ -465,7 +487,8 @@ def main(argv=None) -> None:
     for fn in (_bench_gemm_rs, _bench_wire_rings, _bench_group_gemm,
                _bench_moe_a2a, _bench_flash_decode,
                _bench_serving_moe_decode, _bench_serving_multilayer,
-               _bench_serving_paged, _bench_generate_scan):
+               _bench_serving_paged, _bench_generate_scan,
+               _bench_serving_continuous):
         try:
             print(json.dumps(fn(mesh, n, on_tpu, spec)), file=sys.stderr, flush=True)
         except Exception as e:
@@ -1137,6 +1160,184 @@ def _bench_serving_paged(mesh, n, on_tpu, spec):
     if t1:
         out["vs_contiguous"] = round(t_step / t1, 3)
     return out
+
+
+def _serving_continuous_config(n, on_tpu, tiny=False):
+    """(model config, engine config, trace knobs) for the continuous
+    bench. TPU: the serving headline model (hidden 7168, EP-MoE, every
+    int8 knob) under the ISSUE-6 traffic shape — B≫128 requests,
+    lengths ~U[S/8, 3S/4] against S=2048. Off-TPU (and --dryrun):
+    interpreter-sized shapes, same shape of traffic."""
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.models import TransformerConfig
+    from triton_distributed_tpu.serving import EngineConfig
+
+    # KV heads shard over tp in the serving state — keep divisible
+    n_kv = n if n > 4 else 4
+    if on_tpu and not tiny:
+        s_cap = 2048
+        cfg = TransformerConfig(
+            vocab=4096, n_layers=1, hidden=7168, ffn=2048, n_heads=7 * n_kv,
+            n_kv_heads=n_kv, head_dim=128, moe="ep", moe_layers=(0,),
+            num_experts=max(8, n), topk=8, param_dtype=jnp.bfloat16,
+            moe_weight_quant="int8", moe_act_quant="int8", kv_quant="int8",
+            dense_weight_quant="int8", dense_act_quant="int8",
+        )
+        ecfg = EngineConfig(
+            slots=160, token_budget=512, chunk=256, page=1024,
+            npages=352, max_steps=200_000,
+        )
+        trace_kw = dict(
+            n_requests=256, mean_interarrival=0.25,
+            len_lo=s_cap // 8, len_hi=3 * s_cap // 4,
+            max_new_lo=16, max_new_hi=64, vocab=4096,
+        )
+    else:
+        s_cap = 64
+        cfg = TransformerConfig(
+            vocab=256, n_layers=1, hidden=128, ffn=128, n_heads=2 * n_kv,
+            n_kv_heads=n_kv, head_dim=32, moe="ep", moe_layers=(0,),
+            num_experts=max(4, n), topk=2, param_dtype=jnp.bfloat16,
+            dtype=jnp.float32,
+        )
+        ecfg = EngineConfig(
+            slots=6, token_budget=48, chunk=16, page=8,
+            npages=40, max_steps=5_000,
+        )
+        trace_kw = dict(
+            n_requests=24, mean_interarrival=0.6,
+            len_lo=s_cap // 8, len_hi=3 * s_cap // 4,
+            max_new_lo=3, max_new_hi=8, vocab=256,
+        )
+    return cfg, ecfg, trace_kw, s_cap
+
+
+def _bench_serving_continuous(mesh, n, on_tpu, spec, tiny=False):
+    """CONTINUOUS-BATCHING serving on the ragged paged-attention kernel
+    (ISSUE 6 tentpole acceptance): a seeded Poisson arrival trace with
+    ~U[S/8, 3S/4] prompt lengths drives the ServingEngine — admission/
+    eviction over the page pool, chunked prefill interleaved into
+    decode batches, one ragged mixed kernel launch per step — and the
+    same trace is then served by the FIXED-BATCH paged baseline (FCFS
+    rectangles of `slots` requests through prefill + generate_scan over
+    the paged decode path). Reports sustained tok/s, p50/p99 step time
+    and GOODPUT (completed requests' generated tokens per wall second)
+    for both; ``goodput_vs_fixed_batch`` > 1 is the acceptance."""
+    import time as _time
+
+    import jax
+
+    from triton_distributed_tpu.models import Transformer
+    from triton_distributed_tpu.serving import ServingEngine, poisson_trace
+    from triton_distributed_tpu.tune.perf_model import (
+        ragged_serving_step_ms,
+    )
+
+    cfg, ecfg, trace_kw, s_cap = _serving_continuous_config(
+        n, on_tpu, tiny
+    )
+    model = Transformer(cfg, mesh, tp_axis="x")
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, s),
+        model.init(jax.random.PRNGKey(7)), model.shardings(),
+    )
+    params = model.quantize_moe_weights(params)
+    params = model.quantize_dense_weights(params)
+
+    def fresh_trace():
+        return poisson_trace(seed=11, **trace_kw)
+
+    # ---- continuous engine (run twice; first run pays the compiles)
+    for _warm in (False, True):
+        trace = fresh_trace()
+        eng = ServingEngine(model, params, ecfg)
+        stats = eng.run(trace)
+    assert stats.completed == trace_kw["n_requests"], (
+        stats.completed, stats.deferrals)
+
+    # ---- fixed-batch paged baseline on the SAME trace: FCFS
+    # rectangles of `slots` requests, padded prompts, every row decoded
+    # until the batch's LAST row finishes (the stragglers the engine
+    # does not wait for)
+    b = ecfg.slots
+    page = ecfg.page
+    r_ranks = mesh.shape["x"]
+    cap_align = r_ranks * page             # paged capacity granularity
+
+    def run_baseline():
+        trace = fresh_trace()
+        total_useful = 0
+        t0 = _time.perf_counter()
+        for i in range(0, len(trace), b):
+            batch = trace[i:i + b]
+            bb = len(batch)
+            maxlen = max(len(r.prompt) for r in batch)
+            steps = max(r.max_new for r in batch)
+            # ONE rectangle for all batches (a per-batch capacity
+            # would recompile prefill/scan per batch — charge the
+            # rectangle its true cost, not compile time)
+            cap = -(-(s_cap + trace_kw["max_new_hi"] + 1)
+                    // cap_align) * cap_align
+            toks = np.zeros((bb, s_cap), np.int32)
+            lens = np.zeros((bb,), np.int32)
+            for j, r in enumerate(batch):
+                toks[j, :len(r.prompt)] = r.prompt
+                lens[j] = len(r.prompt)
+            del maxlen
+            caches = model.init_cache(bb, cap)
+            last, caches, klens = model._prefill_jit(
+                params, caches, jnp.asarray(toks), jnp.asarray(lens)
+            )
+            first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            pcaches, table = model.paginate_caches(caches, page=page)
+            out = model.generate_scan(
+                params, pcaches, klens, first, int(steps) - 1,
+                block_table=table,
+            )
+            np.asarray(out[0])             # fence
+            total_useful += sum(r.max_new for r in batch)
+        return total_useful / (_time.perf_counter() - t0)
+
+    run_baseline()                          # compile warm
+    base_goodput = run_baseline()
+
+    # model term: a representative steady step (every slot decoding at
+    # the mean trace length)
+    mean_len = (trace_kw["len_lo"] + trace_kw["len_hi"]) // 2
+    model_ms = ragged_serving_step_ms(
+        [mean_len] * ecfg.slots, [1] * ecfg.slots, page=page,
+        hkv=cfg.n_kv_heads // n, g=cfg.n_heads // cfg.n_kv_heads,
+        d=cfg.head_dim, hidden=cfg.hidden,
+        spec=spec, quant=cfg.kv_quant is not None,
+    )
+    ratio = (stats.goodput_tok_per_s / base_goodput
+             if base_goodput > 0 else float("inf"))
+    return {
+        "metric": "serving_continuous",
+        "value": round(stats.goodput_tok_per_s, 1),
+        "unit": "tok/s goodput",
+        "sustained_tok_per_s": round(stats.sustained_tok_per_s, 1),
+        "p50_step_ms": round(stats.p50_step_ms, 2),
+        "p99_step_ms": round(stats.p99_step_ms, 2),
+        "steps": len(stats.step_times),
+        "completed": stats.completed,
+        "evictions": stats.evictions,
+        "deferrals": stats.deferrals,
+        "degraded_to_xla": stats.degraded,
+        "fixed_batch_goodput": round(base_goodput, 1),
+        "goodput_vs_fixed_batch": round(ratio, 3),
+        "model_steady_step_ms": round(model_ms, 3),
+        "config": (
+            f"n={n} slots={ecfg.slots} budget={ecfg.token_budget} "
+            f"chunk={ecfg.chunk} page={page} npages={ecfg.npages} "
+            f"requests={trace_kw['n_requests']} "
+            f"lens~U[{trace_kw['len_lo']},{trace_kw['len_hi']}] "
+            f"poisson(seed=11) hidden={cfg.hidden} "
+            f"kvq={cfg.kv_quant} "
+            + ("tiny-dryrun" if tiny or not on_tpu else "headline")
+        ),
+    }
 
 
 def _bench_serving_moe_decode(mesh, n, on_tpu, spec):

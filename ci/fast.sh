@@ -5,10 +5,13 @@
 # Runs the `fast`-marked modules — the static analysis suite
 # (shmemlint + the Mosaic-compat pre-flight), the fault engine, the
 # host-level runtime/topology logic, the wire-layout/XLA-twin tests,
-# the lang-layer slices, and the tools — everything that answers
-# "did I just break a protocol, a contract, or the host plumbing?"
-# without paying for the interpreted model/serving suites. Use it as
-# the inner-loop gate; the full tier-1 run remains the merge gate.
+# the lang-layer slices, the tools, and the continuous-batching
+# serving suite (the ragged-kernel numerics + scheduler tests,
+# tests/test_ragged_attention.py + tests/test_serving_engine.py) —
+# everything that answers "did I just break a protocol, a contract,
+# or the host plumbing?" without paying for the big interpreted model
+# suites. Use it as the inner-loop gate; the full tier-1 run remains
+# the merge gate.
 #
 #   ci/fast.sh              # the subset
 #   ci/fast.sh -x -k wire   # extra pytest args pass through

@@ -78,10 +78,23 @@ class TestRegistryClean:
     def test_every_family_produces_cross_rank_traffic(self):
         """A vacuously-clean analyzer is worthless: every family's
         symbolic execution must record real cross-rank events (puts to
-        a different rank and/or remote signals) on every rank."""
+        a different rank and/or remote signals) on every rank —
+        except `local`-contract families (the ragged serving kernel),
+        which must instead record real LOCAL DMA traffic."""
         for name, fam in families().items():
             rec, _ = analyze_family(fam, 4)
+            is_local = (
+                fam.contract is not None
+                and getattr(fam.contract, "kind", None) == "local"
+            )
             for r in range(4):
+                if is_local:
+                    local = [
+                        e for e in rec.traces[r]
+                        if isinstance(e, events.PutEvent) and e.local
+                    ]
+                    assert local, f"{name}: rank {r} recorded no DMAs"
+                    continue
                 cross = [
                     e for e in rec.traces[r]
                     if (isinstance(e, events.PutEvent) and e.dst_rank != r)
@@ -498,7 +511,7 @@ class TestEventModel:
         assert set(RULES) == {
             "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007",
             "SL008", "SL009", "SL010", "SL011",
-            "MC001", "MC002", "MC003", "MC004",
+            "MC001", "MC002", "MC003", "MC004", "MC005",
         }
 
     def test_ring_trace_targets_right_neighbor(self):
@@ -642,3 +655,77 @@ class TestWirePayloadBytes:
         payload = [p for p in puts if p.src_region.ref in ("xq_hbm", "agq_hbm")]
         scales = [p for p in puts if p.src_region.ref in ("xs_hbm", "ags_hbm")]
         assert len(payload) == len(scales) == 3
+
+
+# ----------------------------------------------- ragged serving family
+
+class TestRaggedFamily:
+    """The `flash_decode.ragged_paged` family (ISSUE 6): a LOCAL
+    grid kernel analyzed per grid point, its `local` delivery contract,
+    and the MC005 lane-reshape deny rule its packing exists to avoid."""
+
+    def test_family_lints_clean_both_meshes(self):
+        for n in (4, 8):
+            findings = lint_family("flash_decode.ragged_paged", n=n)
+            assert findings == [], [f.format() for f in findings]
+
+    def test_family_is_preflighted(self):
+        from triton_distributed_tpu.analysis import mosaic_compat
+
+        status, f = mosaic_compat.preflight_family(
+            families()["flash_decode.ragged_paged"], 4
+        )
+        assert status == "scanned" and f == []
+
+    def test_grid_walk_runs_every_row(self):
+        """The symbolic evaluator executes one kernel run PER GRID
+        POINT: both rows' out spans carry write events (a single-
+        invocation evaluation would leave row 1's span untouched and
+        the contract pass blind to it)."""
+        rec, _ = analyze_family(families()["flash_decode.ragged_paged"], 4)
+        writes = [
+            e.dst_region for e in rec.traces[0]
+            if isinstance(e, events.PutEvent) and e.local
+            and e.dst_region.ref == "ref9"
+        ]
+        starts = sorted(r.lo[1] for r in writes)
+        assert starts == [0, 8]            # one out-DMA per packed row
+
+    def test_ragged_hole_fixture_is_sl008(self):
+        spec, in_shapes, contract = fixtures.ragged_hole()
+        _, findings = analyze_spec(
+            spec, in_shapes(4), 4, kernel_name="ragged_hole",
+            site="fixture", contract=contract,
+        )
+        holes = [f for f in findings if f.rule == "SL008"]
+        assert holes and all("hole" in f.message for f in holes)
+        assert all(f.severity == Severity.ERROR for f in holes)
+
+    def test_lane_reshape_fixture_is_mc005(self):
+        from triton_distributed_tpu.analysis import mosaic_compat
+
+        spec, in_shapes = fixtures.lane_reshape()
+        f = mosaic_compat.preflight_spec(
+            spec, in_shapes(8), 8, kernel_name="fixture_lane_reshape"
+        )
+        assert [x.rule for x in f] == ["MC005"]
+        assert "lane" in f[0].message
+
+    def test_unit_collapse_reshape_not_flagged(self):
+        """The supported reshape form — unit dims dropped, lane dim
+        kept — must pass MC005 (the existing kernels' idiom)."""
+        from triton_distributed_tpu.analysis import mosaic_compat
+        from triton_distributed_tpu.analysis.fixtures import _spec
+
+        def kernel(x_ref, out_ref):
+            import jax.numpy as jnp
+
+            out_ref[...] = jnp.reshape(x_ref[...], (8, 128))  # (1,8,128)
+
+        f = mosaic_compat.preflight_spec(
+            _spec(kernel, "fixture_unit_collapse",
+                  out_shapes=[((8, 128), np.dtype(np.float32))]),
+            [((1, 8, 128), np.dtype(np.float32))], 8,
+            kernel_name="unit_collapse",
+        )
+        assert [x.rule for x in f] == []

@@ -791,3 +791,78 @@ class TestFusedWireEngines:
             x, mesh8, "x", method=AllGatherMethod.RING_1D, wire_dtype="fp8"
         )
         assert _rel_err(got, x) < 0.06
+
+
+class TestWeightResidency:
+    """Pre-quantized weight residency for the int8-mxu consumers
+    (ROADMAP carried-forward, closed by PR 6): serving layers holding
+    quantize_grouped_weights-style dicts pass the (bq, bs) pair
+    through — NO per-call quantize_cols of B — and ineligible calls
+    widen once and degrade cleanly."""
+
+    def _ab(self):
+        a = jax.random.normal(jax.random.PRNGKey(31), (512, 256),
+                              jnp.bfloat16)
+        b = jax.random.normal(jax.random.PRNGKey(32), (256, 512),
+                              jnp.bfloat16)
+        return a, b
+
+    def test_resident_pair_matches_per_call_quantization(self, mesh8):
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            ag_gemm,
+        )
+
+        a, b = self._ab()
+        ref = np.asarray(ag_gemm(
+            a, b, mesh8, "x", method=AGGemmMethod.XLA_RING,
+            wire_dtype="int8-mxu",
+        ), np.float32)
+        bq, bs = wirelib.quantize_cols(b)
+        got = np.asarray(ag_gemm(
+            a, b, mesh8, "x", method=AGGemmMethod.XLA_RING,
+            b_quant=(bq, bs),
+        ), np.float32)
+        got_dict = np.asarray(ag_gemm(
+            a, {"q": bq, "scale": bs[0]}, mesh8, "x",
+            method=AGGemmMethod.XLA_RING,
+        ), np.float32)
+        np.testing.assert_array_equal(got, ref)
+        np.testing.assert_array_equal(got_dict, ref)
+
+    def test_resident_path_never_requantizes_b(self, mesh8, monkeypatch):
+        from triton_distributed_tpu.kernels.ag_gemm import (
+            AGGemmMethod,
+            ag_gemm,
+        )
+
+        a, b = self._ab()
+        bq, bs = wirelib.quantize_cols(b)
+        calls = {"n": 0}
+        orig = wirelib.quantize_cols
+
+        def counting(x):
+            calls["n"] += 1
+            return orig(x)
+
+        monkeypatch.setattr(wirelib, "quantize_cols", counting)
+        ag_gemm(a, b, mesh8, "x", method=AGGemmMethod.XLA_RING,
+                b_quant=(bq, bs))
+        assert calls["n"] == 0
+
+    def test_ineligible_call_widens_and_degrades(self):
+        """1-device mesh: the resident pair cannot ride a wire — B is
+        widened once and the plain dot runs, within weight-quant
+        error of the dense result."""
+        from jax.sharding import Mesh
+
+        from triton_distributed_tpu.kernels.ag_gemm import ag_gemm
+
+        mesh1 = Mesh(np.asarray(jax.devices()[:1]), ("x",))
+        a, b = self._ab()
+        bq, bs = wirelib.quantize_cols(b)
+        ref = np.asarray(ag_gemm(a, b, mesh1, "x"), np.float32)
+        got = np.asarray(
+            ag_gemm(a, b, mesh1, "x", b_quant=(bq, bs)), np.float32
+        )
+        assert _rel_err(jnp.asarray(got), jnp.asarray(ref)) < 0.02

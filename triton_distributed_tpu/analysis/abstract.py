@@ -363,6 +363,9 @@ def patched_pallas(rec: ev.Recorder):
 
         return run
 
+    # mutated by run_symbolic's grid walk (one kernel execution per
+    # grid point, ids advancing row-major — the sequential-grid
+    # semantics every registered grid kernel pins)
     grid_env = {"ids": (0,) * 8, "dims": (1,) * 8}
 
     patches = [
@@ -383,7 +386,7 @@ def patched_pallas(rec: ev.Recorder):
         saved.append((mod, attr, getattr(mod, attr, None)))
         setattr(mod, attr, repl)
     try:
-        yield
+        yield grid_env
     finally:
         for mod, attr, orig in reversed(saved):
             if orig is None:
@@ -494,13 +497,33 @@ def run_symbolic(launch, in_shapes, n: int, *, axis="x", mesh_axes=None,
         vmem_limit_bytes=launch.vmem_limit_bytes,
     )
     rec = ev.Recorder(n, axis, mesh_axes, info)
+    # grid kernels (the ragged serving family) execute once PER GRID
+    # POINT, row-major, with persistent refs/scratch across steps —
+    # the sequential-grid semantics their SMEM slot carries and
+    # cross-step DMA prefetches rely on. Gridless launches (every
+    # collective family) run exactly once, as before.
+    grid = launch.grid
+    gs = getattr(launch, "grid_spec", None)
+    if grid is None and gs is not None:
+        grid = getattr(gs, "grid", None)
+    points = (
+        list(itertools.product(*(range(int(d)) for d in grid)))
+        if grid else [()]
+    )
     for me in range(n):
         refs = build_refs(launch, in_shapes, rec, init=init)
         rec.start_rank(me)
         old = ev.set_recorder(rec)
         try:
-            with patched_pallas(rec):
-                launch.kernel(*refs)
+            with patched_pallas(rec) as grid_env:
+                if grid:
+                    grid_env["dims"] = tuple(int(d) for d in grid) + (
+                        (1,) * (8 - len(grid))
+                    )
+                for ids in points:
+                    if ids:
+                        grid_env["ids"] = tuple(ids) + (0,) * (8 - len(ids))
+                    launch.kernel(*refs)
         finally:
             ev.set_recorder(old)
     rec.me = None

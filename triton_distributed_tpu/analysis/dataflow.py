@@ -59,8 +59,13 @@ class DeliveryContract:
 
     ``kind``: 'gather' (AG family — every rank ends holding every
     source chunk), 'reduce' (RS family — each output element is one
-    contribution per rank, folded exactly once), or 'permute'
-    (all-to-all — each source's designated chunk lands exactly once).
+    contribution per rank, folded exactly once), 'permute'
+    (all-to-all — each source's designated chunk lands exactly once),
+    or 'local' (a per-rank kernel, e.g. the ragged paged-attention
+    family: every dst element must be covered by the rank's OWN
+    locally computed writes — holes and foreign/mixed provenance are
+    violations, and the shared raw-quantized-bytes check still
+    applies).
     ``dst``: the destination root buffer, by kernel-parameter name or
     positional ref index. ``payload_per_src``: elements each source
     must deliver into dst (callable of the mesh size; default
@@ -504,6 +509,29 @@ def _check_contract(rec, state: _State, contract: DeliveryContract) -> list:
                 "without a dequantize",
                 site=site, ranks=(rank,),
             ))
+        if contract.kind == "local":
+            own = np.int64(1) << (_NIBBLE * rank)
+            foreign = (c != 0) & (c != own)
+            if foreign.any():
+                findings.append(Finding(
+                    "SL008", kernel,
+                    f"rank {rank}'s {dst}{_bbox(foreign)} holds foreign "
+                    "or mixed-provenance bytes — a LOCAL kernel's "
+                    "output must be its own computed writes only",
+                    site=site, ranks=(rank,),
+                ))
+            if contract.full:
+                empty = c == 0
+                if empty.any():
+                    findings.append(Finding(
+                        "SL008", kernel,
+                        f"rank {rank}'s {dst}{_bbox(empty)} was never "
+                        "written — the per-row output spans terminated "
+                        "with a hole (a row's packed span was skipped "
+                        "or mis-addressed)",
+                        site=site, ranks=(rank,),
+                    ))
+            continue
         if contract.kind == "reduce":
             bad = c != full_mask
             if bad.any():

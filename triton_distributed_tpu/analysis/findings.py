@@ -139,6 +139,15 @@ RULES = {
         "this toolchain at all — quantize the scale fold into the "
         "epilogue, don't ask the MXU for a float accumulate of int8",
     ),
+    "MC005": (
+        "mosaic-lane-reshape",
+        Severity.ERROR,
+        "an in-kernel reshape changes the lane (minor) dimension "
+        "between two >1-lane vectors; this Mosaic's vector shape_cast "
+        "cannot re-lay lanes — restructure the buffer so the lane dim "
+        "survives (the ragged kernel's head-major GQA-rows packing) "
+        "or reshape on the XLA side",
+    ),
 }
 
 

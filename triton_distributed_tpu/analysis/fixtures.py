@@ -581,3 +581,74 @@ def duplicate_collective_id(axis="x"):
         (mk("fixture_dup_cid_a", "site_a"), lambda n: [((8, 128), _F32)]),
         (mk("fixture_dup_cid_b", "site_b"), lambda n: [((8, 128), _F32)]),
     )
+
+
+def ragged_hole(axis="x"):
+    """The REAL ragged paged-attention kernel with mis-addressed row
+    packing: both rows' ``q_starts`` park at 0, so the second row's
+    out-DMA overwrites the first row's span and rows [8:16) of the
+    packed output are never written — every semaphore balances, the
+    page walk is protocol-clean, but the `local` delivery contract
+    terminates with a hole. SL008 (kind='local')."""
+    from dataclasses import replace
+
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.ragged_paged_attention import (
+        LINT_GEOM,
+        build_lint_kernel,
+    )
+    from triton_distributed_tpu.lang.launch import captured_launch
+
+    g = LINT_GEOM
+    build_lint_kernel(token=("fixture_ragged_hole",))
+    real = captured_launch("ragged_paged_attention_q8")
+
+    def kernel(*refs):
+        table, kv_lens, q_lens, q_starts = refs[:4]
+        table[...] = np.arange(
+            g["r"] * g["pps"], dtype=np.int32
+        ).reshape(g["r"], g["pps"])
+        kv_lens[...] = np.asarray([12, 8], np.int32)
+        q_lens[...] = np.asarray([8, 8], np.int32)
+        q_starts[...] = np.asarray([0, 0], np.int32)   # BUG: both park at 0
+        real.kernel(*refs)
+
+    def in_shapes(n):
+        del n
+        pool = (g["npages"], g["hkv"], g["page"], g["d"])
+        return [
+            ((g["r"], g["pps"]), np.dtype(np.int32)),
+            ((g["r"],), np.dtype(np.int32)),
+            ((g["r"],), np.dtype(np.int32)),
+            ((g["r"],), np.dtype(np.int32)),
+            ((g["hkv"], g["t"] * g["g"], g["d"]), _F32),
+            (pool, np.dtype(np.int8)),
+            (pool, np.dtype(np.int8)),
+            ((g["npages"], g["hkv"], 1, g["page"]), _F32),
+            ((g["npages"], g["hkv"], 1, g["page"]), _F32),
+        ]
+
+    return (
+        replace(real, kernel=kernel, name="fixture_ragged_hole"),
+        in_shapes,
+        DeliveryContract(kind="local", dst=9),
+    )
+
+
+def lane_reshape(axis="x"):
+    """An in-kernel reshape that CHANGES the lane (minor) dimension —
+    (8, 256) → (16, 128) — the vector shape_cast this Mosaic cannot
+    re-lay (the naive GQA-row flatten the ragged kernel's head-major
+    packing exists to avoid). MC005."""
+
+    def kernel(x_ref, out_ref):
+        import jax.numpy as jnp
+
+        blk = x_ref[...]                       # (8, 256)
+        out_ref[...] = jnp.reshape(blk, (16, 128))   # BUG: lane change
+
+    return (
+        _spec(kernel, "fixture_lane_reshape",
+              out_shapes=[((16, 128), _F32)]),
+        lambda n: [((8, 256), _F32)],
+    )

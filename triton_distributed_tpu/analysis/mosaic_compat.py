@@ -138,9 +138,10 @@ def scan_kernel_jaxpr(kjaxpr, kernel_name, site=None) -> list:
             ia = eqn.invars[0].aval
             oa = eqn.outvars[0].aval
             ishape = getattr(ia, "shape", None)
+            oshape = getattr(oa, "shape", None)
             if (
                 ishape and len(ishape) >= 2 and all(d == 1 for d in ishape)
-                and getattr(oa, "shape", None) == ()
+                and oshape == ()
                 and "float" in str(getattr(ia, "dtype", ""))
             ):
                 add("MC002",
@@ -148,6 +149,28 @@ def scan_kernel_jaxpr(kjaxpr, kernel_name, site=None) -> list:
                     "in-kernel: Mosaic rejects the vector<1x1> -> scalar "
                     "shape_cast — keep a (1, lanes) row and broadcast "
                     "(the lang.wire scale-plane idiom)")
+            elif (
+                ishape is not None and oshape is not None
+                and len(ishape) >= 2 and len(oshape) >= 2
+                and ishape[-1] != oshape[-1]
+                and ishape[-1] > 1 and oshape[-1] > 1
+            ):
+                # MC005: a reshape that CHANGES the lane (minor)
+                # dimension between two >1-lane vectors — this
+                # Mosaic's vector shape_cast cannot re-lay lanes (the
+                # construct a naive (T, G·D) → (T·G, D) GQA-row
+                # flatten produces; the ragged kernel's head-major
+                # packing exists to avoid it). Unit-collapse reshapes
+                # (lane dim kept) are the supported form and pass.
+                add("MC005",
+                    f"in-kernel reshape {tuple(ishape)} -> "
+                    f"{tuple(oshape)} changes the lane (minor) "
+                    "dimension: this Mosaic's vector shape_cast cannot "
+                    "re-lay lanes — restructure the buffer so the lane "
+                    "dim survives (e.g. the head-major (Hkv, T*G, D) "
+                    "GQA-rows packing of kernels/"
+                    "ragged_paged_attention) or reshape on the XLA "
+                    "side")
         elif name == "broadcast_in_dim" and eqn.outvars:
             dt = getattr(eqn.outvars[0].aval, "dtype", None)
             if dt is not None and _is_subbyte(dt):
@@ -217,16 +240,26 @@ def trace_spec(spec, in_shapes, n, *, mesh=None, axis="x"):
 
     mesh = mesh if mesh is not None else lint_mesh(n, axis)
     kw = {}
-    if spec.grid is not None:
-        kw["grid"] = spec.grid
-    if spec.in_specs is not None:
-        kw["in_specs"] = spec.in_specs
-    if spec.out_specs is not None:
-        kw["out_specs"] = spec.out_specs
+    scratch = list(spec.scratch_shapes)
+    if getattr(spec, "grid_spec", None) is not None:
+        # scalar-prefetch families (PrefetchScalarGridSpec): re-invoke
+        # with the captured spec object — it already carries the
+        # scratch (the capture mirrors it into spec.scratch_shapes for
+        # the abstract evaluator), and in_shapes lists the scalar-
+        # prefetch operands FIRST, exactly the call convention
+        kw["grid_spec"] = spec.grid_spec
+        scratch = []
+    else:
+        if spec.grid is not None:
+            kw["grid"] = spec.grid
+        if spec.in_specs is not None:
+            kw["in_specs"] = spec.in_specs
+        if spec.out_specs is not None:
+            kw["out_specs"] = spec.out_specs
     call = pl.pallas_call(
         spec.kernel,
         out_shape=spec.out_shape,
-        scratch_shapes=list(spec.scratch_shapes),
+        scratch_shapes=scratch,
         interpret=False,
         **kw,
     )

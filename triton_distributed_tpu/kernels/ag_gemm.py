@@ -370,6 +370,7 @@ def _specs(axis, batch_axes, dcn_axis=None):
 def _build_fused(
     mesh, axis, batch_axes, a_shape, b_shape, dtype, out_dtype, collective_id,
     chaos, return_gathered=True, dcn_axis=None, wire=None,
+    b_prequant=False,
 ):
     """Fused engine. ``dcn_axis`` set = the hierarchical decomposition
     (≡ the reference's inter-node AG-GEMM, allgather.py:291-375): the
@@ -408,6 +409,11 @@ def _build_fused(
     fmt = None
     rail_fmt = None
     mx = wire == "int8-mxu"
+    if b_prequant and not (mx and dcn_axis is None):
+        raise ValueError(
+            "b_prequant (weight-resident B) requires wire='int8-mxu' "
+            "on a flat mesh"
+        )
     m_dev = m_gathered // (n * nd)
     if wire is not None and dcn_axis is not None:
         # hierarchical: the wire rides the DCN RAIL legs (XLA-side
@@ -537,6 +543,20 @@ def _build_fused(
         )
         if fmt is None:
             body = call
+        elif mx and b_prequant:
+            def body(a_loc, bq_loc, bs_loc):
+                # weight-RESIDENT int8-mxu: B's (bq, bs) pair arrives
+                # pre-quantized (quantize_grouped_weights convention) —
+                # only the moving A slab quantizes per call
+                aq, asc = wirelib.quantize_slab(a_loc, fmt)
+                out, agq, ags = call(aq, asc, bq_loc, bs_loc)
+                if not return_gathered:
+                    return out, agq
+                g = wirelib.dequantize_slab(agq, ags, fmt, dtype)
+                me = jax.lax.axis_index(axis)
+                return out, jax.lax.dynamic_update_slice(
+                    g, a_loc, (me * slab_rows, 0)
+                )
         elif mx:
             def body(a_loc, b_loc):
                 # both operands quantized ONCE in XLA (fuse with their
@@ -634,6 +654,9 @@ def _build_fused(
                 # gather+transpose copy per step
                 return reorder(o), g.reshape(n * nd * m_dev, k)
             return reorder(o), reorder(g)
+    if b_prequant:
+        # (a, bq, bs): the scale row shards like B's columns
+        in_specs = tuple(in_specs) + (in_specs[1],)
     fn = jax.shard_map(
         body,
         mesh=mesh,
@@ -644,7 +667,8 @@ def _build_fused(
     return jax.jit(fn)
 
 
-def ag_gemm_device(a_loc, b_loc, axis, *, out_dtype=None, wire=None):
+def ag_gemm_device(a_loc, b_loc, axis, *, out_dtype=None, wire=None,
+                   b_quant=None):
     """Per-device XLA-ring AG-GEMM body — usable inside any shard_map.
 
     ppermute hops overlap the next step's dot via XLA async collective
@@ -660,7 +684,15 @@ def ag_gemm_device(a_loc, b_loc, axis, *, out_dtype=None, wire=None):
     int8→MXU engine — identical rails, but every arriving slab (and the
     local one, for uniform numerics) feeds an s8×s8→s32 dot against the
     per-out-channel-quantized B with the chunk·channel scale product
-    folded onto the accumulator; no dequantized copy of A ever exists."""
+    folded onto the accumulator; no dequantized copy of A ever exists.
+
+    ``b_quant``: a PRE-QUANTIZED ``(bq (K, N) int8, bs (1, N) f32)``
+    pair for the int8-mxu consumer (weight-residency: serving layers
+    already holding ``quantize_grouped_weights``-style dicts pass the
+    pair through instead of paying a per-call ``quantize_cols`` of B —
+    the ROADMAP carried-forward item the engine's steady-state decode
+    loop makes measurable). Only consumed when ``wire='int8-mxu'`` and
+    the slab admits the wire layout; ``b_loc`` may then be None."""
     n = jax.lax.axis_size(axis)
     m_local = a_loc.shape[0]
     out_dtype = out_dtype or a_loc.dtype
@@ -675,7 +707,10 @@ def ag_gemm_device(a_loc, b_loc, axis, *, out_dtype=None, wire=None):
             wire, m_local, strict=compiling_for_tpu()
         )
     if mx and fmt is not None:
-        bq, bs = wirelib.quantize_cols(b_loc)
+        if b_quant is not None:
+            bq, bs = b_quant          # resident pair: no per-call quant
+        else:
+            bq, bs = wirelib.quantize_cols(b_loc)
         q, sc = wirelib.quantize_slab(a_loc, fmt)
         # per-row expand of the lane-replicated chunk scales (XLA side —
         # the fused kernel instead pins chunk_rows == block_m)
@@ -688,7 +723,7 @@ def ag_gemm_device(a_loc, b_loc, axis, *, out_dtype=None, wire=None):
             )
             return (acc.astype(jnp.float32) * rs_cur * bs).astype(out_dtype)
 
-        out = jnp.zeros((n * m_local, b_loc.shape[1]), out_dtype)
+        out = jnp.zeros((n * m_local, bq.shape[1]), out_dtype)
         out = jax.lax.dynamic_update_slice(
             out, s8_tile(q, row_scale), (me * m_local, 0)
         )
@@ -707,6 +742,11 @@ def ag_gemm_device(a_loc, b_loc, axis, *, out_dtype=None, wire=None):
         _, _, out = jax.lax.fori_loop(1, n, step_mx, (q, sc, out))
         return out
     if mx:
+        if b_loc is None:
+            # a resident pair whose slab admits no wire layout: widen
+            # ONCE here (the degradation twin of the dequant-free path)
+            bq, bs = b_quant
+            b_loc = (bq.astype(jnp.float32) * bs).astype(a_loc.dtype)
         fmt = None  # no legal chunking: stay on the exact wire
 
     out = jnp.zeros((n * m_local, b_loc.shape[1]), out_dtype)
@@ -754,8 +794,25 @@ def ag_gemm_device(a_loc, b_loc, axis, *, out_dtype=None, wire=None):
 
 @functools.lru_cache(maxsize=256)
 def _build_xla_ring(mesh, axis, batch_axes, out_dtype, dcn_axis=None,
-                    wire=None):
+                    wire=None, b_prequant=False):
     in_specs, out_specs = _specs(axis, batch_axes, dcn_axis)
+    if b_prequant:
+        # resident int8-mxu weights: body takes (a, bq, bs) — no
+        # per-call quantize_cols of B (flat mesh only; the host entry
+        # widens for hierarchical calls)
+        assert dcn_axis is None and wire == "int8-mxu"
+        (a_spec, b_spec), _ = (in_specs, out_specs)
+
+        def body_q(a_loc, bq_loc, bs_loc):
+            return ag_gemm_device(
+                a_loc, None, axis, out_dtype=out_dtype, wire=wire,
+                b_quant=(bq_loc, bs_loc),
+            )
+
+        return jax.jit(jax.shard_map(
+            body_q, mesh=mesh, in_specs=(a_spec, b_spec, b_spec),
+            out_specs=out_specs, check_vma=False,
+        ))
 
     def body(a_loc, b_loc):
         if dcn_axis is not None:
@@ -1084,6 +1141,7 @@ def ag_gemm(
     dcn_axis: str | None = None,
     wire_dtype=None,
     wq: str | None = None,
+    b_quant=None,
 ):
     """Fused AllGather(A) @ B for column-parallel TP.
 
@@ -1104,9 +1162,19 @@ def ag_gemm(
     dequantized remote slabs — inference-grade, like the MoE wire.
 
     ``wq``: the caller's weight-quantization intent ('int8' or None).
-    It does not change B's storage here (pass already-quantized weights
-    to the serving paths for that); it licenses the auto selector to
-    pick 'int8-mxu', whose epilogue quantizes B per out-channel.
+    It does not change B's storage here; it licenses the auto selector
+    to pick 'int8-mxu', whose epilogue quantizes B per out-channel.
+
+    ``b_quant``: PRE-QUANTIZED weight residency (ROADMAP carried-
+    forward, closed by the serving engine's steady-state loop): a
+    ``(bq (K, N) int8, bs per-out-channel f32)`` pair — or pass ``b``
+    itself as a ``{"q", "scale"}`` dict (the
+    ``quantize_grouped_weights`` convention) — and the int8-mxu
+    consumers feed it straight to the s8×s8 epilogue with NO per-call
+    ``quantize_cols`` of B. When the int8-mxu wire is not eligible
+    (1-device mesh, hierarchical call, pinned other wire, slab without
+    a wire layout), B is widened ONCE per call and the ordinary engine
+    runs — the same degradation discipline as every other knob.
 
     ``a``: (M, K) with rows sharded over ``(*batch_axes, axis)`` — each
     device holds an M/(dp·n) row shard; the kernel gathers the ``axis``
@@ -1137,6 +1205,61 @@ def ag_gemm(
     batch_axes = tuple(batch_axes)
     dp = mesh_axes_size(mesh, batch_axes)
     out_dtype = out_dtype or a.dtype
+    if isinstance(b, dict):
+        # quantized-dict weight (the serving layers' storage): implies
+        # the resident int8-mxu consumer
+        b_quant = (b["q"], b["scale"])
+        b = None
+    if b_quant is not None:
+        bq = b_quant[0]
+        bs = jnp.asarray(b_quant[1], jnp.float32).reshape(1, -1)
+        assert a.shape[1] == bq.shape[0], (
+            f"contract dim mismatch {a.shape} @ {bq.shape}"
+        )
+        slab_rows = a.shape[0] // (dp * n * nd)
+        eligible = (
+            n * nd > 1 and dcn_axis is None
+            and wirelib.normalize_wire(wire_dtype) in (None, "int8-mxu",
+                                                       "auto")
+            and wirelib.make_wire_format(
+                "int8-mxu", slab_rows * nd, strict=False
+            ) is not None
+        )
+        if eligible:
+            proxy = jax.ShapeDtypeStruct(bq.shape, a.dtype)
+            try:
+                method = resolve_ag_gemm_method(
+                    mesh, axis, a, proxy, batch_axes=batch_axes,
+                    method=method, out_dtype=out_dtype,
+                    collective_id=collective_id,
+                    return_gathered=return_gathered,
+                    wire_dtype="int8-mxu",
+                )
+            except Exception:
+                method = AGGemmMethod.XLA_RING
+            if method == AGGemmMethod.PALLAS_FUSED:
+                try:
+                    fn = _build_fused(
+                        mesh, axis, batch_axes, a.shape, bq.shape,
+                        a.dtype, jnp.dtype(out_dtype), collective_id,
+                        interp_key(), return_gathered, None, "int8-mxu",
+                        True,
+                    )
+                    out, gathered = fn(a, bq, bs)
+                    return (out, gathered) if return_gathered else out
+                except ValueError:
+                    pass                       # unblockable: XLA ring
+            fn = _build_xla_ring(
+                mesh, axis, batch_axes, jnp.dtype(out_dtype), None,
+                "int8-mxu", True,
+            )
+            out = fn(a, bq, bs)
+            if return_gathered:
+                return out, _build_gather(mesh, axis, batch_axes, None)(a)
+            return out
+        # ineligible for the resident consumer: widen ONCE per call and
+        # run the ordinary engine (documented degradation)
+        b = (bq.astype(jnp.float32) * bs).astype(a.dtype)
     assert a.shape[0] % (n * nd * dp) == 0 and b.shape[1] % (n * nd) == 0
     assert a.shape[1] == b.shape[0], f"contract dim mismatch {a.shape} @ {b.shape}"
     if n * nd == 1:

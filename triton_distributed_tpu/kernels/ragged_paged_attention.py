@@ -1,0 +1,567 @@
+"""Ragged paged attention: mixed prefill-chunk + decode rows, ONE launch.
+
+The continuous-batching serving engine (serving/engine.py) assembles a
+per-step batch in which some requests contribute ONE decode token and
+others contribute a CHUNK of prompt tokens — the "Ragged Paged
+Attention" TPU kernel shape (arXiv:2604.15464, PAPERS.md): one kernel,
+per-row ``(kv_len, q_len)`` metadata, per-request block tables into a
+shared page pool, and NO rectangle padding — each row's KV walk is
+``ceil(kv_len/page)`` pages of ITS true length, and each row's query
+block is its true chunk (rounded to the sublane granule), packed into
+one ragged token array.
+
+Why not reuse the decode kernels (flash_decode.py): those are
+one-query-per-row machines — ``q: (B, Hq, D)`` — so a prefill chunk
+would need its own rectangle launch per step, which is exactly the
+fixed-batch regime the engine exists to kill. This kernel walks BOTH
+kinds of rows in one grid, so a step's cost is proportional to the
+step's true token/KV volume regardless of the prefill/decode mix.
+
+Layout contract (the "GQA-rows" packing):
+
+* ``q``/``out``: ``(Hkv, T·G, D)`` — head-major, then token-major with
+  the G query heads of one token adjacent. Row ``r``'s tokens occupy
+  rows ``[q_starts[r]·G, (q_starts[r]+q_lens[r])·G)`` of dim 1. This
+  makes each row's per-head query block ONE contiguous
+  ``(block_q·G, D)`` DMA run — no in-kernel reshape that changes the
+  lane dim (a construct this toolchain's Mosaic rejects; deny rule
+  MC005). ``pack_gqa_rows`` / ``unpack_gqa_rows`` convert from/to the
+  natural ``(T, Hq, D)``.
+* ``q_starts`` must be 8-aligned token offsets (the engine packs rows
+  at 8-token granularity — ragged, not rectangular: the pad between
+  rows is < 8 tokens, not ``S - len``).
+* KV pools: ``(npages, Hkv, page, D)`` ["phsd"], int8 with
+  ``(npages, Hkv, page)`` f32 scales (the serving default) or bf16;
+  ``block_table``: ``(R, pages_per_seq)`` pool page ids; ``kv_lens``:
+  per-row TOTAL lengths INCLUDING this step's tokens (append-then-
+  attend — the engine scatters the step's K/V into the pool first, so
+  a chunk's tokens attend each other causally through the pool).
+* Causality: token ``t`` of row ``r`` sits at global position
+  ``kv_lens[r] - q_lens[r] + t`` and attends positions
+  ``<= kv_lens[r] - q_lens[r] + t``. Decode rows (``q_lens[r] == 1``)
+  degenerate to the flash-decode mask. Only FRONTIER pages (those
+  crossing ``kv_len - q_len + 1``) pay the mask chain — interior pages
+  run the unmasked fast path, the ``is_tail`` discipline of
+  ``flash_decode._decode_kernel_dyn``.
+* The ``block_q`` query block is a STATIC per-launch bound on
+  ``max(q_lens)``; rows shorter than it over-read into the NEXT row's
+  tokens and over-write garbage outputs there, which the ascending
+  sequential grid self-heals (row r+1 re-writes its own rows after
+  row r; the final row's tail needs ``q_starts[-1] + block_q <= T``
+  of slack in the packed array — the engine reserves it). Out-DMAs
+  are waited before the grid step ends so the self-heal ordering is
+  real, not racy.
+
+The kernel is LOCAL (no remote DMA): under tensor parallelism the
+serving state shards the pools over the KV-HEAD dim (heads are
+independent in GQA attention — no cross-rank LSE merge needed, unlike
+the sequence-sharded decode path), so each rank runs this kernel on
+its own head slice. It is registered in the kernel registry as the
+``flash_decode.ragged_paged`` family with a ``local`` delivery
+contract (every output element covered by locally computed writes, no
+raw quantized bytes left) and covered by the Mosaic pre-flight.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.config import local_interpret
+from triton_distributed_tpu.lang.launch import shmem_call
+from triton_distributed_tpu.utils.testing import chaos_delay
+
+NEG_INF = -1.0e30
+
+
+def _n_valid_pages(kv_len, page):
+    """ceil(kv_len / page) floored at 1 (an empty row still walks one
+    page; its scores are fully masked)."""
+    return jnp.maximum(jax.lax.div(kv_len + page - 1, page), 1)
+
+
+def pack_gqa_rows(q, hkv):
+    """(T, Hq, D) → (Hkv, T·G, D): the kernel's GQA-rows layout — one
+    contiguous (q_len·G, D) run per (row, kv-head)."""
+    t, hq, d = q.shape
+    g = hq // hkv
+    return q.reshape(t, hkv, g, d).transpose(1, 0, 2, 3).reshape(
+        hkv, t * g, d
+    )
+
+
+def unpack_gqa_rows(o, hq):
+    """(Hkv, T·G, D) → (T, Hq, D): inverse of :func:`pack_gqa_rows`."""
+    hkv, tg, d = o.shape
+    g = hq // hkv
+    t = tg // g
+    return o.reshape(hkv, t, g, d).transpose(1, 0, 2, 3).reshape(t, hq, d)
+
+
+def _ragged_kernel(
+    scale, soft_cap, page, n_bufs, hkv, g, d, block_q, quant, *refs,
+):
+    """Grid (R,): one request row per step; all local KV heads unrolled.
+
+    Per row: a dynamic ``fori_loop`` over ``ceil(kv_len/page)`` pages
+    with double-buffered table-indexed pool DMAs (the
+    ``_paged_kernel_dyn_mh`` machinery), a per-row query block of
+    ``block_q`` tokens DMA'd once (double-buffered across rows), and
+    an online softmax whose state spans the row's ``block_q·G`` query
+    rows per head. Slot rotation and the row-ahead prefetch ride an
+    SMEM carry — SEQUENTIAL grid execution required (pinned via
+    dimension_semantics)."""
+    if quant:
+        (table_ref, kv_lens_ref, q_lens_ref, q_starts_ref,
+         q_hbm, k_hbm, v_hbm, ks_hbm, vs_hbm,
+         out_hbm, lse_hbm,
+         qbuf, kbuf, vbuf, ksbuf, vsbuf, obuf, lbuf,
+         sem_q, sem_k, sem_v, sem_ks, sem_vs, sem_o,
+         slot_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        (table_ref, kv_lens_ref, q_lens_ref, q_starts_ref,
+         q_hbm, k_hbm, v_hbm,
+         out_hbm, lse_hbm,
+         qbuf, kbuf, vbuf, obuf, lbuf,
+         sem_q, sem_k, sem_v, sem_o,
+         slot_ref, m_ref, l_ref, acc_ref) = refs
+    r = pl.program_id(0)
+    nr = pl.num_programs(0)
+    npages = k_hbm.shape[0]
+    pps = table_ref.shape[1]
+    rows = block_q * g
+
+    kv_len = kv_lens_ref[r]
+    q_len = q_lens_ref[r]
+    nb = jnp.minimum(_n_valid_pages(kv_len, page), pps)
+
+    def dma(rr, j, slot):
+        # row rr's j-th page; clamp so a prefetch into a short row's
+        # padding never addresses out of pool (table pad entries incl.
+        # -1 are clamped too)
+        jc = jnp.minimum(
+            j, jnp.maximum(_n_valid_pages(kv_lens_ref[rr], page) - 1, 0)
+        )
+        pid = jnp.clip(table_ref[rr, jc], 0, npages - 1)
+        cps = [
+            pltpu.make_async_copy(
+                k_hbm.at[pid], kbuf.at[slot], sem_k.at[slot]
+            ),
+            pltpu.make_async_copy(
+                v_hbm.at[pid], vbuf.at[slot], sem_v.at[slot]
+            ),
+        ]
+        if quant:
+            cps += [
+                pltpu.make_async_copy(
+                    ks_hbm.at[pid], ksbuf.at[slot], sem_ks.at[slot]
+                ),
+                pltpu.make_async_copy(
+                    vs_hbm.at[pid], vsbuf.at[slot], sem_vs.at[slot]
+                ),
+            ]
+        return cps
+
+    def qdma(rr, qslot):
+        # the row's whole query block, every local head, one strided
+        # copy (hkv contiguous (rows, d) runs)
+        start = q_starts_ref[rr] * g
+        return pltpu.make_async_copy(
+            q_hbm.at[:, pl.ds(start, rows)], qbuf.at[qslot],
+            sem_q.at[qslot],
+        )
+
+    @pl.when(r == 0)
+    def _warmup():
+        slot_ref[0] = 0                       # KV slot rotation carry
+        slot_ref[1] = 0                       # q double-buffer parity
+        qdma(0, 0).start()
+        for cp in dma(0, 0, 0):
+            cp.start()
+
+    s0 = slot_ref[0]
+    qslot = slot_ref[1]
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    qdma(r, qslot).wait()                     # warmed by the previous row
+
+    # per-query-row causal limit: token t = row // g sits at global
+    # position kv_len - q_len + t and may attend positions < limit =
+    # that + 1. Rows past q_len (block padding) get limit > kv_len —
+    # they attend whatever the pool holds and produce garbage the
+    # packing contract discards (see module docstring).
+    base = kv_len - q_len
+    row_tok = jax.lax.div(
+        jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0), g
+    )
+    limit = base + row_tok + 1                # (rows, 1)
+
+    def body(j, _):
+        slot = jax.lax.rem(s0 + j, n_bufs)
+        nxt = jax.lax.rem(s0 + j + 1, n_bufs)
+
+        @pl.when(j + 1 < nb)
+        def _prefetch_in_row():
+            for cp in dma(r, j + 1, nxt):
+                cp.start()
+
+        @pl.when(jnp.logical_and(j + 1 == nb, r + 1 < nr))
+        def _prefetch_next_row():
+            qdma(r + 1, 1 - qslot).start()
+            for cp in dma(r + 1, 0, nxt):
+                cp.start()
+
+        # chaos hook: widens the slot-rotation window between the
+        # prefetch issues and this page's wait (the race-prone carry)
+        chaos_delay(site="ragged_paged", step=None, me=None, n=None)
+        for cp in dma(r, j, slot):
+            cp.wait()
+
+        # only pages crossing the causal frontier (or the length tail)
+        # pay the mask chain; interior pages take the plain path
+        is_frontier = (j + 1) * page > base + 1
+
+        def heads(masked):
+            if masked:
+                pos = j * page + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, page), 1
+                )
+                valid = pos < limit           # (rows, page)
+            for h in range(hkv):              # static unroll
+                q = qbuf[qslot, h]            # (rows, d)
+                k = kbuf[slot, h]
+                v = vbuf[slot, h]
+                if quant:
+                    k = k.astype(jnp.bfloat16)
+                    v = v.astype(jnp.bfloat16)
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * scale                     # (rows, page) f32
+                if quant:
+                    s = s * ksbuf[slot, h]    # (1, page) — exact fold
+                if soft_cap > 0.0:
+                    s = soft_cap * jnp.tanh(s / soft_cap)
+                if masked:
+                    s = jnp.where(valid, s, NEG_INF)
+                lo, hi = h * rows, (h + 1) * rows
+                m = m_ref[lo:hi]
+                m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+                alpha = jnp.exp(m - m_new)
+                p = jnp.exp(s - m_new)
+                if masked:
+                    # an all-masked row degenerates exp(s - m) to 1
+                    p = jnp.where(valid, p, 0.0)
+                l_ref[lo:hi] = alpha * l_ref[lo:hi] + jnp.sum(
+                    p, axis=1, keepdims=True
+                )
+                if quant:
+                    pv = (p * vsbuf[slot, h]).astype(v.dtype)
+                else:
+                    pv = p.astype(v.dtype)
+                acc_ref[lo:hi] = alpha * acc_ref[lo:hi] + jnp.dot(
+                    pv, v, preferred_element_type=jnp.float32
+                )
+                m_ref[lo:hi] = m_new
+
+        @pl.when(is_frontier)
+        def _masked():
+            heads(True)
+
+        @pl.when(jnp.logical_not(is_frontier))
+        def _plain():
+            heads(False)
+
+        return 0
+
+    jax.lax.fori_loop(0, nb, body, 0)
+    slot_ref[0] = jax.lax.rem(s0 + nb, n_bufs)   # hand the rotation on
+    slot_ref[1] = jnp.where(r + 1 < nr, 1 - qslot, qslot)
+
+    for h in range(hkv):
+        lo, hi = h * rows, (h + 1) * rows
+        l = l_ref[lo:hi]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        obuf[h] = (acc_ref[lo:hi] / safe_l).astype(obuf.dtype)
+        lbuf[h] = jnp.where(
+            l > 0.0, m_ref[lo:hi] + jnp.log(safe_l), jnp.full_like(l, NEG_INF)
+        )
+    start = q_starts_ref[r] * g
+    o_cp = pltpu.make_async_copy(
+        obuf, out_hbm.at[:, pl.ds(start, rows)], sem_o.at[0]
+    )
+    l_cp = pltpu.make_async_copy(
+        lbuf, lse_hbm.at[:, pl.ds(start, rows)], sem_o.at[1]
+    )
+    o_cp.start()
+    l_cp.start()
+    # wait BEFORE the grid advances: overlapping rows' out regions
+    # self-heal by write order, which async completions would break
+    o_cp.wait()
+    l_cp.wait()
+
+
+@functools.lru_cache(maxsize=64)
+def _build_ragged(
+    r, pps, npages, t_tokens, hkv, g, d, page, block_q, q_dtype,
+    quant, scale, soft_cap, n_bufs, interpret, token=(),
+):
+    """Construct the ragged-paged-attention pallas_call (lru-cached on
+    the full static geometry; ``token`` busts the cache for lint/
+    preflight builds). Returns the call taking
+    ``(table, kv_lens, q_lens, q_starts, q, k_pool, v_pool
+    [, k_scale, v_scale])``."""
+    del token
+    q_dtype = jnp.dtype(q_dtype)
+    rows = block_q * g
+    kernel = functools.partial(
+        _ragged_kernel, scale, soft_cap, page, n_bufs, hkv, g, d,
+        block_q, quant,
+    )
+    pool_dt = jnp.dtype(jnp.int8) if quant else q_dtype
+    in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),    # q (head-major packed)
+        pl.BlockSpec(memory_space=pl.ANY),    # k pool
+        pl.BlockSpec(memory_space=pl.ANY),    # v pool
+    ]
+    scratch = [
+        pltpu.VMEM((2, hkv, rows, d), q_dtype),          # qbuf
+        pltpu.VMEM((n_bufs, hkv, page, d), pool_dt),     # kbuf
+        pltpu.VMEM((n_bufs, hkv, page, d), pool_dt),     # vbuf
+    ]
+    sems = [
+        pltpu.SemaphoreType.DMA((2,)),        # sem_q
+        pltpu.SemaphoreType.DMA((n_bufs,)),   # sem_k
+        pltpu.SemaphoreType.DMA((n_bufs,)),   # sem_v
+    ]
+    if quant:
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),   # k scales
+            pl.BlockSpec(memory_space=pl.ANY),   # v scales
+        ]
+        scratch += [
+            pltpu.VMEM((n_bufs, hkv, 1, page), jnp.float32),  # ksbuf
+            pltpu.VMEM((n_bufs, hkv, 1, page), jnp.float32),  # vsbuf
+        ]
+        sems += [
+            pltpu.SemaphoreType.DMA((n_bufs,)),  # sem_ks
+            pltpu.SemaphoreType.DMA((n_bufs,)),  # sem_vs
+        ]
+    scratch += [
+        pltpu.VMEM((hkv, rows, d), q_dtype),             # obuf
+        pltpu.VMEM((hkv, rows, 1), jnp.float32),         # lbuf
+    ]
+    sems += [pltpu.SemaphoreType.DMA((2,))]   # sem_o (out, lse)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,                # table, kv_lens, q_lens, starts
+        grid=(r,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),           # out
+            pl.BlockSpec(memory_space=pl.ANY),           # lse
+        ],
+        scratch_shapes=scratch + sems + [
+            pltpu.SMEM((2,), jnp.int32),                 # slot carries
+            pltpu.VMEM((hkv * rows, 1), jnp.float32),    # m
+            pltpu.VMEM((hkv * rows, 1), jnp.float32),    # l
+            pltpu.VMEM((hkv * rows, d), jnp.float32),    # acc
+        ],
+    )
+    # VMEM working set: the kv slot buffers + scale planes + q/out
+    # blocks + softmax state, with pipeline headroom
+    kv_bytes = 2 * n_bufs * hkv * page * d * pool_dt.itemsize
+    sc_bytes = 2 * n_bufs * hkv * page * 4 if quant else 0
+    q_bytes = 3 * hkv * rows * d * q_dtype.itemsize
+    st_bytes = hkv * rows * (d + 2) * 4
+    vmem_limit = None
+    total = kv_bytes + sc_bytes + q_bytes + st_bytes
+    if total > 12 * 1024 * 1024:
+        vmem_limit = total + 8 * 1024 * 1024
+    call = shmem_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hkv, t_tokens * g, d), q_dtype),
+            jax.ShapeDtypeStruct((hkv, t_tokens * g, 1), jnp.float32),
+        ],
+        collective_id=None,                   # purely local kernel
+        vmem_limit_bytes=vmem_limit,
+        interpret=local_interpret() if interpret is None else interpret,
+        name="ragged_paged_attention" + ("_q8" if quant else ""),
+        # slot-rotation carries + cross-row prefetch + out self-heal
+        # all require SEQUENTIAL grid execution
+        dimension_semantics=("arbitrary",),
+    )
+    return call
+
+
+def auto_block_q(max_q_len: int, g: int) -> int:
+    """Smallest block from the {8, 16, 32, 64, 128, ...} ladder covering
+    ``max_q_len`` whose GQA row count (block·G) is sublane-aligned —
+    keeping the jit/kernel cache bounded while decode-dominated steps
+    don't pay a prefill-sized MXU block."""
+    b = 8
+    while b < max_q_len:
+        b *= 2
+    while (b * g) % 8:
+        b *= 2
+    return b
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("group", "scale", "soft_cap", "block_q", "n_bufs",
+                     "interpret"),
+)
+def ragged_paged_attention(
+    q, k_pool, v_pool, kv_lens, q_lens, q_starts, block_table, *,
+    group: int, k_scale=None, v_scale=None, scale: float | None = None,
+    soft_cap: float = 0.0, block_q: int = 8, n_bufs: int = 2,
+    interpret=None,
+):
+    """Mixed prefill-chunk/decode attention over a shared page pool.
+
+    q: (Hkv, T·G, D) packed GQA rows (:func:`pack_gqa_rows`) with
+    ``group`` = G = Hq // Hkv (not recoverable from the packed shape);
+    k_pool/v_pool: (npages, Hkv, page, D) — int8 when ``k_scale``/
+    ``v_scale`` ((npages, Hkv, page) f32) are given, else q.dtype;
+    kv_lens/q_lens/q_starts: (R,) int32 per-row metadata (lengths
+    INCLUDE this step's tokens; starts are 8-aligned token offsets
+    with ``q_starts[r] + block_q <= T`` slack for every row);
+    block_table: (R, pages_per_seq) int32 pool page ids. ``block_q``:
+    static bound on max(q_lens) (see :func:`auto_block_q`).
+
+    Returns (out (Hkv, T·G, D) in q.dtype, lse (Hkv, T·G) f32). Rows
+    of dim 1 outside the per-row valid spans hold garbage (the packing
+    contract; see the module docstring).
+    """
+    hkv, tg, d = q.shape
+    g = group
+    npages, _, page, _ = k_pool.shape
+    assert v_pool.shape == k_pool.shape, (k_pool.shape, v_pool.shape)
+    assert tg % g == 0, (tg, g)
+    t_tokens = tg // g
+    r, pps = block_table.shape
+    quant = k_scale is not None
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if (block_q * g) % 8:
+        raise ValueError(
+            f"ragged_paged_attention: block_q·G = {block_q * g} must be "
+            "sublane-aligned (multiple of 8) — pick block_q via "
+            "auto_block_q"
+        )
+    call = _build_ragged(
+        r, pps, npages, t_tokens, hkv, g, d, page, block_q,
+        jnp.dtype(q.dtype).name, quant, float(scale), float(soft_cap),
+        n_bufs, interpret,
+    )
+    args = [
+        block_table.astype(jnp.int32), kv_lens.astype(jnp.int32),
+        q_lens.astype(jnp.int32), q_starts.astype(jnp.int32),
+        q, k_pool, v_pool,
+    ]
+    if quant:
+        args += [
+            k_scale.astype(jnp.float32).reshape(npages, hkv, 1, page),
+            v_scale.astype(jnp.float32).reshape(npages, hkv, 1, page),
+        ]
+    out, lse = call(*args)
+    return out, lse.reshape(hkv, tg)
+
+
+def ragged_paged_attention_xla(
+    q, k_pool, v_pool, kv_lens, q_lens, q_starts, block_table, *,
+    group: int, k_scale=None, v_scale=None, scale=None, soft_cap=0.0,
+):
+    """Dense-XLA twin (correctness reference + degradation target):
+    gather each row's pages into a contiguous cache and run the masked
+    dense attention with the same causal-frontier semantics. Same
+    signature/garbage-rows contract as :func:`ragged_paged_attention`.
+    """
+    hkv, tg, d = q.shape
+    g = group
+    t_tokens = tg // g
+    npages, _, page, _ = k_pool.shape
+    r, pps = block_table.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if k_scale is not None:
+        k_pool = (k_pool.astype(jnp.float32)
+                  * k_scale[..., None]).astype(q.dtype)
+        v_pool = (v_pool.astype(jnp.float32)
+                  * v_scale[..., None]).astype(q.dtype)
+    safe = jnp.clip(block_table.astype(jnp.int32), 0, npages - 1)
+    # (R, pps, Hkv, page, D) → (R, Hkv, pps·page, D)
+    kc = k_pool[safe].transpose(0, 2, 1, 3, 4).reshape(r, hkv, -1, d)
+    vc = v_pool[safe].transpose(0, 2, 1, 3, 4).reshape(r, hkv, -1, d)
+    s_cap = pps * page
+
+    # token t of the packed array belongs to row rt with position
+    # pt = kv_len[rt] - q_len[rt] + (t - q_start[rt]); tokens outside
+    # every row's span keep row -1 (their outputs are garbage anyway —
+    # compute them against row 0 with a full mask)
+    tok = jnp.arange(t_tokens)
+    row_of = jnp.full((t_tokens,), -1, jnp.int32)
+    for rr in range(r):
+        inside = (tok >= q_starts[rr]) & (tok < q_starts[rr] + q_lens[rr])
+        row_of = jnp.where(inside, rr, row_of)
+    row_c = jnp.clip(row_of, 0, r - 1)
+    t_in_row = tok - q_starts[row_c]
+    limit = jnp.where(
+        row_of >= 0,
+        kv_lens[row_c] - q_lens[row_c] + t_in_row + 1,
+        0,
+    )                                          # (T,)
+
+    qg = q.reshape(hkv, t_tokens, g, d).astype(jnp.float32)
+    kt = kc[row_c].astype(jnp.float32)         # (T, Hkv, S, D)
+    vt = vc[row_c].astype(jnp.float32)
+    s = jnp.einsum("htgd,thsd->htgs", qg, kt) * scale
+    if soft_cap > 0.0:
+        s = soft_cap * jnp.tanh(s / soft_cap)
+    mask = jnp.arange(s_cap)[None, None, None, :] < limit[None, :, None, None]
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.where(mask, jnp.exp(s - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("htgs,thsd->htgd", p / jnp.maximum(l, 1e-30), vt)
+    lse = jnp.where(
+        l[..., 0] > 0,
+        m[..., 0] + jnp.log(jnp.maximum(l[..., 0], 1e-30)),
+        NEG_INF,
+    )
+    return (
+        out.reshape(hkv, tg, d).astype(q.dtype),
+        lse.reshape(hkv, tg),
+    )
+
+
+# ------------------------------------------------------------ lint geometry
+#
+# The registry family builds the kernel at this small fixed geometry:
+# 2 rows, 1-page walks, G=1, 8-token blocks packed with ZERO slack
+# (q_starts = (0, 8), T = 16) so the `local` delivery contract can
+# require FULL coverage of the out buffer by locally computed writes.
+
+LINT_GEOM = dict(r=2, pps=2, npages=4, t=16, hkv=2, g=1, d=128, page=8,
+                 block_q=8)
+
+
+def build_lint_kernel(token=(), quant=True):
+    """Construct the ragged kernel exactly as production would (via
+    shmem_call, so the LaunchSpec is captured under the family's
+    launch name) at :data:`LINT_GEOM`. Used by the kernel registry and
+    the Mosaic pre-flight."""
+    gm = LINT_GEOM
+    return _build_ragged(
+        gm["r"], gm["pps"], gm["npages"], gm["t"], gm["hkv"], gm["g"],
+        gm["d"], gm["page"], gm["block_q"], "float32", quant,
+        1.0 / math.sqrt(gm["d"]), 0.0, 2, False, token,
+    )

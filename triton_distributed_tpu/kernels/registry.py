@@ -356,6 +356,59 @@ def _moe_rs_shapes(n):
     ]
 
 
+def _ragged_paged(mesh, n, token):
+    """The ragged paged-attention family is LOCAL (no remote DMA): the
+    serving state shards pools over the KV-head dim, so each rank runs
+    the same kernel on its head slice. Built at the kernel module's
+    LINT_GEOM (zero-slack packing → the `local` contract can demand
+    FULL own-write coverage of the out buffer)."""
+    del mesh
+    from triton_distributed_tpu.kernels.ragged_paged_attention import (
+        build_lint_kernel,
+    )
+
+    build_lint_kernel(token=(token, n))
+
+
+def _ragged_in_shapes(n):
+    from triton_distributed_tpu.kernels.ragged_paged_attention import (
+        LINT_GEOM as g,
+    )
+
+    del n
+    pool = (g["npages"], g["hkv"], g["page"], g["d"])
+    return [
+        ((g["r"], g["pps"]), _I32),                   # block table
+        ((g["r"],), _I32),                            # kv_lens
+        ((g["r"],), _I32),                            # q_lens
+        ((g["r"],), _I32),                            # q_starts
+        ((g["hkv"], g["t"] * g["g"], g["d"]), _F32),  # packed q
+        (pool, _I8),                                  # k pool
+        (pool, _I8),                                  # v pool
+        ((g["npages"], g["hkv"], 1, g["page"]), _F32),  # k scales
+        ((g["npages"], g["hkv"], 1, g["page"]), _F32),  # v scales
+    ]
+
+
+def _ragged_init(n):
+    from triton_distributed_tpu.kernels.ragged_paged_attention import (
+        LINT_GEOM as g,
+    )
+
+    del n
+    # two active rows, zero-slack packing: row 0 walks 2 pages (len 12
+    # over 8-row pages), row 1 walks 1; both contribute 8 tokens at
+    # 8-aligned starts tiling the whole (t, g) out span
+    return {
+        0: np.arange(g["r"] * g["pps"], dtype=np.int32).reshape(
+            g["r"], g["pps"]
+        ),
+        1: np.asarray([12, 8], np.int32),             # kv_lens
+        2: np.asarray([8, 8], np.int32),              # q_lens
+        3: np.asarray([0, 8], np.int32),              # q_starts
+    }
+
+
 #: lint geometry for the chunked MoE a2a: 8-row alignment tiles, 1 chunk
 #: of 8 rows per peer, 2-chunk slots, a 1-row meta block whose chunk
 #: count sits at (row 0, lane 1).
@@ -589,6 +642,19 @@ def families() -> dict:
             _moe_rs("fp8"),
             _moe_rs_shapes,
             contract=reduce("out_hbm"),
+        ),
+        KernelFamily(
+            # the serving engine's mixed prefill/decode attention — a
+            # LOCAL kernel (head-sharded pools, no cross-rank merge):
+            # the contract demands every out element be the rank's own
+            # computed write (full coverage, no holes, no raw
+            # quantized bytes surviving the scale folds)
+            "flash_decode.ragged_paged", "ragged_paged",
+            "ragged_paged_attention_q8",
+            _ragged_paged,
+            _ragged_in_shapes,
+            init=_ragged_init,
+            contract=DeliveryContract(kind="local", dst=9),
         ),
         KernelFamily(
             "moe_dispatch.a2a", "moe_dispatch", "moe_chunked_a2a",

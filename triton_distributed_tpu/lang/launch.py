@@ -39,6 +39,10 @@ class LaunchSpec:
     collective_id: object
     vmem_limit_bytes: int | None
     grid: object = None
+    # full grid_spec object (PrefetchScalarGridSpec kernels): the
+    # Mosaic pre-flight re-invokes pallas_call with it so scalar-
+    # prefetch families trace exactly as production builds them
+    grid_spec: object = None
 
 
 #: most recent LaunchSpec per kernel name. Builders are lru-cached, so
@@ -107,16 +111,22 @@ def shmem_call(
         kwargs["input_output_aliases"] = input_output_aliases
     if name is not None:
         kwargs["name"] = name
+        # grid_spec kernels carry their scratch inside the spec object —
+        # surface it so the analyzer materializes the same refs
+        cap_scratch = tuple(scratch_shapes) or tuple(
+            getattr(grid_spec, "scratch_shapes", ()) or ()
+        )
         _LAUNCH_SPECS[name] = LaunchSpec(
             name=name,
             kernel=kernel,
             out_shape=out_shape,
             in_specs=in_specs,
             out_specs=out_specs,
-            scratch_shapes=tuple(scratch_shapes),
+            scratch_shapes=cap_scratch,
             collective_id=collective_id,
             vmem_limit_bytes=vmem_limit_bytes,
             grid=grid,
+            grid_spec=grid_spec,
         )
     return pl.pallas_call(
         kernel,

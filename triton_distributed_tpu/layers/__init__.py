@@ -9,6 +9,7 @@ into transformer blocks (beyond the reference's inference-only scope).
 
 from triton_distributed_tpu.layers.allgather import AllGatherLayer
 from triton_distributed_tpu.layers.attention import (
+    RaggedPagedAttention,
     SpGQAFlashDecodeAttention,
     append_kv,
     paged_append_kv,
@@ -22,6 +23,7 @@ from triton_distributed_tpu.layers.moe import EPAll2AllLayer, EPMoEMLP, MoETPMLP
 
 __all__ = [
     "AllGatherLayer",
+    "RaggedPagedAttention",
     "SpGQAFlashDecodeAttention",
     "append_kv",
     "paged_append_kv",

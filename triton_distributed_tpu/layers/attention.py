@@ -176,6 +176,73 @@ class SpGQAFlashDecodeAttention:
         )
 
 
+@dataclass(frozen=True)
+class RaggedPagedAttention:
+    """Serving-layout ragged paged attention: pools sharded over the
+    KV-HEAD dim on ``axis`` (GQA heads are independent — no cross-rank
+    LSE merge, unlike the sequence-sharded decode layer above), q/out
+    in the head-major GQA-rows packing, metadata replicated. The layer
+    the continuous-batching serving step composes
+    (models/transformer.serving_step); see
+    kernels/ragged_paged_attention.py for the kernel contract and
+    docs/SERVING.md for the state layout."""
+
+    mesh: jax.sharding.Mesh
+    axis: str = "x"
+    group: int = 4                 # G = Hq // Hkv
+    scale: float | None = None
+    soft_cap: float = 0.0
+    use_pallas: bool = True
+
+    def __call__(self, qp, k_pool, v_pool, kv_lens, q_lens, q_starts,
+                 block_table, *, block_q: int = 8):
+        """qp: (Hkv, T·G, D) packed rows sharded P(axis) on dim 0;
+        k_pool/v_pool: (npages, Hkv, page, D) arrays or int8
+        ``{"q","scale"}`` dicts, sharded P(None, axis); metadata
+        replicated. Returns (Hkv, T·G, D) sharded like qp."""
+        from jax.sharding import PartitionSpec as P
+
+        from triton_distributed_tpu.kernels.ragged_paged_attention import (
+            ragged_paged_attention,
+            ragged_paged_attention_xla,
+        )
+
+        quant = isinstance(k_pool, dict)
+        g, block = self.group, block_q
+        use_pallas = self.use_pallas
+
+        def local(qp, table, kv_lens, q_lens, q_starts, *pools):
+            fn = (ragged_paged_attention if use_pallas
+                  else ragged_paged_attention_xla)
+            kw = dict(group=g, scale=self.scale, soft_cap=self.soft_cap)
+            if use_pallas:
+                kw["block_q"] = block
+            if quant:
+                kq, ks, vq, vs = pools
+                out, _ = fn(qp, kq, vq, kv_lens, q_lens, q_starts,
+                            table, k_scale=ks, v_scale=vs, **kw)
+            else:
+                kc, vc = pools
+                out, _ = fn(qp, kc, vc, kv_lens, q_lens, q_starts,
+                            table, **kw)
+            return out
+
+        pools = (
+            (k_pool["q"], k_pool["scale"], v_pool["q"], v_pool["scale"])
+            if quant else (k_pool, v_pool)
+        )
+        sharded = jax.shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(P(self.axis), P(), P(), P(), P())
+            + tuple(P(None, self.axis) for _ in pools),
+            out_specs=P(self.axis),
+            check_vma=False,
+        )
+        return sharded(qp, block_table, kv_lens, q_lens, q_starts,
+                       *pools)
+
+
 def append_kv(k_cache, v_cache, kv_lens, k_new, v_new, kv_layout="bhsd",
               k_quant=None, v_quant=None):
     """Append one decode step's K/V at each batch row's current length.

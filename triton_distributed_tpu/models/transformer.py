@@ -1367,3 +1367,250 @@ class Transformer:
         if moe_state is None:
             return toks, caches, kv_lens
         return toks, caches, kv_lens, moe_state
+
+    # ------------------------------------------------------- ragged serving
+
+    @property
+    def _serving_pool_sharding(self):
+        """Serving pool placement: KV HEADS (dim 1) over tp. Heads are
+        independent in GQA attention, so the ragged serving step never
+        exchanges LSE partials across ranks — and the whole page pool
+        (dim 0) is one shared allocation any rank can serve any request
+        from, which is what the engine's single free list requires.
+        (The decode path's sequence sharding instead concentrates a
+        short request's pages — and its attention work — on rank 0.)"""
+        return NamedSharding(self.mesh, P(None, self.tp_axis))
+
+    def init_serving_state(self, slots: int, npages: int, page: int):
+        """Build a fresh :class:`~triton_distributed_tpu.serving.state.
+        ServingState` — the explicit serving-state object replacing the
+        ``init_paged_cache``/``paginate_caches`` tuple plumbing for the
+        continuous-batching engine: per-layer head-sharded page pools,
+        one shared (slots, pages_per_seq) block table (allocator-owned,
+        -1 = unallocated), per-slot kv_lens and cursors. Every leaf
+        gets its own buffer (the serving-step jit donates the state).
+        ``pages_per_seq`` is ``npages`` capped at 1024 table columns —
+        a slot may address the whole pool."""
+        from triton_distributed_tpu.serving.state import (
+            ServingState,
+            fresh_table,
+        )
+
+        c = self.config
+        if self.dp_axes:
+            raise ValueError("ragged serving is tp-only (dp composes by "
+                             "running one engine per dp group)")
+        if c.n_kv_heads % self.tp:
+            raise ValueError(
+                f"serving pools shard the {c.n_kv_heads} KV heads over "
+                f"tp={self.tp} — Hkv must divide"
+            )
+        pps = min(npages, 1024)
+        spec = self._serving_pool_sharding
+        if c.kv_quant is not None:
+            zq = jax.device_put(
+                jnp.zeros((npages, c.n_kv_heads, page, c.head_dim),
+                          jnp.int8),
+                spec,
+            )
+            zs = jax.device_put(
+                jnp.ones((npages, c.n_kv_heads, page), jnp.float32), spec
+            )
+
+            def pool():
+                # independent buffers per leaf — the step jit donates
+                return {"q": zq + jnp.int8(0), "scale": zs + 0.0}
+
+            layers = tuple(
+                (pool(), pool()) for _ in range(c.n_layers)
+            )
+        else:
+            z = jax.device_put(
+                jnp.zeros((npages, c.n_kv_heads, page, c.head_dim),
+                          c.dtype),
+                spec,
+            )
+            zero = jnp.zeros((), c.dtype)
+            layers = tuple((z + zero, z + zero) for _ in range(c.n_layers))
+        return ServingState(
+            layers=layers,
+            block_table=jnp.asarray(fresh_table(slots, pps)),
+            kv_lens=jnp.zeros((slots,), jnp.int32),
+            cursors=jnp.zeros((slots,), jnp.int32),
+            page=page,
+        )
+
+    def _ragged_attn(self, qp, k_pool, v_pool, state, q_lens, q_starts,
+                     block_q, use_pallas):
+        """One layer's ragged paged attention over the (updated) pools
+        via the head-sharded serving layer. qp: (Hkv, T·G, D) packed
+        GQA rows (already holding this step's tokens in the pools —
+        append-then-attend). Returns (Hkv, T·G, D)."""
+        from triton_distributed_tpu.layers import RaggedPagedAttention
+
+        c = self.config
+        layer = RaggedPagedAttention(
+            self.mesh, self.tp_axis, group=c.n_heads // c.n_kv_heads,
+            use_pallas=use_pallas,
+        )
+        return layer(
+            qp, k_pool, v_pool, state.kv_lens, q_lens, q_starts,
+            state.block_table, block_q=block_q,
+        )
+
+    def serving_step(self, params, state, tokens, token_rows, token_pos,
+                     q_starts, q_lens, moe_state=None, *,
+                     block_q: int = 8, use_pallas: bool = True):
+        """One CONTINUOUS-BATCHING step: a ragged mixed batch of prefill
+        chunks and decode tokens through every layer in one program.
+
+        ``state``: :class:`ServingState` whose ``kv_lens`` already
+        INCLUDE this step's tokens (the engine advances lengths at
+        batch-assembly time); ``tokens``: (T,) packed token ids;
+        ``token_rows``/``token_pos``: (T,) per-token slot id and global
+        sequence position (pos < 0 marks padding tokens — their K/V
+        writes are dropped); ``q_starts``/``q_lens``: (slots,) per-slot
+        spans into the packed array (8-aligned starts, ``q_lens == 0``
+        for slots not in this batch). Returns ``(logits (slots, vocab),
+        state')`` — logits at each slot's LAST packed token (the
+        next-token distribution for rows that finished a chunk at their
+        prompt end, garbage for q_lens == 0 slots), plus ``moe_state'``
+        threaded as in :meth:`decode_step` when given.
+
+        Every new K/V token is scattered into the page pools FIRST and
+        attention reads the updated pools (append-then-attend): a
+        prefill chunk's tokens attend each other causally through the
+        pool, and under ``kv_quant`` they are attended in their stored
+        int8 form — bit-consistent with every later step by
+        construction."""
+        from triton_distributed_tpu.kernels.ragged_paged_attention import (
+            pack_gqa_rows,
+            unpack_gqa_rows,
+        )
+
+        c = self.config
+        t = tokens.shape[0]
+        page = state.page
+        npages = state.npages
+        x = params["embed"][tokens].astype(c.dtype)          # (T, H)
+        valid = token_pos >= 0
+        pos_c = jnp.maximum(token_pos, 0)
+        local_page = state.block_table[
+            jnp.clip(token_rows, 0, state.slots - 1),
+            jnp.clip(pos_c // page, 0, state.pages_per_seq - 1),
+        ]
+        # padding tokens (and unallocated -1 table entries) scatter out
+        # of pool — JAX OOB-scatter drops them
+        pool_idx = jnp.where(
+            valid & (local_page >= 0), local_page, npages
+        )
+        off = pos_c % page
+        heads = jnp.arange(c.n_kv_heads)
+        pi = pool_idx[:, None]
+        hi = heads[None, :]
+        oi = off[:, None]
+
+        new_layers = []
+        new_states = None if moe_state is None else list(moe_state)
+        for li, (blk, (kp, vp)) in enumerate(
+            zip(params["blocks"], state.layers)
+        ):
+            xn = self._rmsnorm(x, blk["norm_attn"])
+            qkv = self._dmm(xn, blk["wqkv"])                 # (T, qkv)
+            q, k, v = jnp.split(
+                qkv, [c.q_dim, c.q_dim + c.kv_dim], axis=-1
+            )
+            k = k.reshape(t, c.n_kv_heads, c.head_dim)
+            v = v.reshape(t, c.n_kv_heads, c.head_dim)
+            if isinstance(kp, dict):
+                from triton_distributed_tpu.kernels.flash_decode import (
+                    quantize_kv,
+                )
+
+                kq8, ks8 = quantize_kv(k)
+                vq8, vs8 = quantize_kv(v)
+                kp = {
+                    "q": kp["q"].at[pi, hi, oi].set(kq8),
+                    "scale": kp["scale"].at[pi, hi, oi].set(ks8),
+                }
+                vp = {
+                    "q": vp["q"].at[pi, hi, oi].set(vq8),
+                    "scale": vp["scale"].at[pi, hi, oi].set(vs8),
+                }
+            else:
+                kp = kp.at[pi, hi, oi].set(k.astype(kp.dtype))
+                vp = vp.at[pi, hi, oi].set(v.astype(vp.dtype))
+            kp = jax.tree.map(
+                lambda a: jax.lax.with_sharding_constraint(
+                    a, self._serving_pool_sharding
+                ), kp,
+            )
+            vp = jax.tree.map(
+                lambda a: jax.lax.with_sharding_constraint(
+                    a, self._serving_pool_sharding
+                ), vp,
+            )
+            new_layers.append((kp, vp))
+            qp = pack_gqa_rows(
+                q.reshape(t, c.n_heads, c.head_dim), c.n_kv_heads
+            )
+            o = self._ragged_attn(
+                qp, kp, vp, state.replace(layers=()), q_lens, q_starts,
+                block_q, use_pallas,
+            )
+            o = unpack_gqa_rows(o, c.n_heads).reshape(t, c.q_dim)
+            x = x + self._dmm(o.astype(c.dtype), blk["wo"])
+            xn = self._rmsnorm(x, blk["norm_mlp"])
+            if "up" in blk:
+                h = jax.nn.silu(self._dmm(xn, blk["up"]))
+                x = x + self._dmm(h, blk["down"])
+            elif c.moe == "ep":
+                st = None if moe_state is None else moe_state[li]
+                y, st = self._decode_moe_ep(blk, xn, st)
+                x = x + y.astype(x.dtype)
+                if new_states is not None:
+                    new_states[li] = st
+            else:
+                logits_r = xn.astype(jnp.float32) @ blk["router"]
+                w, ids = mu.select_experts(logits_r, c.topk)
+                y = jnp.zeros_like(xn, dtype=jnp.float32)
+                for tt in range(c.topk):
+                    hh = jax.nn.silu(jnp.einsum(
+                        "bh,bhf->bf", xn,
+                        blk["moe_up"][ids[:, tt]].astype(c.dtype),
+                    ))
+                    y += w[:, tt:tt + 1] * jnp.einsum(
+                        "bf,bfh->bh", hh,
+                        blk["moe_down"][ids[:, tt]].astype(c.dtype),
+                    ).astype(jnp.float32)
+                x = x + y.astype(x.dtype)
+        x = self._rmsnorm(x, params["norm_f"])
+        last_idx = jnp.clip(q_starts + q_lens - 1, 0, t - 1)
+        x_last = x[last_idx]                                 # (slots, H)
+        if isinstance(params["lm_head"], dict):
+            logits = self._dmm(
+                x_last, params["lm_head"], out_dtype=jnp.float32,
+                act_quant=False,
+            )
+        else:
+            logits = x_last.astype(jnp.float32) @ params["lm_head"]
+        new_state = state.replace(layers=tuple(new_layers))
+        if moe_state is None:
+            return logits, new_state
+        return logits, new_state, new_states
+
+    @functools.cached_property
+    def _serving_jit(self):
+        # donate the ServingState (pool append aliases in place — the
+        # same discipline as the decode jits) and the LL MoE workspaces
+        @functools.partial(
+            jax.jit, static_argnums=(8, 9), donate_argnums=(1, 7)
+        )
+        def step(params, state, tokens, token_rows, token_pos, q_starts,
+                 q_lens, moe_state, block_q, use_pallas):
+            return self.serving_step(
+                params, state, tokens, token_rows, token_pos, q_starts,
+                q_lens, moe_state, block_q=block_q, use_pallas=use_pallas,
+            )
+
+        return step

@@ -1,0 +1,19 @@
+"""Continuous-batching serving runtime on the ragged paged-attention
+kernel: the explicit :class:`ServingState` (page pools + block table +
+per-request cursors, donated and shard-resident) and the
+:class:`ServingEngine` request scheduler (admission and eviction over
+the page pool, chunked prefill interleaved into decode batches).
+
+See docs/SERVING.md for the lifecycle and knob catalog.
+"""
+
+from triton_distributed_tpu.serving.engine import (  # noqa: F401
+    EngineConfig,
+    EngineStats,
+    Request,
+    ServingEngine,
+    poisson_trace,
+)
+from triton_distributed_tpu.serving.state import (  # noqa: F401
+    ServingState,
+)

@@ -1,0 +1,444 @@
+"""Continuous-batching request scheduler over the ragged serving step.
+
+The engine turns the repo's serving ingredients (paged int8 KV pools,
+the EP-MoE decode step, the ragged paged-attention kernel) into a
+traffic-serving runtime: requests arrive on a trace, are ADMITTED into
+slots when the page pool can hold their first chunk, their prompts are
+prefilled in CHUNKS interleaved with other requests' decode tokens
+(one ragged mixed batch per step — no prefill stall, no rectangle),
+and when the pool runs dry mid-decode the lowest-priority request is
+EVICTED (pages freed, request re-queued; on re-admission its prompt
+*plus everything generated so far* is re-prefilled, so generation
+resumes from the exact cursor — the recompute-eviction discipline).
+
+Scheduling model (all host-side, numpy; the device work is ONE jitted
+``Transformer.serving_step`` per engine step):
+
+* a step's batch is assembled slot-by-slot under a static
+  ``token_budget``: each active slot contributes
+  ``min(chunk, remaining_sequence)`` tokens — 1 in steady decode, up
+  to ``chunk`` while prefilling — packed at 8-aligned offsets;
+* pages for the new tokens are allocated from one shared free list;
+  allocation failure triggers eviction (victims: the latest-arrived
+  active request not already in this step's batch — LIFO preemption),
+  and a row that still cannot get pages is deferred one step;
+* per-slot device ``kv_lens`` are zeroed for slots outside the batch,
+  so the kernel never walks a deferred row's pages.
+
+Degradation: the first device failure of the Pallas kernel path flips
+the engine onto the XLA twin (``use_pallas=False``) and retries — the
+``tools/native``-style graceful-degradation story at engine level, so
+a fault-plan replay (bench.py --dryrun --faults) exercises scheduling
+under chaos without hardware.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class Request:
+    """One serving request. ``arrival`` is in engine-step units (the
+    deterministic clock the tests and the Poisson trace share)."""
+
+    rid: int
+    prompt: np.ndarray                 # (L,) int32 token ids
+    max_new: int = 8
+    arrival: float = 0.0
+
+    # runtime (engine-owned)
+    generated: list = field(default_factory=list)
+    cursor: int = 0                    # tokens of `seq` already in KV
+    slot: int | None = None
+    evictions: int = 0
+    done: bool = False
+    completion_step: int | None = None
+
+    @property
+    def seq(self) -> np.ndarray:
+        """Every known token of the sequence: prompt + generated. The
+        recompute prefix after an eviction IS this — re-prefilling it
+        resumes generation from the exact cursor."""
+        if not self.generated:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)]
+        )
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    slots: int = 8                     # concurrent requests (R)
+    token_budget: int = 64             # static packed tokens per step (T)
+    chunk: int = 16                    # max prefill tokens per row-step
+    page: int = 16
+    npages: int = 64
+    max_steps: int = 10_000
+
+
+@dataclass
+class EngineStats:
+    step_times: list = field(default_factory=list)
+    step_tokens: list = field(default_factory=list)
+    completed: int = 0
+    generated_tokens: int = 0
+    prefill_tokens: int = 0
+    evictions: int = 0
+    deferrals: int = 0
+    degraded: bool = False
+
+    @property
+    def total_time(self) -> float:
+        return float(sum(self.step_times))
+
+    @property
+    def sustained_tok_per_s(self) -> float:
+        t = self.total_time
+        return (sum(self.step_tokens) / t) if t > 0 else 0.0
+
+    @property
+    def goodput_tok_per_s(self) -> float:
+        """GENERATED tokens of completed requests per wall second — the
+        metric padding cannot inflate (prefill re-computation after an
+        eviction, padded rectangle slots, and abandoned work all count
+        against it)."""
+        t = self.total_time
+        return (self.generated_tokens / t) if t > 0 else 0.0
+
+    @property
+    def p99_step_ms(self) -> float:
+        if not self.step_times:
+            return 0.0
+        return float(np.percentile(np.asarray(self.step_times), 99) * 1e3)
+
+    @property
+    def p50_step_ms(self) -> float:
+        if not self.step_times:
+            return 0.0
+        return float(np.percentile(np.asarray(self.step_times), 50) * 1e3)
+
+
+def poisson_trace(seed: int, n_requests: int, mean_interarrival: float,
+                  len_lo: int, len_hi: int, max_new_lo: int,
+                  max_new_hi: int, vocab: int) -> list:
+    """Seeded Poisson arrival trace: exponential inter-arrival gaps (in
+    engine-step units), prompt lengths ~ U[len_lo, len_hi) — the
+    ISSUE-6 traffic shape (lengths ~U[S/8, 3S/4]) — and uniform
+    max_new. Deterministic under ``seed``."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    out = []
+    for i in range(n_requests):
+        t += float(rng.exponential(mean_interarrival))
+        ln = int(rng.integers(len_lo, max(len_hi, len_lo + 1)))
+        out.append(Request(
+            rid=i,
+            prompt=rng.integers(0, vocab, (ln,)).astype(np.int32),
+            max_new=int(rng.integers(max_new_lo, max(max_new_hi,
+                                                     max_new_lo + 1))),
+            arrival=t,
+        ))
+    return out
+
+
+def _ceil8(x: int) -> int:
+    return -(-x // 8) * 8
+
+
+class ServingEngine:
+    """The scheduler. Owns the host mirrors (free list, block table,
+    lengths, cursors) and the device :class:`ServingState`; every
+    :meth:`step` assembles one ragged batch and runs one jitted
+    ``model.serving_step``."""
+
+    def __init__(self, model, params, cfg: EngineConfig, *,
+                 moe_state="auto", use_pallas: bool = True):
+        import jax.numpy as jnp
+
+        self.model = model
+        self.params = params
+        self.cfg = cfg
+        self.use_pallas = use_pallas
+        self.state = model.init_serving_state(
+            cfg.slots, cfg.npages, cfg.page
+        )
+        self._jnp = jnp
+        pps = self.state.pages_per_seq
+        self.table = np.full((cfg.slots, pps), -1, np.int32)
+        self.free_pages = list(range(cfg.npages - 1, -1, -1))
+        self.slot_req: list = [None] * cfg.slots
+        self.pending: deque = deque()      # not yet arrived (by time)
+        self.waiting: deque = deque()      # arrived, not admitted
+        self.stats = EngineStats()
+        self.step_count = 0
+        g = model.config.n_heads // model.config.n_kv_heads
+        self._g = g
+        from triton_distributed_tpu.kernels.ragged_paged_attention import (
+            auto_block_q,
+        )
+
+        self._block_q_cap = auto_block_q(cfg.chunk, g)
+        # the packed array carries a PARKING zone of block_q_cap tokens
+        # past the budget: rows outside the batch (q_len == 0) park
+        # their garbage writes there, where no valid span can be
+        # clobbered by the kernel's sequential out DMAs
+        self._t_pad = cfg.token_budget + self._block_q_cap
+        # LL MoE workspaces sized to the PACKED step width (None when
+        # the model has no fused-transport EP layers)
+        self.moe_state = (
+            model.init_decode_state(self._t_pad)
+            if moe_state == "auto" else moe_state
+        )
+        if cfg.token_budget % 8:
+            raise ValueError("token_budget must be 8-aligned")
+        if cfg.chunk > cfg.token_budget:
+            raise ValueError(
+                f"chunk={cfg.chunk} exceeds token_budget="
+                f"{cfg.token_budget}"
+            )
+
+    # ------------------------------------------------------------ requests
+
+    def submit(self, req: Request) -> None:
+        self.pending.append(req)
+
+    def submit_trace(self, trace) -> None:
+        for r in sorted(trace, key=lambda r: r.arrival):
+            self.submit(r)
+
+    @property
+    def idle(self) -> bool:
+        return (not self.pending and not self.waiting
+                and all(r is None for r in self.slot_req))
+
+    # ----------------------------------------------------------- allocator
+
+    def _pages_held(self, cursor: int) -> int:
+        return -(-cursor // self.cfg.page)
+
+    def _alloc(self, slot: int, held: int, need: int) -> bool:
+        """Grow slot's table from ``held`` to ``need`` pages; all-or-
+        nothing (no partial growth to unwind)."""
+        if need - held > len(self.free_pages):
+            return False
+        for pg in range(held, need):
+            self.table[slot, pg] = self.free_pages.pop()
+        return True
+
+    def _free_slot(self, slot: int) -> None:
+        for pg in self.table[slot]:
+            if pg >= 0:
+                self.free_pages.append(int(pg))
+        self.table[slot] = -1
+        self.slot_req[slot] = None
+
+    def _evict_one(self, batched: set) -> bool:
+        """Evict the latest-arrived active request not already in this
+        step's batch (LIFO preemption); its pages return to the free
+        list and the request re-queues AT THE FRONT with cursor 0 — the
+        recompute prefix (prompt + generated) resumes it exactly."""
+        victims = [
+            (req.arrival, s) for s, req in enumerate(self.slot_req)
+            if req is not None and s not in batched
+        ]
+        if not victims:
+            return False
+        _, s = max(victims)
+        req = self.slot_req[s]
+        req.cursor = 0
+        req.evictions += 1
+        req.slot = None
+        self._free_slot(s)
+        self.waiting.appendleft(req)
+        self.stats.evictions += 1
+        return True
+
+    # ---------------------------------------------------------------- step
+
+    def _committed_pages(self) -> int:
+        """Pages the already-admitted slots will claim for their NEXT
+        chunk but have not allocated yet — admission must not promise
+        them away (allocation happens at batch assembly)."""
+        tot = 0
+        for req in self.slot_req:
+            if req is None:
+                continue
+            take = min(self.cfg.chunk, len(req.seq) - req.cursor)
+            tot += max(
+                self._pages_held(req.cursor + take)
+                - self._pages_held(req.cursor), 0,
+            )
+        return tot
+
+    def _admit(self) -> None:
+        while self.pending and self.pending[0].arrival <= self.step_count:
+            self.waiting.append(self.pending.popleft())
+        while self.waiting:
+            free = [s for s, r in enumerate(self.slot_req) if r is None]
+            if not free:
+                return
+            req = self.waiting[0]
+            first = min(self.cfg.chunk, len(req.seq))
+            if (self._pages_held(first)
+                    > len(self.free_pages) - self._committed_pages()):
+                return                     # pool exhausted — hold the queue
+            self.waiting.popleft()
+            s = free[0]
+            req.slot = s
+            self.slot_req[s] = req
+            if len(req.seq) > self.state.capacity:
+                # cannot ever fit — fail it loudly rather than wedging
+                req.done = True
+                self._free_slot(s)
+                raise ValueError(
+                    f"request {req.rid}: sequence {len(req.seq)} exceeds "
+                    f"slot capacity {self.state.capacity}"
+                )
+
+    def _assemble(self):
+        cfg = self.cfg
+        R, T = cfg.slots, self._t_pad
+        tokens = np.zeros((T,), np.int32)
+        token_rows = np.zeros((T,), np.int32)
+        token_pos = np.full((T,), -1, np.int32)
+        # inactive slots PARK their garbage output block past the
+        # budget (see __init__) — never over another row's valid span
+        q_starts = np.full((R,), cfg.token_budget, np.int32)
+        q_lens = np.zeros((R,), np.int32)
+        kv_dev = np.zeros((R,), np.int32)
+        next_start = 0
+        batched: set = set()
+        takes: dict = {}
+        for s in range(R):
+            req = self.slot_req[s]
+            if req is None:
+                continue
+            seq = req.seq
+            take = min(cfg.chunk, len(seq) - req.cursor)
+            if take <= 0:
+                continue
+            if next_start + _ceil8(take) > cfg.token_budget:
+                self.stats.deferrals += 1
+                continue                   # token budget spent
+            held = self._pages_held(req.cursor)
+            need = self._pages_held(req.cursor + take)
+            while not self._alloc(s, held, need):
+                if not self._evict_one(batched | {s}):
+                    break
+            else:
+                # allocation succeeded
+                span = slice(next_start, next_start + take)
+                tokens[span] = seq[req.cursor:req.cursor + take]
+                token_rows[span] = s
+                token_pos[span] = np.arange(
+                    req.cursor, req.cursor + take, dtype=np.int32
+                )
+                q_starts[s] = next_start
+                q_lens[s] = take
+                kv_dev[s] = req.cursor + take
+                next_start += _ceil8(take)
+                batched.add(s)
+                takes[s] = take
+                continue
+            # page allocation failed even after eviction: defer the row
+            self.stats.deferrals += 1
+        return (tokens, token_rows, token_pos, q_starts, q_lens, kv_dev,
+                batched, takes)
+
+    def _run_device(self, arrays, block_q):
+        jnp = self._jnp
+        tokens, token_rows, token_pos, q_starts, q_lens, kv_dev = arrays
+        state = self.state.replace(
+            block_table=jnp.asarray(self.table),
+            kv_lens=jnp.asarray(kv_dev),
+            cursors=jnp.asarray(
+                [0 if r is None else r.cursor for r in self.slot_req],
+                dtype=jnp.int32,
+            ),
+        )
+        out = self.model._serving_jit(
+            self.params, state, jnp.asarray(tokens),
+            jnp.asarray(token_rows), jnp.asarray(token_pos),
+            jnp.asarray(q_starts), jnp.asarray(q_lens),
+            self.moe_state, block_q, self.use_pallas,
+        )
+        if self.moe_state is None:
+            logits, self.state = out
+        else:
+            logits, self.state, self.moe_state = out
+        return np.asarray(logits)          # host fetch = the fence
+
+    def step(self) -> dict:
+        """One engine step: admit → assemble → device step → advance
+        cursors/completions. Returns a small per-step report."""
+        from triton_distributed_tpu.kernels.ragged_paged_attention import (
+            auto_block_q,
+        )
+
+        self._admit()
+        (tokens, token_rows, token_pos, q_starts, q_lens, kv_dev,
+         batched, takes) = self._assemble()
+        report = {"step": self.step_count, "batched": len(batched),
+                  "tokens": int(q_lens.sum())}
+        if not batched:
+            self.step_count += 1
+            return report
+        block_q = auto_block_q(int(q_lens.max()), self._g)
+        t0 = time.perf_counter()
+        arrays = (tokens, token_rows, token_pos, q_starts, q_lens, kv_dev)
+        try:
+            logits = self._run_device(arrays, block_q)
+        except Exception:
+            if not self.use_pallas:
+                raise
+            # degradation: fall back to the XLA twin for the rest of
+            # the session (the op-level with_fallback story at engine
+            # level) — scheduling state is untouched, re-run the batch
+            self.use_pallas = False
+            self.stats.degraded = True
+            logits = self._run_device(arrays, block_q)
+        dt = time.perf_counter() - t0
+        nxt = np.argmax(logits, axis=-1).astype(np.int32)
+        gen_this_step = 0
+        for s in sorted(batched):
+            req = self.slot_req[s]
+            take = takes[s]
+            req.cursor += take
+            if req.cursor == len(req.seq):
+                # the row's last packed token was its sequence frontier:
+                # the logits row is the next-token distribution
+                tok = int(nxt[s])
+                req.generated.append(tok)
+                gen_this_step += 1
+                if len(req.generated) >= req.max_new:
+                    req.done = True
+                    req.completion_step = self.step_count
+                    self.stats.completed += 1
+                    self.stats.generated_tokens += len(req.generated)
+                    self._free_slot(s)
+        self.stats.step_times.append(dt)
+        self.stats.step_tokens.append(int(q_lens.sum()))
+        self.stats.prefill_tokens += int(q_lens.sum()) - gen_this_step
+        report.update(
+            ms=round(dt * 1e3, 3), generated=gen_this_step,
+            free_pages=len(self.free_pages),
+            waiting=len(self.waiting) + len(self.pending),
+        )
+        self.step_count += 1
+        return report
+
+    def run(self, trace=None, max_steps: int | None = None) -> EngineStats:
+        """Drive the engine until the trace drains (or ``max_steps``)."""
+        if trace is not None:
+            self.submit_trace(trace)
+        max_steps = max_steps or self.cfg.max_steps
+        for _ in range(max_steps):
+            if self.idle:
+                break
+            self.step()
+        return self.stats

@@ -1,0 +1,93 @@
+"""ServingState: the explicit, donated, page-table-addressed decode state.
+
+Before this module, the serving decode state was an ad-hoc tuple spread
+across call sites: per-layer ``(k, v)`` cache tuples from
+``init_paged_cache``/``paginate_caches``, a separate block table, and a
+separate ``kv_lens`` vector, each threaded (and donated) individually.
+The continuous-batching engine needs them as ONE object with one
+placement story:
+
+* **page pools** per layer — ``(npages, Hkv, page, D)`` (int8
+  ``{"q","scale"}`` dicts under ``kv_quant``), sharded over the KV-HEAD
+  dim on the tp axis. Head sharding (not the decode path's sequence
+  sharding) is the serving layout: GQA heads are independent, so ranks
+  never exchange LSE partials, and a request's pages live wholly in the
+  shared pool — any rank can serve any mix of requests, which is what
+  admission/eviction over one free list requires.
+* **block table** ``(slots, pages_per_seq)`` int32 — pool page ids per
+  request slot, replicated (it is scheduler metadata, bytes-tiny).
+* **kv_lens** ``(slots,)`` int32 — per-slot lengths *including* the
+  step currently in flight (the ragged kernel attends append-then-
+  attend).
+* **cursors** ``(slots,)`` int32 — per-request progress (prompt tokens
+  consumed + tokens generated); the device-side mirror of the
+  scheduler's cursor so an evicted request's resume point travels with
+  the state object.
+
+The object is a pytree (``jax.tree_util``): the serving-step jit
+donates it whole, and with the pool placements pinned the per-step
+append aliases in place — no pool-sized copy per step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace as _dc_replace
+
+import jax
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ServingState:
+    """One engine's device-resident serving state (see module docs)."""
+
+    layers: tuple       # per-layer (k_pool, v_pool); dicts under kv_quant
+    block_table: object  # (slots, pages_per_seq) int32
+    kv_lens: object      # (slots,) int32 — includes the in-flight step
+    cursors: object      # (slots,) int32
+    page: int = 0        # static: rows per page
+
+    def replace(self, **kw) -> "ServingState":
+        return _dc_replace(self, **kw)
+
+    @property
+    def slots(self) -> int:
+        return int(self.block_table.shape[0])
+
+    @property
+    def pages_per_seq(self) -> int:
+        return int(self.block_table.shape[1])
+
+    @property
+    def npages(self) -> int:
+        k0 = self.layers[0][0]
+        return int((k0["q"] if isinstance(k0, dict) else k0).shape[0])
+
+    @property
+    def capacity(self) -> int:
+        """Max sequence positions one slot can hold."""
+        return self.pages_per_seq * self.page
+
+
+def _flatten(s: ServingState):
+    return (
+        (s.layers, s.block_table, s.kv_lens, s.cursors),
+        (s.page,),
+    )
+
+
+def _unflatten(aux, children):
+    layers, table, lens, cursors = children
+    return ServingState(
+        layers=layers, block_table=table, kv_lens=lens, cursors=cursors,
+        page=aux[0],
+    )
+
+
+jax.tree_util.register_pytree_node(ServingState, _flatten, _unflatten)
+
+
+def fresh_table(slots: int, pages_per_seq: int) -> np.ndarray:
+    """Host-side table template (-1 = unallocated; device consumers
+    clamp, the allocator never reads a -1 back)."""
+    return np.full((slots, pages_per_seq), -1, np.int32)

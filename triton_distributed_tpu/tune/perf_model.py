@@ -194,6 +194,60 @@ def auto_wire_dtype(slab_rows: int, k: int, n_cols: int, itemsize: int,
     return "int8-mxu" if consumer_wq == "int8" else "fp8"
 
 
+# ------------------------------------------------- ragged serving term
+#
+# The continuous-batching engine's step cost is dominated by the ragged
+# paged-attention page walk (per-row TRUE lengths — the whole point of
+# the ragged kernel) plus the packed batch's weight-HBM-bound
+# projection reads. The bench (serving_continuous) reports this model
+# term next to the measurement so regressions are explainable as
+# %-of-speed-of-light, like every other bench row.
+
+#: fixed per-page DMA-issue/loop overhead of the dynamic page walk,
+#: from the round-5 serving-attention measurements (~0.17 µs/block at
+#: 1024-row blocks on a v5e)
+RAGGED_PAGE_ISSUE_MS = 0.17e-3
+
+
+def ragged_page_walk_ms(kv_lens, page: int, hkv: int, d: int,
+                        spec: TpuSpec | None = None,
+                        quant: bool = True) -> float:
+    """HBM time of one ragged step's KV walk: every row reads
+    ``ceil(kv_len/page)`` pages of K AND V (+ the f32 scale planes
+    under int8), plus the fixed per-page issue cost — proportional to
+    the step's TRUE KV volume, never the slot capacity (the quantity a
+    rectangle batch cannot avoid paying)."""
+    spec = spec or detect_spec()
+    pages = sum(max(-(-int(l) // page), 1) for l in kv_lens if int(l) > 0)
+    per_page = 2 * hkv * page * d * (1 if quant else 2)
+    if quant:
+        per_page += 2 * hkv * page * 4
+    return (pages * per_page / (spec.hbm_gbps * 1e9) * 1e3
+            + pages * RAGGED_PAGE_ISSUE_MS)
+
+
+def ragged_serving_step_ms(kv_lens, q_lens, *, page: int, hkv: int,
+                           g: int, d: int, hidden: int,
+                           weight_bytes_per_token_layer: float = 0.0,
+                           n_layers: int = 1,
+                           spec: TpuSpec | None = None,
+                           quant: bool = True) -> float:
+    """Analytic one-step model for the continuous engine: the per-layer
+    ragged attention walk plus the packed batch's projection/expert
+    weight reads (``weight_bytes_per_token_layer`` — serving GEMMs are
+    weight-HBM-bound at batch-scale M, so the weight fetch, not the
+    FLOPs, is the projection term) and the q/out token traffic."""
+    spec = spec or detect_spec()
+    t = sum(int(x) for x in q_lens)
+    attn = ragged_page_walk_ms(kv_lens, page, hkv, d, spec, quant)
+    tok_bytes = 3 * t * hkv * g * d * 2          # q in, out, lse-ish
+    w_ms = (weight_bytes_per_token_layer
+            / (spec.hbm_gbps * 1e9) * 1e3)
+    return n_layers * (
+        attn + tok_bytes / (spec.hbm_gbps * 1e9) * 1e3 + w_ms
+    )
+
+
 # ------------------------------------------------ hop critical-path term
 #
 # The dataflow pass (analysis/dataflow.py) counts, per element of every
