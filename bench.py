@@ -36,8 +36,21 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import sys
 import time
+
+# CPU dev-box runs (JAX_PLATFORMS=cpu) get the same virtual 8-device
+# mesh the test harness uses (tests/conftest.py): the multi-rank rows —
+# the 2×(n/2) disaggregated serving split, the DCN rails, the ring
+# engines — then exercise their real cross-device paths instead of
+# degenerating to n=1. Real-TPU runs are untouched.
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax
 import jax.numpy as jnp
@@ -319,12 +332,20 @@ def main(argv=None) -> None:
 
         devs = jax.devices()
         mesh = Mesh(np.asarray(devs), ("x",))
+        on_tpu = jax.default_backend() == "tpu"
         out = _bench_serving_continuous(
-            mesh, len(devs), jax.default_backend() == "tpu",
-            detect_spec(), tiny=True,
+            mesh, len(devs), on_tpu, detect_spec(), tiny=True,
         )
         out["faults"] = args.faults
         print(json.dumps(out), flush=True)
+        # the disaggregated twin at the same interpreter shapes: the
+        # split-role engine, the DCN wire rails and the perf-model
+        # placement gate all run hardware-free too
+        out2 = _bench_serving_disaggregated(
+            mesh, len(devs), on_tpu, detect_spec(), tiny=True,
+        )
+        out2["faults"] = args.faults
+        print(json.dumps(out2), flush=True)
         return
 
     from triton_distributed_tpu.kernels.ag_gemm import (
@@ -488,7 +509,7 @@ def main(argv=None) -> None:
                _bench_moe_a2a, _bench_flash_decode,
                _bench_serving_moe_decode, _bench_serving_multilayer,
                _bench_serving_paged, _bench_generate_scan,
-               _bench_serving_continuous):
+               _bench_serving_continuous, _bench_serving_disaggregated):
         try:
             print(json.dumps(fn(mesh, n, on_tpu, spec)), file=sys.stderr, flush=True)
         except Exception as e:
@@ -1305,11 +1326,20 @@ def _bench_serving_continuous(mesh, n, on_tpu, spec, tiny=False):
     # model term: a representative steady step (every slot decoding at
     # the mean trace length)
     mean_len = (trace_kw["len_lo"] + trace_kw["len_hi"]) // 2
+    from triton_distributed_tpu.tune.perf_model import (
+        measured_page_issue_ms,
+    )
+
     model_ms = ragged_serving_step_ms(
         [mean_len] * ecfg.slots, [1] * ecfg.slots, page=page,
         hkv=cfg.n_kv_heads // n, g=cfg.n_heads // cfg.n_kv_heads,
         d=cfg.head_dim, hidden=cfg.hidden,
         spec=spec, quant=cfg.kv_quant is not None,
+        # the backend's MEASURED per-page issue cost (ROADMAP
+        # follow-on): off-TPU the interpreter pays milliseconds per
+        # page, not the v5e's 0.17 µs — the model term should track
+        # the machine the measurement next to it ran on
+        issue_ms=measured_page_issue_ms(),
     )
     ratio = (stats.goodput_tok_per_s / base_goodput
              if base_goodput > 0 else float("inf"))
@@ -1335,6 +1365,182 @@ def _bench_serving_continuous(mesh, n, on_tpu, spec, tiny=False):
             f"lens~U[{trace_kw['len_lo']},{trace_kw['len_hi']}] "
             f"poisson(seed=11) hidden={cfg.hidden} "
             f"kvq={cfg.kv_quant} "
+            + ("tiny-dryrun" if tiny or not on_tpu else "headline")
+        ),
+    }
+
+
+def _bench_serving_disaggregated(mesh, n, on_tpu, spec, tiny=False):
+    """DISAGGREGATED prefill/decode (ISSUE 7 tentpole acceptance): the
+    PR-6 Poisson trace served by a two-role topology on a 2×(n/2)
+    hybrid mesh — a prefill slice runs chunked prefill, each finished
+    request's int8 KV pages ship slice→slice on the quantized DCN wire
+    (payload + per-row scale planes, the pool's native bytes), landing
+    in the decode slice's pool overlapped with its decode steps — vs
+    the COLOCATED PR-6 engine on the same n/2-chip slice serving the
+    same trace. The number disaggregation must win is DECODE p99 step
+    time: colocated decode steps carry interleaved prefill chunks (the
+    contention), the decode role's steps never do. Both engines run the
+    satellite temperature/top-k sampler (request-keyed draws — the two
+    topologies still produce identical token streams, asserted here)."""
+    import jax
+
+    from triton_distributed_tpu.models import Transformer
+    from triton_distributed_tpu.serving import (
+        DisaggregatedEngine,
+        ServingEngine,
+        poisson_trace,
+    )
+    from triton_distributed_tpu.tune.perf_model import (
+        kv_ship_ms,
+        measured_page_issue_ms,
+        refuse_disaggregation,
+    )
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return {"metric": "serving_disaggregated",
+                "error": "needs >= 2 devices for a 2x(n/2) role split"}
+    half = len(devs) // 2
+    mesh_p = Mesh(np.asarray(devs[:half]), ("x",))
+    mesh_d = Mesh(np.asarray(devs[half:2 * half]), ("x",))
+    hybrid = Mesh(
+        np.asarray(devs[:2 * half]).reshape(2, half), ("dcn", "x")
+    )
+
+    cfg, ecfg, trace_kw, s_cap = _serving_continuous_config(
+        half, on_tpu, tiny
+    )
+    from dataclasses import replace as _rep
+
+    if not on_tpu or tiny:
+        # the CONTENDED shape of the comparison: prefill chunks much
+        # wider than a decode batch (budget ≫ 8·slots), prompts many
+        # chunks long, arrivals dense enough that colocated decode
+        # steps almost always carry a prefill chunk. The decode role's
+        # engine auto-narrows to an 8·slots packed width, so its steps
+        # never pay the prefill-sized rectangle — the width gap that
+        # IS the interference, visible even on the dev box where the
+        # XLA-twin step cost is rectangle-shaped.
+        s_cap = 256
+        # int8 KV pools even at interpreter shapes: the ship's payload
+        # is then the pool's native int8 bytes + per-row scale planes —
+        # the quantized wire (and its compression) under test
+        cfg = _rep(cfg, kv_quant="int8")
+        ecfg = _rep(
+            ecfg, slots=6, token_budget=256, chunk=128, page=8,
+            npages=192,
+        )
+        trace_kw = dict(
+            n_requests=24, mean_interarrival=0.8,
+            len_lo=64, len_hi=192, max_new_lo=4, max_new_hi=10,
+            vocab=trace_kw["vocab"],
+        )
+    ecfg = _rep(ecfg, temperature=0.7, top_k=40, seed=11)
+
+    def build(mesh_role):
+        model = Transformer(cfg, mesh_role, tp_axis="x")
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s),
+            model.init(jax.random.PRNGKey(7)), model.shardings(),
+        )
+        params = model.quantize_moe_weights(params)
+        params = model.quantize_dense_weights(params)
+        return model, params
+
+    model_p, params_p = build(mesh_p)
+    model_d, params_d = build(mesh_d)
+
+    def fresh_trace():
+        return poisson_trace(seed=11, **trace_kw)
+
+    # ---- colocated baseline on the SAME n/2-chip slice (run twice;
+    # the first run pays the compiles)
+    for _warm in (False, True):
+        trace_c = fresh_trace()
+        eng_c = ServingEngine(model_p, params_p, ecfg)
+        stats_c = eng_c.run(trace_c)
+    assert stats_c.completed == trace_kw["n_requests"], (
+        stats_c.completed, stats_c.deferrals)
+
+    # ---- disaggregated, KV on the quantized DCN wire
+    for _warm in (False, True):
+        trace_d = fresh_trace()
+        eng = DisaggregatedEngine(
+            model_p, params_p, model_d, params_d, ecfg,
+            hybrid_mesh=hybrid, dcn_axis="dcn", transport="dcn",
+            ship_delay_steps=1,
+        )
+        stats = eng.run(trace_d)
+    assert stats.completed == trace_kw["n_requests"], (
+        stats.completed, len(eng._ready), len(eng._inflight))
+    # token-exactness across topologies (int8 KV pages shipped
+    # verbatim + request-keyed sampling): the split changes WHERE work
+    # runs, never what it computes
+    mismatches = sum(
+        a.generated != b.generated for a, b in zip(trace_c, trace_d)
+    )
+
+    mean_len = (trace_kw["len_lo"] + trace_kw["len_hi"]) // 2
+    pages_per_req = -(-mean_len // ecfg.page)
+    hkv_l = cfg.n_kv_heads // half
+    ship_model_ms = kv_ship_ms(
+        pages_per_req, ecfg.page, hkv_l, cfg.head_dim, cfg.n_layers,
+        cfg.kv_quant is not None, spec,
+    )
+    refusal = refuse_disaggregation(
+        cfg, ecfg.page,
+        {"prompt_len": mean_len,
+         "max_new": (trace_kw["max_new_lo"] + trace_kw["max_new_hi"]) // 2},
+        spec,
+    )
+    # the measured per-page issue cost (ROADMAP follow-on): steady-state
+    # decode walks ~ceil(len/page) pages per active row, so the decode
+    # role's p50 step over its typical row count prices one page walk
+    steady_rows = max(
+        1, min(ecfg.slots, int(np.median(
+            [t for t in stats.decode.step_tokens if t > 0] or [1]
+        )))
+    )
+    measured_issue = (
+        stats.decode.p50_step_ms / (steady_rows * pages_per_req)
+        if pages_per_req else 0.0
+    )
+
+    p99_c = stats_c.decode_p99_step_ms
+    p99_d = stats.decode_p99_step_ms
+    return {
+        "metric": "serving_disaggregated",
+        "value": round(p99_d, 2),
+        "unit": "ms decode p99",
+        "colocated_decode_p99_ms": round(p99_c, 2),
+        "decode_p99_vs_colocated": round(p99_d / p99_c, 3) if p99_c else None,
+        "decode_p99_improved": bool(p99_d < p99_c),
+        "goodput_tok_per_s": round(stats.goodput_tok_per_s, 1),
+        "colocated_goodput": round(stats_c.goodput_tok_per_s, 1),
+        "goodput_vs_colocated": round(
+            stats.goodput_tok_per_s / stats_c.goodput_tok_per_s, 3
+        ) if stats_c.goodput_tok_per_s else None,
+        "ships": stats.ships,
+        "ship_p50_ms": round(float(np.median(stats.ship_ms)), 2)
+        if stats.ship_ms else 0.0,
+        "shipped_wire_bytes": stats.shipped_wire_bytes,
+        "wire_compression_vs_raw": round(stats.wire_compression, 3),
+        "degraded_transport": stats.degraded_transport,
+        "token_mismatches_vs_colocated": mismatches,
+        "prefill_evictions": stats.prefill.evictions,
+        "decode_evictions": stats.decode.evictions,
+        "kv_ship_model_ms_per_req": round(ship_model_ms, 4),
+        "auto_placement": ("refused: " + refusal) if refusal else "accepted",
+        "measured_page_issue_ms": round(measured_issue, 4),
+        "model_page_issue_ms": measured_page_issue_ms(),
+        "config": (
+            f"2x{half} hybrid mesh, slots={ecfg.slots} "
+            f"budget={ecfg.token_budget} chunk={ecfg.chunk} "
+            f"page={ecfg.page} npages={ecfg.npages} "
+            f"requests={trace_kw['n_requests']} "
+            f"lens~U[{trace_kw['len_lo']},{trace_kw['len_hi']}] "
+            f"temp=0.7 top_k=40 kvq={cfg.kv_quant} "
             + ("tiny-dryrun" if tiny or not on_tpu else "headline")
         ),
     }

@@ -3,11 +3,15 @@
 # round 7).
 #
 # Runs the `fast`-marked modules — the static analysis suite
-# (shmemlint + the Mosaic-compat pre-flight), the fault engine, the
-# host-level runtime/topology logic, the wire-layout/XLA-twin tests,
-# the lang-layer slices, the tools, and the continuous-batching
-# serving suite (the ragged-kernel numerics + scheduler tests,
-# tests/test_ragged_attention.py + tests/test_serving_engine.py) —
+# (shmemlint + the Mosaic-compat pre-flight, incl. the kv_ship.pages
+# family + its SL008/SL009 fixtures), the fault engine, the host-level
+# runtime/topology logic, the wire-layout/XLA-twin tests, the
+# lang-layer slices, the tools, the continuous-batching serving suite
+# (the ragged-kernel numerics + scheduler tests,
+# tests/test_ragged_attention.py + tests/test_serving_engine.py with
+# the prefix-cache/sampling satellites) and the disaggregated
+# prefill/decode transport suite (tests/test_kv_ship.py: wire-layout
+# round trips, ship/eviction race pins, 2-role token-exactness) —
 # everything that answers "did I just break a protocol, a contract,
 # or the host plumbing?" without paying for the big interpreted model
 # suites. Use it as the inner-loop gate; the full tier-1 run remains

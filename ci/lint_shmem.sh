@@ -12,7 +12,8 @@
 #   1. the `analysis`-marked pytest subset (rule fixtures + API surface);
 #   2. the CLI over every registered kernel family on an 8-rank mesh —
 #      protocol (SL001-007) AND data correctness (SL008-010: delivery
-#      contracts, wire-rail consistency, stale-scale reads);
+#      contracts incl. the kv_ship pairwise page-ship permute,
+#      wire-rail consistency, stale-scale reads);
 #   3. the Mosaic-compat pre-flight (MC001-004): each family's kernel
 #      jaxpr, built for hardware, scanned for constructs this
 #      toolchain's Mosaic rejects — seconds-fast compile-shaped

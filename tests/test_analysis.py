@@ -657,6 +657,86 @@ class TestWirePayloadBytes:
         assert len(payload) == len(scales) == 3
 
 
+# ------------------------------------------------- KV-ship family
+
+class TestKVShipFamily:
+    """The `kv_ship.pages` family (ISSUE 7): the disaggregated-serving
+    page transport — a PAIRWISE permute contract (src_only pins the
+    role topology) with dual payload/scale DMA rails, and its two
+    seeded fixtures."""
+
+    def test_family_lints_clean_both_meshes(self):
+        for n in (4, 8):
+            findings = lint_family("kv_ship.pages", n=n)
+            assert findings == [], [f.format() for f in findings]
+
+    def test_family_is_preflighted(self):
+        status, f = mosaic_compat.preflight_family(
+            families()["kv_ship.pages"], 8
+        )
+        assert status == "scanned" and f == []
+
+    def test_pages_land_from_exactly_the_partner(self):
+        """Provenance: every rank's landing buffer holds its partner
+        rank's marker on every element — nobody else's, no holes, and
+        the landed bytes end DEQUANTIZED (installed with their scale
+        planes), never raw."""
+        from triton_distributed_tpu.analysis.checks import simulate
+
+        fam = families()["kv_ship.pages"]
+        rec, findings = analyze_family(fam, 4)
+        assert findings == [], [f.format() for f in findings]
+        sim = simulate(rec)
+        st = dataflow._State(rec)
+        st.seed_inputs()
+        dataflow._replay(rec, sim, st)
+        for rank in range(4):
+            s = st.get(rank, "dst_q")
+            partner = (rank - 2) % 4
+            marker = np.int64(1) << (4 * partner)
+            assert (s["contrib"] == marker).all(), rank
+            assert not (s["wire"] == dataflow.QUANTIZED).any(), rank
+        # the install edges are consume-with-scale epilogue events
+        eps = [e for e in rec.events(events.DequantEvent) if e.epilogue]
+        assert eps and all(e.s_region is not None for e in eps)
+
+    def test_skipped_page_fixture_is_sl008(self):
+        rec, findings = _analyze_df_fixture(fixtures.kv_ship_skipped_page)
+        assert _rules(findings) == ["SL008"], [f.format() for f in findings]
+        msgs = " | ".join(f.message for f in findings)
+        assert "chunk missing" in msgs and "hole" in msgs
+        assert all(f.severity == Severity.ERROR for f in findings)
+        # every rank is short exactly its partner's page
+        short = {f.ranks[0] for f in findings if "of source rank" in f.message}
+        assert short == set(range(8))
+
+    def test_unpaired_scale_fixture_is_sl009(self):
+        rec, findings = _analyze_df_fixture(fixtures.kv_ship_unpaired_scale)
+        assert _rules(findings) == ["SL009"], [f.format() for f in findings]
+        msgs = " | ".join(f.message for f in findings)
+        assert "no paired scale-plane RDMA" in msgs
+        assert "NO scale folded" in msgs
+
+    def test_src_only_flags_stray_sources(self):
+        """The src_only extension itself: a delivery from OUTSIDE the
+        declared sender set is flagged even when its byte count looks
+        plausible — want is 0 for non-partners."""
+        from triton_distributed_tpu.analysis.dataflow import (
+            DeliveryContract,
+        )
+
+        spec, in_shapes, _ = fixtures.skipped_chunk()
+        _, findings = analyze_spec(
+            spec, in_shapes(4), 4, kernel_name="stray", site="fixture",
+            contract=DeliveryContract(
+                kind="gather", dst="out_ref",
+                src_only=lambda rank, n: {rank},   # only own writes legal
+            ),
+        )
+        dup = [f for f in findings if "duplicated" in f.message]
+        assert dup, [f.format() for f in findings]
+
+
 # ----------------------------------------------- ragged serving family
 
 class TestRaggedFamily:

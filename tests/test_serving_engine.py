@@ -231,6 +231,133 @@ class TestServingEngine:
             model.init_serving_state(slots=2, npages=8, page=8)
 
 
+class TestPrefixCache:
+    """The PR-6 follow-on: per-page refcounts + chain-hash page reuse
+    (serving/state.PagePool) — shared prefixes and re-admitted evicted
+    requests reattach resident pages instead of recomputing, pinned
+    token-exact."""
+
+    def test_shared_prefix_reuses_pages_token_exact(self, model_params):
+        model, params = model_params
+        shared = (np.arange(24, dtype=np.int32) * 3) % 128
+        r1 = Request(rid=0, prompt=shared.copy(), max_new=3, arrival=0.0)
+        r2 = Request(
+            rid=1,
+            prompt=np.concatenate([shared, np.asarray([9, 4], np.int32)]),
+            max_new=3, arrival=6.0,       # admitted after r1's pages froze
+        )
+        eng = ServingEngine(
+            model, params,
+            EngineConfig(slots=4, token_budget=48, chunk=8, page=8,
+                         npages=32, prefix_cache=True),
+        )
+        stats = eng.run([r1, r2], max_steps=300)
+        assert stats.completed == 2
+        assert stats.prefix_hits > 0, "shared prefix never reattached"
+        for r in (r1, r2):
+            assert r.generated == _reference_tokens(model, params, r), r.rid
+
+    def test_evicted_request_reattaches_resident_pages(self, model_params):
+        """Eviction decrements refcounts instead of freeing; the
+        re-admitted request's recompute prefix reattaches the cached
+        pages and still produces the exact reference tokens."""
+        model, params = model_params
+        eng = ServingEngine(
+            model, params,
+            EngineConfig(slots=4, token_budget=48, chunk=16, page=8,
+                         npages=12, prefix_cache=True),
+        )
+        trace = poisson_trace(7, 8, 1.0, 5, 30, 3, 6, 128)
+        stats = eng.run(trace, max_steps=600)
+        assert stats.completed == 8
+        assert stats.evictions > 0, "config failed to force an eviction"
+        assert stats.prefix_hits > 0, "re-admission never reused a page"
+        for req in trace:
+            assert req.generated == _reference_tokens(model, params, req), (
+                req.rid
+            )
+
+    def test_refcounted_release_keeps_shared_pages(self):
+        from triton_distributed_tpu.serving.state import PagePool
+
+        pool = PagePool(4, 8, prefix_cache=True)
+        pg = pool.alloc()
+        pool.register(pg, 1234)
+        pool.retain(pg)                    # second holder
+        pool.release(pg)                   # first lets go — still held
+        assert pool.refs[pg] == 1
+        assert pool.lookup(1234) == pg
+        pool.release(pg)                   # last holder: parks in cache
+        assert pool.refs[pg] == 0
+        assert pool.lookup(1234) == pg     # resident, reattachable
+        assert pool.available == 4         # and reclaimable under pressure
+        # reclaim under pressure unregisters it
+        got = {pool.alloc() for _ in range(4)}
+        assert len(got) == 4
+        assert pool.lookup(1234) is None
+        assert pool.alloc() is None
+
+    def test_prefix_cache_off_by_default(self, model_params):
+        model, params = model_params
+        eng = ServingEngine(
+            model, params,
+            EngineConfig(slots=2, token_budget=32, chunk=8, page=8,
+                         npages=16),
+        )
+        assert eng.pool.prefix_cache is False
+
+
+class TestSampling:
+    """Engine-side temperature/top-k over the per-slot logits: draws
+    are (seed, rid, n_generated)-keyed, so token streams are invariant
+    to scheduling (chunking, contention, eviction replays)."""
+
+    def test_greedy_default_unchanged(self, model_params):
+        model, params = model_params
+        req = Request(rid=0, prompt=np.arange(9, dtype=np.int32),
+                      max_new=3, arrival=0.0)
+        ServingEngine(
+            model, params,
+            EngineConfig(slots=2, token_budget=32, chunk=8, page=8,
+                         npages=16),
+        ).run([req], max_steps=50)
+        assert req.generated == _reference_tokens(model, params, req)
+
+    def test_sampled_stream_invariant_to_chunking(self, model_params):
+        model, params = model_params
+        outs = []
+        for chunk in (4, 16):
+            req = Request(rid=0, prompt=np.arange(12, dtype=np.int32),
+                          max_new=6, arrival=0.0)
+            ServingEngine(
+                model, params,
+                EngineConfig(slots=2, token_budget=32, chunk=chunk,
+                             page=8, npages=16, temperature=0.8,
+                             top_k=16, seed=3),
+            ).run([req], max_steps=80)
+            outs.append(req.generated)
+        assert outs[0] == outs[1]
+        assert len(outs[0]) == 6
+
+    def test_top_k_truncates_support(self, model_params):
+        """With top_k=1 the sampler IS greedy regardless of
+        temperature."""
+        model, params = model_params
+        req_g = Request(rid=0, prompt=np.arange(10, dtype=np.int32),
+                        max_new=4, arrival=0.0)
+        req_s = Request(rid=0, prompt=np.arange(10, dtype=np.int32),
+                        max_new=4, arrival=0.0)
+        base = dict(slots=2, token_budget=32, chunk=8, page=8, npages=16)
+        ServingEngine(
+            model, params, EngineConfig(**base),
+        ).run([req_g], max_steps=60)
+        ServingEngine(
+            model, params,
+            EngineConfig(**base, temperature=2.5, top_k=1, seed=9),
+        ).run([req_s], max_steps=60)
+        assert req_g.generated == req_s.generated
+
+
 class TestServingStepTP:
     def test_tp2_head_sharded_matches_reference(self):
         """tp=2: pools shard over the KV-head dim; the engine's tokens

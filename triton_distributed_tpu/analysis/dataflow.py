@@ -75,6 +75,13 @@ class DeliveryContract:
     ``own_absent_ok``: a gather destination may legitimately omit the
     local rank's own chunk (kernels that consume it straight from the
     input and never publish it, e.g. the moe_tp AG workspace).
+    ``src_only``: callable ``(rank, n) -> collection of source ranks``
+    restricting WHICH sources must deliver into ``rank``'s destination
+    (every other source's expected payload is zero — a stray delivery
+    from outside the set is flagged as a duplicate). The pairwise
+    transports (kv_ship: each decode rank receives exactly its partner
+    prefill rank's pages) declare their topology with this; None keeps
+    the all-sources default of the all-to-all/gather families.
     """
 
     kind: str
@@ -82,6 +89,7 @@ class DeliveryContract:
     payload_per_src: object = None
     full: bool = True
     own_absent_ok: bool = False
+    src_only: object = None
 
 
 # ------------------------------------------------------------- replay state
@@ -556,12 +564,16 @@ def _check_contract(rec, state: _State, contract: DeliveryContract) -> list:
             continue
         # gather / permute: every element single-sourced, per-src counts
         single = np.zeros(meta.shape, bool)
+        senders = (
+            set(contract.src_only(rank, n))
+            if contract.src_only is not None else None
+        )
         for s in range(n):
             marker = np.int64(1) << (_NIBBLE * s)
             hits = c == marker
             single |= hits
             got = int(hits.sum())
-            want = expect
+            want = expect if senders is None or s in senders else 0
             if s == rank and contract.own_absent_ok and got == 0:
                 continue
             if got != want:

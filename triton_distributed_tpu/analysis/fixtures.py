@@ -503,6 +503,133 @@ def serialized_ring(axis="x"):
     )
 
 
+def kv_ship_skipped_page(axis="x"):
+    """The KV page ship one page SHORT: the sender's loop walks
+    ``range(pages - 1)``, so the last staged page never leaves the
+    prefill pool — every semaphore balances (each started rail pair is
+    waited), the rails stay paired, but the decode pool terminates with
+    that page's slot unwritten and its source's delivered element count
+    short. SL008 against the pairwise permute contract (the bug a
+    protocol pass cannot see: an admission gate reading kv_lens would
+    happily walk the hole)."""
+    from dataclasses import replace
+
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.kv_ship import (
+        KV_SHIP_GEOM,
+        _kv_ship_kernel,
+    )
+    from triton_distributed_tpu.lang.launch import captured_launch
+    from triton_distributed_tpu.kernels.kv_ship import build_lint_kernel
+    from triton_distributed_tpu.analysis.lint import lint_mesh
+
+    g = KV_SHIP_GEOM
+    n = 8
+    build_lint_kernel(lint_mesh(n, axis), n,
+                      token=("fixture_kv_ship_skip",))
+    real = captured_launch("kv_ship_pages")
+    import functools as _ft
+
+    short = _ft.partial(
+        _kv_ship_kernel, n, axis, (axis,),
+        g["pages"] - 1,                      # BUG: one page never ships
+        g["rows"],
+    )
+
+    def kernel(dstpg_ref, src_q, src_s, dst_q, dst_s,
+               send_sem, recv_sem, s_send_sem, s_recv_sem):
+        dstpg_ref[...] = np.asarray(
+            list(reversed(range(g["pages"]))), np.int32
+        )
+        short(dstpg_ref, src_q, src_s, dst_q, dst_s,
+              send_sem, recv_sem, s_send_sem, s_recv_sem)
+
+    def in_shapes(n):
+        del n
+        rows = g["pages"] * g["rows"]
+        return [
+            ((g["pages"],), np.dtype(np.int32)),
+            ((rows, g["cols"]), np.dtype(np.int8)),
+            ((rows, 128), _F32),
+        ]
+
+    return (
+        replace(real, kernel=kernel, name="fixture_kv_ship_skipped_page"),
+        in_shapes,
+        DeliveryContract(
+            kind="permute", dst="dst_q",
+            payload_per_src=lambda n: g["pages"] * g["rows"] * g["cols"],
+            src_only=lambda rank, n: {(rank - n // 2) % n},
+        ),
+    )
+
+
+def kv_ship_unpaired_scale(axis="x"):
+    """A KV page ship whose SCALE RAIL was dropped: the int8 page
+    payloads fly and land at their assigned slots (the permute contract
+    is satisfied — every page exactly once), but no per-row scale plane
+    accompanies them and the landing is installed without a scale fold.
+    The decode pool now holds int8 bytes whose scales are whatever the
+    pool's scale plane last held — silently wrong logits. SL009 (no
+    paired scale-plane RDMA before the next wait, and the
+    scale-fold-omitted consume)."""
+
+    from triton_distributed_tpu.kernels.kv_ship import KV_SHIP_GEOM
+
+    g = KV_SHIP_GEOM
+    pages, rows = g["pages"], g["rows"]
+
+    def kernel(dstpg_ref, src_q, src_s, dst_q, dst_s,
+               send_sem, recv_sem, s_send_sem, s_recv_sem):
+        from jax.experimental import pallas as pl
+
+        dstpg_ref[...] = np.asarray(
+            list(reversed(range(pages))), np.int32
+        )
+        me = lang.my_pe(axis)
+        n = lang.n_pes(axis)
+        to = (me + n // 2) % n
+
+        lang.barrier_all(axis)
+        handles = []
+        for i in range(pages):
+            slot = dstpg_ref[i]
+            dq = lang.remote_copy(
+                src_q.at[pl.ds(i * rows, rows)],
+                dst_q.at[pl.ds(slot * rows, rows)],
+                send_sem.at[i], recv_sem.at[i], to,
+            )
+            # BUG: the scale plane never ships — payload rail only
+            dq.start()
+            handles.append(dq)
+        lang.quiet(*handles)
+        for dq in handles:
+            dq.wait_recv()
+        for i in range(pages):
+            slot = dstpg_ref[i]
+            # BUG: installed with NO scale fold (s=None)
+            wirelib.epilogue_consume(
+                dst_q.at[pl.ds(slot * rows, rows)], None, None
+            )
+
+    total = pages * rows
+    return (
+        _spec(
+            kernel, "fixture_kv_ship_unpaired_scale",
+            out_shapes=[((total, g["cols"]), np.dtype(np.int8)),
+                        ((total, 128), _F32)],
+            scratch=_sems((pages,), (pages,), (pages,), (pages,)),
+            collective_id=52,
+        ),
+        lambda n: [
+            ((pages,), np.dtype(np.int32)),
+            ((total, g["cols"]), np.dtype(np.int8)),
+            ((total, 128), _F32),
+        ],
+        None,
+    )
+
+
 # ------------------------------------------------ Mosaic-compat fixtures
 #
 # These are consumed by analysis.mosaic_compat.preflight_spec (real jax

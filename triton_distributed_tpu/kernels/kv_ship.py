@@ -1,0 +1,227 @@
+"""KV page shipping: prefill slice → decode slice on the quantized wire.
+
+Disaggregated serving separates prefill from decode because their
+rooflines differ (prefill is compute-bound, decode bandwidth-bound —
+mixing them in one batch makes each steal the other's headroom); the
+price is moving every finished request's KV cache between the roles.
+This module is that transport:
+
+* **Payload layout** — the pool's NATIVE quantized form travels
+  verbatim: int8 page payloads ``(P·page, ·)`` with their per-row f32
+  scale planes riding a parallel rail (the ``lang.wire`` paired-rail
+  layout with ``chunk_rows == 1`` — the KV cache's own per-row scale
+  granularity). No requantization happens anywhere on the path, so an
+  int8-KV request decodes TOKEN-EXACTLY as if it had prefilled on the
+  decode slice, and the wire moves ~half the bytes a dequantized
+  bf16 ship would.
+
+* **XLA-side helpers** (:func:`gather_kv_pages` /
+  :func:`scatter_kv_pages`) — the pool↔payload plumbing the serving
+  engines jit: gather a request's pages out of every layer's pool,
+  scatter arrivals into the decode pool at block-table-assigned slots.
+  Shared by every transport (the DCN ``ppermute`` rail, the
+  ``device_put`` fallback, and this kernel's launch wrapper), so the
+  bytes on every path are identical by construction.
+
+* **The Pallas SHMEM kernel** (:func:`_kv_ship_kernel`) — the
+  ICI-role-split transport: when both roles live on one slice (a
+  2×(n/2) partition of a single torus), pages move rank→rank by remote
+  DMA, each page's payload and scale plane driven as one dual-rail
+  handle (the ring machinery's ``_DualDMA`` discipline: the receive
+  wait releases only when BOTH rails have landed, so a landed page can
+  never be consumed with a half-landed scale plane). Registered as the
+  ``kv_ship.pages`` lint family with a pairwise PERMUTE delivery
+  contract — every page lands exactly once, at its assigned slot, from
+  exactly its partner rank (SL008), with the scale rail paired on its
+  own semaphores (SL009) — and preflighted by the Mosaic scan like
+  every family.
+
+The role-pair topology: rank ``r`` ships to ``(r + n//2) % n`` — on an
+even mesh this is exactly the slice split (prefill ranks [0, n/2) each
+feed their head-shard twin decode rank), and it stays a bijection on
+the odd lint meshes the analyzer also runs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from triton_distributed_tpu import lang
+from triton_distributed_tpu.lang import wire as wirelib
+
+_SITE = "kv_ship"
+
+
+# ------------------------------------------------- XLA-side pool plumbing
+
+def gather_kv_pages(layers, pids):
+    """Pull pages ``pids`` (P,) out of every layer's K and V pool.
+
+    Returns ``(q_payload, s_payload)``: ``q`` stacked
+    ``(L·2, P, Hkv, page, D)`` in the pool dtype (int8 under
+    ``kv_quant`` — the wire payload IS the pool bytes), ``s`` the
+    matching ``(L·2, P, Hkv, page)`` f32 scale planes, or None for
+    unquantized pools (raw wire)."""
+    import jax.numpy as jnp
+
+    qs, ss = [], []
+    for kp, vp in layers:
+        for pool in (kp, vp):
+            if isinstance(pool, dict):
+                qs.append(pool["q"][pids])
+                ss.append(pool["scale"][pids])
+            else:
+                qs.append(pool[pids])
+    q = jnp.stack(qs)
+    s = jnp.stack(ss) if ss else None
+    return q, s
+
+
+def scatter_kv_pages(layers, pids, q_payload, s_payload):
+    """Inverse of :func:`gather_kv_pages`: land the arrived payload in
+    the destination pools at page slots ``pids`` (the decode block
+    table's assignment). Meant to be jitted with ``layers`` donated —
+    the landing aliases in place like the serving step's append."""
+    new, i = [], 0
+    for kp, vp in layers:
+        pair = []
+        for pool in (kp, vp):
+            if isinstance(pool, dict):
+                pool = {
+                    "q": pool["q"].at[pids].set(q_payload[i]),
+                    "scale": pool["scale"].at[pids].set(s_payload[i]),
+                }
+            else:
+                pool = pool.at[pids].set(q_payload[i])
+            pair.append(pool)
+            i += 1
+        new.append(tuple(pair))
+    return tuple(new)
+
+
+def ship_wire_bytes(n_pages: int, page: int, hkv: int, d: int,
+                    n_layers: int, quant: bool = True) -> int:
+    """Bytes one request's KV ship puts on the wire: K and V pages for
+    every layer — 1 B/element int8 payload plus the per-row f32 scale
+    planes under ``kv_quant``, else the raw 2 B/element pages."""
+    per_page = hkv * page * d * (1 if quant else 2)
+    if quant:
+        per_page += hkv * page * 4          # the per-row scale plane
+    return n_layers * 2 * n_pages * per_page
+
+
+# --------------------------------------------------- the Pallas transport
+
+def _kv_ship_kernel(
+    n, axis, mesh_axes, pages, rows,
+    dstpg_ref, src_q, src_s, dst_q, dst_s,
+    send_sem, recv_sem, s_send_sem, s_recv_sem,
+):
+    """Pairwise page ship: every rank pushes its ``pages`` staged pages
+    (each ``rows`` rows of payload + its per-row scale plane) to its
+    partner rank's pool at the LANDING slots ``dstpg_ref`` assigned by
+    the receiver's block table, one dual-rail DMA pair per page.
+
+    Per-page semaphore slots: page i's arrival can only credit slot i,
+    so a wait being satisfied proves THAT page (and its scale plane —
+    own rail, own semaphores) landed. After the waits, each landed
+    page/scale pair is installed-as-quantized: the pool keeps the int8
+    bytes and their scales (the attention kernel folds the scales at
+    read time), which :func:`lang.wire.epilogue_consume` records as the
+    consume-with-scale provenance edge — leaving a page uninstalled is
+    SL008 against the permute contract, installing one without its
+    scale plane is SL009."""
+    me = lang.my_pe(axis)
+    to = lang.pe_flat(axis, (me + n // 2) % n, mesh_axes)
+
+    lang.barrier_all(axis, mesh_axes)
+
+    from jax.experimental import pallas as pl
+
+    handles = []
+    for i in range(pages):
+        slot = dstpg_ref[i]
+        dq = lang.remote_copy(
+            src_q.at[pl.ds(i * rows, rows)],
+            dst_q.at[pl.ds(slot * rows, rows)],
+            send_sem.at[i], recv_sem.at[i], to,
+        )
+        ds = lang.remote_copy(
+            src_s.at[pl.ds(i * rows, rows)],
+            dst_s.at[pl.ds(slot * rows, rows)],
+            s_send_sem.at[i], s_recv_sem.at[i], to,
+        )
+        dq.start()
+        ds.start()
+        handles.append((dq, ds))
+    for dq, ds in handles:
+        lang.quiet(dq, ds)
+    # the n//2-shifted inbound partner ships the same page count with
+    # the same landing table, so waiting my own descriptors' recv side
+    # releases exactly when MY pool has page i + scales resident
+    for dq, ds in handles:
+        dq.wait_recv()
+        ds.wait_recv()
+    for i in range(pages):
+        slot = dstpg_ref[i]
+        wirelib.epilogue_consume(
+            dst_q.at[pl.ds(slot * rows, rows)],
+            dst_s.at[pl.ds(slot * rows, rows)],
+            None,
+        )
+
+
+#: lint geometry: 4 staged pages of 8 rows × 128 lanes, landing slots a
+#: permutation of the whole destination buffer (zero slack, so the
+#: permute contract can demand FULL exactly-once coverage).
+KV_SHIP_GEOM = dict(pages=4, rows=8, cols=128)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_kv_ship(mesh, axis, pages, rows, cols, collective_id, token=()):
+    """Construct the page-ship kernel via ``shmem_call`` (the LaunchSpec
+    capture the analyzer and the Mosaic pre-flight read back). The
+    dev-box serving engines ride the XLA transports; this is the
+    ICI-role-split fast path and the family's analyzable body."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    del token
+    n = mesh.shape[axis]
+    nsem = max(pages, 1)
+    return lang.shmem_call(
+        functools.partial(
+            _kv_ship_kernel, n, axis, mesh.axis_names, pages, rows
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((pages * rows, cols), jnp.int8),
+            jax.ShapeDtypeStruct(
+                (pages * rows, wirelib.SCALE_LANES), jnp.float32
+            ),
+        ],
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + lang.vmem_specs(2),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((nsem,)),
+            pltpu.SemaphoreType.DMA((nsem,)),
+            pltpu.SemaphoreType.DMA((nsem,)),   # scale rail
+            pltpu.SemaphoreType.DMA((nsem,)),
+        ],
+        collective_id=collective_id,
+        name="kv_ship_pages",
+    )
+
+
+def build_lint_kernel(mesh, n, token=()):
+    """The registry/pre-flight entry: construct the ship kernel at
+    :data:`KV_SHIP_GEOM` exactly as production would (the partner
+    rotation is baked from the mesh's rank count)."""
+    del n                                  # read from the mesh
+    g = KV_SHIP_GEOM
+    return _build_kv_ship(
+        mesh, "x", g["pages"], g["rows"], g["cols"], 14, token,
+    )

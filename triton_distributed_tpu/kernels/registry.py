@@ -356,6 +356,48 @@ def _moe_rs_shapes(n):
     ]
 
 
+def _kv_ship(mesh, n, token):
+    """The disaggregated-serving KV page ship (kernels/kv_ship.py):
+    pairwise prefill→decode page transfers on the quantized wire —
+    int8 page payloads + per-row f32 scale planes as dual DMA rails,
+    landing at the receiver's block-table-assigned slots."""
+    from triton_distributed_tpu.kernels.kv_ship import build_lint_kernel
+
+    build_lint_kernel(mesh, n, token=(token, n))
+
+
+def _kv_ship_in_shapes(n):
+    from triton_distributed_tpu.kernels.kv_ship import KV_SHIP_GEOM as g
+
+    del n
+    rows = g["pages"] * g["rows"]
+    return [
+        ((g["pages"],), _I32),               # landing page table (SMEM)
+        ((rows, g["cols"]), _I8),            # staged page payload
+        ((rows, 128), _F32),                 # per-row scale planes
+    ]
+
+
+def _kv_ship_init(n):
+    from triton_distributed_tpu.kernels.kv_ship import KV_SHIP_GEOM as g
+
+    del n
+    # landing slots: a permutation of the destination pool (zero slack,
+    # so the permute contract demands full exactly-once coverage) —
+    # identical on every rank, as the reserve→ship handshake guarantees
+    return {0: np.asarray(
+        list(reversed(range(g["pages"]))), np.int32
+    )}
+
+
+def _kv_ship_elems() -> int:
+    """Elements ONE partner rank delivers into a pool: the whole staged
+    page set (pages · rows · cols)."""
+    from triton_distributed_tpu.kernels.kv_ship import KV_SHIP_GEOM as g
+
+    return g["pages"] * g["rows"] * g["cols"]
+
+
 def _ragged_paged(mesh, n, token):
     """The ragged paged-attention family is LOCAL (no remote DMA): the
     serving state shards pools over the KV-head dim, so each rank runs
@@ -655,6 +697,27 @@ def families() -> dict:
             _ragged_in_shapes,
             init=_ragged_init,
             contract=DeliveryContract(kind="local", dst=9),
+        ),
+        KernelFamily(
+            # the disaggregated-serving page ship: a PAIRWISE permute —
+            # each decode rank's pool must hold exactly its partner
+            # prefill rank's pages, each exactly once at its assigned
+            # slot (src_only pins the topology; a skipped or doubled
+            # page is SL008), with the scale rail paired per page on
+            # its own semaphores (SL009) and the landed pair recorded
+            # installed-as-quantized (epilogue_consume — the pool keeps
+            # int8+scales, the attention kernel folds at read time)
+            "kv_ship.pages", "kv_ship", "kv_ship_pages",
+            _kv_ship,
+            _kv_ship_in_shapes,
+            init=_kv_ship_init,
+            contract=DeliveryContract(
+                kind="permute", dst="dst_q",
+                payload_per_src=lambda n: (
+                    _kv_ship_elems()
+                ),
+                src_only=lambda rank, n: {(rank - n // 2) % n},
+            ),
         ),
         KernelFamily(
             "moe_dispatch.a2a", "moe_dispatch", "moe_chunked_a2a",

@@ -17,6 +17,8 @@ routes AllGatherMethod; this is the construction side).
 
 from __future__ import annotations
 
+import functools as _functools
+
 import jax
 import numpy as np
 from jax.sharding import Mesh
@@ -142,6 +144,64 @@ def dcn_wire_all_gather(a_loc, dcn_axis: str, fmt):
     return jax.lax.dynamic_update_slice(
         out, a_loc, (me * a_loc.shape[0],) + (0,) * (a_loc.ndim - 1)
     )
+
+
+def dcn_wire_kv_ship(q_loc, s_loc, dcn_axis: str, *, src: int = 0,
+                     dst: int = 1):
+    """The KV-page ship's DCN leg (per-device body, inside a shard_map
+    over the hybrid mesh): fly the ALREADY-QUANTIZED page payload and
+    its per-row f32 scale planes from slice-role ``src`` to ``dst`` as
+    PAIRED ``ppermute`` rails — the same paired-rail discipline as the
+    other ``dcn_wire_*`` transports, except nothing (re)quantizes here:
+    the int8 KV pool's bytes and scales ARE the wire format, so the
+    landing is bit-identical to the source pool and the decode slice's
+    attention reads exactly what a local prefill would have written.
+    Unquantized pools pass ``s_loc=None`` (raw wire, no scale rail).
+
+    Returns ``(q, s)`` whose role-``dst`` shard holds the arrived
+    payload (other roles hold the rotated garbage every ppermute
+    leaves; callers read only the destination role's shard)."""
+    import jax
+
+    perm = [(src, dst)]
+    qg = jax.lax.ppermute(q_loc, dcn_axis, perm=perm)
+    sg = (
+        jax.lax.ppermute(s_loc, dcn_axis, perm=perm)
+        if s_loc is not None else None
+    )
+    return qg, sg
+
+
+@_functools.lru_cache(maxsize=32)
+def kv_ship_rail(mesh, dcn_axis: str, has_scales: bool, src: int = 0,
+                 dst: int = 1):
+    """Jitted role-stacked wrapper of :func:`dcn_wire_kv_ship`: takes
+    arrays whose LEADING dim indexes the slice role (sharded over
+    ``dcn_axis``; the source role's slab is the payload, the rest is
+    don't-care) and returns the same layout with role ``dst`` holding
+    the arrivals. Built per (mesh, rails) and cached — jax's jit cache
+    handles the per-payload-shape retraces."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if has_scales:
+        def body(q, s):
+            return dcn_wire_kv_ship(q, s, dcn_axis, src=src, dst=dst)
+
+        fn = jax.shard_map(
+            body, mesh=mesh, in_specs=(P(dcn_axis), P(dcn_axis)),
+            out_specs=(P(dcn_axis), P(dcn_axis)), check_vma=False,
+        )
+    else:
+        def body(q):
+            qg, _ = dcn_wire_kv_ship(q, None, dcn_axis, src=src, dst=dst)
+            return (qg,)
+
+        fn = jax.shard_map(
+            body, mesh=mesh, in_specs=(P(dcn_axis),),
+            out_specs=(P(dcn_axis),), check_vma=False,
+        )
+    return jax.jit(fn)
 
 
 def dcn_wire_reduce_scatter(part, dcn_axis: str, nd: int, fmt):

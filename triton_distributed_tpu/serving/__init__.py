@@ -8,6 +8,8 @@ See docs/SERVING.md for the lifecycle and knob catalog.
 """
 
 from triton_distributed_tpu.serving.engine import (  # noqa: F401
+    DisaggregatedEngine,
+    DisaggStats,
     EngineConfig,
     EngineStats,
     Request,
@@ -15,5 +17,6 @@ from triton_distributed_tpu.serving.engine import (  # noqa: F401
     poisson_trace,
 )
 from triton_distributed_tpu.serving.state import (  # noqa: F401
+    PagePool,
     ServingState,
 )

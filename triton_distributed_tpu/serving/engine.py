@@ -58,6 +58,13 @@ class Request:
     evictions: int = 0
     done: bool = False
     completion_step: int | None = None
+    # resident-but-not-schedulable: the request holds its slot and pages
+    # but must not be batched or evicted — the state of a finished
+    # prefill awaiting its KV ship (prefill side) and of a shipped-to
+    # slot whose pages are still in flight (decode side). The
+    # DisaggregatedEngine owns the flag; the colocated engine never
+    # sets it.
+    parked: bool = False
 
     @property
     def seq(self) -> np.ndarray:
@@ -79,17 +86,40 @@ class EngineConfig:
     page: int = 16
     npages: int = 64
     max_steps: int = 10_000
+    # --- decode sampling (engine-side, over the per-slot logits) ---
+    # temperature <= 0 keeps greedy argmax; > 0 samples the softmax of
+    # logits/temperature, optionally top_k-truncated. Draws are keyed on
+    # (seed, rid, tokens-generated-so-far) — NOT the step count — so a
+    # request's tokens are deterministic under `seed` regardless of how
+    # scheduling interleaved it (eviction replays and the disaggregated
+    # split reproduce the colocated stream exactly).
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+    # --- roles ---
+    # prefill_only: the request "completes" (for this engine) once its
+    # prompt is in KV and the FIRST token is generated — the prefill
+    # half of a disaggregated deployment. The request is NOT marked
+    # done; the on_complete hook decides whether its pages free.
+    prefill_only: bool = False
+    # prefix_cache: per-page refcounts + chain-hash page reuse
+    # (serving/state.PagePool) — shared-prefix requests and re-admitted
+    # evicted requests reattach resident pages instead of recomputing
+    # the prefix.
+    prefix_cache: bool = False
 
 
 @dataclass
 class EngineStats:
     step_times: list = field(default_factory=list)
     step_tokens: list = field(default_factory=list)
+    step_generated: list = field(default_factory=list)
     completed: int = 0
     generated_tokens: int = 0
     prefill_tokens: int = 0
     evictions: int = 0
     deferrals: int = 0
+    prefix_hits: int = 0               # pages reattached from the cache
     degraded: bool = False
 
     @property
@@ -121,6 +151,21 @@ class EngineStats:
         if not self.step_times:
             return 0.0
         return float(np.percentile(np.asarray(self.step_times), 50) * 1e3)
+
+    @property
+    def decode_p99_step_ms(self) -> float:
+        """p99 over the steps that generated at least one token — the
+        latency a decoding request actually observes. In a colocated
+        engine these steps carry interleaved prefill chunks (the
+        contention disaggregation removes); in a decode-role engine
+        every step qualifies."""
+        ts = [
+            t for t, g in zip(self.step_times, self.step_generated)
+            if g > 0
+        ]
+        if not ts:
+            return 0.0
+        return float(np.percentile(np.asarray(ts), 99) * 1e3)
 
 
 def poisson_trace(seed: int, n_requests: int, mean_interarrival: float,
@@ -157,8 +202,11 @@ class ServingEngine:
     ``model.serving_step``."""
 
     def __init__(self, model, params, cfg: EngineConfig, *,
-                 moe_state="auto", use_pallas: bool = True):
+                 moe_state="auto", use_pallas: bool = True,
+                 on_complete=None):
         import jax.numpy as jnp
+
+        from triton_distributed_tpu.serving.state import PagePool
 
         self.model = model
         self.params = params
@@ -170,7 +218,15 @@ class ServingEngine:
         self._jnp = jnp
         pps = self.state.pages_per_seq
         self.table = np.full((cfg.slots, pps), -1, np.int32)
-        self.free_pages = list(range(cfg.npages - 1, -1, -1))
+        self.pool = PagePool(cfg.npages, cfg.page,
+                             prefix_cache=cfg.prefix_cache)
+        # hook: called (req, slot) when a request completes (or, under
+        # prefill_only, finishes its prefill + first token). Return True
+        # (the default behavior) to free the slot and pages; False to
+        # PARK the request — slot and pages stay resident, unbatchable
+        # and unevictable, until the caller releases them (the
+        # DisaggregatedEngine's ship handshake).
+        self.on_complete = on_complete
         self.slot_req: list = [None] * cfg.slots
         self.pending: deque = deque()      # not yet arrived (by time)
         self.waiting: deque = deque()      # arrived, not admitted
@@ -224,16 +280,19 @@ class ServingEngine:
     def _alloc(self, slot: int, held: int, need: int) -> bool:
         """Grow slot's table from ``held`` to ``need`` pages; all-or-
         nothing (no partial growth to unwind)."""
-        if need - held > len(self.free_pages):
+        if need - held > self.pool.available:
             return False
         for pg in range(held, need):
-            self.table[slot, pg] = self.free_pages.pop()
+            self.table[slot, pg] = self.pool.alloc()
         return True
 
     def _free_slot(self, slot: int) -> None:
+        """Release the slot's page references — shared-prefix pages only
+        truly free when their LAST holder lets go (the refcount
+        discipline); privately-held pages return to the free list."""
         for pg in self.table[slot]:
             if pg >= 0:
-                self.free_pages.append(int(pg))
+                self.pool.release(int(pg))
         self.table[slot] = -1
         self.slot_req[slot] = None
 
@@ -241,10 +300,13 @@ class ServingEngine:
         """Evict the latest-arrived active request not already in this
         step's batch (LIFO preemption); its pages return to the free
         list and the request re-queues AT THE FRONT with cursor 0 — the
-        recompute prefix (prompt + generated) resumes it exactly."""
+        recompute prefix (prompt + generated) resumes it exactly.
+        Parked requests (pages pinned by an in-flight KV ship) and
+        already-completed holders are never victims."""
         victims = [
             (req.arrival, s) for s, req in enumerate(self.slot_req)
             if req is not None and s not in batched
+            and not req.parked and not req.done
         ]
         if not victims:
             return False
@@ -266,7 +328,7 @@ class ServingEngine:
         them away (allocation happens at batch assembly)."""
         tot = 0
         for req in self.slot_req:
-            if req is None:
+            if req is None or req.parked or req.done:
                 continue
             take = min(self.cfg.chunk, len(req.seq) - req.cursor)
             tot += max(
@@ -285,7 +347,7 @@ class ServingEngine:
             req = self.waiting[0]
             first = min(self.cfg.chunk, len(req.seq))
             if (self._pages_held(first)
-                    > len(self.free_pages) - self._committed_pages()):
+                    > self.pool.available - self._committed_pages()):
                 return                     # pool exhausted — hold the queue
             self.waiting.popleft()
             s = free[0]
@@ -299,6 +361,55 @@ class ServingEngine:
                     f"request {req.rid}: sequence {len(req.seq)} exceeds "
                     f"slot capacity {self.state.capacity}"
                 )
+            if self.pool.prefix_cache and req.cursor == 0:
+                self._attach_prefix(req, s)
+
+    # ------------------------------------------------------ prefix cache
+
+    def _page_hashes(self, req, upto: int) -> list:
+        """Chain hashes of ``req.seq``'s first ``upto`` full pages."""
+        from triton_distributed_tpu.serving.state import page_chain_hash
+
+        seq, page = req.seq, self.cfg.page
+        hashes, h = [], 0
+        for p in range(upto):
+            h = page_chain_hash(h, seq[p * page:(p + 1) * page])
+            hashes.append(h)
+        return hashes
+
+    def _attach_prefix(self, req, slot: int) -> None:
+        """Reattach the longest run of resident full pages matching this
+        request's prefix; the cursor jumps past them — those tokens'
+        K/V are already in the pool, byte-identical (frozen pages are a
+        pure function of the chained prefix). At least one trailing
+        token is always left to recompute so the admission step still
+        produces the row's next-token logits."""
+        page = self.cfg.page
+        limit = min((len(req.seq) - 1) // page, self.state.pages_per_seq)
+        matched = 0
+        for h in self._page_hashes(req, limit):
+            pg = self.pool.lookup(h)
+            if pg is None:
+                break
+            self.pool.retain(pg)
+            self.table[slot, matched] = pg
+            matched += 1
+        if matched:
+            req.cursor = matched * page
+            self.stats.prefix_hits += matched
+
+    def _register_frozen(self, req, slot: int, old_cursor: int) -> None:
+        """Publish pages the cursor just moved past (their content is
+        frozen — nothing writes below the cursor) into the prefix
+        cache."""
+        page = self.cfg.page
+        first = old_cursor // page          # first page possibly frozen now
+        last = req.cursor // page           # pages [0, last) are full
+        if last <= first:
+            return
+        hashes = self._page_hashes(req, last)
+        for p in range(first, last):
+            self.pool.register(int(self.table[slot, p]), hashes[p])
 
     def _assemble(self):
         cfg = self.cfg
@@ -316,7 +427,7 @@ class ServingEngine:
         takes: dict = {}
         for s in range(R):
             req = self.slot_req[s]
-            if req is None:
+            if req is None or req.parked or req.done:
                 continue
             seq = req.seq
             take = min(cfg.chunk, len(seq) - req.cursor)
@@ -403,34 +514,64 @@ class ServingEngine:
             self.stats.degraded = True
             logits = self._run_device(arrays, block_q)
         dt = time.perf_counter() - t0
-        nxt = np.argmax(logits, axis=-1).astype(np.int32)
         gen_this_step = 0
         for s in sorted(batched):
             req = self.slot_req[s]
             take = takes[s]
+            old_cursor = req.cursor
             req.cursor += take
+            if self.pool.prefix_cache:
+                self._register_frozen(req, s, old_cursor)
             if req.cursor == len(req.seq):
                 # the row's last packed token was its sequence frontier:
                 # the logits row is the next-token distribution
-                tok = int(nxt[s])
+                tok = self._sample(logits[s], req)
                 req.generated.append(tok)
                 gen_this_step += 1
-                if len(req.generated) >= req.max_new:
-                    req.done = True
+                target = 1 if self.cfg.prefill_only else req.max_new
+                if len(req.generated) >= target:
                     req.completion_step = self.step_count
                     self.stats.completed += 1
                     self.stats.generated_tokens += len(req.generated)
-                    self._free_slot(s)
+                    if not self.cfg.prefill_only:
+                        req.done = True
+                    if self.on_complete is None or self.on_complete(req, s):
+                        self._free_slot(s)
         self.stats.step_times.append(dt)
         self.stats.step_tokens.append(int(q_lens.sum()))
+        self.stats.step_generated.append(gen_this_step)
         self.stats.prefill_tokens += int(q_lens.sum()) - gen_this_step
         report.update(
             ms=round(dt * 1e3, 3), generated=gen_this_step,
-            free_pages=len(self.free_pages),
+            free_pages=self.pool.available,
             waiting=len(self.waiting) + len(self.pending),
         )
         self.step_count += 1
         return report
+
+    def _sample(self, row_logits, req) -> int:
+        """Next token for one completed row. Greedy argmax at
+        ``temperature <= 0``; otherwise softmax sampling of
+        ``logits/temperature`` over the ``top_k`` best (0 = full vocab),
+        drawn from a generator keyed on (seed, rid, generated-so-far) —
+        request-local, so scheduling (chunking, eviction replays, the
+        disaggregated prefill/decode split) can never change a
+        request's token stream."""
+        t = self.cfg.temperature
+        if t <= 0.0:
+            return int(np.argmax(row_logits))
+        z = np.asarray(row_logits, np.float64) / t
+        k = self.cfg.top_k
+        if 0 < k < z.shape[-1]:
+            kth = np.partition(z, -k)[-k]
+            z = np.where(z >= kth, z, -np.inf)
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        rng = np.random.default_rng(
+            (self.cfg.seed, req.rid, len(req.generated))
+        )
+        return int(rng.choice(p.shape[-1], p=p))
 
     def run(self, trace=None, max_steps: int | None = None) -> EngineStats:
         """Drive the engine until the trace drains (or ``max_steps``)."""
@@ -441,4 +582,406 @@ class ServingEngine:
             if self.idle:
                 break
             self.step()
+        return self.stats
+
+    # ------------------------------------------------ shipped admission
+    # The decode half of a disaggregated deployment admits requests
+    # whose KV was COMPUTED ELSEWHERE: reserve_shipped claims the slot
+    # and the block-table-assigned landing pages up front (parked — the
+    # in-flight-transfer state eviction must never touch), and
+    # commit_shipped flips the row schedulable once the pages have
+    # landed. Admission therefore gates on *shipped* pages, not on
+    # promises.
+
+    def reserve_shipped(self, req) -> tuple | None:
+        """Claim a slot + landing pages for a request whose first
+        ``req.cursor`` tokens of KV will arrive by transfer. Returns
+        (slot, page_ids) or None (no slot / pool pressure — the caller
+        retries, leaving the source pages pinned)."""
+        free = [s for s, r in enumerate(self.slot_req) if r is None]
+        if not free:
+            return None
+        if len(req.seq) > self.state.capacity:
+            raise ValueError(
+                f"request {req.rid}: sequence {len(req.seq)} exceeds "
+                f"slot capacity {self.state.capacity}"
+            )
+        need = self._pages_held(req.cursor)
+        if need > self.pool.available - self._committed_pages():
+            return None
+        s = free[0]
+        pids = []
+        for p in range(need):
+            pg = self.pool.alloc()
+            self.table[s, p] = pg
+            pids.append(int(pg))
+        req.slot = s
+        req.parked = True
+        self.slot_req[s] = req
+        return s, pids
+
+    def commit_shipped(self, req) -> None:
+        """The transfer into this request's reserved pages has landed:
+        the row becomes schedulable (and evictable) like any other."""
+        req.parked = False
+
+    def release_parked(self, slot: int) -> None:
+        """Free a parked slot (source-side handoff after its pages have
+        shipped, or an abandoned reservation)."""
+        req = self.slot_req[slot]
+        assert req is not None and req.parked, (slot, req)
+        req.parked = False
+        self._free_slot(slot)
+
+
+# ===================================================================
+# Disaggregated prefill/decode: two role engines, KV shipped between
+# ===================================================================
+
+@dataclass
+class ShipRecord:
+    """One in-flight KV transfer (prefill pool → decode pool)."""
+
+    req: Request
+    pslot: int                   # prefill-side slot (pages pinned)
+    dslot: int                   # decode-side reserved slot
+    dpids: list                  # decode-side landing page ids
+    payload: tuple               # (q, s) device arrays on the decode mesh
+    issued_tick: int
+    wire_bytes: int
+    raw_bytes: int
+    launch_ms: float = 0.0
+
+
+@dataclass
+class DisaggStats:
+    """Two role engines' stats plus the ship ledger. Wall-time metrics
+    model the production deployment — the roles run on DISJOINT slices,
+    so the system's wall clock is the slower role, not the host-side
+    sum this single-process harness serializes."""
+
+    prefill: EngineStats
+    decode: EngineStats
+    ships: int = 0
+    ship_ms: list = field(default_factory=list)
+    shipped_wire_bytes: int = 0
+    shipped_raw_bytes: int = 0
+    degraded_transport: bool = False
+
+    @property
+    def completed(self) -> int:
+        return self.decode.completed
+
+    @property
+    def goodput_tok_per_s(self) -> float:
+        t = max(self.prefill.total_time, self.decode.total_time)
+        return (self.decode.generated_tokens / t) if t > 0 else 0.0
+
+    @property
+    def decode_p99_step_ms(self) -> float:
+        return self.decode.decode_p99_step_ms
+
+    @property
+    def wire_compression(self) -> float:
+        """Raw-payload bytes per wire byte actually shipped (> 1 means
+        the quantized wire genuinely shrank the DCN transfer)."""
+        return (self.shipped_raw_bytes / self.shipped_wire_bytes
+                if self.shipped_wire_bytes else 1.0)
+
+
+class DisaggregatedEngine:
+    """Two-role serving topology: a PREFILL engine runs chunked prefill
+    (plus the first token) into its local page pool; each finished
+    request's KV pages then ship slice→slice — int8 page payloads with
+    their per-row f32 scale planes, the pool's native quantized layout
+    riding the paired-rail wire — landing in the DECODE engine's pool
+    at block-table-assigned slots, overlapped with ongoing decode
+    steps. The decode engine admits a request only once its pages have
+    LANDED (reserve → transfer → commit), and in-flight transfers pin
+    their pages on both sides, so eviction can never free a page
+    mid-ship.
+
+    Transport selection (``transport=``):
+
+    * ``"dcn"`` — the quantized DCN wire: paired payload+scale
+      ``ppermute`` rails over the hybrid mesh's DCN axis
+      (:func:`runtime.multislice.dcn_wire_kv_ship`); requires
+      ``hybrid_mesh``.
+    * ``"xla"`` — :func:`tools.native.xla_kv_ship`: a plain device_put
+      of the payload onto the decode mesh — the degradation target.
+    * ``"auto"`` — ``"dcn"`` when a hybrid mesh is given, else
+      ``"xla"``. The FIRST failure of the wire path degrades the
+      engine onto ``"xla"`` for the rest of the session
+      (``stats.degraded_transport``), mirroring the kernel→XLA-twin
+      story at engine level.
+
+    ``ship_delay_steps`` holds a transfer "in flight" for that many
+    ticks before committing — on hardware the window is the real DCN
+    latency; here it deterministically exercises the
+    overlap/eviction-pinning machinery.
+
+    ``placement="auto"`` consults the perf model
+    (:func:`tune.perf_model.refuse_disaggregation`) with the expected
+    ``traffic`` shape and REFUSES to construct the split topology when
+    the KV wire would dominate the decode window it must hide under.
+    """
+
+    def __init__(self, prefill_model, prefill_params, decode_model,
+                 decode_params, cfg: EngineConfig, *, decode_cfg=None,
+                 hybrid_mesh=None, dcn_axis: str = "dcn",
+                 transport: str = "auto", ship_delay_steps: int = 0,
+                 placement: str = "force", traffic: dict | None = None,
+                 moe_state="auto", use_pallas: bool = True):
+        from dataclasses import replace as _rep
+
+        if transport not in ("auto", "dcn", "xla"):
+            raise ValueError(f"unknown transport {transport!r}")
+        if transport == "auto":
+            transport = "dcn" if hybrid_mesh is not None else "xla"
+        if transport == "dcn" and hybrid_mesh is None:
+            raise ValueError("transport='dcn' needs a hybrid_mesh")
+        if decode_cfg is None:
+            # the decode role's batches are at most one token per slot
+            # (8 packed slots each — the row alignment): size its
+            # packed width to 8·slots instead of the prefill budget,
+            # never wider than it. Part of the point of the split: the
+            # decode slice's steps stop paying prefill-sized
+            # buffers/blocks (the colocated engine cannot shrink its
+            # budget — its steps must carry prefill chunks). Evicted
+            # requests re-prefilling decode-side chunk at this
+            # narrower width.
+            dbudget = max(8, min(8 * cfg.slots, cfg.token_budget))
+            decode_cfg = _rep(
+                cfg, token_budget=dbudget, chunk=min(cfg.chunk, dbudget),
+            )
+        dcfg = decode_cfg
+        if dcfg.page != cfg.page:
+            raise ValueError(
+                f"page size must match across roles ({cfg.page} vs "
+                f"{dcfg.page}) — pages ship verbatim"
+            )
+        if placement == "auto":
+            from triton_distributed_tpu.tune import perf_model
+
+            reason = perf_model.refuse_disaggregation(
+                decode_model.config, cfg.page, traffic or {},
+            )
+            if reason is not None:
+                raise ValueError(
+                    f"auto placement refuses disaggregation: {reason}"
+                )
+        self.transport = transport
+        self.hybrid_mesh = hybrid_mesh
+        self.dcn_axis = dcn_axis
+        self.ship_delay_steps = int(ship_delay_steps)
+        self.prefill = ServingEngine(
+            prefill_model, prefill_params,
+            _rep(cfg, prefill_only=True),
+            moe_state=moe_state, use_pallas=use_pallas,
+            on_complete=self._on_prefill_complete,
+        )
+        self.decode = ServingEngine(
+            decode_model, decode_params,
+            _rep(dcfg, prefill_only=False),
+            moe_state=moe_state, use_pallas=use_pallas,
+        )
+        self._ready: deque = deque()       # (req, prefill slot) awaiting ship
+        self._inflight: list = []
+        self.ticks = 0
+        self.stats = DisaggStats(
+            prefill=self.prefill.stats, decode=self.decode.stats
+        )
+        self._build_jits()
+
+    # ------------------------------------------------------------ plumbing
+
+    def _build_jits(self):
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from triton_distributed_tpu.kernels.kv_ship import (
+            gather_kv_pages,
+            scatter_kv_pages,
+        )
+
+        self._gather_jit = jax.jit(gather_kv_pages)
+        self._scatter_jit = jax.jit(
+            scatter_kv_pages, donate_argnums=(0,)
+        )
+        mesh_d = self.decode.model.mesh
+        tp = self.decode.model.tp_axis
+        # payload (L·2, P, Hkv, page[, D]): KV heads stay sharded over
+        # the decode slice's tp axis, like the pools they land in
+        self._q_sharding = NamedSharding(mesh_d, P(None, None, tp))
+        self._s_sharding = NamedSharding(mesh_d, P(None, None, tp))
+
+    def _on_prefill_complete(self, req, slot) -> bool:
+        """Prefill-role completion hook: requests already done (max_new
+        reached during prefill) finish here; everyone else parks —
+        pages pinned — until their KV has shipped."""
+        if len(req.generated) >= req.max_new:
+            req.done = True
+            # account the finished request on the decode ledger (the
+            # system's completion ledger), not the prefill engine's
+            self.decode.stats.completed += 1
+            self.decode.stats.generated_tokens += len(req.generated)
+            return True                    # free the prefill slot now
+        req.parked = True
+        self._ready.append((req, slot))
+        return False                       # hold pages for the ship
+
+    # ------------------------------------------------------------ shipping
+
+    def _launch_ships(self) -> None:
+        import time as _t
+
+        import jax.numpy as jnp
+
+        while self._ready:
+            req, pslot = self._ready[0]
+            res = self.decode.reserve_shipped(req)
+            if res is None:
+                return                     # decode backpressure; retry
+            self._ready.popleft()
+            dslot, dpids = res
+            t0 = _t.perf_counter()
+            npg = self.prefill._pages_held(req.cursor)
+            pids = jnp.asarray(
+                self.prefill.table[pslot, :npg].astype(np.int32)
+            )
+            qpay, spay = self._gather_jit(
+                self.prefill.state.layers, pids
+            )
+            payload = self._run_transport(qpay, spay)
+            dt = _t.perf_counter() - t0
+            q_elems = int(np.prod(qpay.shape))
+            wire = q_elems * qpay.dtype.itemsize + (
+                int(np.prod(spay.shape)) * 4 if spay is not None else 0
+            )
+            raw = q_elems * max(2, qpay.dtype.itemsize)
+            self._inflight.append(ShipRecord(
+                req=req, pslot=pslot, dslot=dslot, dpids=dpids,
+                payload=payload, issued_tick=self.ticks,
+                wire_bytes=wire, raw_bytes=raw, launch_ms=dt * 1e3,
+            ))
+
+    def _run_transport(self, qpay, spay):
+        if self.transport == "dcn":
+            try:
+                return self._transport_dcn(qpay, spay)
+            except Exception:
+                # first wire failure: degrade onto the XLA transfer for
+                # the rest of the session (scheduling state untouched)
+                self.transport = "xla"
+                self.stats.degraded_transport = True
+        return self._transport_xla(qpay, spay)
+
+    def _transport_xla(self, qpay, spay):
+        """The degradation target: a plain device_put of the (already
+        wire-shaped) payload onto the decode mesh."""
+        from triton_distributed_tpu.tools.native import xla_kv_ship
+
+        return xla_kv_ship(
+            (qpay, spay),
+            (self._q_sharding, None if spay is None else self._s_sharding),
+        )
+
+    def _transport_dcn(self, qpay, spay):
+        """The quantized DCN wire: stage the payload+scale pair on the
+        hybrid mesh's source role and fly both rails over the DCN axis
+        with paired ``ppermute``s. (Single-process staging round-trips
+        the host; on a real multislice deployment the role engines
+        address one global mesh and the rails ARE the inter-slice
+        bytes.)"""
+        from triton_distributed_tpu.runtime.multislice import (
+            kv_ship_rail,
+        )
+        from triton_distributed_tpu.tools.native import xla_kv_ship
+
+        rail = kv_ship_rail(
+            self.hybrid_mesh, self.dcn_axis, spay is not None
+        )
+        qh = np.asarray(qpay)
+        stk_q = np.stack([qh, np.zeros_like(qh)])
+        if spay is not None:
+            sh = np.asarray(spay)
+            out_q, out_s = rail(stk_q, np.stack([sh, np.zeros_like(sh)]))
+            arr_q, arr_s = np.asarray(out_q)[1], np.asarray(out_s)[1]
+        else:
+            (out_q,) = rail(stk_q)
+            arr_q, arr_s = np.asarray(out_q)[1], None
+        return xla_kv_ship(
+            (arr_q, arr_s),
+            (self._q_sharding, None if arr_s is None else self._s_sharding),
+        )
+
+    def _commit_ships(self) -> None:
+        import time as _t
+
+        import jax
+        import jax.numpy as jnp
+
+        ready = [
+            r for r in self._inflight
+            if self.ticks - r.issued_tick >= self.ship_delay_steps
+        ]
+        for r in ready:
+            t0 = _t.perf_counter()
+            qd, sd = r.payload
+            new_layers = self._scatter_jit(
+                self.decode.state.layers,
+                jnp.asarray(np.asarray(r.dpids, np.int32)), qd, sd,
+            )
+            jax.block_until_ready(new_layers)          # the landing fence
+            self.decode.state = self.decode.state.replace(
+                layers=new_layers
+            )
+            # handoff order matters: the source frees its pinned pages
+            # first, THEN the row becomes schedulable
+            self.prefill.release_parked(r.pslot)
+            self.decode.commit_shipped(r.req)
+            self._inflight.remove(r)
+            self.stats.ships += 1
+            self.stats.shipped_wire_bytes += r.wire_bytes
+            self.stats.shipped_raw_bytes += r.raw_bytes
+            self.stats.ship_ms.append(
+                r.launch_ms + (_t.perf_counter() - t0) * 1e3
+            )
+
+    # ------------------------------------------------------------- driving
+
+    @property
+    def idle(self) -> bool:
+        return (self.prefill.idle and self.decode.idle
+                and not self._ready and not self._inflight)
+
+    def submit_trace(self, trace) -> None:
+        self.prefill.submit_trace(trace)
+
+    def tick(self) -> dict:
+        """One system tick: a prefill step, ship launches/commits, a
+        decode step. On hardware the two roles run concurrently on
+        their own slices with the transfer in flight between them;
+        the single-process harness serializes them but keeps the same
+        ordering semantics (decode never observes a page before its
+        commit fence)."""
+        rep_p = None if self.prefill.idle else self.prefill.step()
+        self._launch_ships()
+        self._commit_ships()
+        rep_d = None if self.decode.idle else self.decode.step()
+        self.ticks += 1
+        return {
+            "tick": self.ticks, "prefill": rep_p, "decode": rep_d,
+            "inflight": len(self._inflight), "ready": len(self._ready),
+        }
+
+    def run(self, trace=None, max_ticks: int | None = None) -> DisaggStats:
+        if trace is not None:
+            self.submit_trace(trace)
+        max_ticks = max_ticks or self.prefill.cfg.max_steps
+        for _ in range(max_ticks):
+            if self.idle:
+                break
+            self.tick()
         return self.stats

@@ -31,6 +31,7 @@ append aliases in place — no pool-sized copy per step.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, replace as _dc_replace
 
 import jax
@@ -91,3 +92,101 @@ def fresh_table(slots: int, pages_per_seq: int) -> np.ndarray:
     """Host-side table template (-1 = unallocated; device consumers
     clamp, the allocator never reads a -1 back)."""
     return np.full((slots, pages_per_seq), -1, np.int32)
+
+
+class PagePool:
+    """Host-side page allocator with PER-PAGE REFCOUNTS and an optional
+    prefix cache (the PR-6 follow-on the block tables already made
+    expressible).
+
+    Three page states:
+
+    * **free** — on the free list, content garbage;
+    * **held** — ``refs[pg] >= 1``: referenced by that many block-table
+      rows (shared-prefix pages are held by several slots at once; the
+      engine's eviction *decrements* instead of freeing);
+    * **cached** — ``refs[pg] == 0`` but the page is registered in the
+      prefix cache: its KV content (a pure function of the token prefix
+      it froze under — the chain hash) stays resident so a re-admitted
+      evicted request, or a new request sharing the prefix, can reattach
+      it instead of recomputing. Cached pages are *reclaimable*: when
+      the free list runs dry the least-recently-released cached page is
+      unregistered and reused, so the cache never shrinks the pool.
+
+    Only FULL pages are ever registered (a page's content is frozen the
+    moment the owning request's cursor crosses its end — nothing writes
+    a page below the cursor), so a cached page's bytes can never change
+    while it sits in the cache.
+    """
+
+    def __init__(self, npages: int, page: int, *, prefix_cache: bool = False):
+        self.npages = int(npages)
+        self.page = int(page)
+        self.prefix_cache = bool(prefix_cache)
+        self.refs = np.zeros((npages,), np.int32)
+        self.free: list = list(range(npages - 1, -1, -1))
+        self._by_hash: dict = {}              # chain hash -> page id
+        self._hash_of: dict = {}              # page id -> chain hash
+        self._reclaim: OrderedDict = OrderedDict()   # refcount-0 cached, LRU
+
+    @property
+    def available(self) -> int:
+        """Pages an allocation may claim: free + reclaimable-cached."""
+        return len(self.free) + len(self._reclaim)
+
+    def alloc(self) -> int | None:
+        """Claim one page (refcount 1), reclaiming the LRU cached page
+        when the free list is dry. None when genuinely exhausted."""
+        if self.free:
+            pg = self.free.pop()
+        elif self._reclaim:
+            pg, _ = self._reclaim.popitem(last=False)
+            h = self._hash_of.pop(pg)
+            if self._by_hash.get(h) == pg:
+                del self._by_hash[h]
+        else:
+            return None
+        assert self.refs[pg] == 0, (pg, self.refs[pg])
+        self.refs[pg] = 1
+        return pg
+
+    def retain(self, pg: int) -> None:
+        """One more block-table row references ``pg`` (prefix share, or
+        resurrection of a cached page)."""
+        if pg in self._reclaim:
+            del self._reclaim[pg]
+        self.refs[pg] += 1
+
+    def release(self, pg: int) -> None:
+        """Drop one reference; the page frees (or parks in the cache)
+        only when the LAST reference drops — shared-prefix pages survive
+        their co-holders' evictions."""
+        assert self.refs[pg] >= 1, (pg, self.refs[pg])
+        self.refs[pg] -= 1
+        if self.refs[pg] == 0:
+            if pg in self._hash_of:
+                self._reclaim[pg] = None
+            else:
+                self.free.append(pg)
+
+    def register(self, pg: int, chain_hash) -> None:
+        """Publish a FROZEN full page under its prefix-chain hash. First
+        registration wins; a second page with identical content simply
+        stays private (no post-hoc dedup — the bytes are already paid)."""
+        if not self.prefix_cache or chain_hash in self._by_hash:
+            return
+        self._by_hash[chain_hash] = pg
+        self._hash_of[pg] = chain_hash
+
+    def lookup(self, chain_hash) -> int | None:
+        """The resident page holding this prefix page, or None."""
+        return self._by_hash.get(chain_hash)
+
+
+def page_chain_hash(prev_hash, tokens) -> int:
+    """The prefix-cache key of one FULL page: chains the previous
+    page's hash with this page's token ids. KV content of page ``p`` is
+    a function of the ENTIRE prefix up to its end (attention mixes every
+    earlier token into the residual stream), which is exactly what the
+    chain covers."""
+    return hash((prev_hash, tuple(int(t) for t in tokens)))

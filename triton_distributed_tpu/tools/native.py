@@ -419,3 +419,22 @@ def xla_gemm_rs(a, b, mesh, axis, *, batch_axes=(), out_dtype=None,
         mesh, axis, tuple(batch_axes), out_dtype, interp_key(),
         wirelib.normalize_wire(wire_dtype),
     )(a, b)
+
+
+def xla_kv_ship(payload, shardings):
+    """KV-page transfer via plain XLA data movement — the kv_ship
+    degradation target: a ``device_put`` of the (already wire-shaped)
+    payload pytree onto the decode mesh's placements. No collective, no
+    rails, nothing to deadlock — XLA/the runtime route the bytes over
+    whatever link connects the meshes (DCN across slices, ICI within
+    one), which is exactly the predictability a degraded path wants.
+    The payload stays in its quantized pool form (int8 pages + f32
+    per-row scale planes), so even the fallback never widens the wire
+    — a demotion changes the transport, never the bytes."""
+    import jax
+
+    return jax.tree.map(
+        lambda x, s: x if s is None else jax.device_put(x, s),
+        payload, shardings,
+        is_leaf=lambda x: x is None,
+    )

@@ -28,6 +28,11 @@ class TpuSpec:
     ici_gbps: float          # ICI bandwidth per link, per direction
     ici_links: int           # torus links per chip
     int8_tops: float = 0.0   # peak s8×s8→s32 MXU rate (0 = no speedup)
+    # per-chip share of the inter-slice DCN fabric. Order-of-magnitude
+    # deployment numbers (multi-NIC hosts divided by chips per host) —
+    # DCN is 4-16× slower than one ICI link, which is exactly why the
+    # KV-ship placement model must be able to REFUSE disaggregation.
+    dcn_gbps: float = 6.25
 
     @property
     def s8_tops(self) -> float:
@@ -42,10 +47,13 @@ class TpuSpec:
 # v6e (the W8A8 grouped GEMM measured 320–350 TOP/s on a v5e against the
 # 394 peak, kernels/group_gemm.py); v4 has no separate int8 path.
 TPU_SPECS = {
-    "v4": TpuSpec("v4", 275.0, 1228.0, 50.0, 6),
-    "v5e": TpuSpec("v5e", 197.0, 819.0, 50.0, 4, int8_tops=394.0),
-    "v5p": TpuSpec("v5p", 459.0, 2765.0, 100.0, 6, int8_tops=918.0),
-    "v6e": TpuSpec("v6e", 918.0, 1640.0, 100.0, 4, int8_tops=1836.0),
+    "v4": TpuSpec("v4", 275.0, 1228.0, 50.0, 6, dcn_gbps=6.25),
+    "v5e": TpuSpec("v5e", 197.0, 819.0, 50.0, 4, int8_tops=394.0,
+                   dcn_gbps=12.5),
+    "v5p": TpuSpec("v5p", 459.0, 2765.0, 100.0, 6, int8_tops=918.0,
+                   dcn_gbps=25.0),
+    "v6e": TpuSpec("v6e", 918.0, 1640.0, 100.0, 4, int8_tops=1836.0,
+                   dcn_gbps=25.0),
 }
 _DEFAULT = TPU_SPECS["v5e"]
 
@@ -204,26 +212,61 @@ def auto_wire_dtype(slab_rows: int, k: int, n_cols: int, itemsize: int,
 # %-of-speed-of-light, like every other bench row.
 
 #: fixed per-page DMA-issue/loop overhead of the dynamic page walk,
-#: from the round-5 serving-attention measurements (~0.17 µs/block at
-#: 1024-row blocks on a v5e)
-RAGGED_PAGE_ISSUE_MS = 0.17e-3
+#: MEASURED per backend (the ROADMAP "fold the measured per-page issue
+#: cost" follow-on). Keys are coarse backend kinds:
+#:
+#: * ``"tpu"`` — the round-5 v5e serving-attention measurement
+#:   (~0.17 µs/block at 1024-row blocks); refresh on the next
+#:   multi-chip run from the serving_disaggregated bench's
+#:   ``measured_page_issue_ms`` field.
+#: * ``"cpu-interp"`` — the dev-box measurement backing the bench's
+#:   model row off-TPU: derived from ``bench.py --dryrun``'s
+#:   serving_disaggregated decode-role p50 (the pure-decode steps —
+#:   the cleanest per-page signal: ~6 ms over ~6 rows × ~8 walked
+#:   pages on the XLA-twin path; the bench re-derives and reports it
+#:   as ``measured_page_issue_ms`` every run). Coarse by nature — the
+#:   interpreter's cost is partly per-dispatch, not per-page — but 3
+#:   orders closer to what the dev box pays than the TPU constant.
+RAGGED_PAGE_ISSUE_MS_MEASURED = {
+    "tpu": 0.17e-3,
+    "cpu-interp": 0.13,
+}
+
+RAGGED_PAGE_ISSUE_MS = RAGGED_PAGE_ISSUE_MS_MEASURED["tpu"]
+
+
+def measured_page_issue_ms(backend: str | None = None) -> float:
+    """The measured per-page issue cost for ``backend`` (default: the
+    current jax backend — 'tpu' on hardware, the dev-box row
+    otherwise)."""
+    if backend is None:
+        backend = "tpu" if jax.default_backend() == "tpu" else "cpu-interp"
+    return RAGGED_PAGE_ISSUE_MS_MEASURED.get(
+        backend, RAGGED_PAGE_ISSUE_MS
+    )
 
 
 def ragged_page_walk_ms(kv_lens, page: int, hkv: int, d: int,
                         spec: TpuSpec | None = None,
-                        quant: bool = True) -> float:
+                        quant: bool = True,
+                        issue_ms: float | None = None) -> float:
     """HBM time of one ragged step's KV walk: every row reads
     ``ceil(kv_len/page)`` pages of K AND V (+ the f32 scale planes
     under int8), plus the fixed per-page issue cost — proportional to
     the step's TRUE KV volume, never the slot capacity (the quantity a
-    rectangle batch cannot avoid paying)."""
+    rectangle batch cannot avoid paying). ``issue_ms`` overrides the
+    per-page issue constant (pass
+    :func:`measured_page_issue_ms` to use the backend's measured row —
+    the bench does, so its model term tracks the machine it ran on)."""
     spec = spec or detect_spec()
+    if issue_ms is None:
+        issue_ms = RAGGED_PAGE_ISSUE_MS
     pages = sum(max(-(-int(l) // page), 1) for l in kv_lens if int(l) > 0)
     per_page = 2 * hkv * page * d * (1 if quant else 2)
     if quant:
         per_page += 2 * hkv * page * 4
     return (pages * per_page / (spec.hbm_gbps * 1e9) * 1e3
-            + pages * RAGGED_PAGE_ISSUE_MS)
+            + pages * issue_ms)
 
 
 def ragged_serving_step_ms(kv_lens, q_lens, *, page: int, hkv: int,
@@ -231,7 +274,8 @@ def ragged_serving_step_ms(kv_lens, q_lens, *, page: int, hkv: int,
                            weight_bytes_per_token_layer: float = 0.0,
                            n_layers: int = 1,
                            spec: TpuSpec | None = None,
-                           quant: bool = True) -> float:
+                           quant: bool = True,
+                           issue_ms: float | None = None) -> float:
     """Analytic one-step model for the continuous engine: the per-layer
     ragged attention walk plus the packed batch's projection/expert
     weight reads (``weight_bytes_per_token_layer`` — serving GEMMs are
@@ -239,7 +283,8 @@ def ragged_serving_step_ms(kv_lens, q_lens, *, page: int, hkv: int,
     FLOPs, is the projection term) and the q/out token traffic."""
     spec = spec or detect_spec()
     t = sum(int(x) for x in q_lens)
-    attn = ragged_page_walk_ms(kv_lens, page, hkv, d, spec, quant)
+    attn = ragged_page_walk_ms(kv_lens, page, hkv, d, spec, quant,
+                               issue_ms)
     tok_bytes = 3 * t * hkv * g * d * 2          # q in, out, lse-ish
     w_ms = (weight_bytes_per_token_layer
             / (spec.hbm_gbps * 1e9) * 1e3)
@@ -275,3 +320,65 @@ def ring_depth_regression(max_hop: int, n: int, hop_bytes: int,
         return None
     excess = max_hop - (n - 1)
     return excess, hop_critical_path_ms(excess, hop_bytes, spec)
+
+
+# --------------------------------------------------- KV-ship (DCN) term
+#
+# Disaggregated prefill/decode moves every finished request's KV cache
+# slice→slice over DCN — the slowest fabric in the system. The split
+# only wins when that transfer hides under the decode work the request
+# buys (max_new decode steps); when prompts are long and generations
+# short the wire DOMINATES and disaggregation makes latency worse.
+# These terms price the ship so `auto` placement can refuse it
+# analytically, before any hardware run.
+
+def kv_ship_ms(n_pages: int, page: int, hkv: int, d: int, n_layers: int,
+               quant: bool = True, spec: TpuSpec | None = None) -> float:
+    """DCN time of ONE request's KV ship: K and V pages for every
+    layer in the wire layout (1 B/elem int8 payload + the per-row f32
+    scale planes under ``kv_quant``, else raw 2 B/elem pages) across
+    the per-chip DCN share. Matches
+    ``kernels.kv_ship.ship_wire_bytes`` by construction."""
+    from triton_distributed_tpu.kernels.kv_ship import ship_wire_bytes
+
+    spec = spec or detect_spec()
+    return (ship_wire_bytes(n_pages, page, hkv, d, n_layers, quant)
+            / (spec.dcn_gbps * 1e9) * 1e3)
+
+
+def refuse_disaggregation(model_cfg, page: int, traffic: dict,
+                          spec: TpuSpec | None = None) -> str | None:
+    """The `auto` placement gate: None when the expected per-request KV
+    ship hides under the decode window it buys, else a human-readable
+    refusal reason. ``traffic``: expected request shape —
+    ``prompt_len`` (tokens whose pages ship) and ``max_new`` (decode
+    steps the ship can overlap with); optional ``decode_step_ms``
+    overrides the analytic steady-step estimate."""
+    spec = spec or detect_spec()
+    prompt = int(traffic.get("prompt_len", 1024))
+    max_new = int(traffic.get("max_new", 32))
+    hkv = model_cfg.n_kv_heads
+    d = model_cfg.head_dim
+    quant = getattr(model_cfg, "kv_quant", None) is not None
+    n_pages = max(-(-prompt // page), 1)
+    ship = kv_ship_ms(
+        n_pages, page, hkv, d, model_cfg.n_layers, quant, spec
+    )
+    step_ms = traffic.get("decode_step_ms")
+    if step_ms is None:
+        step_ms = ragged_serving_step_ms(
+            [prompt], [1], page=page, hkv=hkv,
+            g=model_cfg.n_heads // max(hkv, 1), d=d,
+            hidden=model_cfg.hidden, n_layers=model_cfg.n_layers,
+            spec=spec, quant=quant,
+        )
+    window = max_new * float(step_ms)
+    if ship <= window:
+        return None
+    return (
+        f"kv_ship_ms={ship:.3f} exceeds the decode window "
+        f"{window:.3f} ms ({max_new} steps x {float(step_ms):.3f} ms) — "
+        f"shipping {n_pages} pages over {spec.dcn_gbps} GB/s DCN "
+        "dominates the decode work it buys; keep prefill and decode "
+        "colocated for this traffic"
+    )
