@@ -24,3 +24,10 @@ cd "$(dirname "$0")/.."
 
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'fast and not slow' \
   -p no:cacheprovider "$@"
+
+# Bounded schedule-search smoke: enumerate + mutate one ring family,
+# replay every candidate through shmemlint + the Mosaic pre-flight, and
+# require that the oracle rejected at least one mutation (stable rule
+# IDs) AND produced a lint-clean pick. Exits 2 if the gate is unwired.
+JAX_PLATFORMS=cpu python -m triton_distributed_tpu.tune.schedule \
+  --family ag_gemm.fused --mesh 8

@@ -1,0 +1,356 @@
+"""Schedule-space search (tune.schedule + autotuner.search_ring_schedule).
+
+Pins the tentpole's three contracts:
+
+* DEFAULT BYTE-IDENTITY — threading ``schedule=None`` or the explicit
+  canonical :data:`~triton_distributed_tpu.tune.schedule.DEFAULT`
+  through every ring consumer produces the IDENTICAL symbolic trace
+  (every DMA, semaphore op, write and dequant, on every rank). The
+  refactor that made schedules data may not have moved a single byte of
+  the default protocol.
+* THE ORACLE GATES — every family's legal candidates replay clean
+  through shmemlint + the Mosaic pre-flight, and the deliberately
+  illegal mutations are rejected with stable rule IDs (SL008 for the
+  skipped hop, SL009 for the scale-on-payload rail). A search whose
+  oracle rejects nothing must fail loudly.
+* WINNERS PERSIST — searched winners round-trip the flock'd store
+  keyed by (family, shape, mesh, wire) and reload with ZERO search
+  cost; explicit schedules outrank stored winners.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from triton_distributed_tpu.analysis import fixtures, lint
+from triton_distributed_tpu.lang.launch import captured_launch
+from triton_distributed_tpu.tune import schedule as S
+
+pytestmark = [pytest.mark.analysis, pytest.mark.fast]
+
+_F32 = np.dtype(np.float32)
+_I8 = np.dtype(np.int8)
+_TOK = itertools.count()
+
+
+def _tok():
+    return ("test-schedule", next(_TOK))
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------------ IR
+
+class TestScheduleIR:
+    def test_default_roundtrip_and_identity(self):
+        assert S.DEFAULT.is_default()
+        assert S.RingSchedule.from_dict(S.DEFAULT.to_dict()) == S.DEFAULT
+        mutated = S.RingSchedule(depth=3)
+        assert not mutated.is_default()
+        assert S.RingSchedule.from_dict(mutated.to_dict()) == mutated
+
+    def test_enumerate_default_first_everywhere(self):
+        for fam in S.searchable_families():
+            cands = S.enumerate_schedules(fam)
+            assert cands[0].is_default(), fam
+            assert len(set(cands)) == len(cands), fam
+
+    def test_mutations_are_off_menu(self):
+        """A mutation is never inside the family's legal freedom set."""
+        for fam in S.searchable_families():
+            legal = set(S.enumerate_schedules(fam))
+            for m in S.mutate(S.DEFAULT, fam):
+                assert m not in legal, (fam, m)
+
+
+# ------------------------------------------------- default byte-identity
+
+def _trace(builder, launch, in_shapes, site):
+    spec = captured_launch(launch)
+    assert spec is not None, launch
+    rec, findings = lint.analyze_spec(
+        spec, in_shapes, 8, kernel_name=launch, site=site,
+    )
+    return [[repr(e) for e in tr] for tr in rec.traces], findings
+
+
+def _build_ag_gemm(sched):
+    from triton_distributed_tpu.kernels.ag_gemm import _build_fused
+
+    _build_fused(
+        lint.lint_mesh(8), "x", (), (16 * 8, 128), (128, 64 * 8),
+        jnp.dtype(jnp.float32), jnp.dtype(jnp.float32), 5, _tok(),
+        return_gathered=True, wire="int8", schedule=sched,
+    )
+    return "ag_gemm_fused_int8w", [
+        ((16, 128), _F32), ((16, 128), _I8), ((1, 128), _F32),
+        ((128, 64), _F32),
+    ], "ag_gemm"
+
+
+def _build_gemm_rs(sched):
+    from triton_distributed_tpu.kernels.gemm_rs import _build_fused
+
+    _build_fused(
+        lint.lint_mesh(8), "x", (), (16 * 8, 128 * 8), (128 * 8, 64),
+        jnp.dtype(jnp.float32), jnp.dtype(jnp.float32), 6, _tok(),
+        wire="int8", schedule=sched,
+    )
+    return "gemm_rs_fused_int8w", [
+        ((16 * 8, 128), _F32), ((128, 64), _F32),
+    ], "gemm_rs"
+
+
+def _build_ag_ring(sched):
+    from triton_distributed_tpu.kernels.allgather import _build_all_gather
+    from triton_distributed_tpu.runtime import AllGatherMethod
+
+    _build_all_gather(
+        lint.lint_mesh(8), "x", AllGatherMethod.RING_1D, (64, 2048),
+        jnp.dtype(jnp.float32), 2, _tok(), wire="int8", schedule=sched,
+    )
+    return "ag_ring_1d_int8w", [
+        ((8, 2048), _F32), ((8, 2048), _I8), ((8, 128), _F32),
+    ], "allgather"
+
+
+def _build_ag_bidir(sched):
+    from triton_distributed_tpu.kernels.allgather import _build_all_gather
+    from triton_distributed_tpu.runtime import AllGatherMethod
+
+    _build_all_gather(
+        lint.lint_mesh(8), "x", AllGatherMethod.RING_BIDIR, (64, 1024),
+        jnp.dtype(jnp.float32), 2, _tok(), schedule=sched,
+    )
+    return "ag_ring_bidir", [((8, 1024), _F32)], "allgather"
+
+
+def _build_rs_stream(sched):
+    from triton_distributed_tpu.kernels.reduce_scatter import (
+        _build_rs_stream_w,
+    )
+
+    _build_rs_stream_w(
+        lint.lint_mesh(8), "x", 64, 2048, jnp.dtype(jnp.float32), False,
+        3, _tok(), "int8", sched,
+    )
+    return "rs_ring_stream_int8w", [((64, 2048), _F32)], "reduce_scatter"
+
+
+_CONSUMERS = {
+    "ag_gemm": _build_ag_gemm,
+    "gemm_rs": _build_gemm_rs,
+    "allgather_ring": _build_ag_ring,
+    "allgather_bidir": _build_ag_bidir,
+    "reduce_scatter_stream": _build_rs_stream,
+}
+
+
+class TestDefaultByteIdentity:
+    @pytest.mark.parametrize("name", sorted(_CONSUMERS))
+    def test_none_and_default_trace_identically(self, name):
+        """schedule=None (the un-refactored code path) and the explicit
+        canonical DEFAULT must leave the SAME event trace on every rank
+        — same DMAs, same semaphores, same writes, same order."""
+        build = _CONSUMERS[name]
+        launch, in_shapes, site = build(None)
+        base, f0 = _trace(build, launch, in_shapes, site)
+        launch, in_shapes, site = build(S.DEFAULT)
+        dflt, f1 = _trace(build, launch, in_shapes, site)
+        assert base == dflt, name
+        assert not f0 and not f1, (name, _rules(f0), _rules(f1))
+
+    def test_non_default_schedule_changes_the_trace(self):
+        """The counter-pin: a genuinely different legal schedule must
+        NOT trace identically (otherwise the identity test is vacuous
+        and the kernels ignore their schedule)."""
+        launch, in_shapes, site = _build_ag_ring(None)
+        base, _ = _trace(_build_ag_ring, launch, in_shapes, site)
+        launch, in_shapes, site = _build_ag_ring(
+            S.RingSchedule(direction="rev")
+        )
+        rev, _ = _trace(_build_ag_ring, launch, in_shapes, site)
+        assert base != rev
+        launch, in_shapes, site = _build_rs_stream(S.RingSchedule(depth=3))
+        d3, _ = _trace(_build_rs_stream, launch, in_shapes, site)
+        launch, in_shapes, site = _build_rs_stream(None)
+        d2, _ = _trace(_build_rs_stream, launch, in_shapes, site)
+        assert d3 != d2
+
+
+# --------------------------------------------------------------- oracle
+
+class TestLegalityOracle:
+    @pytest.mark.parametrize("family", S.searchable_families())
+    def test_every_legal_candidate_gates_clean(self, family):
+        for cand in S.enumerate_schedules(family):
+            findings = S.check_schedule(family, cand, 8)
+            assert not findings, (family, cand, _rules(findings))
+
+    def test_skipped_hop_is_sl008(self):
+        f = S.check_schedule(
+            "allgather.ring_1d", S.RingSchedule(chunk_order="skip_last"), 8
+        )
+        assert "SL008" in _rules(f), _rules(f)
+
+    def test_scale_on_payload_is_sl009(self):
+        f = S.check_schedule(
+            "reduce_scatter.stream", S.RingSchedule(scale_rail="payload"), 8
+        )
+        assert "SL009" in _rules(f), _rules(f)
+
+    def test_search_smoke_rejects_and_picks(self):
+        out = S.search_smoke("ag_gemm.fused", 8)
+        assert out["legal"] >= 1
+        rules = sorted({r for _, rs in out["rejected"] for r in rs})
+        assert "SL008" in rules and "SL009" in rules
+        assert out["pick"] is not None
+
+
+class TestMutatedScheduleFixtures:
+    """The mutations as seeded fixtures: built through the REAL
+    production builders (not hand-written replicas), each pinned to
+    exactly its rule."""
+
+    def test_schedule_skipped_chunk_is_sl008_only(self):
+        spec, in_shapes, contract = fixtures.schedule_skipped_chunk()
+        _, findings = lint.analyze_spec(
+            spec, in_shapes(8), 8, kernel_name="schedule_skipped_chunk",
+            site="fixture", contract=contract,
+        )
+        assert _rules(findings) == ["SL008"], [f.format() for f in findings]
+
+    def test_schedule_scale_on_payload_is_sl009_only(self):
+        spec, in_shapes, contract = fixtures.schedule_scale_on_payload()
+        _, findings = lint.analyze_spec(
+            spec, in_shapes(8), 8, kernel_name="schedule_scale_on_payload",
+            site="fixture", contract=contract,
+        )
+        assert _rules(findings) == ["SL009"], [f.format() for f in findings]
+
+
+# ------------------------------------------------------------ perf model
+
+class TestPricing:
+    def test_epilogue_dequant_prices_below_eager_on_wire(self):
+        eager = S.price_schedule(
+            "ag_gemm.fused", S.DEFAULT, rows=128, cols=8192, n=8,
+            wire="int8",
+        )
+        epi = S.price_schedule(
+            "ag_gemm.fused", S.RingSchedule(dequant="epilogue"),
+            rows=128, cols=8192, n=8, wire="int8",
+        )
+        assert epi < eager
+
+    def test_bidir_even_split_is_cheapest(self):
+        prices = {
+            s8: S.price_schedule(
+                "allgather.ring_bidir", S.RingSchedule(split8=s8),
+                rows=64, cols=2048, n=8,
+            )
+            for s8 in (2, 3, 4, 5, 6)
+        }
+        assert min(prices, key=prices.get) == 4
+        assert prices[2] == prices[6]      # symmetric skew, same path
+
+
+# ---------------------------------------------------------- winner store
+
+@pytest.fixture
+def store_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("TDTPU_AUTOTUNE_LOG_DIR", str(tmp_path))
+    S.load_schedule.cache_clear()
+    yield tmp_path
+    S.load_schedule.cache_clear()
+
+
+class TestWinnerStore:
+    def test_store_load_roundtrip(self, store_dir):
+        win = S.RingSchedule(dequant="epilogue")
+        key = S.store_schedule(
+            "ag_gemm.fused", (1024, 8192), (8,), "int8", win,
+            price_ms=1.0, default_ms=2.0,
+        )
+        assert S.load_schedule("ag_gemm.fused", (1024, 8192), (8,),
+                               "int8") == win
+        entry = S.stored_entries()[key]
+        assert entry["family"] == "ag_gemm.fused"
+        assert entry["price_ms"] == 1.0
+        # a different key misses
+        assert S.load_schedule("ag_gemm.fused", (1024, 4096), (8,),
+                               "int8") is None
+
+    def test_resolve_precedence(self, store_dir):
+        stored = S.RingSchedule(direction="rev")
+        S.store_schedule("allgather.ring_1d", (64, 2048), (8,), None,
+                         stored)
+        explicit = S.RingSchedule(chunk_order="skip_last")
+        assert S.resolve_schedule(
+            "allgather.ring_1d", (64, 2048), (8,), None, explicit
+        ) == explicit
+        assert S.resolve_schedule(
+            "allgather.ring_1d", (64, 2048), (8,), None
+        ) == stored
+        assert S.resolve_schedule(
+            "allgather.ring_1d", (9999, 1), (8,), None
+        ) is None
+
+    def test_corrupt_store_loads_as_empty(self, store_dir):
+        p = store_dir / "schedules.json"
+        p.write_text("{not json")
+        S.load_schedule.cache_clear()
+        assert S.load_schedule("ag_gemm.fused", (1, 1), (8,), None) is None
+        assert S.stored_entries() == {}
+
+
+class TestSearchMode:
+    def test_search_persists_and_reloads_with_zero_cost(self, store_dir):
+        from triton_distributed_tpu.tune.autotuner import (
+            search_ring_schedule,
+        )
+
+        rep = search_ring_schedule(
+            "ag_gemm.fused", rows=128, cols=8192, mesh_shape=(8,),
+            wire="int8", shape=(1024, 8192), itemsize=2, dryrun=True,
+        )
+        assert not rep["cached"]
+        assert rep["winner_ms"] <= rep["default_ms"] + 1e-9
+        rules = sorted({r for _, rs in rep["rejected"] for r in rs})
+        assert "SL008" in rules and "SL009" in rules
+        # on disk, keyed by (family, shape, mesh, wire)
+        data = json.loads((store_dir / "schedules.json").read_text())
+        assert any("ag_gemm.fused" in k for k in data["entries"])
+        # the second call never enumerates: zero candidates gated
+        rep2 = search_ring_schedule(
+            "ag_gemm.fused", rows=128, cols=8192, mesh_shape=(8,),
+            wire="int8", shape=(1024, 8192), itemsize=2, dryrun=True,
+        )
+        assert rep2["cached"] and rep2["candidates"] == 0
+        assert rep2["winner"] == rep["winner"]
+        # and the op resolve path sees the winner
+        assert S.resolve_schedule(
+            "ag_gemm.fused", (1024, 8192), (8,), "int8"
+        ) == S.RingSchedule.from_dict(rep["winner"])
+
+    def test_search_refuses_a_dead_oracle(self, store_dir, monkeypatch):
+        """An oracle that rejects nothing means the gate is unwired —
+        the search must fail instead of silently caching winners."""
+        from triton_distributed_tpu.tune.autotuner import (
+            search_ring_schedule,
+        )
+
+        monkeypatch.setitem(S._MUTATIONS, "allgather.ring_bidir", ())
+        with pytest.raises(RuntimeError, match="rejected nothing"):
+            search_ring_schedule(
+                "allgather.ring_bidir", rows=64, cols=1024,
+                mesh_shape=(8,), dryrun=True,
+            )

@@ -503,6 +503,80 @@ def serialized_ring(axis="x"):
     )
 
 
+_SCHED_TOKENS = None
+
+
+def _schedule_token():
+    global _SCHED_TOKENS
+    if _SCHED_TOKENS is None:
+        import itertools
+
+        _SCHED_TOKENS = itertools.count()
+    return ("fixture-schedule", next(_SCHED_TOKENS))
+
+
+def schedule_skipped_chunk(axis="x"):
+    """A schedule-search MUTATION executed by the REAL ring kernel (not
+    a hand-written replica): ``chunk_order='skip_last'`` threaded
+    through the production allgather builder drops the final hop's
+    start+wait+consume — every remaining semaphore balances, the rails
+    stay paired, but each rank terminates one source short. SL008 is
+    the only rule that can see it, which is exactly why the schedule
+    enumerator's legality gate is shmemlint."""
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.analysis import lint
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.allgather import _build_all_gather
+    from triton_distributed_tpu.lang.launch import captured_launch
+    from triton_distributed_tpu.runtime import AllGatherMethod
+    from triton_distributed_tpu.tune.schedule import RingSchedule
+
+    n = 8
+    _build_all_gather(
+        lint.lint_mesh(n), axis, AllGatherMethod.RING_1D, (8 * n, 2048),
+        jnp.dtype(jnp.float32), 53, _schedule_token(), wire="int8",
+        schedule=RingSchedule(chunk_order="skip_last"),
+    )
+    spec = captured_launch("ag_ring_1d_int8w")
+    return (
+        spec,
+        lambda _n: [((8, 2048), _F32), ((8, 2048), np.dtype(np.int8)),
+                    ((8, 128), _F32)],
+        DeliveryContract(kind="gather", dst="out_ref"),
+    )
+
+
+def schedule_scale_on_payload(axis="x"):
+    """The other mutation family: ``scale_rail='payload'`` threaded
+    through the production streaming-RS builder signals the quantized
+    wire's scale arrivals on the PAYLOAD's recv semaphore. Credits
+    balance (reduce_ring still waits the right totals) — only the SL009
+    rail-pairing replay can reject it."""
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.analysis import lint
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.reduce_scatter import (
+        _build_rs_stream_w,
+    )
+    from triton_distributed_tpu.lang.launch import captured_launch
+    from triton_distributed_tpu.tune.schedule import RingSchedule
+
+    n = 8
+    _build_rs_stream_w(
+        lint.lint_mesh(n), axis, 8 * n, 2048, jnp.dtype(jnp.float32),
+        False, 54, _schedule_token(), "int8",
+        schedule=RingSchedule(scale_rail="payload"),
+    )
+    spec = captured_launch("rs_ring_stream_int8w")
+    return (
+        spec,
+        lambda _n: [((8 * n, 2048), _F32)],
+        DeliveryContract(kind="reduce", dst="out_hbm"),
+    )
+
+
 def kv_ship_skipped_page(axis="x"):
     """The KV page ship one page SHORT: the sender's loop walks
     ``range(pages - 1)``, so the last staged page never leaves the

@@ -225,7 +225,7 @@ def mm_q8_pipeline(mb, nb, kb, bm, bk, bn):
 
 
 def _fused_kernel(
-    n, axis, mesh_axes, blocks, publish_local,
+    n, axis, mesh_axes, blocks, publish_local, schedule,
     x_hbm, b_hbm, out_hbm, ag_hbm, acc_ref, local_sem, send_sem, recv_sem,
 ):
     """HBM-streaming ring AG-GEMM. Per step: wait shard arrival → start
@@ -257,14 +257,14 @@ def _fused_kernel(
 
     ag_forward_ring(
         n, axis, mesh_axes, x_hbm, ag_hbm, m, send_sem, recv_sem, consume,
-        site="ag_gemm",
+        site="ag_gemm", schedule=schedule,
     )
     if publish_local:
         cp.wait()
 
 
 def _fused_kernel_w(
-    n, axis, mesh_axes, blocks, publish_local, fmt,
+    n, axis, mesh_axes, blocks, publish_local, fmt, schedule,
     x_hbm, xq_hbm, xs_hbm, b_hbm,
     out_hbm, ag_hbm, agq_hbm, ags_hbm,
     acc_ref, local_sem, send_sem, recv_sem, s_send_sem, s_recv_sem,
@@ -300,14 +300,14 @@ def _fused_kernel_w(
     )
     ag_forward_ring(
         n, axis, mesh_axes, x_hbm, ag_hbm, m, send_sem, recv_sem, consume,
-        site="ag_gemm", wire=wire,
+        site="ag_gemm", wire=wire, schedule=schedule,
     )
     if publish_local:
         cp.wait()
 
 
 def _fused_kernel_mx(
-    n, axis, mesh_axes, blocks, fmt,
+    n, axis, mesh_axes, blocks, fmt, schedule,
     xq_hbm, xs_hbm, bq_hbm, bs_hbm,
     out_hbm, agq_hbm, ags_hbm,
     acc_ref, send_sem, recv_sem, s_send_sem, s_recv_sem,
@@ -344,7 +344,7 @@ def _fused_kernel_mx(
     )
     ag_forward_ring(
         n, axis, mesh_axes, xq_hbm, agq_hbm, m, send_sem, recv_sem, consume,
-        site="ag_gemm", wire=wire,
+        site="ag_gemm", wire=wire, schedule=schedule,
     )
 
 
@@ -370,7 +370,7 @@ def _specs(axis, batch_axes, dcn_axis=None):
 def _build_fused(
     mesh, axis, batch_axes, a_shape, b_shape, dtype, out_dtype, collective_id,
     chaos, return_gathered=True, dcn_axis=None, wire=None,
-    b_prequant=False,
+    b_prequant=False, schedule=None,
 ):
     """Fused engine. ``dcn_axis`` set = the hierarchical decomposition
     (≡ the reference's inter-node AG-GEMM, allgather.py:291-375): the
@@ -449,6 +449,7 @@ def _build_fused(
             return lang.shmem_call(
                 functools.partial(
                     _fused_kernel_mx, n, axis, mesh.axis_names, blk, fmt,
+                    schedule,
                 ),
                 out_shape=[
                     jax.ShapeDtypeStruct((m_g, n_local), out_dtype),
@@ -477,7 +478,7 @@ def _build_fused(
             return lang.shmem_call(
                 functools.partial(
                     _fused_kernel_w, n, axis, mesh.axis_names, blk,
-                    return_gathered, fmt,
+                    return_gathered, fmt, schedule,
                 ),
                 out_shape=[
                     jax.ShapeDtypeStruct((m_g, n_local), out_dtype),
@@ -504,7 +505,8 @@ def _build_fused(
             )
         return lang.shmem_call(
             functools.partial(
-                _fused_kernel, n, axis, mesh.axis_names, blk, return_gathered
+                _fused_kernel, n, axis, mesh.axis_names, blk,
+                return_gathered, schedule,
             ),
             out_shape=[
                 jax.ShapeDtypeStruct((m_g, n_local), out_dtype),
@@ -1142,6 +1144,7 @@ def ag_gemm(
     wire_dtype=None,
     wq: str | None = None,
     b_quant=None,
+    schedule=None,
 ):
     """Fused AllGather(A) @ B for column-parallel TP.
 
@@ -1239,11 +1242,19 @@ def ag_gemm(
                 method = AGGemmMethod.XLA_RING
             if method == AGGemmMethod.PALLAS_FUSED:
                 try:
+                    from triton_distributed_tpu.tune.schedule import (
+                        resolve_schedule,
+                    )
+
                     fn = _build_fused(
                         mesh, axis, batch_axes, a.shape, bq.shape,
                         a.dtype, jnp.dtype(out_dtype), collective_id,
                         interp_key(), return_gathered, None, "int8-mxu",
                         True,
+                        resolve_schedule(
+                            "ag_gemm.fused", a.shape, (n * nd,),
+                            "int8-mxu", schedule,
+                        ),
                     )
                     out, gathered = fn(a, bq, bs)
                     return (out, gathered) if return_gathered else out
@@ -1276,9 +1287,24 @@ def ag_gemm(
         wire_dtype=wire_dtype, dcn_axis=dcn_axis, dp=dp, wq=wq,
     )
     if method == AGGemmMethod.PALLAS_FUSED:
+        from triton_distributed_tpu.tune.schedule import resolve_schedule
+
+        sched = resolve_schedule(
+            "ag_gemm.fused", a.shape, (n * nd,), wire, schedule
+        )
+        if (
+            sched is not None and sched.dequant == "epilogue"
+            and wire == "int8" and dcn_axis is None
+            and wirelib.inkernel_s8_dot_ok()
+        ):
+            # a searched epilogue-dequant schedule means the winner was
+            # gated on the MXU-consumer kernel twin: the int8 payload is
+            # consumed straight by the s8×s8 epilogue, no dequant pass
+            wire = "int8-mxu"
         fn = _build_fused(
             mesh, axis, batch_axes, a.shape, b.shape, a.dtype, out_dtype,
             collective_id, interp_key(), return_gathered, dcn_axis, wire,
+            False, sched,
         )
         out, gathered = fn(a, b)
         return (out, gathered) if return_gathered else out

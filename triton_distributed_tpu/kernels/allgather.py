@@ -39,13 +39,21 @@ from triton_distributed_tpu.utils.testing import chaos_delay
 _SITE = "allgather"     # fault-plan / watchdog site for every AG engine
 
 
-def _ring_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
+def _ring_ag_kernel(
+    n, axis, mesh_axes, schedule, x_ref, out_ref, send_sem, recv_sem
+):
     """Unidirectional ring: at step s forward shard (me-s) to the right
-    neighbor; after n-1 steps everyone holds everything."""
+    neighbor; after n-1 steps everyone holds everything. The traversal
+    (direction, chunk order) is the :class:`RingSchedule`'s to choose;
+    ``schedule=None`` is the canonical forward ring, byte-identical to
+    the pre-schedule kernel."""
+    direction = "fwd" if schedule is None else schedule.direction
+    order = "ring" if schedule is None else schedule.chunk_order
     me = lang.my_pe(axis)
     m = x_ref.shape[0]
     left, right = ring_neighbors(me, n)
     left, right = lang.pe_flat(axis, left, mesh_axes), lang.pe_flat(axis, right, mesh_axes)
+    to = right if direction == "fwd" else left
 
     out_ref[pl.ds(me * m, m)] = x_ref[:]
     # payload-corruption hook: the local slab is both what the ring
@@ -58,22 +66,26 @@ def _ring_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
     # that step's DMA, so a wait being satisfied proves that *specific*
     # transfer landed (slot reuse would let a later step's credit release an
     # earlier wait while its data is still in flight).
-    for s in range(n - 1):
-        src = jax.lax.rem(me + n - s, n) if s > 0 else me
+    last = n - 1 if order != "skip_last" else n - 2
+    for s in range(last):
+        if direction == "fwd":
+            src = jax.lax.rem(me + n - s, n) if s > 0 else me
+        else:
+            src = jax.lax.rem(me + s, n)
         chaos_delay(site=_SITE, step=s, me=me, n=n)
         dma = lang.remote_copy(
             out_ref.at[pl.ds(src * m, m)],
             out_ref.at[pl.ds(src * m, m)],
             send_sem.at[s],
             recv_sem.at[s],
-            right,
+            to,
         )
         dma.start()
         dma.wait()  # drains send + the symmetric incoming recv
 
 
 def _ring_ag_kernel_w(
-    n, axis, mesh_axes,
+    n, axis, mesh_axes, schedule,
     x_ref, xq_ref, xs_ref, out_ref, outq_ref, outs_ref,
     send_sem, recv_sem, s_send_sem, s_recv_sem,
 ):
@@ -83,11 +95,16 @@ def _ring_ag_kernel_w(
     row-granular scales), dequantizing each arrival into ``out_ref``.
     The own slab is written exact from ``x_ref`` (it never crosses the
     wire), matching the fused engines' wire contract."""
+    direction = "fwd" if schedule is None else schedule.direction
+    order = "ring" if schedule is None else schedule.chunk_order
+    rail = "own" if schedule is None else schedule.scale_rail
     me = lang.my_pe(axis)
     m = x_ref.shape[0]
     left, right = ring_neighbors(me, n)
     left = lang.pe_flat(axis, left, mesh_axes)
     right = lang.pe_flat(axis, right, mesh_axes)
+    to = right if direction == "fwd" else left
+    sr_sem = s_recv_sem if rail == "own" else recv_sem
 
     out_ref[pl.ds(me * m, m)] = x_ref[:]
     outq_ref[pl.ds(me * m, m)] = xq_ref[:]
@@ -95,27 +112,34 @@ def _ring_ag_kernel_w(
     _faults.maybe_corrupt(out_ref, _SITE, me, n, row_off=me * m)
     lang.neighbor_barrier(axis, left, right, site=_SITE, me=me, n=n)
 
-    for s in range(n - 1):
-        src = jax.lax.rem(me + n - s, n) if s > 0 else me
+    last = n - 1 if order != "skip_last" else n - 2
+    for s in range(last):
+        if direction == "fwd":
+            src = jax.lax.rem(me + n - s, n) if s > 0 else me
+        else:
+            src = jax.lax.rem(me + s, n)
         chaos_delay(site=_SITE, step=s, me=me, n=n)
         dma_q = lang.remote_copy(
             outq_ref.at[pl.ds(src * m, m)],
             outq_ref.at[pl.ds(src * m, m)],
-            send_sem.at[s], recv_sem.at[s], right,
+            send_sem.at[s], recv_sem.at[s], to,
         )
         dma_s = lang.remote_copy(
             outs_ref.at[pl.ds(src * m, m)],
             outs_ref.at[pl.ds(src * m, m)],
-            s_send_sem.at[s], s_recv_sem.at[s], right,
+            s_send_sem.at[s], sr_sem.at[s], to,
         )
         dma_q.start()
         dma_s.start()
         dma_q.wait()   # drains send + the symmetric incoming recv
         dma_s.wait()
-        # the slab that just LANDED came from the left: left's step-s
-        # source, i.e. shard (me-1-s) — dequantize it for the caller
-        # (the wire copy stays resident for the next forward)
-        arr = jax.lax.rem(me + 2 * n - 1 - s, n)
+        # the slab that just LANDED came from the upstream neighbor:
+        # its step-s source — shard (me∓1∓s) — dequantize it for the
+        # caller (the wire copy stays resident for the next forward)
+        if direction == "fwd":
+            arr = jax.lax.rem(me + 2 * n - 1 - s, n)
+        else:
+            arr = jax.lax.rem(me + 1 + s, n)
         wirelib.dequant_rows_into(
             out_ref.at[pl.ds(arr * m, m)],
             outq_ref.at[pl.ds(arr * m, m)],
@@ -123,14 +147,23 @@ def _ring_ag_kernel_w(
         )
 
 
-def _ring_bidir_ag_kernel(n, axis, mesh_axes, x_ref, out_ref, send_sem, recv_sem):
-    """Bidirectional ring: clockwise carries the left half-columns of every
-    shard, counter-clockwise the right half → each link moves half the
-    bytes, halving AG time on a torus."""
+def _ring_bidir_ag_kernel(
+    n, axis, mesh_axes, schedule, x_ref, out_ref, send_sem, recv_sem
+):
+    """Bidirectional ring: clockwise carries the left split8/8 columns of
+    every shard, counter-clockwise the rest → each link moves a fraction
+    of the bytes, halving AG time on a torus at the default even split."""
     me = lang.my_pe(axis)
     m = x_ref.shape[0]
     k = x_ref.shape[1]
-    kh = k // 2
+    if schedule is None:
+        kh = k // 2
+    else:
+        # lane-align the split point so both column slices stay Mosaic-
+        # friendly; at split8=4 on lane-multiple widths this is k // 2
+        kh = (k * int(schedule.split8)) // 8
+        if k >= 256:
+            kh = max(128, min(k - 128, (kh // 128) * 128))
     left, right = ring_neighbors(me, n)
     left, right = lang.pe_flat(axis, left, mesh_axes), lang.pe_flat(axis, right, mesh_axes)
 
@@ -272,7 +305,7 @@ _KERNELS = {
 
 @functools.lru_cache(maxsize=256)
 def _build_all_gather(mesh, axis, method, shape, dtype, collective_id, chaos,
-                      wire=None):
+                      wire=None, schedule=None):
     """Compile-once factory: the jitted collective for one (mesh, shape)
     configuration. lru_cache gives call-site reuse — without it every
     invocation would rebuild pallas_call+shard_map+jit and retrace.
@@ -323,7 +356,9 @@ def _build_all_gather(mesh, axis, method, shape, dtype, collective_id, chaos,
         wirelib.require_inkernel(wire, "all_gather")
         nsem = max(n - 1, 1)
         call = lang.shmem_call(
-            functools.partial(_ring_ag_kernel_w, n, axis, mesh.axis_names),
+            functools.partial(
+                _ring_ag_kernel_w, n, axis, mesh.axis_names, schedule
+            ),
             out_shape=[
                 jax.ShapeDtypeStruct(shape, dtype),
                 jax.ShapeDtypeStruct(shape, fmt.wire_dtype),
@@ -357,8 +392,14 @@ def _build_all_gather(mesh, axis, method, shape, dtype, collective_id, chaos,
 
     kernel_fn, nsem_fn = _KERNELS[method]
     nsem = max(nsem_fn(n), 1)
+    if method in (AllGatherMethod.RING_1D, AllGatherMethod.RING_BIDIR):
+        kernel = functools.partial(
+            kernel_fn, n, axis, mesh.axis_names, schedule
+        )
+    else:
+        kernel = functools.partial(kernel_fn, n, axis, mesh.axis_names)
     call = lang.shmem_call(
-        functools.partial(kernel_fn, n, axis, mesh.axis_names),
+        kernel,
         out_shape=jax.ShapeDtypeStruct(shape, dtype),
         in_specs=lang.vmem_specs(1),
         scratch_shapes=[
@@ -552,6 +593,7 @@ def all_gather(
     method: AllGatherMethod | None = None,
     collective_id: int = 2,
     wire_dtype=None,
+    schedule=None,
 ):
     """AllGather ``x`` (sharded on dim 0 along ``axis``) → replicated full array.
 
@@ -621,9 +663,21 @@ def all_gather(
                 mesh, axis, (x.shape[0] // n, x.shape[1]), x.dtype,
                 collective_id,
             )(x)
+    wire = _resolve_ag_wire(wire_dtype, method, x, n)
+    if method in (AllGatherMethod.RING_1D, AllGatherMethod.RING_BIDIR):
+        from triton_distributed_tpu.tune.schedule import resolve_schedule
+
+        family = (
+            "allgather.ring_1d"
+            if method == AllGatherMethod.RING_1D
+            else "allgather.ring_bidir"
+        )
+        schedule = resolve_schedule(family, x.shape, (n,), wire, schedule)
+    else:
+        schedule = None
     fn = _build_all_gather(
         mesh, axis, method, x.shape, x.dtype, collective_id, interp_key(),
-        wire=_resolve_ag_wire(wire_dtype, method, x, n),
+        wire=wire, schedule=schedule,
     )
     return fn(x)
 

@@ -105,8 +105,92 @@ def ew_add_pipeline(m, n, itemsize):
     return run
 
 
+def mm_q8_rs_pipeline(mb, nb, kb, bm, bk, bn, fmt, acc_ref, *, m_off=0):
+    """s8×s8→s32 producer for the wire reduce ring: the partial runs on
+    the MXU's native int8 path (int8 weights + activations) and is
+    quantized for the wire STRAIGHT OFF THE ACCUMULATOR — the epilogue
+    (mm_q8_pipeline's ``as·bs`` rescale shape) writes the f32-rescaled
+    partial slab AND its wire copy (int8 payload + per-chunk scale row)
+    in one pass, so the separate quant_pipeline read-back over HBM is
+    gone. Requires ``nb == 1`` (the out tile spans every column, so a
+    row block IS a scale chunk: ``fmt.chunk_rows == bm``)."""
+    assert nb == 1 and fmt.chunk_rows == bm, (nb, fmt.chunk_rows, bm)
+    qmax = fmt.qmax
+
+    def inner(aq_ref, as_ref, bq_ref, bs_ref, o_ref, q_ref, s_ref):
+        @pl.when(pl.program_id(2) == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        acc_ref[...] += jax.lax.dot_general(
+            aq_ref[...], bq_ref[...],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+
+        @pl.when(pl.program_id(2) == kb - 1)
+        def _():
+            # rank-1 a_scale[chunk]·b_scale[n] rescale on the s32
+            # accumulator (mm_q8_pipeline's epilogue shape) → the f32
+            # partial tile; its wire quantization happens HERE, off the
+            # same accumulator values, before the tile leaves VMEM
+            t = acc_ref[...].astype(jnp.float32) * (
+                as_ref[:, :1] * bs_ref[...]
+            )
+            o_ref[...] = t.astype(o_ref.dtype)
+            row = jnp.max(jnp.abs(t), axis=1, keepdims=True)
+            chunk = jnp.max(row, axis=0, keepdims=True)
+            scale = jnp.maximum(chunk, 1e-12) / qmax
+            s_ref[...] = jnp.broadcast_to(
+                scale, (1, wirelib.SCALE_LANES)
+            ).astype(jnp.float32)
+            y = t / scale
+            if fmt.quant == "int8":
+                y = jnp.clip(jnp.round(y), -127, 127)
+            q_ref[...] = y.astype(q_ref.dtype)
+
+    pipe = pltpu.emit_pipeline(
+        inner,
+        grid=(mb, nb, kb),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (m_off + i, kk)),
+            pl.BlockSpec(
+                (1, wirelib.SCALE_LANES), lambda i, j, kk: (m_off + i, 0)
+            ),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+            pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+            pl.BlockSpec(
+                (1, wirelib.SCALE_LANES), lambda i, j, kk: (i, 0)
+            ),
+        ],
+    )
+
+    def run(aq_hbm, as_hbm, bq_hbm, bs_hbm, dst_hbm, wq_hbm, ws_hbm):
+        from triton_distributed_tpu.analysis import events
+
+        rec = events.active_recorder()
+        if rec is not None:
+            # symbolic twin: the product is locally-owned data in the
+            # work slab, immediately re-quantized into the wire rails —
+            # the same Write+Quant provenance mm_pipeline+quant_pipeline
+            # would leave, minus the value-level HBM read-back
+            rec.emit(events.WriteEvent(region=dst_hbm.region()))
+            rec.emit(events.QuantEvent(
+                src_region=dst_hbm.region(), q_region=wq_hbm.region(),
+                s_region=ws_hbm.region(), chunk_rows=fmt.chunk_rows,
+            ))
+            return
+        pipe(aq_hbm, as_hbm, bq_hbm, bs_hbm, dst_hbm, wq_hbm, ws_hbm)
+
+    return run
+
+
 def _fused_kernel(
-    n, axis, mesh_axes, blocks,
+    n, axis, mesh_axes, blocks, schedule,
     a_hbm, b_hbm, out_hbm, w0, w1, r0, r1, acc_ref, send_sem, recv_sem, ack_sem,
 ):
     """HBM-streaming compute-into-the-ring GEMM-RS.
@@ -134,12 +218,12 @@ def _fused_kernel(
         n, axis, mesh_axes, out_hbm, (w0, w1), (r0, r1),
         send_sem, recv_sem, ack_sem, partial_into,
         ew_add_pipeline(m_local, n_out, out_hbm.dtype.itemsize),
-        site="gemm_rs",
+        site="gemm_rs", schedule=schedule,
     )
 
 
 def _fused_kernel_w(
-    n, axis, mesh_axes, blocks, fmt,
+    n, axis, mesh_axes, blocks, fmt, schedule,
     a_hbm, b_hbm, out_hbm, w0, w1,
     wq0, wq1, ws0, ws1, rq0, rq1, rs0, rs1,
     acc_ref, send_sem, recv_sem, ack_sem, s_send_sem, s_recv_sem,
@@ -170,7 +254,50 @@ def _fused_kernel_w(
     reduce_ring(
         n, axis, mesh_axes, out_hbm, (w0, w1), (None, None),
         send_sem, recv_sem, ack_sem, partial_into, None,
-        site="gemm_rs", wire=wire,
+        site="gemm_rs", wire=wire, schedule=schedule,
+    )
+
+
+def _fused_kernel_mxw(
+    n, axis, mesh_axes, blocks, fmt, schedule,
+    aq_hbm, as_hbm, bq_hbm, bs_hbm, out_hbm, w0, w1,
+    wq0, wq1, ws0, ws1, rq0, rq1, rs0, rs1,
+    acc_ref, send_sem, recv_sem, ack_sem, s_send_sem, s_recv_sem,
+):
+    """int8-MXU-producer twin of :func:`_fused_kernel_w` (carried-forward
+    ROADMAP item): with int8 weights + activations the producer matmul
+    runs the MXU's native s8×s8→s32 path and its epilogue quantizes the
+    partial for the wire straight off the accumulator into the wq/ws
+    rails — ``RSWireRefs.quantize=None`` tells the ring harness the
+    read-back quantize pass is gone."""
+    m_local = out_hbm.shape[0]
+    n_out = out_hbm.shape[1]
+    k = aq_hbm.shape[1]
+    bm, bk, bn = blocks
+    mb, nb, kb = m_local // bm, n_out // bn, k // bk
+    wq, ws = (wq0, wq1), (ws0, ws1)
+    produced = [0]
+
+    def partial_into(dst, dst_ref):
+        # produce calls walk the ring slots in order (call i → slot i%2,
+        # matching reduce_ring's send slot for that partial), so the
+        # epilogue knows which wire rail pair it owns
+        slot = produced[0] % 2
+        produced[0] += 1
+        mm_q8_rs_pipeline(
+            mb, nb, kb, bm, bk, bn, fmt, acc_ref, m_off=dst * mb
+        )(aq_hbm, as_hbm, bq_hbm, bs_hbm, dst_ref, wq[slot], ws[slot])
+
+    wire = RSWireRefs(
+        fmt=fmt, wq=wq, ws=ws, rq=(rq0, rq1), rs=(rs0, rs1),
+        s_send_sem=s_send_sem, s_recv_sem=s_recv_sem,
+        quantize=None,   # producer-quantized: the epilogue wrote wq/ws
+        dequant_add=wirelib.dequant_add_pipeline(m_local, n_out, fmt),
+    )
+    reduce_ring(
+        n, axis, mesh_axes, out_hbm, (w0, w1), (None, None),
+        send_sem, recv_sem, ack_sem, partial_into, None,
+        site="gemm_rs", wire=wire, schedule=schedule,
     )
 
 
@@ -197,7 +324,7 @@ def _specs(axis, batch_axes, dcn_axis=None):
 @functools.lru_cache(maxsize=256)
 def _build_fused(
     mesh, axis, batch_axes, a_shape, b_shape, dtype, out_dtype, collective_id,
-    chaos, dcn_axis=None, wire=None,
+    chaos, dcn_axis=None, wire=None, schedule=None,
 ):
     """Fused engine. ``dcn_axis`` set = hierarchical (≡ the reference's
     inter-node GEMM-RS, reduce_scatter.py:524-545): the fused ring
@@ -234,7 +361,17 @@ def _build_fused(
         collective_id = None  # degenerate path uses no barrier semaphore
     fmt = None
     rail_fmt = None
-    if wire is not None and dcn_axis is not None:
+    mx = wire == "int8-mxu" and dcn_axis is None
+    if mx and (n_out // blocks[2] != 1 or m_local % blocks[0]):
+        # the accumulator-epilogue quantizer needs the out tile to span
+        # every column (a row block IS a scale chunk); otherwise run the
+        # ordinary int8 wire with its separate quantize pass
+        mx = False
+        wire = "int8"
+    if mx:
+        wirelib.require_mxu("gemm_rs")
+        fmt = wirelib.WireFormat(quant="int8", chunk_rows=blocks[0])
+    elif wire is not None and dcn_axis is not None:
         # hierarchical: the wire rides the DCN LEG (the quantized
         # ppermute reduce ring replacing psum_scatter — XLA-side
         # quant/dequant, any backend); intra-slice rings stay raw.
@@ -261,6 +398,33 @@ def _build_fused(
 
     def mk_call(n_cols, blk, cid):
         slab = jax.ShapeDtypeStruct((m_local, n_cols), out_dtype)
+        if mx:
+            qslab = jax.ShapeDtypeStruct((m_local, n_cols), fmt.wire_dtype)
+            sslab = jax.ShapeDtypeStruct(
+                (fmt.chunks(m_local), wirelib.SCALE_LANES), jnp.float32
+            )
+            return lang.shmem_call(
+                functools.partial(
+                    _fused_kernel_mxw, n, axis, mesh.axis_names, blk, fmt,
+                    schedule,
+                ),
+                out_shape=[slab, slab, slab,
+                           qslab, qslab, sslab, sslab,
+                           qslab, qslab, sslab, sslab],
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+                out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 11,
+                scratch_shapes=[
+                    pltpu.VMEM((blk[0], blk[2]), jnp.int32),  # s32 acc
+                    pltpu.SemaphoreType.DMA((2,)),
+                    pltpu.SemaphoreType.DMA((2,)),
+                    pltpu.SemaphoreType.REGULAR,
+                    pltpu.SemaphoreType.DMA((2,)),   # scale rail
+                    pltpu.SemaphoreType.DMA((2,)),
+                ],
+                collective_id=cid,
+                vmem_limit_bytes=fused_vmem_budget(),
+                name="gemm_rs_fused_int8mxw",
+            )
         if fmt is not None:
             qslab = jax.ShapeDtypeStruct((m_local, n_cols), fmt.wire_dtype)
             sslab = jax.ShapeDtypeStruct(
@@ -268,7 +432,8 @@ def _build_fused(
             )
             return lang.shmem_call(
                 functools.partial(
-                    _fused_kernel_w, n, axis, mesh.axis_names, blk, fmt
+                    _fused_kernel_w, n, axis, mesh.axis_names, blk, fmt,
+                    schedule,
                 ),
                 # out + bf16 work pair + quantized work/scale pairs +
                 # quantized recv/scale pairs (HBM workspaces as outputs)
@@ -293,7 +458,9 @@ def _build_fused(
                 name=f"gemm_rs_fused_{wirelib.wire_payload(wire)}w",
             )
         return lang.shmem_call(
-            functools.partial(_fused_kernel, n, axis, mesh.axis_names, blk),
+            functools.partial(
+                _fused_kernel, n, axis, mesh.axis_names, blk, schedule
+            ),
             # work/recv ring slabs are HBM workspaces (Mosaic supports
             # scratch only in vmem/smem/semaphore space, so they ride as
             # extra outputs — the symmetric-workspace pattern of the
@@ -337,8 +504,17 @@ def _build_fused(
             axis=axis, site="gemm_rs", collective_id=collective_id, n=n,
         )
 
-        def body(a, b):
-            return call(a, b)[0]
+        if mx:
+            def body(a, b):
+                # quantize both operands in XLA; the kernel's MXU path
+                # consumes s8×s8→s32 and quantizes the wire partial
+                # straight off the accumulator epilogue
+                aq, asc = wirelib.quantize_slab(a, fmt)
+                bq, bsc = wirelib.quantize_cols(b)
+                return call(aq, asc, bq, bsc)[0]
+        else:
+            def body(a, b):
+                return call(a, b)[0]
     elif n_chunks == 1:
         call = mk_call(n_out, blocks, collective_id)
 
@@ -755,6 +931,7 @@ def gemm_rs(
     collective_id: int = 6,
     dcn_axis: str | None = None,
     wire_dtype=None,
+    schedule=None,
 ):
     """Fused (A @ B) → ReduceScatter for row-parallel TP.
 
@@ -762,9 +939,18 @@ def gemm_rs(
     wire"). None/'bf16' — the raw partials (default, today's numerics);
     'fp8'/'int8' — each hop's partial quantized to a 1-byte payload +
     per-chunk f32 scales (lang.wire), dequant-accumulated in f32 on
-    receive so reduction error is one bounded rounding per hop; 'auto'
-    — the measured wire tuner, else the perf model picks the compressed
-    wire exactly on comm-bound shapes. Inference-grade transport.
+    receive so reduction error is one bounded rounding per hop;
+    'int8-mxu' — additionally run the producer GEMM itself on s8×s8→s32
+    and quantize each hop's wire partial straight off the accumulator
+    epilogue (no separate read-back quantize pass); 'auto' — the
+    measured wire tuner, else the perf model picks the compressed wire
+    exactly on comm-bound shapes. Inference-grade transport.
+
+    ``schedule``: an explicit :class:`tune.schedule.RingSchedule` for
+    the fused reduce ring (scale-rail assignment, buffer depth). None
+    resolves a persisted schedule-search winner for this
+    (shape, mesh, wire) key, falling back to the canonical default —
+    byte-identical to the pre-schedule kernel.
 
     ``a``: (M, K) with rows sharded over ``batch_axes`` (DP) and cols
     P(axis) — each device holds a K/n column shard. ``b``: (K, N) sharded
@@ -802,9 +988,21 @@ def gemm_rs(
         wire_dtype=wire_dtype, out_dtype=out_dtype, dcn_axis=dcn_axis, dp=dp,
     )
     if method == GemmRSMethod.PALLAS_FUSED:
+        from triton_distributed_tpu.tune.schedule import resolve_schedule
+
+        if (wirelib.normalize_wire(wire_dtype) == "int8-mxu"
+                and wire == "int8" and dcn_axis is None
+                and wirelib.inkernel_s8_dot_ok()):
+            # the caller asked for the MXU consumer; resolve_gemm_rs_wire
+            # reports the payload ('int8') since that is what the ring
+            # ships — re-upgrade for the builder
+            wire = "int8-mxu"
+        sched = resolve_schedule(
+            "gemm_rs.fused", a.shape, (n * nd,), wire, schedule
+        )
         fn = _build_fused(
             mesh, axis, batch_axes, a.shape, b.shape, a.dtype, out_dtype,
-            collective_id, interp_key(), dcn_axis, wire,
+            collective_id, interp_key(), dcn_axis, wire, sched,
         )
     elif method == GemmRSMethod.XLA_RING:
         fn = _build_xla_ring(

@@ -151,7 +151,7 @@ def _ring_rs_kernel_w(
 
 
 def _rs_stream_kernel(
-    n, axis, mesh_axes, x_hbm, out_hbm, w0, w1, r0, r1,
+    n, axis, mesh_axes, schedule, x_hbm, out_hbm, w0, w1, r0, r1,
     copy_sem, send_sem, recv_sem, ack_sem,
 ):
     """HBM-streaming reduce ring: each destination's contribution is
@@ -174,11 +174,39 @@ def _rs_stream_kernel(
         n, axis, mesh_axes, out_hbm, (w0, w1), (r0, r1),
         send_sem, recv_sem, ack_sem, partial_into,
         ew_add_pipeline(m, out_hbm.shape[1], out_hbm.dtype.itemsize),
+        schedule=schedule,
+    )
+
+
+def _rs_stream_kernel3(
+    n, axis, mesh_axes, schedule, x_hbm, out_hbm, w0, w1, w2, r0, r1, r2,
+    copy_sem, send_sem, recv_sem, ack_sem,
+):
+    """Triple-buffered twin of :func:`_rs_stream_kernel` (schedule depth
+    3): identical protocol with one extra in-flight slot of slack — the
+    ack credit arrives at ``s >= 3`` instead of ``s >= 2``."""
+    from triton_distributed_tpu.kernels.gemm_rs import ew_add_pipeline
+    from triton_distributed_tpu.kernels.ring import reduce_ring
+
+    m = out_hbm.shape[0]
+
+    def partial_into(dst, dst_ref):
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(dst * m, m)], dst_ref, copy_sem
+        )
+        cp.start()
+        cp.wait()
+
+    reduce_ring(
+        n, axis, mesh_axes, out_hbm, (w0, w1, w2), (r0, r1, r2),
+        send_sem, recv_sem, ack_sem, partial_into,
+        ew_add_pipeline(m, out_hbm.shape[1], out_hbm.dtype.itemsize),
+        schedule=schedule,
     )
 
 
 def _rs_stream_kernel_w(
-    n, axis, mesh_axes, fmt,
+    n, axis, mesh_axes, fmt, schedule,
     x_hbm, out_hbm, w0, w1,
     wq0, wq1, ws0, ws1, rq0, rq1, rs0, rs1,
     copy_sem, send_sem, recv_sem, ack_sem, s_send_sem, s_recv_sem,
@@ -211,12 +239,47 @@ def _rs_stream_kernel_w(
     reduce_ring(
         n, axis, mesh_axes, out_hbm, (w0, w1), (None, None),
         send_sem, recv_sem, ack_sem, partial_into, None, wire=wire,
+        schedule=schedule,
+    )
+
+
+def _rs_stream_kernel_w3(
+    n, axis, mesh_axes, fmt, schedule,
+    x_hbm, out_hbm, w0, w1, w2,
+    wq0, wq1, wq2, ws0, ws1, ws2, rq0, rq1, rq2, rs0, rs1, rs2,
+    copy_sem, send_sem, recv_sem, ack_sem, s_send_sem, s_recv_sem,
+):
+    """Triple-buffered twin of :func:`_rs_stream_kernel_w` (schedule
+    depth 3): every wire rail grows a third slot."""
+    from triton_distributed_tpu.kernels.ring import RSWireRefs, reduce_ring
+
+    m = out_hbm.shape[0]
+    cols = out_hbm.shape[1]
+
+    def partial_into(dst, dst_ref):
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(dst * m, m)], dst_ref, copy_sem
+        )
+        cp.start()
+        cp.wait()
+
+    wire = RSWireRefs(
+        fmt=fmt, wq=(wq0, wq1, wq2), ws=(ws0, ws1, ws2),
+        rq=(rq0, rq1, rq2), rs=(rs0, rs1, rs2),
+        s_send_sem=s_send_sem, s_recv_sem=s_recv_sem,
+        quantize=wirelib.quant_pipeline(m, cols, fmt),
+        dequant_add=wirelib.dequant_add_pipeline(m, cols, fmt),
+    )
+    reduce_ring(
+        n, axis, mesh_axes, out_hbm, (w0, w1, w2), (None, None, None),
+        send_sem, recv_sem, ack_sem, partial_into, None, wire=wire,
+        schedule=schedule,
     )
 
 
 @functools.lru_cache(maxsize=256)
 def _build_rs_stream_w(mesh, axis, rows, cols, dtype, stacked,
-                       collective_id, ikey, wire):
+                       collective_id, ikey, wire, schedule=None):
     """Quantized-wire HBM-streaming reduce ring (2-D payloads, per-chunk
     scales — the lang.wire streaming layout of the fused gemm_rs wire)."""
     from triton_distributed_tpu.config import compiling_for_tpu
@@ -224,6 +287,7 @@ def _build_rs_stream_w(mesh, axis, rows, cols, dtype, stacked,
     wirelib.require_inkernel(wire, "reduce_scatter")
     n = mesh.shape[axis]
     m_local = rows // n
+    d = 2 if schedule is None else int(schedule.depth)
     fmt = wirelib.make_wire_format(wire, m_local, strict=compiling_for_tpu())
     assert fmt is not None, (wire, m_local)   # gated by the entry
     slab = jax.ShapeDtypeStruct((m_local, cols), dtype)
@@ -231,24 +295,25 @@ def _build_rs_stream_w(mesh, axis, rows, cols, dtype, stacked,
     sslab = jax.ShapeDtypeStruct(
         (fmt.chunks(m_local), wirelib.SCALE_LANES), jnp.float32
     )
+    kernel = _rs_stream_kernel_w if d == 2 else _rs_stream_kernel_w3
     call = lang.shmem_call(
         functools.partial(
-            _rs_stream_kernel_w, n, axis, mesh.axis_names, fmt
+            kernel, n, axis, mesh.axis_names, fmt, schedule
         ),
-        # out + bf16 work pair + quantized work/scale + recv/scale pairs
+        # out + bf16 work slots + quantized work/scale + recv/scale slots
         # (HBM workspaces ride as ANY outputs — Mosaic has no HBM scratch)
-        out_shape=[slab, slab, slab,
-                   qslab, qslab, sslab, sslab,
-                   qslab, qslab, sslab, sslab],
+        out_shape=[slab] + [slab] * d
+                  + [qslab] * d + [sslab] * d
+                  + [qslab] * d + [sslab] * d,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 11,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (1 + 5 * d),
         scratch_shapes=[
             pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((d,)),
+            pltpu.SemaphoreType.DMA((d,)),
             pltpu.SemaphoreType.REGULAR,
-            pltpu.SemaphoreType.DMA((2,)),   # scale rail
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((d,)),   # scale rail
+            pltpu.SemaphoreType.DMA((d,)),
         ],
         collective_id=collective_id,
         name=f"rs_ring_stream_{wire}w",
@@ -269,19 +334,22 @@ def _build_rs_stream_w(mesh, axis, rows, cols, dtype, stacked,
 
 
 @functools.lru_cache(maxsize=256)
-def _build_rs_stream(mesh, axis, rows, cols, dtype, stacked, collective_id, ikey):
+def _build_rs_stream(mesh, axis, rows, cols, dtype, stacked, collective_id,
+                     ikey, schedule=None):
     n = mesh.shape[axis]
+    d = 2 if schedule is None else int(schedule.depth)
     slab = jax.ShapeDtypeStruct((rows // n, cols), dtype)
+    kernel = _rs_stream_kernel if d == 2 else _rs_stream_kernel3
     call = lang.shmem_call(
-        functools.partial(_rs_stream_kernel, n, axis, mesh.axis_names),
+        functools.partial(kernel, n, axis, mesh.axis_names, schedule),
         # ring slabs ride as extra ANY outputs (Mosaic has no HBM scratch)
-        out_shape=[slab, slab, slab, slab, slab],
+        out_shape=[slab] * (1 + 2 * d),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 5,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (1 + 2 * d),
         scratch_shapes=[
             pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((d,)),
+            pltpu.SemaphoreType.DMA((d,)),
             pltpu.SemaphoreType.REGULAR,
         ],
         collective_id=collective_id,
@@ -352,7 +420,7 @@ def _resolve_rs_wire(wire_dtype, rows, cols, n, itemsize):
 
 def reduce_scatter(
     x, mesh, axis: str = "x", *, stacked: bool = False, collective_id: int = 3,
-    wire_dtype=None,
+    wire_dtype=None, schedule=None,
 ):
     """ReduceScatter: sums per-device (M, ...) contributions and scatters the
     row-shards along ``axis``.
@@ -373,6 +441,11 @@ def reduce_scatter(
     scales via the fused gemm_rs wire pipelines — round 8) and the XLA
     twin; only payloads too ragged to stream fall back to the bf16
     wire.
+
+    ``schedule``: an explicit :class:`~triton_distributed_tpu.tune.schedule.
+    RingSchedule` for the HBM-streaming engines (``None`` loads any
+    persisted searched winner, falling back to the canonical default).
+    The VMEM rings ignore it — they have no streaming schedule to vary.
 
     Host entry ≡ reference ``reduce_scatter_2d_op`` (reduce_scatter.py:863).
     """
@@ -398,6 +471,11 @@ def reduce_scatter(
     assert full_shape[0] % n == 0, f"dim0 {full_shape[0]} not divisible by {n}"
     local_shape = (full_shape[0] // n,) + tuple(full_shape[1:])
     wire = _resolve_rs_wire(wire_dtype, rows, cols, n, x.dtype.itemsize)
+    from triton_distributed_tpu.tune.schedule import resolve_schedule
+
+    sched = resolve_schedule(
+        "reduce_scatter.stream", (rows, cols), (n,), wire, schedule
+    )
     if wire == "fp8" and not wirelib.inkernel_wire_ok("fp8"):
         # the Pallas VMEM ring dequantizes in-kernel; this Mosaic lacks
         # the f8 casts — explicit fp8 raises, auto stays exact
@@ -426,7 +504,7 @@ def reduce_scatter(
             x2d = x.reshape(((n,) if stacked else ()) + (rows, cols))
             fn = _build_rs_stream_w(
                 mesh, axis, rows, cols, x.dtype, stacked, collective_id,
-                interp_key(), wire,
+                interp_key(), wire, sched,
             )
             return fn(x2d).reshape(full_shape)
         _warn_rs_wire_once()
@@ -437,7 +515,7 @@ def reduce_scatter(
         x2d = x.reshape(((n,) if stacked else ()) + (rows, cols))
         fn = _build_rs_stream(
             mesh, axis, rows, cols, x.dtype, stacked, collective_id,
-            interp_key(),
+            interp_key(), sched,
         )
         return fn(x2d).reshape(full_shape)
     fn = _build_reduce_scatter(

@@ -84,7 +84,11 @@ class RSWireRefs:
     rs: tuple            # their scale planes
     s_send_sem: object   # (2,) DMA sems, scale rail
     s_recv_sem: object
-    quantize: object     # callable(src_hbm, q_hbm, s_hbm) — lang.wire
+    #: callable(src_hbm, q_hbm, s_hbm) — lang.wire — or None when the
+    #: producer (``partial_into``) quantizes straight off its accumulator
+    #: epilogue into wq/ws (the gemm_rs int8-MXU producer): the ring
+    #: then ships those bytes without a separate read-back pass.
+    quantize: object
     dequant_add: object  # callable(a_hbm, q_hbm, s_hbm, dst_hbm)
 
 
@@ -110,7 +114,7 @@ class _DualDMA:
 
 def ag_forward_ring(
     n, axis, mesh_axes, local_hbm, ag_hbm, slab_rows, send_sem, recv_sem,
-    consume, *, site=None, wire: AGWireRefs | None = None,
+    consume, *, site=None, wire: AGWireRefs | None = None, schedule=None,
 ):
     """Run the AG forward ring; ``consume(s, src, a_hbm, a_row_off)``
     computes over shard ``src`` (rows ``[a_row_off, a_row_off+slab_rows)``
@@ -120,7 +124,20 @@ def ag_forward_ring(
     (n·slab_rows, ·) gathered workspace (slab ``me`` is NOT written by
     this harness — publish it yourself if the gathered result is part of
     your contract, cf. ag_gemm's ``return_gathered``).
+
+    ``schedule``: an optional ``tune.schedule.RingSchedule`` the harness
+    EXECUTES — traversal direction, hop set and scale-rail assignment
+    are schedule data, not code. ``None`` runs the canonical default
+    (forward ring, every hop, scale rail on its own semaphores), byte-
+    identical to the pre-schedule harness. Mutated schedules may be
+    deliberately illegal (a skipped hop, a scale rail on the payload's
+    semaphore): the harness executes what it is told and shmemlint is
+    the oracle that rejects the candidate (SL008/SL009).
     """
+    direction = "fwd" if schedule is None else schedule.direction
+    order = "ring" if schedule is None else schedule.chunk_order
+    rail = "own" if schedule is None else schedule.scale_rail
+
     if n == 1:
         # single-rank degenerate ring: no barrier (self-signal semantics
         # would otherwise be load-bearing — cf. reduce_ring's early
@@ -132,6 +149,16 @@ def ag_forward_ring(
     left, right = ring_neighbors(me, n)
     left = lang.pe_flat(axis, left, mesh_axes)
     right = lang.pe_flat(axis, right, mesh_axes)
+    # "rev" flips nothing about the protocol — chunks flow leftward and
+    # the consumed source walks (me+s) instead of (me-s)
+    to = right if direction == "fwd" else left
+
+    def src_at(s):
+        if s == 0:
+            return me
+        if direction == "fwd":
+            return jax.lax.rem(me + n - s, n)
+        return jax.lax.rem(me + s, n)
 
     lang.neighbor_barrier(axis, left, right, site=site, me=me, n=n)
 
@@ -145,10 +172,11 @@ def ag_forward_ring(
                 ag_hbm.at[pl.ds(src * slab_rows, slab_rows)],
                 send_sem.at[slot],
                 recv_sem.at[slot],
-                right,
+                to,
             )
     else:
         ch = wire.fmt.chunks(slab_rows)
+        s_recv = recv_sem if rail == "payload" else wire.s_recv_sem
 
         def fwd(src, slot, from_local):
             # two rails, one handle: the quantized payload slab and its
@@ -164,21 +192,25 @@ def ag_forward_ring(
                 lang.remote_copy(
                     q_src,
                     wire.agq.at[pl.ds(src * slab_rows, slab_rows)],
-                    send_sem.at[slot], recv_sem.at[slot], right,
+                    send_sem.at[slot], recv_sem.at[slot], to,
                 ),
                 lang.remote_copy(
                     s_src,
                     wire.ags.at[pl.ds(src * ch, ch)],
-                    wire.s_send_sem.at[slot], wire.s_recv_sem.at[slot],
-                    right,
+                    wire.s_send_sem.at[slot], s_recv.at[slot],
+                    to,
                 ),
             )
 
-    for s in range(n):
-        src = jax.lax.rem(me + n - s, n) if s > 0 else me
+    # the mutated "skip_last" order drops the final hop entirely —
+    # start, wait AND consume — so every semaphore still balances and
+    # only the delivery contract (SL008) can see the hole
+    last = n - 1 if order != "skip_last" else n - 2
+    for s in range(last + 1):
+        src = src_at(s)
         if s > 0:
             fwd(src, s - 1, s == 1).wait_recv()
-        if s < n - 1:
+        if s < last:
             chaos_delay(site=site, step=s, me=me, n=n)
             fwd(src, s, s == 0).start()
         if s == 0:
@@ -198,24 +230,37 @@ def ag_forward_ring(
                     ag_hbm.at[pl.ds(src * slab_rows, slab_rows)],
                 )
             consume(s, src, ag_hbm, src * slab_rows)
-    for s in range(n - 1):
-        src = jax.lax.rem(me + n - s, n) if s > 0 else me
+    for s in range(last):
+        src = src_at(s)
         fwd(src, s, s == 0).wait_send()
 
 
 def reduce_ring(
     n, axis, mesh_axes, out_hbm, work, recv, send_sem, recv_sem, ack_sem,
     partial_into, fold, *, site=None, wire: RSWireRefs | None = None,
+    schedule=None,
 ):
     """Run the compute-into-the-ring reduce.
 
     ``partial_into(dst, dst_ref)`` produces this device's contribution to
     destination shard ``dst`` — invoked between a ring DMA's start and
     its recv wait so the transfer hides under it. ``fold(a, b, dst_ref)``
-    writes ``a + b`` (streamed). ``work``/``recv``: pairs of
-    double-buffered HBM slabs. Destination order me+1…me is the
-    rank-swizzle of gemm_reduce_scatter.py:205-219.
+    writes ``a + b`` (streamed). ``work``/``recv``: ``depth``-buffered
+    HBM slab tuples. Destination order me+1…me is the rank-swizzle of
+    gemm_reduce_scatter.py:205-219.
+
+    ``schedule``: an optional ``tune.schedule.RingSchedule``; ``None``
+    runs the canonical default (depth 2, scale rail on its own
+    semaphores), byte-identical to the pre-schedule harness. The buffer
+    depth d generalizes the double-buffer protocol: slot ``s % d``, ack
+    credit waited from ``s >= d`` (the receiver must have folded the
+    slot before it is rewritten), in-loop send drain from ``s >= d-1``,
+    and ``min(d-1, n-1)`` sends / ``min(d, n-1)`` acks drained at exit.
     """
+    d = 2 if schedule is None else int(schedule.depth)
+    rail = "own" if schedule is None else schedule.scale_rail
+    assert len(work) >= d and len(recv) >= d, (len(work), len(recv), d)
+
     me = lang.my_pe(axis)
     left, right = ring_neighbors(me, n)
     left = lang.pe_flat(axis, left, mesh_axes)
@@ -232,6 +277,8 @@ def reduce_ring(
                 left,
             )
     else:
+        s_recv = recv_sem if rail == "payload" else wire.s_recv_sem
+
         def ring_dma(slot):
             return _DualDMA(
                 lang.remote_copy(
@@ -240,7 +287,7 @@ def reduce_ring(
                 ),
                 lang.remote_copy(
                     wire.ws[slot], wire.rs[slot],
-                    wire.s_send_sem.at[slot], wire.s_recv_sem.at[slot],
+                    wire.s_send_sem.at[slot], s_recv.at[slot],
                     left,
                 ),
             )
@@ -250,35 +297,41 @@ def reduce_ring(
     partial_into(jax.lax.rem(me + 1, n), work[0])
 
     for s in range(n - 1):
-        slot = s % 2
+        slot = s % d
+        nxt_slot = (s + 1) % d
         chaos_delay(site=site, step=s, me=me, n=n)
-        if s >= 2:
-            # left must have folded my slot (s-2) before I rewrite it
+        if s >= d:
+            # left must have folded my slot (s-d) before I rewrite it
             pltpu.semaphore_wait(ack_sem, 1)
-        if wire is not None:
-            # fresh partial → wire format; the wait_send at step s-1 (or
-            # the ack above) already freed wq/ws[slot] for rewriting
+        if wire is not None and wire.quantize is not None:
+            # fresh partial → wire format; the wait_send at step s-d+1
+            # (or the ack above) already freed wq/ws[slot] for rewriting.
+            # quantize=None = producer-quantized wire (gemm_rs int8-MXU):
+            # partial_into already wrote wq/ws straight off its
+            # accumulator epilogue, so the read-back pass is gone.
             wire.quantize(work[slot], wire.wq[slot], wire.ws[slot])
         dma = ring_dma(slot)
         dma.start()
         # produce my contribution to the next destination while the
         # accumulator is in flight
         nxt = jax.lax.rem(me + 2 + s, n)
-        if s >= 1:
-            ring_dma(1 - slot).wait_send()  # slot reusable
-        partial_into(nxt, work[1 - slot])
+        if s >= d - 1:
+            ring_dma(nxt_slot).wait_send()  # slot reusable
+        partial_into(nxt, work[nxt_slot])
         dma.wait_recv()
         # received: partial sum of shard (me+2+s) accumulated so far by
         # the ring to my right; fold in my own contribution.
-        dst = out_hbm if s == n - 2 else work[1 - slot]
+        dst = out_hbm if s == n - 2 else work[nxt_slot]
         if wire is None:
-            fold(work[1 - slot], recv[slot], dst)
+            fold(work[nxt_slot], recv[slot], dst)
         else:
             wire.dequant_add(
-                work[1 - slot], wire.rq[slot], wire.rs[slot], dst
+                work[nxt_slot], wire.rq[slot], wire.rs[slot], dst
             )
         lang.signal_op(ack_sem, 1, pe=right, site=site, me=me, n=n)
 
-    ring_dma((n - 2) % 2).wait_send()
-    # drain leftover acks: n-1 received, max(n-3, 0) consumed in-loop
-    pltpu.semaphore_wait(ack_sem, min(2, n - 1))
+    # drain the last min(d-1, n-1) sends the in-loop waits never reached
+    for i in range(min(d - 1, n - 1)):
+        ring_dma((n - 2 - i) % d).wait_send()
+    # drain leftover acks: n-1 received, max(n-1-d, 0) consumed in-loop
+    pltpu.semaphore_wait(ack_sem, min(d, n - 1))

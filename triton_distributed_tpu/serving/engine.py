@@ -838,32 +838,49 @@ class DisaggregatedEngine:
 
         import jax.numpy as jnp
 
+        # drain the whole ready cohort FIRST (reservations are cheap
+        # bookkeeping), then ship it as ONE gather + ONE transport
+        # flight: per-tick launch cost stops scaling with the number of
+        # simultaneously finishing prefills, and the DCN rail flies one
+        # big pair instead of a convoy of small ones
+        cohort = []
         while self._ready:
             req, pslot = self._ready[0]
             res = self.decode.reserve_shipped(req)
             if res is None:
-                return                     # decode backpressure; retry
+                break                      # decode backpressure; retry
             self._ready.popleft()
             dslot, dpids = res
-            t0 = _t.perf_counter()
             npg = self.prefill._pages_held(req.cursor)
-            pids = jnp.asarray(
-                self.prefill.table[pslot, :npg].astype(np.int32)
-            )
-            qpay, spay = self._gather_jit(
-                self.prefill.state.layers, pids
-            )
-            payload = self._run_transport(qpay, spay)
-            dt = _t.perf_counter() - t0
-            q_elems = int(np.prod(qpay.shape))
-            wire = q_elems * qpay.dtype.itemsize + (
-                int(np.prod(spay.shape)) * 4 if spay is not None else 0
-            )
-            raw = q_elems * max(2, qpay.dtype.itemsize)
+            cohort.append((req, pslot, dslot, dpids, npg))
+        if not cohort:
+            return
+        t0 = _t.perf_counter()
+        pids = jnp.asarray(np.concatenate([
+            self.prefill.table[pslot, :npg].astype(np.int32)
+            for _, pslot, _, _, npg in cohort
+        ]))
+        qpay, spay = self._gather_jit(self.prefill.state.layers, pids)
+        payload = self._run_transport(qpay, spay)
+        dt = _t.perf_counter() - t0
+        q_elems = int(np.prod(qpay.shape))
+        wire = q_elems * qpay.dtype.itemsize + (
+            int(np.prod(spay.shape)) * 4 if spay is not None else 0
+        )
+        raw = q_elems * max(2, qpay.dtype.itemsize)
+        # one ShipRecord per request (the scheduling unit: pins, slots
+        # and commit hooks stay per-request); bytes and launch time are
+        # attributed by page share, so stats.ships keeps meaning "one
+        # request's KV shipped"
+        total_pg = sum(npg for *_, npg in cohort)
+        for req, pslot, dslot, dpids, npg in cohort:
+            frac = npg / total_pg
             self._inflight.append(ShipRecord(
                 req=req, pslot=pslot, dslot=dslot, dpids=dpids,
                 payload=payload, issued_tick=self.ticks,
-                wire_bytes=wire, raw_bytes=raw, launch_ms=dt * 1e3,
+                wire_bytes=int(round(wire * frac)),
+                raw_bytes=int(round(raw * frac)),
+                launch_ms=dt * 1e3 * frac,
             ))
 
     def _run_transport(self, qpay, spay):
@@ -926,28 +943,56 @@ class DisaggregatedEngine:
             r for r in self._inflight
             if self.ticks - r.issued_tick >= self.ship_delay_steps
         ]
+        # a launch batch shares one transported payload (same tuple
+        # object on every record) and its records share issued_tick, so
+        # each group lands with ONE scatter over the concatenated
+        # landing pages — the commit-side mirror of the batched gather
+        groups: dict = {}
         for r in ready:
+            groups.setdefault(id(r.payload), []).append(r)
+        for rs in groups.values():
             t0 = _t.perf_counter()
-            qd, sd = r.payload
+            qd, sd = rs[0].payload
+            dpids = jnp.asarray(np.concatenate([
+                np.asarray(r.dpids, np.int32) for r in rs
+            ]))
             new_layers = self._scatter_jit(
-                self.decode.state.layers,
-                jnp.asarray(np.asarray(r.dpids, np.int32)), qd, sd,
+                self.decode.state.layers, dpids, qd, sd,
             )
             jax.block_until_ready(new_layers)          # the landing fence
             self.decode.state = self.decode.state.replace(
                 layers=new_layers
             )
-            # handoff order matters: the source frees its pinned pages
-            # first, THEN the row becomes schedulable
-            self.prefill.release_parked(r.pslot)
-            self.decode.commit_shipped(r.req)
-            self._inflight.remove(r)
-            self.stats.ships += 1
-            self.stats.shipped_wire_bytes += r.wire_bytes
-            self.stats.shipped_raw_bytes += r.raw_bytes
-            self.stats.ship_ms.append(
-                r.launch_ms + (_t.perf_counter() - t0) * 1e3
-            )
+            dt = (_t.perf_counter() - t0) * 1e3 / len(rs)
+            for r in rs:
+                # handoff order matters: the source frees its pinned
+                # pages first, THEN the row becomes schedulable
+                self.prefill.release_parked(r.pslot)
+                self.decode.commit_shipped(r.req)
+                self._warm_prefix_cache(r)
+                self._inflight.remove(r)
+                self.stats.ships += 1
+                self.stats.shipped_wire_bytes += r.wire_bytes
+                self.stats.shipped_raw_bytes += r.raw_bytes
+                self.stats.ship_ms.append(r.launch_ms + dt)
+
+    def _warm_prefix_cache(self, r: ShipRecord) -> None:
+        """Decode-slice prefix-cache warm-up: the shipped pages' content
+        is frozen (nothing on the decode side writes below the shipped
+        cursor), so each FULL landed page registers its prefix-chain
+        hash in the decode pool the moment it lands. A later request
+        sharing the prefix then attaches on the decode slice without
+        re-shipping — the pages are already home. Partial trailing
+        pages stay private (their content is still growing)."""
+        if not self.decode.pool.prefix_cache:
+            return
+        full = r.req.cursor // self.decode.cfg.page
+        full = min(full, len(r.dpids))
+        if full <= 0:
+            return
+        hashes = self.decode._page_hashes(r.req, full)
+        for p in range(full):
+            self.decode.pool.register(int(r.dpids[p]), hashes[p])
 
     # ------------------------------------------------------------- driving
 

@@ -379,3 +379,125 @@ def contextual_autotune(configs, **opts):
         return ContextualAutoTuner(fn, configs, **opts)
 
     return wrap
+
+
+def search_ring_schedule(
+    family: str,
+    *,
+    rows: int,
+    cols: int,
+    mesh_shape,
+    wire: str | None = None,
+    shape=None,
+    n: int | None = None,
+    itemsize: int = 4,
+    dryrun: bool = False,
+    top_k: int = 2,
+    time_fn=None,
+    force: bool = False,
+):
+    """Schedule-space search for one ring family (the tune.schedule IR).
+
+    enumerate (freedoms + illegal mutations) → LEGALITY GATE (every
+    candidate abstractly replayed through shmemlint against the family's
+    DeliveryContract, then Mosaic-preflighted; rejections carry rule
+    IDs) → perf-model pricing of the lint-clean survivors (hop critical
+    path + wire bytes + dequant placement) → optionally time the top-k
+    on hardware (``time_fn(schedule) -> ms``; skipped under ``dryrun``
+    or when no timer is supplied) → persist the winner keyed by
+    ``(family, shape, mesh, wire_dtype)``.
+
+    Reload is ZERO-COST: a persisted winner short-circuits the whole
+    search (``cached=True`` in the report) — op resolve paths never pay
+    for enumeration, and neither does a second search call.
+    Mutated candidates are rejected by the oracle, never timed, never
+    cached; the search fails loudly if the oracle rejected nothing
+    (a gate that cannot reject is not a gate).
+    """
+    from triton_distributed_tpu.tune import schedule as sched_lib
+
+    n = int(n if n is not None else int(np.prod(mesh_shape)))
+    shape = tuple(shape) if shape is not None else (rows, cols)
+
+    if not force:
+        cached = sched_lib.load_schedule(
+            family, tuple(int(x) for x in shape),
+            tuple(int(x) for x in mesh_shape),
+            None if wire is None else str(wire),
+        )
+        if cached is not None:
+            return {
+                "family": family, "cached": True,
+                "winner": cached.to_dict(),
+                "winner_ms": sched_lib.price_schedule(
+                    family, cached, rows=rows, cols=cols,
+                    itemsize=itemsize, n=n, wire=wire,
+                ),
+                "default_ms": sched_lib.price_schedule(
+                    family, sched_lib.DEFAULT, rows=rows, cols=cols,
+                    itemsize=itemsize, n=n, wire=wire,
+                ),
+                "rejected": [], "timed": 0, "candidates": 0,
+            }
+
+    legal, rejected = [], []
+    for cand in sched_lib.enumerate_schedules(family, include_mutations=True):
+        findings = sched_lib.check_schedule(family, cand, n)
+        if findings:
+            rejected.append(
+                (cand.to_dict(), sorted({f.rule for f in findings}))
+            )
+        else:
+            legal.append(cand)
+    if not legal:
+        raise RuntimeError(
+            f"schedule search {family!r}: no lint-clean candidate "
+            f"(rejections: {[r for _, r in rejected]})"
+        )
+    if not rejected:
+        raise RuntimeError(
+            f"schedule search {family!r}: the oracle rejected nothing — "
+            "the legality gate is not wired"
+        )
+
+    priced = sorted(
+        legal,
+        key=lambda s: sched_lib.price_schedule(
+            family, s, rows=rows, cols=cols, itemsize=itemsize, n=n,
+            wire=wire,
+        ),
+    )
+    timed = 0
+    winner = priced[0]
+    if time_fn is not None and not dryrun:
+        best_ms, best = float("inf"), None
+        for cand in priced[:max(1, int(top_k))]:
+            try:
+                ms = float(time_fn(cand))
+            except Exception:
+                traceback.print_exc()
+                continue
+            timed += 1
+            if ms < best_ms:
+                best_ms, best = ms, cand
+        if best is not None:
+            winner = best
+
+    default_ms = sched_lib.price_schedule(
+        family, sched_lib.DEFAULT, rows=rows, cols=cols,
+        itemsize=itemsize, n=n, wire=wire,
+    )
+    winner_ms = sched_lib.price_schedule(
+        family, winner, rows=rows, cols=cols, itemsize=itemsize, n=n,
+        wire=wire,
+    )
+    key = sched_lib.store_schedule(
+        family, shape, mesh_shape, wire, winner,
+        price_ms=winner_ms, default_ms=default_ms,
+    )
+    return {
+        "family": family, "cached": False, "key": key,
+        "winner": winner.to_dict(), "winner_ms": winner_ms,
+        "default_ms": default_ms, "rejected": rejected,
+        "timed": timed, "candidates": len(legal) + len(rejected),
+    }

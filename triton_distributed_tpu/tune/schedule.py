@@ -1,0 +1,526 @@
+"""Ring schedules as data — the schedule IR, its enumerator/mutator,
+the shmemlint legality oracle, and the persisted winner store.
+
+Every fused engine used to hand-pick exactly one ring schedule
+(``kernels/ring.py``: unidirectional forward/reduce rings, fixed chunk
+order, fixed double-buffer depth 2, one rail assignment). This module
+makes the choice a VALUE:
+
+* :class:`RingSchedule` — per-hop chunk order, traversal direction,
+  bidirectional split ratio, double-buffer depth, payload/scale rail
+  assignment and eager-vs-epilogue dequant placement. The rings in
+  ``kernels/ring.py`` (and the inline bidirectional AG) *execute* a
+  schedule; :data:`DEFAULT` reproduces today's behavior byte-
+  identically (test-pinned).
+* :func:`enumerate_schedules` / :func:`mutate` — the candidate
+  generator over each family's declared freedom set. Mutations include
+  deliberately ILLEGAL values (a skipped hop, a scale rail on the
+  payload's semaphore): the generator proposes, the oracle disposes.
+* :func:`check_schedule` — the legality gate: every candidate is built
+  through the real kernel builder over an abstract mesh, abstractly
+  replayed through shmemlint (SL001–SL011 against the family's declared
+  ``DeliveryContract``) and Mosaic-preflighted (MC001–MC005). A
+  candidate may be timed or cached ONLY with zero findings; rejections
+  carry their rule IDs.
+* :func:`store_schedule` / :func:`load_schedule` — the flock'd winner
+  store keyed by ``(family, shape, mesh, wire_dtype)``. Resolve paths
+  load with zero search cost; only the autotuner search mode
+  (``tune.autotuner.search_ring_schedule``) ever writes.
+
+No devices are required anywhere here: the gate runs on an
+``AbstractMesh`` exactly like ``analysis.lint``.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import json
+import os
+import time
+from dataclasses import asdict, dataclass, replace
+
+import numpy as np
+
+_F32 = np.dtype(np.float32)
+_I8 = np.dtype(np.int8)
+
+#: schema version of the persisted schedule store
+_STORE_VERSION = 1
+
+#: fields a schedule serializes (stable order for the store)
+_FIELDS = ("chunk_order", "direction", "split8", "depth", "scale_rail",
+           "dequant")
+
+
+@dataclass(frozen=True)
+class RingSchedule:
+    """One executable ring schedule.
+
+    ``chunk_order``
+        ``"ring"`` — every hop of the standard ring traversal;
+        ``"skip_last"`` — the final hop dropped entirely (start, wait
+        AND consume), a protocol-clean mutation only the delivery
+        contract can reject (SL008).
+    ``direction``
+        ``"fwd"`` (chunks flow to the right neighbor) or ``"rev"``
+        (leftward; the consumed source walks ``me+s`` instead of
+        ``me-s``) — both legal, identical on the perf model.
+    ``split8``
+        Bidirectional-AG column split in eighths: the clockwise ring
+        carries ``split8/8`` of the columns, the counter-clockwise ring
+        the rest. 4 is today's even ``k // 2``.
+    ``depth``
+        Reduce-ring buffer depth (work/recv slab count and DMA-semaphore
+        lanes). 2 is today's double buffer; 3 adds one in-flight hop of
+        slack against a slow folder.
+    ``scale_rail``
+        ``"own"`` — the quantized wire's scale planes ride their own
+        DMA semaphores (legal); ``"payload"`` — scales signal the
+        payload's recv semaphore, so a payload wait can be released by
+        a scale arrival while the 1-byte slab is still in flight.
+        Credits balance; SL009 is the only thing that can see it.
+    ``dequant``
+        ``"eager"`` — each wire arrival is dequantized into the bf16
+        workspace before the MXU consumes it; ``"epilogue"`` — the MXU
+        consumes the quantized slab directly and folds the scale in its
+        accumulator epilogue (legal only for int8 wires with an
+        s8×s8-capable consumer; resolve maps it to the ``int8-mxu``
+        kernel twin).
+    """
+
+    chunk_order: str = "ring"
+    direction: str = "fwd"
+    split8: int = 4
+    depth: int = 2
+    scale_rail: str = "own"
+    dequant: str = "eager"
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RingSchedule":
+        return cls(**{k: d[k] for k in _FIELDS if k in d})
+
+    def is_default(self) -> bool:
+        return self == DEFAULT
+
+
+#: the canonical default — byte-identical to the pre-schedule rings
+DEFAULT = RingSchedule()
+
+
+# ------------------------------------------------------------ freedom sets
+#
+# What each searchable family may vary. Values outside these sets are
+# MUTATIONS — enumerable on request so the oracle has something to
+# reject, never timed, never cached.
+
+_FREEDOMS: dict = {
+    "ag_gemm.fused": dict(
+        direction=("fwd", "rev"),
+        dequant=("eager", "epilogue"),
+    ),
+    "gemm_rs.fused": dict(
+        scale_rail=("own",),          # rail is load-bearing; depth pinned
+    ),
+    "allgather.ring_1d": dict(
+        direction=("fwd", "rev"),
+    ),
+    "allgather.ring_bidir": dict(
+        split8=(2, 3, 4, 5, 6),
+    ),
+    "reduce_scatter.stream": dict(
+        depth=(2, 3),
+    ),
+}
+
+#: one-field illegal mutations per family — the oracle's test diet
+_MUTATIONS: dict = {
+    "ag_gemm.fused": (dict(chunk_order="skip_last"),
+                      dict(scale_rail="payload")),
+    "gemm_rs.fused": (dict(scale_rail="payload"),),
+    "allgather.ring_1d": (dict(chunk_order="skip_last"),
+                          dict(scale_rail="payload")),
+    "allgather.ring_bidir": (),
+    "reduce_scatter.stream": (dict(scale_rail="payload"),),
+}
+
+
+def searchable_families() -> tuple:
+    return tuple(sorted(_FREEDOMS))
+
+
+def enumerate_schedules(family: str, *, include_mutations: bool = False):
+    """All candidate schedules in ``family``'s freedom set (the default
+    always first), optionally extended with the family's deliberately
+    illegal one-field mutations."""
+    free = _FREEDOMS[family]
+    keys = sorted(free)
+    seen, out = set(), []
+    for combo in itertools.product(*(free[k] for k in keys)):
+        s = replace(DEFAULT, **dict(zip(keys, combo)))
+        if s not in seen:
+            seen.add(s)
+            out.append(s)
+    out.sort(key=lambda s: not s.is_default())   # default first
+    if include_mutations:
+        for m in _MUTATIONS[family]:
+            s = replace(DEFAULT, **m)
+            if s not in seen:
+                seen.add(s)
+                out.append(s)
+    return out
+
+
+def mutate(schedule: RingSchedule, family: str):
+    """The family's illegal one-field mutations of ``schedule`` — what
+    the search feeds the oracle to prove the gate is alive."""
+    return [replace(schedule, **m) for m in _MUTATIONS[family]]
+
+
+# ------------------------------------------------------------ legality gate
+#
+# Each searchable family maps to a gate builder: construct the REAL
+# kernel (over an AbstractMesh, nothing executes) with the candidate
+# schedule threaded through, read the captured LaunchSpec back, and
+# replay it through shmemlint + the Mosaic pre-flight.
+
+_TOKENS = itertools.count()
+
+
+def _gate_ag_gemm(schedule, n, mesh):
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.ag_gemm import _build_fused
+
+    import jax.numpy as jnp
+
+    if schedule.dequant == "epilogue":
+        wire, launch = "int8-mxu", "ag_gemm_fused_int8mxw"
+        shapes = [((16, 128), _I8), ((1, 128), _F32),
+                  ((128, 64), _I8), ((1, 64), _F32)]
+        contract = DeliveryContract(kind="gather", dst="agq_hbm",
+                                    own_absent_ok=True)
+    else:
+        # int8 eager wire: portable across Mosaic versions (fp8 in-kernel
+        # casts trip MC001 on toolchains without f8 extensions — the gate
+        # must test the schedule, not the toolchain)
+        wire, launch = "int8", "ag_gemm_fused_int8w"
+        shapes = [((16, 128), _F32), ((16, 128), _I8),
+                  ((1, 128), _F32), ((128, 64), _F32)]
+        contract = DeliveryContract(kind="gather", dst="ag_hbm")
+    _build_fused(
+        mesh, "x", (), (16 * n, 128), (128, 64 * n),
+        jnp.dtype(jnp.float32), jnp.dtype(jnp.float32), 5,
+        ("schedule-gate", next(_TOKENS)), return_gathered=True, wire=wire,
+        schedule=schedule,
+    )
+    return launch, (lambda _n: shapes), contract, "ag_gemm"
+
+
+def _gate_gemm_rs(schedule, n, mesh):
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.gemm_rs import _build_fused
+
+    import jax.numpy as jnp
+
+    _build_fused(
+        mesh, "x", (), (16 * n, 128 * n), (128 * n, 64),
+        jnp.dtype(jnp.float32), jnp.dtype(jnp.float32), 6,
+        ("schedule-gate", next(_TOKENS)), wire="int8", schedule=schedule,
+    )
+    shapes = [((16 * n, 128), _F32), ((128, 64), _F32)]
+    return ("gemm_rs_fused_int8w", (lambda _n: shapes),
+            DeliveryContract(kind="reduce", dst="out_hbm"), "gemm_rs")
+
+
+def _gate_ag_ring(schedule, n, mesh):
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.allgather import _build_all_gather
+    from triton_distributed_tpu.runtime import AllGatherMethod
+
+    import jax.numpy as jnp
+
+    _build_all_gather(
+        mesh, "x", AllGatherMethod.RING_1D, (8 * n, 2048),
+        jnp.dtype(jnp.float32), 2, ("schedule-gate", next(_TOKENS)),
+        wire="int8", schedule=schedule,
+    )
+    shapes = [((8, 2048), _F32), ((8, 2048), _I8), ((8, 128), _F32)]
+    return ("ag_ring_1d_int8w", (lambda _n: shapes),
+            DeliveryContract(kind="gather", dst="out_ref"), "allgather")
+
+
+def _gate_ag_bidir(schedule, n, mesh):
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.allgather import _build_all_gather
+    from triton_distributed_tpu.runtime import AllGatherMethod
+
+    import jax.numpy as jnp
+
+    _build_all_gather(
+        mesh, "x", AllGatherMethod.RING_BIDIR, (8 * n, 1024),
+        jnp.dtype(jnp.float32), 2, ("schedule-gate", next(_TOKENS)),
+        schedule=schedule,
+    )
+    shapes = [((8, 1024), _F32)]
+    return ("ag_ring_bidir", (lambda _n: shapes),
+            DeliveryContract(kind="gather", dst="out_ref"), "allgather")
+
+
+def _gate_rs_stream(schedule, n, mesh):
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.reduce_scatter import (
+        _build_rs_stream_w,
+    )
+
+    import jax.numpy as jnp
+
+    _build_rs_stream_w(
+        mesh, "x", 8 * n, 2048, jnp.dtype(jnp.float32), False, 3,
+        ("schedule-gate", next(_TOKENS)), "int8", schedule=schedule,
+    )
+    shapes = [((8 * n, 2048), _F32)]
+    return ("rs_ring_stream_int8w", (lambda _n: shapes),
+            DeliveryContract(kind="reduce", dst="out_hbm"), "reduce_scatter")
+
+
+_GATES: dict = {
+    "ag_gemm.fused": _gate_ag_gemm,
+    "gemm_rs.fused": _gate_gemm_rs,
+    "allgather.ring_1d": _gate_ag_ring,
+    "allgather.ring_bidir": _gate_ag_bidir,
+    "reduce_scatter.stream": _gate_rs_stream,
+}
+
+
+def check_schedule(family: str, schedule: RingSchedule, n: int = 8,
+                   *, mosaic: bool = True):
+    """The oracle: build ``family`` with ``schedule`` over an abstract
+    ``n``-rank mesh, replay through shmemlint against the family's
+    delivery contract, and (when the protocol is clean) Mosaic-preflight
+    the trace. Returns the finding list — empty means the candidate may
+    be timed/cached; otherwise ``findings[i].rule`` names why not."""
+    from triton_distributed_tpu.analysis import lint, mosaic_compat
+    from triton_distributed_tpu.analysis.findings import has_errors
+    from triton_distributed_tpu.lang.launch import captured_launch
+
+    mesh = lint.lint_mesh(n)
+    launch, in_shapes, contract, site = _GATES[family](schedule, n, mesh)
+    spec = captured_launch(launch)
+    if spec is None:
+        raise RuntimeError(
+            f"schedule gate for {family!r}: builder did not construct a "
+            f"shmem_call named {launch!r}"
+        )
+    name = f"{family}[{schedule.to_dict()}]"
+    _, findings = lint.analyze_spec(
+        spec, in_shapes(n), n, kernel_name=name, site=site,
+        contract=contract,
+    )
+    if mosaic and not has_errors(findings):
+        findings = findings + mosaic_compat.preflight_spec(
+            spec, in_shapes(n), n, kernel_name=name, site=site,
+        )
+    return findings
+
+
+# ------------------------------------------------------------ perf pricing
+
+def price_schedule(family: str, schedule: RingSchedule, *, rows: int,
+                   cols: int, itemsize: int = 4, n: int = 8,
+                   wire: str | None = None, spec=None) -> float:
+    """Perf-model price (ms) of running ``family`` under ``schedule`` on
+    an (rows, cols) per-rank ring slab: the hop-critical-path wire term
+    plus the dequant-placement term. Legality is NOT checked here — the
+    search gates first, prices second."""
+    from triton_distributed_tpu.tune import perf_model as pm
+
+    spec = spec or pm.detect_spec()
+    hops = n - 1
+    if family == "allgather.ring_bidir":
+        # each direction carries its column share the full n-1 hops; the
+        # critical path is the heavier direction
+        frac = max(schedule.split8, 8 - schedule.split8) / 8.0
+        hop_bytes = int(rows * cols * itemsize * frac)
+        return pm.hop_critical_path_ms(hops, hop_bytes, spec)
+    hop_bytes = pm.ring_wire_bytes(rows, cols, itemsize, wire)
+    ms = pm.hop_critical_path_ms(hops, hop_bytes, spec)
+    if wire not in (None, "bf16") and schedule.dequant == "eager":
+        # one dequant pass per arrival rides the critical path unless
+        # the epilogue consumer folds the scale off the accumulator
+        ms += hops * pm.dequant_pass_ms(rows, cols, 2, spec)
+    return ms
+
+
+# ------------------------------------------------------------ winner store
+#
+# Same discipline as the autotuner cache: flock'd read-modify-write,
+# atomic replace, validated on load. Keys are
+# repr((family, shape, mesh, wire_dtype)).
+
+def _store_path() -> str:
+    import pathlib
+
+    # beside the autotuner cache: same env knob, same default dir
+    d = pathlib.Path(
+        os.environ.get("TDTPU_AUTOTUNE_LOG_DIR", ".autotune_logs")
+    )
+    d.mkdir(parents=True, exist_ok=True)
+    return str(d / "schedules.json")
+
+
+def schedule_key(family: str, shape, mesh_shape, wire_dtype) -> str:
+    return repr((
+        str(family),
+        tuple(int(x) for x in shape),
+        tuple(int(x) for x in mesh_shape),
+        None if wire_dtype is None else str(wire_dtype),
+    ))
+
+
+def _read_store(path: str) -> dict:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(data, dict) or data.get("v") != _STORE_VERSION:
+        return {}
+    entries = data.get("entries")
+    return entries if isinstance(entries, dict) else {}
+
+
+def store_schedule(family: str, shape, mesh_shape, wire_dtype,
+                   schedule: RingSchedule, *, price_ms: float | None = None,
+                   default_ms: float | None = None) -> str:
+    """Persist a searched winner; returns the store key."""
+    import fcntl
+
+    key = schedule_key(family, shape, mesh_shape, wire_dtype)
+    path = _store_path()
+    lock = path + ".lock"
+    with open(lock, "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        entries = _read_store(path)
+        entries[key] = {
+            "family": family,
+            "schedule": schedule.to_dict(),
+            "price_ms": price_ms,
+            "default_ms": default_ms,
+            "ts": time.time(),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"v": _STORE_VERSION, "entries": entries}, f,
+                      indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    load_schedule.cache_clear()
+    return key
+
+
+def _load_entry(key: str) -> dict | None:
+    entry = _read_store(_store_path()).get(key)
+    if not isinstance(entry, dict):
+        return None
+    sched = entry.get("schedule")
+    if not isinstance(sched, dict):
+        return None
+    try:
+        RingSchedule.from_dict(sched)
+    except TypeError:
+        return None
+    return entry
+
+
+def stored_entries() -> dict:
+    """Snapshot of the persisted store (key → entry) — bench --lint
+    walks this to re-gate every cached schedule."""
+    return _read_store(_store_path())
+
+
+@functools.lru_cache(maxsize=256)
+def load_schedule(family: str, shape, mesh_shape,
+                  wire_dtype) -> RingSchedule | None:
+    """The zero-search-cost resolve hook: the persisted winner for this
+    ``(family, shape, mesh, wire_dtype)``, or None. Cached per process —
+    the second build never touches the disk either."""
+    entry = _load_entry(schedule_key(family, shape, mesh_shape, wire_dtype))
+    if entry is None or entry.get("family") != family:
+        return None
+    return RingSchedule.from_dict(entry["schedule"])
+
+
+def resolve_schedule(family: str, shape, mesh_shape, wire_dtype,
+                     explicit: RingSchedule | None = None):
+    """What an op entry should run: the caller's explicit schedule if
+    given, else the persisted searched winner, else None (the canonical
+    default paths, bit-for-bit today's rings)."""
+    if explicit is not None:
+        return explicit
+    try:
+        return load_schedule(
+            family,
+            tuple(int(x) for x in shape),
+            tuple(int(x) for x in mesh_shape),
+            None if wire_dtype is None else str(wire_dtype),
+        )
+    except Exception:
+        return None
+
+
+# ------------------------------------------------------------- CI smoke
+
+def search_smoke(family: str = "ag_gemm.fused", n: int = 8) -> dict:
+    """The bounded enumerate → lint-reject → pick loop ci/fast.sh runs:
+    every legal candidate gates clean, every mutation is rejected with a
+    stable rule ID, and the pick is the cheapest legal candidate."""
+    legal, rejected = [], []
+    for s in enumerate_schedules(family, include_mutations=True):
+        findings = check_schedule(family, s, n)
+        if findings:
+            rejected.append((s, sorted({f.rule for f in findings})))
+        else:
+            legal.append(s)
+    priced = sorted(
+        legal,
+        key=lambda s: price_schedule(family, s, rows=128, cols=2048,
+                                     n=n, wire="int8"),
+    )
+    return {
+        "family": family,
+        "legal": len(legal),
+        "rejected": [(s.to_dict(), rules) for s, rules in rejected],
+        "pick": priced[0].to_dict() if priced else None,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m triton_distributed_tpu.tune.schedule",
+        description="schedule-space smoke: enumerate ring schedules, "
+        "reject illegal mutations through shmemlint, pick the cheapest "
+        "legal candidate",
+    )
+    ap.add_argument("--family", default="ag_gemm.fused",
+                    choices=sorted(_GATES))
+    ap.add_argument("--mesh", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    out = search_smoke(args.family, args.mesh)
+    print(json.dumps(out))
+    if not out["rejected"]:
+        print("schedule smoke: no mutation was rejected — the oracle "
+              "is not gating", flush=True)
+        return 2
+    if out["pick"] is None:
+        print("schedule smoke: no legal candidate survived", flush=True)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
