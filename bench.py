@@ -315,11 +315,28 @@ def _run_lint() -> None:
             file=sys.stderr, flush=True,
         )
 
-    errs = sum(f.severity >= Severity.ERROR for f in findings)
+    # degradation-target gate: every registered family must declare a
+    # resolvable XLA twin to fall onto (the health ledger's demotion
+    # needs somewhere to go — an undeclared target is the silent-gap
+    # class docs/ROBUSTNESS.md's matrix documents)
+    from triton_distributed_tpu.kernels.registry import (
+        missing_degradation_targets,
+    )
+
+    gaps = missing_degradation_targets()
+    for fam, problem in gaps:
+        print(
+            json.dumps({"lint_degradation_gap":
+                        {"family": fam, "problem": problem}}),
+            file=sys.stderr, flush=True,
+        )
+
+    errs = sum(f.severity >= Severity.ERROR for f in findings) + len(gaps)
     print(
         json.dumps({"metric": "shmemlint", "errors": errs,
                     "findings": len(findings),
                     "rule_counts": rule_counts(findings),
+                    "degradation_gaps": len(gaps),
                     "mosaic_scanned": len(report["scanned"]),
                     "mosaic_refused": len(report["refused"])}),
         file=sys.stderr, flush=True,
@@ -1553,14 +1570,47 @@ def _bench_serving_disaggregated(mesh, n, on_tpu, spec, tiny=False):
     def fresh_trace():
         return poisson_trace(seed=11, **trace_kw)
 
+    import os as _os
+
+    from triton_distributed_tpu.runtime import faults as _rt_faults
+    from triton_distributed_tpu.runtime import watchdog as _rt_watchdog
+
+    wd_trips = []
+
+    def _guarded(run_fn):
+        """Under --faults, arm the collective watchdog around the run
+        (the serving_step / kv_ship host heartbeats are live) so a
+        stalled ship or step TRIPS — the trip feeds the health ledger
+        and releases the stall gates — instead of wedging the bench.
+        Trips are reported, not fatal: the run's recovery behavior is
+        the thing under test."""
+        if _rt_faults.active_plan() is None:
+            return run_fn()
+        # generous default: the first guarded run pays jit compiles,
+        # which can take seconds on the dev box — only a real stall
+        # (or a wedged slice) should out-wait this
+        deadline = float(_os.environ.get("TDTPU_BENCH_WATCHDOG", "10.0"))
+        box = {}
+        try:
+            with _rt_watchdog.collective_watchdog(deadline=deadline):
+                box["stats"] = run_fn()
+        except _rt_watchdog.WatchdogTimeout as e:
+            wd_trips.append(str(e).splitlines()[0])
+        finally:
+            _rt_watchdog.clear_trip()
+        return box.get("stats")
+
     # ---- colocated baseline on the SAME n/2-chip slice (run twice;
-    # the first run pays the compiles)
+    # the first run pays the compiles). Under a SliceDeath fault plan
+    # this engine is untouched (no slice roles), so its token streams
+    # stay the fault-free reference the failover must reproduce.
     for _warm in (False, True):
         trace_c = fresh_trace()
         eng_c = ServingEngine(model_p, params_p, ecfg)
-        stats_c = eng_c.run(trace_c)
-    assert stats_c.completed == trace_kw["n_requests"], (
-        stats_c.completed, stats_c.deferrals)
+        stats_c = _guarded(lambda: eng_c.run(trace_c))
+    assert stats_c is not None and (
+        stats_c.completed == trace_kw["n_requests"]
+    ), (stats_c and stats_c.completed, wd_trips)
 
     # ---- disaggregated, KV on the quantized DCN wire
     for _warm in (False, True):
@@ -1570,9 +1620,11 @@ def _bench_serving_disaggregated(mesh, n, on_tpu, spec, tiny=False):
             hybrid_mesh=hybrid, dcn_axis="dcn", transport="dcn",
             ship_delay_steps=1,
         )
-        stats = eng.run(trace_d)
-    assert stats.completed == trace_kw["n_requests"], (
-        stats.completed, len(eng._ready), len(eng._inflight))
+        stats = _guarded(lambda: eng.run(trace_d))
+    assert stats is not None and (
+        stats.completed == trace_kw["n_requests"]
+    ), (stats and stats.completed, len(eng._ready), len(eng._inflight),
+        wd_trips)
     # token-exactness across topologies (int8 KV pages shipped
     # verbatim + request-keyed sampling): the split changes WHERE work
     # runs, never what it computes
@@ -1626,6 +1678,20 @@ def _bench_serving_disaggregated(mesh, n, on_tpu, spec, tiny=False):
         "shipped_wire_bytes": stats.shipped_wire_bytes,
         "wire_compression_vs_raw": round(stats.wire_compression, 3),
         "degraded_transport": stats.degraded_transport,
+        "final_transport": eng.transport,
+        "ship_retries": stats.ship_retries,
+        "transport_repromotions": stats.transport_repromotions,
+        "kernel_repromotions": (
+            stats.prefill.repromotions + stats.decode.repromotions
+        ),
+        # failover outcome (ISSUE 10): under a SliceDeath plan the
+        # colocated run above is the fault-free token reference, so
+        # token_mismatches_vs_colocated == 0 IS the token-exactness
+        # acceptance; lost_requests must be 0
+        "failover": stats.failover,
+        "lost_requests": trace_kw["n_requests"] - stats.completed,
+        "watchdog_trips": wd_trips,
+        "health": eng.health.snapshot(),
         "token_mismatches_vs_colocated": mismatches,
         "prefill_evictions": stats.prefill.evictions,
         "decode_evictions": stats.decode.evictions,

@@ -9,13 +9,15 @@
 # lang-layer slices, the tools, the continuous-batching serving suite
 # (the ragged-kernel numerics + scheduler tests,
 # tests/test_ragged_attention.py + tests/test_serving_engine.py with
-# the prefix-cache/sampling satellites) and the disaggregated
+# the prefix-cache/sampling satellites), the disaggregated
 # prefill/decode transport suite (tests/test_kv_ship.py: wire-layout
-# round trips, ship/eviction race pins, 2-role token-exactness) —
-# everything that answers "did I just break a protocol, a contract,
-# or the host plumbing?" without paying for the big interpreted model
-# suites. Use it as the inner-loop gate; the full tier-1 run remains
-# the merge gate.
+# round trips, ship/eviction race pins, 2-role token-exactness) and
+# the health/failover suite (tests/test_health.py: ledger state
+# machine + determinism, mesh shrink, slice-death failover
+# token-exactness, probation re-promotion) — everything that answers
+# "did I just break a protocol, a contract, or the host plumbing?"
+# without paying for the big interpreted model suites. Use it as the
+# inner-loop gate; the full tier-1 run remains the merge gate.
 #
 #   ci/fast.sh              # the subset
 #   ci/fast.sh -x -k wire   # extra pytest args pass through
@@ -31,3 +33,17 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'fast and not slow' \
 # IDs) AND produced a lint-clean pick. Exits 2 if the gate is unwired.
 JAX_PLATFORMS=cpu python -m triton_distributed_tpu.tune.schedule \
   --family ag_gemm.fused --mesh 8
+
+# Degradation-target gate (the `bench.py --lint` check, standalone):
+# every registered kernel family must name a degradation target that
+# resolves to a real callable — a family without a declared fallback
+# is a robustness hole, not a style nit.
+JAX_PLATFORMS=cpu python - <<'EOF'
+from triton_distributed_tpu.kernels.registry import (
+    missing_degradation_targets,
+)
+
+gaps = missing_degradation_targets()
+assert not gaps, f"families without a resolvable degradation target: {gaps}"
+print(f"degradation targets: all families declare a resolvable fallback")
+EOF

@@ -122,6 +122,81 @@ class KernelFamily:
     axis: str = "x"
     mesh_axes: tuple = ("x",)
     contract: object = None
+    # dotted path of the XLA twin this family degrades onto (the
+    # with_fallback / health-probation target). Filled from
+    # DEGRADATION_TARGETS in families(); a registered family without
+    # one is a silent-gap lint error (bench.py --lint).
+    degrades_to: str | None = None
+
+
+#: family name → dotted path of its declared degradation target. Every
+#: registered family MUST appear here (or set degrades_to directly):
+#: ``bench.py --lint`` fails on a family whose degraded path is
+#: undeclared or unresolvable — the silent-gap class where a fused
+#: engine has no tested place to fall when the health ledger demotes it.
+DEGRADATION_TARGETS = {
+    "allgather.ring_1d": "jax.lax.all_gather",
+    "allgather.ring_bidir": "jax.lax.all_gather",
+    "allgather.ll_small": "jax.lax.all_gather",
+    "allgather.ll_persist": "jax.lax.all_gather",
+    "allgather.ring_1d_fp8w": "jax.lax.all_gather",
+    "reduce_scatter.ring": "jax.lax.psum_scatter",
+    "reduce_scatter.stream": "jax.lax.psum_scatter",
+    "reduce_scatter.ring_fp8w": "jax.lax.psum_scatter",
+    "reduce_scatter.stream_int8w": "jax.lax.psum_scatter",
+    "all_to_all.dense": "jax.lax.all_to_all",
+    "ag_gemm.fused": "triton_distributed_tpu.tools.native.xla_ag_gemm",
+    "ag_gemm.fused_fp8w": "triton_distributed_tpu.tools.native.xla_ag_gemm",
+    "ag_gemm.fused_int8mxw":
+        "triton_distributed_tpu.tools.native.xla_ag_gemm",
+    "gemm_rs.fused": "triton_distributed_tpu.tools.native.xla_gemm_rs",
+    "gemm_rs.fused_fp8w": "triton_distributed_tpu.tools.native.xla_gemm_rs",
+    "moe_tp.ag_group_gemm":
+        "triton_distributed_tpu.kernels.group_gemm.grouped_matmul_xla",
+    "moe_tp.ag_group_gemm_fp8w":
+        "triton_distributed_tpu.kernels.group_gemm.grouped_matmul_xla",
+    "moe_tp.ag_group_gemm_int8mxw":
+        "triton_distributed_tpu.kernels.group_gemm.grouped_matmul_xla",
+    "moe_tp.reduce_rs":
+        "triton_distributed_tpu.kernels.group_gemm.grouped_matmul_xla",
+    "moe_tp.reduce_rs_fp8w":
+        "triton_distributed_tpu.kernels.group_gemm.grouped_matmul_xla",
+    "flash_decode.ragged_paged":
+        "triton_distributed_tpu.kernels.ragged_paged_attention."
+        "ragged_paged_attention_xla",
+    "kv_ship.pages": "triton_distributed_tpu.tools.native.xla_kv_ship",
+    "moe_dispatch.a2a": "jax.lax.all_to_all",
+    "moe_combine.a2a": "jax.lax.all_to_all",
+}
+
+
+def resolve_degradation_target(path: str):
+    """Import the object behind a DEGRADATION_TARGETS dotted path (or
+    raise) — the lint gate's existence proof that the declared fallback
+    is real, not a typo."""
+    import importlib
+
+    mod_name, _, attr = path.rpartition(".")
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, attr)
+
+
+def missing_degradation_targets() -> tuple:
+    """(family, problem) pairs for every registered family whose
+    degradation target is undeclared or fails to import. Empty means
+    the bidirectional degradation matrix (docs/ROBUSTNESS.md) has no
+    silent gaps; ``bench.py --lint`` and ci/fast.sh enforce empty."""
+    out = []
+    for name, fam in families().items():
+        if not fam.degrades_to:
+            out.append((name, "no declared degradation target"))
+            continue
+        try:
+            resolve_degradation_target(fam.degrades_to)
+        except Exception as e:  # noqa: BLE001 — report, don't crash lint
+            out.append(
+                (name, f"target {fam.degrades_to!r} unresolvable: {e}"))
+    return tuple(out)
 
 
 _F32 = np.dtype(np.float32)
@@ -734,4 +809,12 @@ def families() -> dict:
             contract=moe_contract,
         ),
     ]
-    return {f.name: f for f in fams}
+    from dataclasses import replace as _replace
+
+    return {
+        f.name: (
+            f if f.degrades_to
+            else _replace(f, degrades_to=DEGRADATION_TARGETS.get(f.name))
+        )
+        for f in fams
+    }

@@ -143,7 +143,7 @@ def vmem_specs(n: int):
     return [pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(n)]
 
 
-def maybe_instrument(call, *, axis, site, collective_id, n):
+def maybe_instrument(call, *, axis, site, collective_id, n, step=None):
     """Wrap a per-device collective callable (used inside shard_map) with
     the robustness host hooks — the ``shmem_call`` side of the collective
     watchdog (:mod:`triton_distributed_tpu.runtime.watchdog`):
@@ -155,6 +155,13 @@ def maybe_instrument(call, *, axis, site, collective_id, n):
     * an EXIT heartbeat data-tied to the kernel's outputs, so the
       watchdog can tell *which ranks* are still inside a wedged launch.
 
+    ``axis=None`` selects HOST mode for plain-Python call sites with no
+    mapped axis (the serving step jit, the kv_ship transports): the
+    heartbeats run synchronously around ``call`` on the calling thread as
+    rank 0, with ``step`` forwarded so step-bound (transient) stalls can
+    match. Host mode re-evaluates arming per call, so it never caches a
+    wrapped/unwrapped decision.
+
     Returns ``call`` untouched when neither a watchdog is armed nor the
     active fault plan stalls this site — the wrapped/unwrapped decision
     is part of the trace-cache key (``config.interp_key`` folds in
@@ -163,9 +170,24 @@ def maybe_instrument(call, *, axis, site, collective_id, n):
     from triton_distributed_tpu.runtime import faults, watchdog
 
     plan = faults.active_plan()
-    stalls = plan is not None and plan.stalled_ranks(site)
+    stalls = plan is not None and plan.stalled_ranks(site, step)
     if not (watchdog.armed() or stalls):
         return call
+
+    if axis is None:
+        def host_body(*args, **kwargs):
+            wd = watchdog.current()
+            if wd is not None:
+                wd.on_enter(site, collective_id, n, 0, step=step)
+            else:
+                faults.stall_wait(site, 0, step)
+            try:
+                return call(*args, **kwargs)
+            finally:
+                if wd is not None:
+                    wd.on_exit(site, collective_id, n, 0)
+
+        return host_body
 
     import jax.numpy as jnp
     from jax.experimental import io_callback

@@ -829,6 +829,12 @@ def _transport_degrade_reason(ctx: EPMoEContext) -> str | None:
         )
     if watchdog.last_trip() is not None:
         return "collective watchdog tripped on a prior step"
+    from triton_distributed_tpu.runtime import health
+
+    for ledger in health.live_ledgers():
+        bad = ledger.unhealthy_peers()
+        if bad:
+            return f"health ledger marks peer(s) {bad} unhealthy"
     return None
 
 

@@ -322,6 +322,16 @@ def preflight(ctx: OverlapContext, engine: str, a, b) -> str | None:
         )
     if watchdog.last_trip() is not None:
         return "collective watchdog tripped on a prior step"
+    from triton_distributed_tpu.runtime import health
+
+    for ledger in health.live_ledgers():
+        bad = ledger.unhealthy_peers()
+        if bad:
+            return (
+                f"health ledger marks peer(s) {bad} unhealthy — "
+                f"re-plan the mesh (topology.replan_mesh) or wait out "
+                f"probation"
+            )
     dp = mesh_axes_size(ctx.mesh, tuple(ctx.batch_axes))
     if engine == "ag_gemm":
         from triton_distributed_tpu.kernels.ag_gemm import auto_ag_gemm_method

@@ -17,17 +17,32 @@ from triton_distributed_tpu.runtime.faults import (
     Delay,
     FaultPlan,
     SignalFault,
+    SliceDeath,
     Stall,
     fault_plan,
     parse_plan,
     set_fault_plan,
 )
+from triton_distributed_tpu.runtime.health import (
+    HealthLedger,
+    HealthSignal,
+    PeerState,
+    broadcast_signal,
+    get_ledger,
+    reset_ledger,
+    set_ledger,
+)
 from triton_distributed_tpu.runtime.watchdog import (
+    TripSummary,
     WatchdogTimeout,
     collective_watchdog,
+    merge_trip_summaries,
+    report_merged_trip,
+    trip_summary,
 )
 from triton_distributed_tpu.runtime.multislice import (
     create_hybrid_mesh,
+    exchange_trip_summaries,
     is_dcn_axis,
     num_slices,
 )
@@ -40,11 +55,13 @@ from triton_distributed_tpu.runtime.shardguard import (
 from triton_distributed_tpu.runtime.topology import (
     AllGatherMethod,
     LinkKind,
+    MeshReplan,
     TopologyInfo,
     auto_allgather_method,
     detect_topology,
     flat_device_id,
     mesh_axes_size,
+    replan_mesh,
     ring_neighbors,
 )
 
@@ -76,6 +93,21 @@ __all__ = [
     "Corrupt",
     "fault_plan",
     "set_fault_plan",
+    "SliceDeath",
     "collective_watchdog",
     "WatchdogTimeout",
+    "TripSummary",
+    "trip_summary",
+    "merge_trip_summaries",
+    "report_merged_trip",
+    "exchange_trip_summaries",
+    "HealthLedger",
+    "HealthSignal",
+    "PeerState",
+    "broadcast_signal",
+    "get_ledger",
+    "set_ledger",
+    "reset_ledger",
+    "MeshReplan",
+    "replan_mesh",
 ]

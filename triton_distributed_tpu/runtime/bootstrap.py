@@ -173,6 +173,16 @@ def _initialize_with_retry(
                 attempt + 1, retries, coord, e, delay,
             )
             sleep(delay)
+    try:
+        from triton_distributed_tpu.runtime import health
+
+        health.broadcast_signal(
+            "bootstrap_exhausted", f"host:{pid}",
+            detail=f"rendezvous with {coord!r} failed after {retries} "
+                   f"attempt(s): {last}",
+        )
+    except Exception:           # the ledger must not mask the real error
+        logger.exception("bootstrap: health broadcast failed")
     raise RuntimeError(
         f"jax.distributed.initialize failed after {retries} attempt(s) "
         f"rendezvousing with coordinator {coord!r} "

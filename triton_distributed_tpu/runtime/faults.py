@@ -61,9 +61,14 @@ from dataclasses import dataclass, field
 logger = logging.getLogger(__name__)
 
 #: Site names used by the kernel hook points. "*" in a fault matches any.
+#: The last three are HOST-level sites (serving-engine instrumentation,
+#: ``lang.maybe_instrument(axis=None)``): the ragged serving kernel's
+#: chaos hook, the jitted serving step, and the disaggregated KV-ship
+#: transport.
 SITES = (
     "allgather", "reduce_scatter", "all_to_all", "ag_gemm", "gemm_rs",
     "moe_dispatch", "flash_decode",
+    "ragged_paged", "serving_step", "kv_ship",
 )
 
 
@@ -88,10 +93,18 @@ class Delay:
 class Stall:
     """Single-peer stall: ``rank`` blocks on a host gate at entry of the
     matching collective until released (watchdog trip / deactivation /
-    ``TDTPU_STALL_TIMEOUT``)."""
+    ``TDTPU_STALL_TIMEOUT``).
+
+    ``step`` of None stalls every entry (the kernel-side gates carry no
+    step context, so only step-less stalls reach them). A step-bound
+    stall is TRANSIENT: it only fires at host instruments that pass
+    their step/tick (the serving engines' ``serving_step``/``kv_ship``
+    sites) and only at that step — the "stalled ship that recovers"
+    the probation machinery exists to re-promote after."""
 
     site: str = "*"
     rank: int = 0
+    step: int | None = None
 
 
 @dataclass(frozen=True)
@@ -116,7 +129,20 @@ class Corrupt:
     value: float = 1.0e9
 
 
-_FAULT_TYPES = (Delay, Stall, SignalFault, Corrupt)
+@dataclass(frozen=True)
+class SliceDeath:
+    """Kill a whole serving slice at a tick: from ``step`` on, the
+    :class:`~triton_distributed_tpu.serving.engine.DisaggregatedEngine`
+    treats the role living on hybrid-mesh DCN index ``slice`` (0 =
+    prefill, 1 = decode) as dead — a fatal ``slice_death`` health
+    signal plus the failover re-queue of everything the slice held.
+    No kernel hook consumes it; it is an ENGINE-level fault."""
+
+    slice: int = 1
+    step: int = 0
+
+
+_FAULT_TYPES = (Delay, Stall, SignalFault, Corrupt, SliceDeath)
 
 
 @dataclass(frozen=True)
@@ -206,10 +232,28 @@ class FaultPlan:
                 return f.word, f.value
         return None
 
-    def stalled_ranks(self, site: str | None) -> tuple:
+    def stalled_ranks(self, site: str | None, step: int | None = None
+                      ) -> tuple:
+        """Ranks stalled at (site, step). Kernel gates call with
+        ``step=None`` and see only step-less stalls (they have no step
+        context to match a transient stall against); host instruments
+        pass their engine step/tick and additionally pick up the
+        step-bound ones."""
+        out = set()
+        for f in self.faults:
+            if not isinstance(f, Stall) or not self._site_match(f.site, site):
+                continue
+            if f.step is None or (step is not None and f.step == step):
+                out.add(f.rank)
+        return tuple(sorted(out))
+
+    def dead_slices(self, step: int | None = None) -> tuple:
+        """Slice indices dead at ``step`` (every :class:`SliceDeath`
+        whose death step has arrived; all of them when step is None)."""
         return tuple(sorted({
-            f.rank for f in self.faults
-            if isinstance(f, Stall) and self._site_match(f.site, site)
+            f.slice for f in self.faults
+            if isinstance(f, SliceDeath)
+            and (step is None or f.step <= step)
         }))
 
     def schedule(self, site: str, n: int, steps: int) -> tuple:
@@ -230,8 +274,9 @@ class FaultPlan:
             c = self.corruption(site, r)
             if c is not None:
                 entries.append(("corrupt", r, None, c))
-        for r in self.stalled_ranks(site):
-            entries.append(("stall", r, None, None))
+        for f in self.faults:
+            if isinstance(f, Stall) and self._site_match(f.site, site):
+                entries.append(("stall", f.rank, f.step, None))
         return tuple(entries)
 
     def key(self) -> tuple:
@@ -382,17 +427,25 @@ def held_stalls() -> int:
         return _HELD
 
 
-def stall_wait(site: str, rank: int) -> None:
+def stall_wait(site: str, rank: int, step: int | None = None) -> None:
     """Host-side stall gate, called from the collective-entry heartbeat
     (runs on an io_callback worker thread, NOT the main thread). Blocks
     iff the active plan stalls ``rank`` at ``site`` — unless the plan's
     ``max_concurrent_stalls`` gates are already held, in which case the
     stall is SKIPPED (logged): a parked gate costs a worker thread, and
     exhausting the pool wedges the interpreter itself (ROADMAP: big
-    stall matrices on 2-vCPU CI runners)."""
+    stall matrices on 2-vCPU CI runners). Once an armed watchdog has
+    tripped, further stalls are skipped too: the trip already released
+    the gates, and re-parking after it would wedge the recovery path on
+    the ``TDTPU_STALL_TIMEOUT`` backstop."""
     global _HELD
     plan = _ACTIVE
-    if plan is None or rank not in plan.stalled_ranks(site):
+    if plan is None or rank not in plan.stalled_ranks(site, step):
+        return
+    from triton_distributed_tpu.runtime import watchdog
+
+    wd = watchdog.current()
+    if wd is not None and wd.trip_report is not None:
         return
     cap = plan.max_concurrent_stalls
     with _GATES_LOCK:

@@ -1,0 +1,395 @@
+"""Peer-health ledger: every failure signal the stack emits, one state
+machine, three layers of action.
+
+PR-1 built the *diagnosis* half of robustness: the fault-plan chaos
+engine injects, the watchdog names the wedged rank, the degradation
+layer demotes fused engines to their XLA twins. But the verdicts were
+disconnected one-way latches — ``FaultPlan.unhealthy_peers`` had to be
+hand-declared, ``stats.degraded`` never un-set, and a slice death
+stranded whatever it was holding. The :class:`HealthLedger` closes the
+loop: it AGGREGATES the signals the stack already produces —
+
+* watchdog trip reports (per-rank enter/exit heartbeats,
+  :mod:`triton_distributed_tpu.runtime.watchdog` — the monitor thread
+  calls :func:`notify_trip` at trip time, before it releases the stall
+  gates, so a caller blocked on a gated transport observes the verdict
+  the moment it unblocks);
+* bootstrap retry exhaustion (:mod:`runtime.bootstrap` broadcasts a
+  ``bootstrap_exhausted`` signal before raising);
+* transport/kernel exceptions from the serving engines
+  (``DisaggregatedEngine._run_transport``, ``ServingEngine`` device
+  failures);
+* chaos-injected signals (``SliceDeath`` replay, tests);
+
+— into a per-peer state machine::
+
+      healthy ──failure──▶ suspect ──2nd failure──▶ unhealthy
+         ▲                    │                        │
+         │              clean×suspect_clears     clean×probation_after
+         │                    ▼                        ▼
+         └────────────────(healthy)      probation ──probe ok×promote_after──▶ healthy
+                                              │
+                                          probe fail ──▶ unhealthy
+
+FATAL signals (:data:`FATAL_KINDS`: a slice death, a watchdog trip, a
+kernel exception, rendezvous exhaustion) jump straight to ``unhealthy``;
+soft signals (a transport error that retries absorbed) walk through
+``suspect``. Probes are SEEDED and deterministic: :meth:`probe_due`
+fires on a crc32-phased step schedule, so two replays of the same trace
+probe at the same ticks — the property the determinism test asserts.
+
+Peer keys: collective ranks are plain ``int``s (these feed
+``FaultPlan.unhealthy_peers`` via :meth:`to_fault_plan` and the mesh
+shrink via :func:`runtime.topology.replan_mesh`); slices are
+``"slice:<k>"``; engine-level sites are ``"site:<name>"``
+(``site:kv_ship`` = the DCN ship wire, ``site:serving_step`` = the
+serving kernel path).
+
+Ledger instances register in a module-level weak set so out-of-band
+reporters (the watchdog monitor thread, bootstrap) can
+:func:`broadcast_signal` without plumbing a handle through every layer;
+:func:`get_ledger` lazily owns a process-default instance for code with
+no engine in scope.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import re
+import threading
+import weakref
+import zlib
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+
+class PeerState(enum.Enum):
+    HEALTHY = "healthy"
+    SUSPECT = "suspect"
+    UNHEALTHY = "unhealthy"
+    PROBATION = "probation"
+
+
+#: signal kinds that jump a peer straight to UNHEALTHY — verdicts, not
+#: hints: a tripped watchdog already waited out a full deadline, a slice
+#: death and a rendezvous exhaustion are not ambiguous, and a kernel
+#: exception means the device path is broken NOW (the engine re-runs the
+#: batch on its XLA twin either way; probation decides when to re-trust).
+FATAL_KINDS = frozenset({
+    "slice_death", "watchdog_trip", "bootstrap_exhausted", "kernel_error",
+})
+
+
+@dataclass(frozen=True)
+class HealthSignal:
+    """One recorded failure signal (the ledger keeps a bounded tail per
+    peer for the snapshot/report path)."""
+
+    kind: str
+    peer: object
+    step: int | None = None
+    detail: str = ""
+
+
+@dataclass
+class _PeerHealth:
+    state: PeerState = PeerState.HEALTHY
+    strikes: int = 0        # lifetime-ish failure count; reset on promotion
+    cleans: int = 0         # consecutive clean observations in this state
+    probes_ok: int = 0      # consecutive successful probes in probation
+    signals: list = field(default_factory=list)
+
+
+class HealthLedger:
+    """The per-peer / per-slice health state machine (module docstring).
+
+    All thresholds are constructor knobs so tests can tighten them;
+    defaults are tuned for serving traces (a probe every ~4 engine
+    steps, two clean probes to re-trust a wire).
+
+    Thread-safe: the watchdog monitor thread records concurrently with
+    the engine's host loop.
+    """
+
+    def __init__(self, seed: int = 0, *, suspect_clears: int = 2,
+                 unhealthy_after: int = 2, probation_after: int = 3,
+                 promote_after: int = 2, probe_interval: int = 4,
+                 max_signals: int = 256):
+        self.seed = int(seed)
+        self.suspect_clears = int(suspect_clears)
+        self.unhealthy_after = int(unhealthy_after)
+        self.probation_after = int(probation_after)
+        self.promote_after = int(promote_after)
+        self.probe_interval = max(int(probe_interval), 1)
+        self.max_signals = int(max_signals)
+        self._peers: dict = {}
+        self._lock = threading.RLock()
+        _LEDGERS.add(self)
+
+    # -- determinism core ---------------------------------------------------
+
+    def uniform(self, *key) -> float:
+        """Deterministic uniform in [0, 1) from (seed, *key) — the
+        fault engine's crc32 trick (stable across processes, unlike
+        ``hash``). Shared by the probe schedule and the ship-retry
+        backoff jitter."""
+        h = zlib.crc32(repr((self.seed,) + key).encode())
+        return h / 2.0 ** 32
+
+    def _phase(self, peer) -> int:
+        return int(self.uniform("probe_phase", peer) * self.probe_interval)
+
+    # -- signal ingestion ---------------------------------------------------
+
+    def _entry(self, peer) -> _PeerHealth:
+        p = self._peers.get(peer)
+        if p is None:
+            p = self._peers[peer] = _PeerHealth()
+        return p
+
+    def record(self, kind: str, peer, step: int | None = None,
+               detail: str = "", fatal: bool | None = None) -> PeerState:
+        """Ingest one failure signal for ``peer``; returns its new
+        state. ``fatal`` overrides the :data:`FATAL_KINDS` default."""
+        fatal = (kind in FATAL_KINDS) if fatal is None else bool(fatal)
+        with self._lock:
+            p = self._entry(peer)
+            p.signals.append(HealthSignal(kind, peer, step, detail[:500]))
+            del p.signals[:-self.max_signals]
+            p.cleans = 0
+            p.probes_ok = 0
+            p.strikes += 1
+            old = p.state
+            if fatal or p.strikes >= self.unhealthy_after \
+                    or p.state is PeerState.PROBATION:
+                p.state = PeerState.UNHEALTHY
+            elif p.state is PeerState.HEALTHY:
+                p.state = PeerState.SUSPECT
+            if p.state is not old:
+                logger.warning(
+                    "health: peer %r %s -> %s on %s%s", peer, old.value,
+                    p.state.value, kind,
+                    f" (step {step})" if step is not None else "",
+                )
+            return p.state
+
+    def observe_clean(self, peer, step: int | None = None) -> PeerState:
+        """Ingest one clean observation (a successful step/ship on the
+        degraded path). SUSPECT clears back to HEALTHY after
+        ``suspect_clears``; UNHEALTHY earns PROBATION after
+        ``probation_after``; PROBATION promotes only through probes."""
+        with self._lock:
+            p = self._peers.get(peer)
+            if p is None or p.state is PeerState.HEALTHY:
+                return PeerState.HEALTHY
+            p.cleans += 1
+            if p.state is PeerState.SUSPECT \
+                    and p.cleans >= self.suspect_clears:
+                p.state = PeerState.HEALTHY
+                p.cleans = 0
+            elif p.state is PeerState.UNHEALTHY \
+                    and p.cleans >= self.probation_after:
+                p.state = PeerState.PROBATION
+                p.cleans = 0
+                p.probes_ok = 0
+            return p.state
+
+    # -- probes -------------------------------------------------------------
+
+    def probe_due(self, peer, step) -> bool:
+        """Should ``step`` run a seeded probe of ``peer``'s fused/wire
+        path? True only in PROBATION, on a deterministic schedule: every
+        ``probe_interval`` steps at a crc32 phase of (seed, peer) — two
+        replays of the same trace probe at the same steps."""
+        with self._lock:
+            p = self._peers.get(peer)
+            if p is None or p.state is not PeerState.PROBATION:
+                return False
+        return (int(step) + self._phase(peer)) % self.probe_interval == 0
+
+    def probe_result(self, peer, ok: bool, step: int | None = None
+                     ) -> PeerState:
+        """Outcome of a probe step: ``promote_after`` consecutive clean
+        probes re-promote to HEALTHY (strikes forgiven); one failed
+        probe falls back to UNHEALTHY."""
+        with self._lock:
+            p = self._entry(peer)
+            if not ok:
+                p.signals.append(
+                    HealthSignal("probe_failed", peer, step)
+                )
+                del p.signals[:-self.max_signals]
+                p.state = PeerState.UNHEALTHY
+                p.cleans = 0
+                p.probes_ok = 0
+                return p.state
+            if p.state is not PeerState.PROBATION:
+                return p.state
+            p.probes_ok += 1
+            if p.probes_ok >= self.promote_after:
+                p.state = PeerState.HEALTHY
+                p.strikes = 0
+                p.cleans = 0
+                p.probes_ok = 0
+                logger.info("health: peer %r re-promoted to healthy "
+                            "after %d clean probe(s)", peer,
+                            self.promote_after)
+            return p.state
+
+    # -- queries ------------------------------------------------------------
+
+    def state(self, peer) -> PeerState:
+        with self._lock:
+            p = self._peers.get(peer)
+            return PeerState.HEALTHY if p is None else p.state
+
+    def peers(self) -> dict:
+        with self._lock:
+            return {k: v.state for k, v in self._peers.items()}
+
+    def unhealthy_peers(self) -> tuple:
+        """UNHEALTHY collective ranks (int peer keys), sorted — the
+        tuple :meth:`to_fault_plan` feeds into
+        ``FaultPlan.unhealthy_peers`` automatically."""
+        with self._lock:
+            return tuple(sorted(
+                k for k, v in self._peers.items()
+                if isinstance(k, int) and v.state is PeerState.UNHEALTHY
+            ))
+
+    def unhealthy_slices(self) -> tuple:
+        """UNHEALTHY slice indices (``"slice:<k>"`` peer keys), sorted."""
+        with self._lock:
+            out = []
+            for k, v in self._peers.items():
+                if (isinstance(k, str) and k.startswith("slice:")
+                        and v.state is PeerState.UNHEALTHY):
+                    out.append(int(k.split(":", 1)[1]))
+            return tuple(sorted(out))
+
+    def snapshot(self) -> dict:
+        """Reporting view: peer -> {state, strikes, last signal kind}."""
+        with self._lock:
+            return {
+                str(k): {
+                    "state": v.state.value,
+                    "strikes": v.strikes,
+                    "signals": len(v.signals),
+                    "last": v.signals[-1].kind if v.signals else None,
+                }
+                for k, v in self._peers.items()
+            }
+
+    def to_fault_plan(self, base=None):
+        """A :class:`~triton_distributed_tpu.runtime.faults.FaultPlan`
+        with ``unhealthy_peers`` filled from the ledger (merged with
+        ``base``'s, faults preserved) — the hand-declared field, now
+        automatic."""
+        from dataclasses import replace
+
+        from triton_distributed_tpu.runtime.faults import FaultPlan
+
+        base = base if base is not None else FaultPlan(seed=self.seed)
+        merged = tuple(sorted(
+            set(base.unhealthy_peers) | set(self.unhealthy_peers())
+        ))
+        return replace(base, unhealthy_peers=merged)
+
+    # -- watchdog trip ingestion -------------------------------------------
+
+    _RE_SITE = re.compile(
+        r"deadline [\d.]+s exceeded for '([^']+)' \(collective_id=.*?"
+        r"n=(\d+)", re.S)
+    _RE_MISSING_EXIT = re.compile(r"ranks exited\s*:\s*\[[^\]]*\]\s*"
+                                  r"\(missing \[([^\]]*)\]\)")
+    _RE_GATED = re.compile(r"stalled at fault-plan entry gate: rank "
+                           r"\[([^\]]*)\]")
+
+    def ingest_trip_report(self, report: str) -> None:
+        """Parse a watchdog trip report (``_Record.describe`` text —
+        possibly several blocks) into ledger signals: the tripped SITE
+        becomes an unhealthy ``site:<name>`` peer, and on multi-rank
+        collectives every rank that never exited (or sat on a stall
+        gate) is recorded as an unhealthy int rank. Single-participant
+        host instruments (n=1: the serving step, a kv_ship transport)
+        only mark the site — their "rank 0" is the host, not a mesh
+        peer."""
+        blocks = report.split("collective watchdog: ")
+        for block in blocks:
+            m = self._RE_SITE.search(block)
+            if m is None:
+                continue
+            site, n = m.group(1), int(m.group(2))
+            self.record("watchdog_trip", f"site:{site}",
+                        detail=block[:500])
+            if n <= 1:
+                continue
+            ranks: set = set()
+            for rx in (self._RE_MISSING_EXIT, self._RE_GATED):
+                mm = rx.search(block)
+                if mm and mm.group(1).strip():
+                    ranks.update(
+                        int(x) for x in mm.group(1).split(",")
+                        if x.strip()
+                    )
+            for r in sorted(ranks):
+                self.record("watchdog_trip", r, detail=f"site {site}")
+
+
+# ---------------------------------------------------------- module registry
+
+_LEDGERS: "weakref.WeakSet[HealthLedger]" = weakref.WeakSet()
+_DEFAULT: HealthLedger | None = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_ledger() -> HealthLedger:
+    """The process-default ledger (lazily created) — for reporters with
+    no engine in scope (bootstrap, tools)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = HealthLedger()
+        return _DEFAULT
+
+
+def set_ledger(ledger: HealthLedger | None) -> None:
+    """Replace (or, with None, drop) the process-default ledger."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        _DEFAULT = ledger
+
+
+def reset_ledger() -> HealthLedger:
+    """Fresh process-default ledger (test isolation)."""
+    set_ledger(None)
+    return get_ledger()
+
+
+def live_ledgers() -> tuple:
+    return tuple(_LEDGERS)
+
+
+def broadcast_signal(kind: str, peer, step: int | None = None,
+                     detail: str = "", fatal: bool | None = None) -> None:
+    """Record a signal into EVERY live ledger — the out-of-band
+    reporters' entry point (watchdog monitor thread, bootstrap,
+    multi-slice merge): they cannot know which engine's ledger cares,
+    and a ledger that never hears about its own peers is no ledger."""
+    for led in live_ledgers():
+        try:
+            led.record(kind, peer, step=step, detail=detail, fatal=fatal)
+        except Exception:
+            logger.exception("health: broadcast to %r failed", led)
+
+
+def notify_trip(report: str) -> None:
+    """Watchdog trip hook: fan a trip report out to every live ledger
+    (called from the monitor thread BEFORE stall gates release)."""
+    for led in live_ledgers():
+        try:
+            led.ingest_trip_report(report)
+        except Exception:
+            logger.exception("health: trip ingestion into %r failed", led)

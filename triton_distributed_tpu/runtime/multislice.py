@@ -238,3 +238,41 @@ def dcn_wire_reduce_scatter(part, dcn_axis: str, nd: int, fmt):
 
     acc = stripe(jax.lax.rem(me + 1, nd))
     return jax.lax.fori_loop(0, nd - 1, step, acc)
+
+
+# ------------------------------------------------ trip-summary exchange
+
+def exchange_trip_summaries(summary, *, max_bytes: int = 4096):
+    """All-gather per-slice watchdog :class:`TripSummary` objects over
+    the DCN *host* channel, so every slice can run the same
+    ``watchdog.merge_trip_summaries`` and agree on which slice wedged.
+
+    The exchange is a fixed-width uint8 row per process (length-prefixed
+    JSON, padded to ``max_bytes``) through
+    ``multihost_utils.process_allgather`` — a host collective, usable
+    exactly when the device fabric may be wedged is NOT guaranteed, but
+    the coordinator-backed host channel usually survives a device hang.
+    Single-process (CPU sim / one slice): the identity, ``[summary]``.
+    """
+    from triton_distributed_tpu.runtime.watchdog import TripSummary
+
+    if jax.process_count() <= 1:
+        return [summary]
+
+    from jax.experimental import multihost_utils
+
+    blob = summary.to_json().encode()
+    if len(blob) + 4 > max_bytes:
+        raise ValueError(
+            f"trip summary ({len(blob)}B) exceeds max_bytes={max_bytes}")
+    row = np.zeros(max_bytes, dtype=np.uint8)
+    row[:4] = np.frombuffer(
+        np.uint32(len(blob)).tobytes(), dtype=np.uint8)
+    row[4:4 + len(blob)] = np.frombuffer(blob, dtype=np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(row))
+    gathered = gathered.reshape(-1, max_bytes)
+    out = []
+    for r in gathered:
+        ln = int(np.frombuffer(r[:4].tobytes(), dtype=np.uint32)[0])
+        out.append(TripSummary.from_json(r[4:4 + ln].tobytes().decode()))
+    return out

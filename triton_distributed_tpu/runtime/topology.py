@@ -153,3 +153,96 @@ def device_coords(mesh: Mesh) -> np.ndarray | None:
     if devs[0].platform != "tpu" or getattr(devs[0], "coords", None) is None:
         return None
     return np.array([d.coords for d in devs])
+
+
+# --------------------------------------------------------------- mesh shrink
+
+@dataclass(frozen=True)
+class MeshReplan:
+    """Result of :func:`replan_mesh`: the surviving mesh plus the fault
+    plan that routes collectives around the removed peers.
+
+    * ``mesh`` — the shrunk Mesh (collectives/engines re-built on it see
+      only survivors);
+    * ``survivors`` — flat indices into the ORIGINAL mesh's raveled
+      device array for each surviving position (old-rank bookkeeping:
+      workspace slices, KV pages, sharded params indexed by old rank);
+    * ``removed_ranks`` / ``removed_slices`` — what the ledger condemned;
+    * ``plan`` — a FaultPlan whose ``unhealthy_peers`` carries the
+      removed OLD ranks, for code still running on the original mesh
+      (``ops.overlap.preflight`` / ``ops.moe`` refuse those paths).
+    """
+
+    mesh: Mesh
+    survivors: tuple
+    removed_ranks: tuple
+    removed_slices: tuple
+    plan: object
+
+
+def replan_mesh(mesh: Mesh, ledger, *, dcn_axis: str | None = None,
+                base_plan=None) -> MeshReplan:
+    """Shrink ``mesh`` to its healthy peers per ``ledger`` (a
+    :class:`~triton_distributed_tpu.runtime.health.HealthLedger`) and
+    derive the matching fault plan — the ledger's signal aggregation
+    turned into an actionable n−1 (or surviving-slice) mesh.
+
+    Two removal granularities, composable:
+
+    * slice-level: ``ledger.unhealthy_slices()`` removes whole rows
+      along ``dcn_axis`` (default: the axis literally named "dcn", as
+      built by ``multislice.hybrid_mesh``);
+    * rank-level: integer peers in ``ledger.unhealthy_peers()`` are flat
+      indices into the (slice-pruned) device array. Rank removal keeps
+      a mesh reshapeable only in 1-D — for multi-axis meshes a bad rank
+      must be covered by its slice's removal, else we raise rather than
+      silently deliver a ragged mesh.
+    """
+    devices = np.asarray(mesh.devices)
+    axis_names = tuple(mesh.axis_names)
+    flat_ids = np.arange(devices.size).reshape(devices.shape)
+
+    bad_slices = tuple(ledger.unhealthy_slices())
+    if bad_slices:
+        if dcn_axis is None:
+            dcn_axis = "dcn" if "dcn" in axis_names else axis_names[0]
+        ax = axis_names.index(dcn_axis)
+        keep = [i for i in range(devices.shape[ax]) if i not in bad_slices]
+        if not keep:
+            raise ValueError(
+                f"replan_mesh: every slice along {dcn_axis!r} is "
+                f"unhealthy ({bad_slices}) — nothing survives")
+        # deleting the KEPT positions leaves exactly the condemned rows
+        removed_flat = np.delete(flat_ids, keep, axis=ax).ravel()
+        devices = np.take(devices, keep, axis=ax)
+        flat_ids = np.take(flat_ids, keep, axis=ax)
+    else:
+        removed_flat = np.array([], dtype=int)
+
+    bad_ranks = tuple(ledger.unhealthy_peers())
+    covered = set(int(r) for r in removed_flat)
+    pending = [r for r in bad_ranks if r not in covered]
+    if pending:
+        if devices.ndim != 1:
+            raise ValueError(
+                f"replan_mesh: rank-level removal of {pending} needs a "
+                f"1-D mesh (got shape {devices.shape}); condemn the "
+                f"containing slice instead")
+        mask = ~np.isin(flat_ids, pending)
+        if not mask.any():
+            raise ValueError(
+                f"replan_mesh: all ranks unhealthy ({bad_ranks}) — "
+                f"nothing survives")
+        devices = devices[mask]
+        flat_ids = flat_ids[mask]
+
+    new_mesh = Mesh(devices, axis_names)
+    plan = ledger.to_fault_plan(base_plan)
+    removed = tuple(sorted(set(map(int, removed_flat)) | set(bad_ranks)))
+    return MeshReplan(
+        mesh=new_mesh,
+        survivors=tuple(int(i) for i in flat_ids.ravel()),
+        removed_ranks=removed,
+        removed_slices=bad_slices,
+        plan=plan,
+    )

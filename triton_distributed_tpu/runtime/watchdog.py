@@ -109,13 +109,14 @@ class CollectiveWatchdog:
         self.deadline = float(deadline)
         self.poll = float(poll)
         self.trip_report: str | None = None
+        self.tripped_records: list[_Record] = []
         self._records: list[_Record] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     # -- heartbeats (io_callback worker threads) ---------------------------
-    def on_enter(self, site, collective_id, n, me) -> None:
+    def on_enter(self, site, collective_id, n, me, step=None) -> None:
         me = int(me)
         with self._lock:
             rec = self._open_record(site, collective_id, n, me)
@@ -123,10 +124,10 @@ class CollectiveWatchdog:
         from triton_distributed_tpu.runtime import faults
 
         plan = faults.active_plan()
-        if plan is not None and me in plan.stalled_ranks(site):
+        if plan is not None and me in plan.stalled_ranks(site, step):
             with self._lock:
                 rec.gated.add(me)
-            faults.stall_wait(site, me)
+            faults.stall_wait(site, me, step)
             with self._lock:
                 rec.gated.discard(me)
 
@@ -169,7 +170,14 @@ class CollectiveWatchdog:
                     continue
                 report = "\n".join(r.describe(self.deadline) for r in expired)
                 self.trip_report = report
+                self.tripped_records = list(expired)
             logger.error("%s", report)
+            try:
+                from triton_distributed_tpu.runtime import health
+
+                health.notify_trip(report)
+            except Exception:   # the ledger must never block the unwedge
+                logger.exception("watchdog: health notification failed")
             from triton_distributed_tpu.runtime import faults
 
             # unwedge what we own: plan-injected stalls are host gates
@@ -222,6 +230,131 @@ def last_trip() -> str | None:
 def clear_trip() -> None:
     global _LAST_TRIP
     _LAST_TRIP = None
+
+
+# -- multi-slice trip aggregation -------------------------------------------
+# Per-slice watchdogs see only their own heartbeats; a cross-slice hang
+# trips on EVERY slice that was waiting. Each slice condenses its trip
+# into a TripSummary, the summaries are exchanged over the DCN host
+# channel (multislice.exchange_trip_summaries), and the merge names the
+# actually-wedged slice: the one whose own ranks never exited (or sit on
+# a stall gate), as opposed to slices that merely timed out waiting.
+
+@dataclass(frozen=True)
+class TripSummary:
+    """One slice's condensed view of a watchdog trip (JSON-portable)."""
+
+    slice_index: int
+    site: str | None = None
+    collective_id: str | None = None
+    n: int = 0
+    entered: tuple = ()
+    exited: tuple = ()
+    gated: tuple = ()
+    open_s: float = 0.0
+
+    @property
+    def clean(self) -> bool:
+        return self.site is None
+
+    @property
+    def wedged(self) -> bool:
+        """Did THIS slice's ranks wedge (vs. merely waiting on a peer)?"""
+        if self.clean:
+            return False
+        missing_exit = self.n - len(self.exited)
+        return bool(self.gated) or missing_exit > 0
+
+    def to_json(self) -> str:
+        import json
+
+        return json.dumps({
+            "slice_index": self.slice_index, "site": self.site,
+            "collective_id": self.collective_id, "n": self.n,
+            "entered": list(self.entered), "exited": list(self.exited),
+            "gated": list(self.gated), "open_s": self.open_s,
+        })
+
+    @staticmethod
+    def from_json(text: str) -> "TripSummary":
+        import json
+
+        d = json.loads(text)
+        return TripSummary(
+            slice_index=int(d["slice_index"]), site=d.get("site"),
+            collective_id=d.get("collective_id"), n=int(d.get("n", 0)),
+            entered=tuple(d.get("entered", ())),
+            exited=tuple(d.get("exited", ())),
+            gated=tuple(d.get("gated", ())),
+            open_s=float(d.get("open_s", 0.0)),
+        )
+
+
+def trip_summary(wd: CollectiveWatchdog, slice_index: int = 0) -> TripSummary:
+    """Condense ``wd``'s trip (if any) into a :class:`TripSummary`. A
+    watchdog that never tripped yields a clean summary — every slice
+    contributes one so the exchange is collective."""
+    recs = wd.tripped_records
+    if not recs:
+        return TripSummary(slice_index=slice_index)
+    r = recs[0]
+    return TripSummary(
+        slice_index=slice_index, site=r.site,
+        collective_id=repr(r.collective_id), n=r.n,
+        entered=tuple(sorted(r.entered)), exited=tuple(sorted(r.exited)),
+        gated=tuple(sorted(r.gated)),
+        open_s=time.monotonic() - r.t_start,
+    )
+
+
+def merge_trip_summaries(summaries) -> tuple:
+    """Merge per-slice trip summaries into one report naming the wedged
+    slice(s). Returns ``(report_text, wedged_slice_indices)``."""
+    summaries = sorted(summaries, key=lambda s: s.slice_index)
+    tripped = [s for s in summaries if not s.clean]
+    if not tripped:
+        return ("multi-slice watchdog: no trips on any slice", ())
+    wedged = tuple(s.slice_index for s in tripped if s.wedged)
+    lines = ["multi-slice watchdog: merged trip report"]
+    for s in summaries:
+        if s.clean:
+            lines.append(f"  slice {s.slice_index}: clean (no trip)")
+            continue
+        missing = sorted(set(range(s.n)) - set(s.exited))
+        lines.append(
+            f"  slice {s.slice_index}: tripped at '{s.site}' "
+            f"(collective_id={s.collective_id}, n={s.n}, "
+            f"open {s.open_s:.2f}s) missing-exit {missing} "
+            f"gated {sorted(s.gated)}"
+        )
+    if wedged:
+        lines.append(
+            f"  verdict: wedged slice {list(wedged)} — ranks never "
+            f"exited / held at a stall gate; other tripped slices were "
+            f"waiting on it"
+        )
+    else:
+        lines.append(
+            "  verdict: no slice shows a local wedge — trips were "
+            "deadline overruns only (deadline too tight, or the wedge "
+            "cleared before the exchange)"
+        )
+    return ("\n".join(lines), wedged)
+
+
+def report_merged_trip(summaries) -> str:
+    """Merge summaries AND feed the verdict to the health ledgers: each
+    wedged slice gets a fatal ``watchdog_trip`` signal under the peer key
+    ``"slice:<k>"`` — the bridge from multi-slice diagnosis to mesh
+    shrink (``topology.replan_mesh``)."""
+    report, wedged = merge_trip_summaries(summaries)
+    if wedged:
+        from triton_distributed_tpu.runtime import health
+
+        for k in wedged:
+            health.broadcast_signal(
+                "watchdog_trip", f"slice:{k}", detail=report)
+    return report
 
 
 # -- io_callback targets (module-level so traced closures stay tiny) --------

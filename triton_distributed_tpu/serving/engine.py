@@ -29,7 +29,12 @@ Degradation: the first device failure of the Pallas kernel path flips
 the engine onto the XLA twin (``use_pallas=False``) and retries — the
 ``tools/native``-style graceful-degradation story at engine level, so
 a fault-plan replay (bench.py --dryrun --faults) exercises scheduling
-under chaos without hardware.
+under chaos without hardware. Degradation is no longer one-way: every
+failure also lands in a :class:`~triton_distributed_tpu.runtime.health
+.HealthLedger`, whose probation machinery re-promotes the fused path
+after enough clean XLA steps plus seeded probes (and, in the
+disaggregated engine, re-promotes the DCN wire and fails a dead slice
+over onto the survivor).
 """
 
 from __future__ import annotations
@@ -120,7 +125,10 @@ class EngineStats:
     evictions: int = 0
     deferrals: int = 0
     prefix_hits: int = 0               # pages reattached from the cache
+    # CURRENTLY on the XLA twin (no longer a one-way latch: probation
+    # re-promotion clears it — see HealthLedger)
     degraded: bool = False
+    repromotions: int = 0              # probe-driven returns to the fused path
 
     @property
     def total_time(self) -> float:
@@ -203,15 +211,23 @@ class ServingEngine:
 
     def __init__(self, model, params, cfg: EngineConfig, *,
                  moe_state="auto", use_pallas: bool = True,
-                 on_complete=None):
+                 on_complete=None, health=None,
+                 health_peer: str = "site:serving_step"):
         import jax.numpy as jnp
 
+        from triton_distributed_tpu.runtime.health import HealthLedger
         from triton_distributed_tpu.serving.state import PagePool
 
         self.model = model
         self.params = params
         self.cfg = cfg
         self.use_pallas = use_pallas
+        # every failure signal lands here; probation re-promotes the
+        # fused path. A shared ledger (DisaggregatedEngine) makes one
+        # role's kernel failure visible to the other.
+        self.health = health if health is not None else HealthLedger(
+            seed=cfg.seed)
+        self.health_peer = health_peer
         self.state = model.init_serving_state(
             cfg.slots, cfg.npages, cfg.page
         )
@@ -472,7 +488,17 @@ class ServingEngine:
                 dtype=jnp.int32,
             ),
         )
-        out = self.model._serving_jit(
+        from triton_distributed_tpu.lang.launch import maybe_instrument
+
+        # host-mode heartbeat around the jitted step: an armed watchdog
+        # sees a wedged serving step (site "serving_step"), and a
+        # fault-plan Stall at that site gates here
+        step_fn = maybe_instrument(
+            self.model._serving_jit, axis=None, site="serving_step",
+            collective_id=("serving_step", self.health_peer), n=1,
+            step=self.step_count,
+        )
+        out = step_fn(
             self.params, state, jnp.asarray(tokens),
             jnp.asarray(token_rows), jnp.asarray(token_pos),
             jnp.asarray(q_starts), jnp.asarray(q_lens),
@@ -500,6 +526,21 @@ class ServingEngine:
             self.step_count += 1
             return report
         block_q = auto_block_q(int(q_lens.max()), self._g)
+        from triton_distributed_tpu.runtime.health import PeerState
+
+        peer = self.health_peer
+        if self.use_pallas \
+                and self.health.state(peer) is PeerState.UNHEALTHY:
+            # the ledger condemned the fused path out-of-band (a shared
+            # ledger's other role, a watchdog trip): demote before
+            # launching
+            self.use_pallas = False
+            self.stats.degraded = True
+        # PROBATION: on the seeded schedule, try the fused path again
+        probing = (not self.use_pallas
+                   and self.health.probe_due(peer, self.step_count))
+        if probing:
+            self.use_pallas = True
         t0 = time.perf_counter()
         arrays = (tokens, token_rows, token_pos, q_starts, q_lens, kv_dev)
         try:
@@ -507,12 +548,39 @@ class ServingEngine:
         except Exception:
             if not self.use_pallas:
                 raise
-            # degradation: fall back to the XLA twin for the rest of
-            # the session (the op-level with_fallback story at engine
-            # level) — scheduling state is untouched, re-run the batch
+            # degradation: fall back to the XLA twin (the op-level
+            # with_fallback story at engine level) — scheduling state is
+            # untouched, re-run the batch. The failure is a ledger
+            # signal: a probe failure drops straight back to UNHEALTHY,
+            # a first failure is fatal (kernel_error) so re-entry to the
+            # fused path only ever happens through clean probes.
+            if probing:
+                self.health.probe_result(peer, False,
+                                         step=self.step_count)
+            else:
+                self.health.record("kernel_error", peer,
+                                   step=self.step_count)
             self.use_pallas = False
             self.stats.degraded = True
             logits = self._run_device(arrays, block_q)
+        else:
+            if probing:
+                st = self.health.probe_result(peer, True,
+                                              step=self.step_count)
+                if st is PeerState.HEALTHY:
+                    # enough clean probes: stay on the fused path
+                    self.stats.degraded = False
+                    self.stats.repromotions += 1
+                else:
+                    self.use_pallas = False   # keep earning probes
+            elif not self.use_pallas and self.stats.degraded:
+                st = self.health.observe_clean(peer,
+                                               step=self.step_count)
+                if st is PeerState.HEALTHY:
+                    # SUSPECT cleared (non-fatal signal sources): resume
+                    self.use_pallas = True
+                    self.stats.degraded = False
+                    self.stats.repromotions += 1
         dt = time.perf_counter() - t0
         gen_this_step = 0
         for s in sorted(batched):
@@ -666,7 +734,29 @@ class DisaggStats:
     ship_ms: list = field(default_factory=list)
     shipped_wire_bytes: int = 0
     shipped_raw_bytes: int = 0
+    # CURRENTLY on the XLA transfer (probation re-promotion clears it)
     degraded_transport: bool = False
+    ship_retries: int = 0              # DCN attempts retried before success/fallback
+    transport_repromotions: int = 0    # probe-driven returns to the DCN wire
+    # --- slice-death failover ---
+    failover_role: str | None = None   # which role's slice died
+    failover_tick: int | None = None
+    failover_requeued: int = 0         # requests re-queued onto the survivor
+    failover_re_prefill_tokens: int = 0  # KV tokens that must re-prefill
+    recovery_tick: int | None = None   # first tick with every re-queued req done
+
+    @property
+    def failover(self) -> dict | None:
+        """The failover outcome in one dict (None if no slice died)."""
+        if self.failover_role is None:
+            return None
+        return {
+            "role": self.failover_role,
+            "tick": self.failover_tick,
+            "requeued": self.failover_requeued,
+            "re_prefill_tokens": self.failover_re_prefill_tokens,
+            "recovery_tick": self.recovery_tick,
+        }
 
     @property
     def completed(self) -> int:
@@ -731,8 +821,10 @@ class DisaggregatedEngine:
                  hybrid_mesh=None, dcn_axis: str = "dcn",
                  transport: str = "auto", ship_delay_steps: int = 0,
                  placement: str = "force", traffic: dict | None = None,
-                 moe_state="auto", use_pallas: bool = True):
+                 moe_state="auto", use_pallas: bool = True, health=None):
         from dataclasses import replace as _rep
+
+        from triton_distributed_tpu.runtime.health import HealthLedger
 
         if transport not in ("auto", "dcn", "xla"):
             raise ValueError(f"unknown transport {transport!r}")
@@ -740,6 +832,8 @@ class DisaggregatedEngine:
             transport = "dcn" if hybrid_mesh is not None else "xla"
         if transport == "dcn" and hybrid_mesh is None:
             raise ValueError("transport='dcn' needs a hybrid_mesh")
+        self.health = health if health is not None else HealthLedger(
+            seed=cfg.seed)
         if decode_cfg is None:
             # the decode role's batches are at most one token per slot
             # (8 packed slots each — the row alignment): size its
@@ -765,12 +859,14 @@ class DisaggregatedEngine:
 
             reason = perf_model.refuse_disaggregation(
                 decode_model.config, cfg.page, traffic or {},
+                ledger=self.health,
             )
             if reason is not None:
                 raise ValueError(
                     f"auto placement refuses disaggregation: {reason}"
                 )
         self.transport = transport
+        self._transport_pref = transport   # what we re-promote back to
         self.hybrid_mesh = hybrid_mesh
         self.dcn_axis = dcn_axis
         self.ship_delay_steps = int(ship_delay_steps)
@@ -778,15 +874,18 @@ class DisaggregatedEngine:
             prefill_model, prefill_params,
             _rep(cfg, prefill_only=True),
             moe_state=moe_state, use_pallas=use_pallas,
-            on_complete=self._on_prefill_complete,
+            on_complete=self._on_prefill_complete, health=self.health,
         )
         self.decode = ServingEngine(
             decode_model, decode_params,
             _rep(dcfg, prefill_only=False),
             moe_state=moe_state, use_pallas=use_pallas,
+            health=self.health,
         )
         self._ready: deque = deque()       # (req, prefill slot) awaiting ship
         self._inflight: list = []
+        self._dead_role: str | None = None  # set by slice-death failover
+        self._requeued: list = []           # failover's re-queued requests
         self.ticks = 0
         self.stats = DisaggStats(
             prefill=self.prefill.stats, decode=self.decode.stats
@@ -884,15 +983,85 @@ class DisaggregatedEngine:
             ))
 
     def _run_transport(self, qpay, spay):
-        if self.transport == "dcn":
+        from triton_distributed_tpu.runtime.health import PeerState
+
+        peer = "site:kv_ship"
+        if (self.transport == "dcn"
+                and self.health.state(peer) is PeerState.UNHEALTHY):
+            # condemned out-of-band (watchdog trip on a prior ship)
+            self.transport = "xla"
+            self.stats.degraded_transport = True
+        probing = (self._transport_pref == "dcn"
+                   and self.transport == "xla"
+                   and self.health.probe_due(peer, self.ticks))
+        if self.transport == "dcn" or probing:
+            out = self._dcn_with_retries(qpay, spay)
+            if out is not None:
+                if probing:
+                    st = self.health.probe_result(peer, True,
+                                                  step=self.ticks)
+                    if st is PeerState.HEALTHY:
+                        self.transport = "dcn"
+                        self.stats.degraded_transport = False
+                        self.stats.transport_repromotions += 1
+                elif self.health.state(peer) is PeerState.UNHEALTHY:
+                    # the ship completed but only because a watchdog
+                    # trip released its stall gate: demote for the next
+                    self.transport = "xla"
+                    self.stats.degraded_transport = True
+                return out
+            # retries exhausted: the failure is a ledger signal, then
+            # degrade onto the XLA transfer (scheduling state untouched)
+            if probing:
+                self.health.probe_result(peer, False, step=self.ticks)
+            else:
+                self.health.record("transport_error", peer,
+                                   step=self.ticks)
+            self.transport = "xla"
+            self.stats.degraded_transport = True
+        out = self._transport_xla(qpay, spay)
+        if self._transport_pref == "dcn" and self.transport == "xla" \
+                and self.stats.degraded_transport:
+            # a clean degraded ship: SUSPECT clears straight back,
+            # UNHEALTHY earns PROBATION (probes re-promote above)
+            st = self.health.observe_clean(peer, step=self.ticks)
+            if st is PeerState.HEALTHY:
+                self.transport = "dcn"
+                self.stats.degraded_transport = False
+                self.stats.transport_repromotions += 1
+        return out
+
+    def _dcn_with_retries(self, qpay, spay):
+        """The DCN wire with capped jittered backoff (the
+        ``TDTPU_BOOTSTRAP_*`` pattern at ship scope): up to
+        ``TDTPU_SHIP_RETRIES`` attempts (default 3), backing off
+        ``TDTPU_SHIP_BACKOFF * 2**attempt`` seconds (default 0.2,
+        clamped to ``TDTPU_SHIP_BACKOFF_CAP``, ledger-seeded ±50%
+        jitter). Returns the landed payload or None when exhausted —
+        the caller degrades. Each attempt runs under the kv_ship
+        heartbeat so an armed watchdog can trip on a stalled ship."""
+        import os as _os
+
+        from triton_distributed_tpu.lang.launch import maybe_instrument
+
+        retries = max(1, int(_os.environ.get("TDTPU_SHIP_RETRIES", "3")))
+        backoff = float(_os.environ.get("TDTPU_SHIP_BACKOFF", "0.2"))
+        cap = float(_os.environ.get("TDTPU_SHIP_BACKOFF_CAP", "2.0"))
+        send = maybe_instrument(
+            self._transport_dcn, axis=None, site="kv_ship",
+            collective_id=("kv_ship", self.ticks), n=1, step=self.ticks,
+        )
+        for attempt in range(retries):
             try:
-                return self._transport_dcn(qpay, spay)
+                return send(qpay, spay)
             except Exception:
-                # first wire failure: degrade onto the XLA transfer for
-                # the rest of the session (scheduling state untouched)
-                self.transport = "xla"
-                self.stats.degraded_transport = True
-        return self._transport_xla(qpay, spay)
+                if attempt == retries - 1:
+                    return None
+                self.stats.ship_retries += 1
+                delay = min(cap, backoff * (2.0 ** attempt))
+                delay *= 0.5 + self.health.uniform(
+                    "ship_backoff", self.ticks, attempt)
+                time.sleep(delay)
 
     def _transport_xla(self, qpay, spay):
         """The degradation target: a plain device_put of the (already
@@ -933,7 +1102,13 @@ class DisaggregatedEngine:
             (self._q_sharding, None if arr_s is None else self._s_sharding),
         )
 
-    def _commit_ships(self) -> None:
+    def _commit_ships(self, force: bool = False,
+                      release_source: bool = True) -> list:
+        """Land ready transfers. ``force`` ignores the in-flight delay
+        window and ``release_source=False`` skips freeing the prefill
+        pages — the prefill-slice-death path: the payloads already left
+        the dead slice, so they commit, but the source pool died with
+        its slice. Returns the committed records."""
         import time as _t
 
         import jax
@@ -941,7 +1116,7 @@ class DisaggregatedEngine:
 
         ready = [
             r for r in self._inflight
-            if self.ticks - r.issued_tick >= self.ship_delay_steps
+            if force or self.ticks - r.issued_tick >= self.ship_delay_steps
         ]
         # a launch batch shares one transported payload (same tuple
         # object on every record) and its records share issued_tick, so
@@ -967,7 +1142,8 @@ class DisaggregatedEngine:
             for r in rs:
                 # handoff order matters: the source frees its pinned
                 # pages first, THEN the row becomes schedulable
-                self.prefill.release_parked(r.pslot)
+                if release_source:
+                    self.prefill.release_parked(r.pslot)
                 self.decode.commit_shipped(r.req)
                 self._warm_prefix_cache(r)
                 self._inflight.remove(r)
@@ -975,6 +1151,7 @@ class DisaggregatedEngine:
                 self.stats.shipped_wire_bytes += r.wire_bytes
                 self.stats.shipped_raw_bytes += r.raw_bytes
                 self.stats.ship_ms.append(r.launch_ms + dt)
+        return ready
 
     def _warm_prefix_cache(self, r: ShipRecord) -> None:
         """Decode-slice prefix-cache warm-up: the shipped pages' content
@@ -1010,16 +1187,145 @@ class DisaggregatedEngine:
         their own slices with the transfer in flight between them;
         the single-process harness serializes them but keeps the same
         ordering semantics (decode never observes a page before its
-        commit fence)."""
-        rep_p = None if self.prefill.idle else self.prefill.step()
-        self._launch_ships()
-        self._commit_ships()
-        rep_d = None if self.decode.idle else self.decode.step()
+        commit fence). A fault-plan :class:`SliceDeath` whose step has
+        arrived fails the dead role over onto the survivor first."""
+        self._check_slice_deaths()
+        rep_p = (None if self._dead_role == "prefill" or self.prefill.idle
+                 else self.prefill.step())
+        if self._dead_role is None:
+            self._launch_ships()
+            self._commit_ships()
+        rep_d = (None if self._dead_role == "decode" or self.decode.idle
+                 else self.decode.step())
         self.ticks += 1
+        if (self.stats.failover_role is not None
+                and self.stats.recovery_tick is None
+                and all(r.done for r in self._requeued)):
+            self.stats.recovery_tick = self.ticks
         return {
             "tick": self.ticks, "prefill": rep_p, "decode": rep_d,
             "inflight": len(self._inflight), "ready": len(self._ready),
         }
+
+    # ------------------------------------------------- slice-death failover
+
+    def _check_slice_deaths(self) -> None:
+        """Consume the active plan's :class:`SliceDeath` faults: hybrid
+        DCN index 0 is the prefill role, 1 the decode role (the
+        ``create_hybrid_mesh`` layout bench builds)."""
+        from triton_distributed_tpu.runtime import faults as _faults
+
+        if self._dead_role is not None:
+            return
+        plan = _faults.active_plan()
+        if plan is None:
+            return
+        dead = plan.dead_slices(self.ticks)
+        if not dead:
+            return
+        roles = {0: "prefill", 1: "decode"}
+        dead_roles = sorted({roles[s] for s in dead if s in roles})
+        if len(dead_roles) > 1:
+            raise RuntimeError(
+                f"fault plan killed both serving slices by tick "
+                f"{self.ticks} ({dead}) — no survivor to fail over to")
+        for s in sorted(dead):
+            if s not in roles:
+                continue
+            role = roles[s]
+            self.health.record(
+                "slice_death", f"slice:{s}", step=self.ticks,
+                detail=f"{role} slice died at tick {self.ticks}")
+            self._fail_over(role)
+            return
+
+    def _fail_over(self, dead_role: str) -> None:
+        """Re-queue everything the dead slice held onto the survivor.
+        Zero requests are lost and output stays token-exact: sampling is
+        keyed on (seed, rid, generated-so-far), so an exact-cursor
+        re-prefill (the eviction recompute discipline — prompt plus
+        everything generated) resumes each stream byte-identically."""
+        from dataclasses import replace as _rep
+
+        self.stats.failover_role = dead_role
+        self.stats.failover_tick = self.ticks
+        requeued: list = []
+        re_tokens = 0
+
+        def requeue(req, surv):
+            nonlocal re_tokens
+            if req.done:
+                return
+            re_tokens += req.cursor
+            if req.cursor > 0:
+                req.evictions += 1
+            req.cursor = 0
+            req.slot = None
+            req.parked = False
+            surv.waiting.append(req)
+            requeued.append(req)
+
+        if dead_role == "decode":
+            dead, surv = self.decode, self.prefill
+            # the survivor becomes a FULL engine: prefill_only off,
+            # completions credited to the system (decode) ledger
+            surv.cfg = _rep(surv.cfg, prefill_only=False)
+            surv.on_complete = self._on_failover_complete
+            # requests awaiting/in a ship: their prefilled KV is intact
+            # in the SURVIVOR's pool — un-park and decode in place
+            kept = set()
+            for req, pslot in self._ready:
+                req.parked = False
+                req.slot = pslot
+                kept.add(id(req))
+            for r in self._inflight:
+                r.req.parked = False
+                r.req.slot = r.pslot    # reserve_shipped repointed it
+                kept.add(id(r.req))
+            self._ready.clear()
+            self._inflight.clear()
+            # dead-pool residents lost their KV: exact-cursor re-prefill
+            for req in dead.slot_req:
+                if req is not None and id(req) not in kept:
+                    requeue(req, surv)
+        else:
+            dead, surv = self.prefill, self.decode
+            # payloads already transported left the dead slice — land
+            # them now (their source pool is gone: no release)
+            committed = self._commit_ships(force=True,
+                                           release_source=False)
+            handled = {id(r.req) for r in committed}
+            # never-transported KV is lost: re-prefill from scratch
+            for req, pslot in self._ready:
+                requeue(req, surv)
+                handled.add(id(req))
+            self._ready.clear()
+            for req in dead.slot_req:
+                if req is not None and not req.done \
+                        and id(req) not in handled:
+                    requeue(req, surv)
+        # drain the dead role's queues onto the survivor
+        while dead.waiting:
+            req = dead.waiting.popleft()
+            surv.waiting.append(req)
+            requeued.append(req)
+        while dead.pending:
+            surv.pending.append(dead.pending.popleft())
+        # neutralize the dead engine (its device state is gone with the
+        # slice; the host mirrors must read as empty so `idle` holds)
+        dead.slot_req = [None] * dead.cfg.slots
+        dead.table[:] = -1
+        self.stats.failover_requeued = len(requeued)
+        self.stats.failover_re_prefill_tokens = re_tokens
+        self._requeued = requeued
+        self._dead_role = dead_role
+
+    def _on_failover_complete(self, req, slot) -> bool:
+        """Post-failover completion hook on the surviving prefill-role
+        engine: credit the system (decode) ledger, free the slot."""
+        self.decode.stats.completed += 1
+        self.decode.stats.generated_tokens += len(req.generated)
+        return True
 
     def run(self, trace=None, max_ticks: int | None = None) -> DisaggStats:
         if trace is not None:

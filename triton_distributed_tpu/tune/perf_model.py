@@ -347,13 +347,32 @@ def kv_ship_ms(n_pages: int, page: int, hkv: int, d: int, n_layers: int,
 
 
 def refuse_disaggregation(model_cfg, page: int, traffic: dict,
-                          spec: TpuSpec | None = None) -> str | None:
+                          spec: TpuSpec | None = None, *,
+                          ledger=None) -> str | None:
     """The `auto` placement gate: None when the expected per-request KV
     ship hides under the decode window it buys, else a human-readable
     refusal reason. ``traffic``: expected request shape —
     ``prompt_len`` (tokens whose pages ship) and ``max_new`` (decode
     steps the ship can overlap with); optional ``decode_step_ms``
-    overrides the analytic steady-step estimate."""
+    overrides the analytic steady-step estimate. ``ledger`` (a
+    ``runtime.health.HealthLedger``) adds the health gate: a split
+    topology is refused while a slice is condemned or the kv_ship wire
+    itself is unhealthy — placement consults health, not just perf."""
+    if ledger is not None:
+        bad_slices = ledger.unhealthy_slices()
+        if bad_slices:
+            return (
+                f"health ledger marks slice(s) {bad_slices} unhealthy — "
+                "a split topology cannot place a role on a condemned "
+                "slice"
+            )
+        from triton_distributed_tpu.runtime.health import PeerState
+
+        if ledger.state("site:kv_ship") is PeerState.UNHEALTHY:
+            return (
+                "health ledger marks the kv_ship wire unhealthy — the "
+                "split topology's transport is the thing that is broken"
+            )
     spec = spec or detect_spec()
     prompt = int(traffic.get("prompt_len", 1024))
     max_new = int(traffic.get("max_new", 32))
