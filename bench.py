@@ -271,6 +271,14 @@ def _parse_args(argv=None):
         "runtime.faults.parse_plan). The plan is active for every "
         "benched collective.",
     )
+    ap.add_argument(
+        "scenario", nargs="?", default=None,
+        help="run ONLY this named scenario (currently: serving_fleet "
+        "— the multi-replica router bench; composes with --dryrun and "
+        "--faults, e.g. the ISSUE-11 acceptance line "
+        "'serving_fleet --dryrun --faults \"seed=1; "
+        "ReplicaDeath(replica=1, step=8)\"')",
+    )
     return ap.parse_args(argv)
 
 
@@ -331,12 +339,40 @@ def _run_lint() -> None:
             file=sys.stderr, flush=True,
         )
 
-    errs = sum(f.severity >= Severity.ERROR for f in findings) + len(gaps)
+    # fleet gate (ISSUE 11): every kernel family a fleet replica's
+    # engines launch must be REGISTERED with a resolvable degradation
+    # target — a replica whose engines cannot degrade is not a safe
+    # failover destination, so the router's whole health story would
+    # rest on an unverified fallback
+    from triton_distributed_tpu.kernels import registry as _registry
+    from triton_distributed_tpu.serving.fleet import (
+        FLEET_ENGINE_FAMILIES,
+    )
+
+    fams = _registry.families()
+    gap_names = {f for f, _ in gaps}
+    fleet_gaps = []
+    for fam in FLEET_ENGINE_FAMILIES:
+        if fam not in fams:
+            fleet_gaps.append((fam, "fleet replica family not registered"))
+        elif fam in gap_names:
+            fleet_gaps.append(
+                (fam, "fleet replica family has a degradation gap"))
+    for fam, problem in fleet_gaps:
+        print(
+            json.dumps({"lint_fleet_gap":
+                        {"family": fam, "problem": problem}}),
+            file=sys.stderr, flush=True,
+        )
+
+    errs = (sum(f.severity >= Severity.ERROR for f in findings)
+            + len(gaps) + len(fleet_gaps))
     print(
         json.dumps({"metric": "shmemlint", "errors": errs,
                     "findings": len(findings),
                     "rule_counts": rule_counts(findings),
                     "degradation_gaps": len(gaps),
+                    "fleet_gaps": len(fleet_gaps),
                     "mosaic_scanned": len(report["scanned"]),
                     "mosaic_refused": len(report["refused"])}),
         file=sys.stderr, flush=True,
@@ -367,6 +403,25 @@ def main(argv=None) -> None:
             json.dumps({"metric": "fault_replay", "plan": repr(plan)}),
             file=sys.stderr, flush=True,
         )
+
+    if args.scenario is not None:
+        from triton_distributed_tpu.tune.perf_model import detect_spec
+
+        if args.scenario != "serving_fleet":
+            print(json.dumps({"error":
+                              f"unknown scenario {args.scenario!r}"}),
+                  file=sys.stderr, flush=True)
+            sys.exit(2)
+        devs = jax.devices()
+        mesh = Mesh(np.asarray(devs), ("x",))
+        on_tpu = jax.default_backend() == "tpu"
+        out = _bench_serving_fleet(
+            mesh, len(devs), on_tpu, detect_spec(),
+            tiny=args.dryrun or not on_tpu,
+        )
+        out["faults"] = args.faults
+        print(json.dumps(out), flush=True)
+        return
 
     if args.dryrun:
         from triton_distributed_tpu.tune.perf_model import detect_spec
@@ -1706,6 +1761,217 @@ def _bench_serving_disaggregated(mesh, n, on_tpu, spec, tiny=False):
             f"requests={trace_kw['n_requests']} "
             f"lens~U[{trace_kw['len_lo']},{trace_kw['len_hi']}] "
             f"temp=0.7 top_k=40 kvq={cfg.kv_quant} "
+            + ("tiny-dryrun" if tiny or not on_tpu else "headline")
+        ),
+    }
+
+
+def _fleet_trace(trace_kw, page):
+    """The serving_fleet traffic: the seeded Poisson base PLUS two
+    session bursts, each sharing its OWN 10-page prompt prefix — a
+    leader arrives early and its followers arrive after the leader's
+    prefill has published the prefix pages. A cache-aware router lands
+    every follower on resident pages (one prefill per session);
+    round-robin scatters each session across replicas and pays the
+    prefill once per replica. Deterministic; fresh Request objects per
+    call (engines mutate them in place)."""
+    from triton_distributed_tpu.serving import poisson_trace
+    from triton_distributed_tpu.serving.engine import Request
+
+    base = poisson_trace(seed=13, **trace_kw)
+    rng = np.random.default_rng(17)
+    out = list(base)
+    rid = len(base)
+    for s in range(2):
+        prefix = rng.integers(
+            0, trace_kw["vocab"], (10 * page,)).astype(np.int32)
+        # leader at 1.0/2.0 (prefilled well before the acceptance
+        # plan's step-8 death); followers straddle the death
+        arrivals = [1.0 + s] + [8.0 + s + 1.5 * j for j in range(5)]
+        for a in arrivals:
+            tail = rng.integers(
+                0, trace_kw["vocab"], (int(rng.integers(4, 12)),)
+            ).astype(np.int32)
+            req = Request(
+                rid=rid,
+                prompt=np.concatenate([prefix, tail]),
+                max_new=int(rng.integers(trace_kw["max_new_lo"],
+                                         trace_kw["max_new_hi"])),
+                arrival=a,
+            )
+            req.session = f"burst-{s}"
+            out.append(req)
+            rid += 1
+    return out
+
+
+def _bench_serving_fleet(mesh, n, on_tpu, spec, tiny=False):
+    """FLEET serving (ISSUE 11 tentpole acceptance): 3 engine replicas,
+    each on its own mesh slice carved by ``carve_replica_meshes``,
+    behind the scored ``FleetRouter`` (prefix overlap × health × load
+    estimate, session affinity, spill) vs a ROUND-ROBIN baseline on
+    the same Poisson + shared-prefix-burst trace. Under a --faults
+    ``ReplicaDeath`` plan the dead replica's in-flight requests drain
+    back through the router onto the survivor: ``lost_requests`` must
+    be 0 and the token streams byte-identical to the fault-free
+    reference run (request-keyed sampling — placement cannot change
+    tokens)."""
+    import os as _os
+
+    import jax
+
+    from triton_distributed_tpu.models import Transformer
+    from triton_distributed_tpu.runtime import faults as _rt_faults
+    from triton_distributed_tpu.runtime import watchdog as _rt_watchdog
+    from triton_distributed_tpu.runtime.topology import (
+        carve_replica_meshes,
+    )
+    from triton_distributed_tpu.serving import ServingEngine
+    from triton_distributed_tpu.serving.fleet import (
+        RouterConfig,
+        ServingFleet,
+    )
+
+    devs = jax.devices()
+    # 3 replicas: the acceptance plan kills replica 1 mid-trace, and
+    # with TWO survivors the router keeps being a router afterwards —
+    # a 2-replica fleet degenerates to "route everything to the lone
+    # survivor" where every policy is equal
+    n_replicas = 3
+    meshes = carve_replica_meshes(n_replicas, devs)
+    w = int(meshes[0].devices.size)
+    cfg, ecfg, trace_kw, s_cap = _serving_continuous_config(
+        w, on_tpu, tiny
+    )
+    from dataclasses import replace as _rep
+
+    if not on_tpu or tiny:
+        # small enough for the CI smoke, big enough that the burst's
+        # shared prefix (10 pages, ~5 prefill chunks) dominates the
+        # routing decision
+        trace_kw = dict(
+            n_requests=12, mean_interarrival=1.0,
+            len_lo=8, len_hi=40, max_new_lo=3, max_new_hi=7,
+            vocab=trace_kw["vocab"],
+        )
+        ecfg = _rep(ecfg, slots=4, token_budget=48, chunk=16, page=8,
+                    npages=64)
+    ecfg = _rep(ecfg, prefix_cache=True, temperature=0.7, top_k=40,
+                seed=11)
+
+    models = []
+    for m in meshes:
+        model = Transformer(cfg, m, tp_axis="x")
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s),
+            model.init(jax.random.PRNGKey(7)), model.shardings(),
+        )
+        params = model.quantize_moe_weights(params)
+        params = model.quantize_dense_weights(params)
+        models.append((model, params))
+
+    def fresh_trace():
+        return _fleet_trace(trace_kw, ecfg.page)
+
+    n_total = len(fresh_trace())
+
+    def build_fleet(policy):
+        engines = [ServingEngine(model, params, ecfg)
+                   for model, params in models]
+        return ServingFleet(
+            engines, seed=1, router=RouterConfig(policy=policy),
+            meshes=meshes,
+        )
+
+    wd_trips = []
+
+    def _guarded(run_fn):
+        # same contract as the disaggregated bench: under --faults the
+        # collective watchdog is armed so a stalled router_dispatch /
+        # serving_step trips into the ledger instead of wedging
+        if _rt_faults.active_plan() is None:
+            return run_fn()
+        deadline = float(_os.environ.get("TDTPU_BENCH_WATCHDOG", "10.0"))
+        box = {}
+        try:
+            with _rt_watchdog.collective_watchdog(deadline=deadline):
+                box["out"] = run_fn()
+        except _rt_watchdog.WatchdogTimeout as e:
+            wd_trips.append(str(e).splitlines()[0])
+        finally:
+            _rt_watchdog.clear_trip()
+        return box.get("out")
+
+    # ---- fault-free reference (the token oracle; run twice — the
+    # first run pays every jit compile for both replica models)
+    plan = _rt_faults.active_plan()
+    _rt_faults.set_fault_plan(None)
+    try:
+        for _warm in (False, True):
+            ref_fleet = build_fleet("scored")
+            ref_fleet.run(fresh_trace())
+    finally:
+        _rt_faults.set_fault_plan(plan)
+    ref_tokens = ref_fleet.token_streams()
+    assert ref_fleet.stats.lost_requests == 0, ref_fleet.stats
+
+    # ---- the routed fleet under the active plan (the headline run)
+    fleet = build_fleet("scored")
+    stats = _guarded(lambda: fleet.run(fresh_trace()))
+    assert stats is not None, wd_trips
+
+    # ---- round-robin baseline under the SAME plan
+    rr = build_fleet("round_robin")
+    rr_stats = _guarded(lambda: rr.run(fresh_trace()))
+    assert rr_stats is not None, wd_trips
+
+    tokens = fleet.token_streams()
+    mismatches = sum(
+        1 for rid, t in ref_tokens.items() if tokens.get(rid) != t
+    )
+
+    def hit_rate(fl):
+        total_pages = sum(
+            len(rec["req"].prompt) // ecfg.page
+            for rec in fl.stats.records.values())
+        return fl.prefix_hits / total_pages if total_pages else 0.0
+
+    goodput = fleet.goodput_tok_per_s
+    rr_goodput = rr.goodput_tok_per_s
+    return {
+        "metric": "serving_fleet",
+        "value": round(goodput, 1),
+        "unit": "tok/s fleet goodput (modeled wall)",
+        "rr_goodput": round(rr_goodput, 1),
+        "goodput_vs_round_robin": round(goodput / rr_goodput, 3)
+        if rr_goodput else None,
+        "ticks": fleet.ticks,
+        "rr_ticks": rr.ticks,
+        "p99_ttft_ticks": round(stats.p99_ttft_ticks, 2),
+        "p99_tpot_ticks": round(stats.p99_tpot_ticks, 2),
+        "rr_p99_ttft_ticks": round(rr_stats.p99_ttft_ticks, 2),
+        "prefix_hit_rate": round(hit_rate(fleet), 3),
+        "rr_prefix_hit_rate": round(hit_rate(rr), 3),
+        "completed": stats.completed,
+        "lost_requests": stats.lost_requests,
+        "rr_lost_requests": rr_stats.lost_requests,
+        "token_mismatches_vs_fault_free": mismatches,
+        "deaths": stats.deaths,
+        "failover_requeued": stats.failover_requeued,
+        "failover_re_prefill_tokens": stats.failover_re_prefill_tokens,
+        "routed": {str(k): v for k, v in sorted(stats.routed.items())},
+        "spills": stats.spills,
+        "affinity_hits": stats.affinity_hits,
+        "probes": stats.probes,
+        "rotation": list(fleet.rotation()),
+        "watchdog_trips": wd_trips,
+        "health": fleet.health.snapshot(),
+        "config": (
+            f"replicas={n_replicas}x{w} slots={ecfg.slots} "
+            f"budget={ecfg.token_budget} chunk={ecfg.chunk} "
+            f"page={ecfg.page} npages={ecfg.npages} "
+            f"requests={n_total} temp=0.7 top_k=40 "
+            f"prefix_cache=on fleet_seed=1 "
             + ("tiny-dryrun" if tiny or not on_tpu else "headline")
         ),
     }

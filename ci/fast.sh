@@ -14,7 +14,9 @@
 # round trips, ship/eviction race pins, 2-role token-exactness) and
 # the health/failover suite (tests/test_health.py: ledger state
 # machine + determinism, mesh shrink, slice-death failover
-# token-exactness, probation re-promotion) — everything that answers
+# token-exactness, probation re-promotion) and the fleet router suite
+# (tests/test_fleet.py: scoring/affinity/spill, ReplicaDeath failover,
+# probe re-entry, chaos-site heartbeats) — everything that answers
 # "did I just break a protocol, a contract, or the host plumbing?"
 # without paying for the big interpreted model suites. Use it as the
 # inner-loop gate; the full tier-1 run remains the merge gate.
@@ -46,4 +48,68 @@ from triton_distributed_tpu.kernels.registry import (
 gaps = missing_degradation_targets()
 assert not gaps, f"families without a resolvable degradation target: {gaps}"
 print(f"degradation targets: all families declare a resolvable fallback")
+EOF
+
+# Fleet failover smoke (ISSUE 11 acceptance): a 2-replica fleet on a
+# short seeded trace with a mid-trace ReplicaDeath must finish with
+# ZERO lost requests — every in-flight request on the dead replica
+# drains back through the router onto the survivor.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2").strip()
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from triton_distributed_tpu.models import Transformer, TransformerConfig
+from triton_distributed_tpu.runtime import faults
+from triton_distributed_tpu.serving import (
+    EngineConfig, ServingEngine, ServingFleet, poisson_trace,
+)
+
+cfg = TransformerConfig(
+    vocab=128, n_layers=2, hidden=64, ffn=128, n_heads=4, n_kv_heads=2,
+    head_dim=16, dtype=jnp.float32, param_dtype=jnp.float32,
+    kv_quant="int8")
+ecfg = EngineConfig(slots=4, token_budget=48, chunk=16, page=8,
+                    npages=32, prefix_cache=True, temperature=0.7,
+                    top_k=40, seed=11)
+devs = jax.devices()
+engines = []
+params = None
+for k in range(2):
+    mesh = Mesh(np.asarray(devs[k:k + 1]), ("tp",))
+    model = Transformer(cfg, mesh, "tp", ())
+    if params is None:
+        params = model.init(jax.random.PRNGKey(0))
+    p = jax.tree.map(lambda x, s: jax.device_put(x, s), params,
+                     model.shardings())
+    engines.append(ServingEngine(model, p, ecfg, use_pallas=False))
+
+fleet = ServingFleet(engines, seed=1)
+trace = poisson_trace(seed=9, n_requests=8, mean_interarrival=0.7,
+                      len_lo=8, len_hi=30, max_new_lo=5, max_new_hi=8,
+                      vocab=128)
+# pin a session to the doomed replica so the step-6 death is
+# guaranteed to catch in-flight work (the failover path, not a no-op)
+for i, r in enumerate(trace):
+    if i % 2:
+        r.session = "s"
+fleet.router.affinity["s"] = 1
+plan = faults.parse_plan("seed=1; ReplicaDeath(replica=1, step=6)")
+with faults.fault_plan(plan):
+    stats = fleet.run(trace)
+assert stats.lost_requests == 0, (
+    f"fleet smoke lost {stats.lost_requests} requests: {stats}")
+assert stats.deaths == [(1, 6)], stats.deaths
+assert stats.failover_requeued >= 1, stats.failover_requeued
+print(f"fleet smoke: {stats.completed}/{stats.submitted} completed, "
+      f"0 lost across ReplicaDeath(replica=1, step=6), "
+      f"requeued={stats.failover_requeued}")
 EOF

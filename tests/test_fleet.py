@@ -1,0 +1,428 @@
+"""ISSUE-11 fleet suite: the health- and cache-aware router over N
+engine replicas and its ReplicaDeath failover discipline.
+
+The tentpole under test is :mod:`triton_distributed_tpu.serving.fleet`:
+
+* **scoring** — the admission score (prefix overlap × health factor /
+  fleet-relative load) against hand-built expectations, and the
+  affinity/spill rules (queue at the prefix home while its score beats
+  the best replica with room; spill — and re-home — when it doesn't);
+* **cache-aware routing** — a shared-prefix session trace lands more
+  prefix-cache page hits under the scored router than under the
+  round-robin baseline;
+* **failover** — a :class:`ReplicaDeath` mid-trace drains the dead
+  replica's requests back through the router onto survivors: zero lost
+  requests, token streams byte-identical to the fault-free run
+  (sampling is keyed ``(seed, rid, n_generated)``, so placement can
+  never change tokens); both-replicas-dead is a loud refusal;
+* **probation re-entry** — a revived replica earns PROBATION through
+  clean ticks and re-enters rotation through seeded probe traffic,
+  never a blind re-add;
+* **determinism** — same fleet seed ⇒ identical placement, and the
+  fleet seed folds into ``config.interp_key`` like the fault plan;
+* **chaos sites** — the ``router_dispatch`` site and the XLA
+  ``kv_ship`` fallback transport are heartbeated: a fault-plan Stall
+  under an armed watchdog trips into the ledger instead of wedging.
+
+All sim-free: the fleet/router layers are host code, the engines run
+their CPU paths (XLA twins).
+"""
+
+import gc
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from triton_distributed_tpu import config
+from triton_distributed_tpu.models import Transformer, TransformerConfig
+from triton_distributed_tpu.runtime import faults, health, watchdog
+from triton_distributed_tpu.runtime.faults import (
+    FaultPlan,
+    ReplicaDeath,
+    Stall,
+    parse_plan,
+)
+from triton_distributed_tpu.runtime.health import HealthLedger, PeerState
+from triton_distributed_tpu.runtime.watchdog import WatchdogTimeout
+from triton_distributed_tpu.serving import (
+    EngineConfig,
+    Request,
+    ServingEngine,
+)
+from triton_distributed_tpu.serving.fleet import (
+    FleetRouter,
+    RouterConfig,
+    ServingFleet,
+)
+
+#: tier-1 fast subset (ci/fast.sh): the fleet half of the robustness
+#: story
+pytestmark = pytest.mark.fast
+
+
+@pytest.fixture(autouse=True)
+def _isolated_ledgers():
+    yield
+    health.set_ledger(None)
+    faults.set_fault_plan(None)
+    watchdog.clear_trip()
+    config.set_fleet_seed(None)
+    gc.collect()
+
+
+CFG = dict(
+    vocab=128, n_layers=2, hidden=64, ffn=128,
+    n_heads=4, n_kv_heads=2, head_dim=16,
+    dtype=jnp.float32, param_dtype=jnp.float32, kv_quant="int8",
+)
+
+ECFG = dict(slots=4, token_budget=48, chunk=16, page=8, npages=32,
+            prefix_cache=True, temperature=0.7, top_k=40, seed=11)
+
+
+@pytest.fixture(scope="module")
+def fleet_models():
+    """Two replica models on their own 1-device meshes, same params."""
+    devs = jax.devices()
+    out = []
+    params = None
+    for k in range(2):
+        mesh = Mesh(np.asarray(devs[k:k + 1]), ("tp",))
+        model = Transformer(TransformerConfig(**CFG), mesh, "tp", ())
+        if params is None:
+            params = model.init(jax.random.PRNGKey(0))
+        p = jax.tree.map(lambda x, s: jax.device_put(x, s), params,
+                         model.shardings())
+        out.append((model, p))
+    return out
+
+
+def _fast_ledger(seed=0):
+    return HealthLedger(seed=seed, probation_after=1, promote_after=1,
+                        probe_interval=2)
+
+
+def _fleet(fleet_models, policy="scored", seed=1, ledger=None, **ecfg):
+    kw = dict(ECFG, **ecfg)
+    engines = [ServingEngine(m, p, EngineConfig(**kw), use_pallas=False)
+               for m, p in fleet_models]
+    return ServingFleet(engines, seed=seed,
+                        router=RouterConfig(policy=policy),
+                        health=ledger)
+
+
+def _req(rid, arrival, session=None, plen=20, max_new=5, prefix=None):
+    rng = np.random.default_rng(1000 + rid)
+    prompt = rng.integers(0, CFG["vocab"], (plen,)).astype(np.int32)
+    if prefix is not None:
+        prompt = np.concatenate(
+            [prefix, prompt[:6].astype(np.int32)])
+    r = Request(rid=rid, prompt=prompt, max_new=max_new,
+                arrival=arrival)
+    if session is not None:
+        r.session = session
+    return r
+
+
+def _trace(n=8, session_every=None, prefix=None, spread=1.0):
+    out = []
+    for i in range(n):
+        sess = ("s" if session_every and i % session_every == 0
+                else None)
+        out.append(_req(i, arrival=i * spread, session=sess,
+                        prefix=prefix if sess else None))
+    return out
+
+
+# ------------------------------------------------------------- scoring
+
+class _StubReplica:
+    def __init__(self, index, overlap=0, load=0.0, room=True):
+        self.index = index
+        self.peer = f"replica:{index}"
+        self._overlap, self._load, self._room = overlap, load, room
+
+    def overlap_pages(self, req):
+        return self._overlap
+
+    def load_ms(self):
+        return self._load
+
+    def can_accept(self, req):
+        return self._room
+
+
+class _StubLedger:
+    def __init__(self, states=None):
+        self._states = states or {}
+
+    def state(self, peer):
+        return self._states.get(peer, PeerState.HEALTHY)
+
+
+class TestScoring:
+    def test_score_matches_hand_formula(self):
+        router = FleetRouter(seed=0)
+        r = _StubReplica(0, overlap=4, load=2.0)
+        # (1 + w_prefix*4) * hf / (1 + w_load * load/mean)
+        assert router.score(r, None, PeerState.HEALTHY, 2.0) \
+            == pytest.approx(5.0 / 2.0)
+        assert router.score(r, None, PeerState.SUSPECT, 2.0) \
+            == pytest.approx(5.0 / 4.0)
+        assert router.score(r, None, PeerState.UNHEALTHY, 2.0) is None
+        assert router.score(r, None, PeerState.PROBATION, 2.0) is None
+        # no load anywhere -> pure prefix * health
+        assert router.score(r, None, PeerState.HEALTHY, 0.0) \
+            == pytest.approx(5.0)
+
+    def test_route_picks_highest_score(self):
+        router = FleetRouter(seed=0)
+        cold = _StubReplica(0, overlap=0, load=1.0)
+        warm = _StubReplica(1, overlap=5, load=1.0)
+        chosen, spilled = router.route(
+            _req(0, 0.0), [cold, warm], _StubLedger())
+        assert chosen is warm and not spilled
+
+    def test_route_excludes_condemned(self):
+        router = FleetRouter(seed=0)
+        sick = _StubReplica(0, overlap=9)
+        ok = _StubReplica(1)
+        led = _StubLedger({"replica:0": PeerState.UNHEALTHY})
+        chosen, _ = router.route(_req(0, 0.0), [sick, ok], led)
+        assert chosen is ok
+        led = _StubLedger({"replica:0": PeerState.UNHEALTHY,
+                           "replica:1": PeerState.PROBATION})
+        with pytest.raises(RuntimeError, match="no survivor"):
+            router.route(_req(0, 0.0), [sick, ok], led)
+
+    def test_affinity_sticks_and_follows(self):
+        router = FleetRouter(seed=0)
+        a, b = _StubReplica(0), _StubReplica(1)
+        req = _req(0, 0.0, session="s")
+        router.affinity["s"] = 0
+        chosen, spilled = router.route(req, [a, b], _StubLedger())
+        assert chosen is a and not spilled
+        assert router.affinity["s"] == 0
+
+    def test_full_home_queues_while_score_justifies(self):
+        """A full home with a resident prefix still wins: waiting where
+        the pages live beats re-prefilling them elsewhere."""
+        router = FleetRouter(seed=0)
+        home = _StubReplica(0, overlap=10, load=1.0, room=False)
+        other = _StubReplica(1, overlap=0, load=1.0, room=True)
+        router.affinity["s"] = 0
+        chosen, spilled = router.route(
+            _req(0, 0.0, session="s"), [home, other], _StubLedger())
+        assert chosen is home and not spilled
+
+    def test_full_cold_home_spills_and_rehomes(self):
+        router = FleetRouter(seed=0)
+        home = _StubReplica(0, overlap=0, load=3.0, room=False)
+        other = _StubReplica(1, overlap=0, load=1.0, room=True)
+        router.affinity["s"] = 0
+        chosen, spilled = router.route(
+            _req(0, 0.0, session="s"), [home, other], _StubLedger())
+        assert chosen is other and spilled
+        assert router.affinity["s"] == 1   # affinity follows the spill
+
+
+# ------------------------------------------------- cache-aware routing
+
+class TestCacheAwareRouting:
+    def test_prefix_routing_beats_round_robin(self, fleet_models):
+        """A session's followers land where the leader's prefix pages
+        are resident under the scored router; round-robin scatters them
+        and pays the prefill once per replica."""
+        rng = np.random.default_rng(7)
+        prefix = rng.integers(0, CFG["vocab"], (80,)).astype(np.int32)
+
+        def trace():
+            # leader at 0, followers after its prefill completed,
+            # poisson-ish fillers in between
+            out = [_req(0, 0.0, session="s", prefix=prefix)]
+            out += [_req(1 + j, 1.0 + j) for j in range(4)]
+            out += [_req(5 + j, 8.0 + 1.5 * j, session="s",
+                         prefix=prefix) for j in range(4)]
+            return out
+
+        scored = _fleet(fleet_models, "scored")
+        scored.run(trace())
+        rr = _fleet(fleet_models, "round_robin")
+        rr.run(trace())
+        assert scored.stats.lost_requests == 0
+        assert rr.stats.lost_requests == 0
+        assert scored.prefix_hits > rr.prefix_hits
+        assert scored.goodput_tok_per_s > 0
+
+
+# ------------------------------------------------------------ failover
+
+class TestReplicaDeathFailover:
+    def _session_trace(self):
+        # session "s" pinned to replica 1 via the public affinity map,
+        # so the step-4 death is guaranteed to catch in-flight work
+        out = [_req(i, i * 0.7, session="s" if i % 2 else None,
+                    max_new=6) for i in range(8)]
+        return out
+
+    def test_death_failover_token_exact(self, fleet_models):
+        ref = _fleet(fleet_models, "scored")
+        ref.router.affinity["s"] = 1
+        ref.run(self._session_trace())
+        assert ref.stats.lost_requests == 0
+        ref_tokens = ref.token_streams()
+
+        fleet = _fleet(fleet_models, "scored")
+        fleet.router.affinity["s"] = 1
+        plan = FaultPlan(seed=1,
+                         faults=(ReplicaDeath(replica=1, step=4),))
+        with faults.fault_plan(plan):
+            stats = fleet.run(self._session_trace())
+        assert stats.lost_requests == 0
+        assert stats.completed == 8
+        assert stats.deaths == [(1, 4)]
+        assert stats.failover_requeued >= 1
+        assert fleet.health.state("replica:1") is PeerState.UNHEALTHY
+        assert fleet.rotation() == (0,)
+        assert fleet.token_streams() == ref_tokens
+        # run() restored the ambient fleet seed
+        assert config.fleet_seed() is None
+
+    def test_all_replicas_dead_refuses(self, fleet_models):
+        fleet = _fleet(fleet_models)
+        plan = FaultPlan(seed=1, faults=(
+            ReplicaDeath(replica=0, step=2),
+            ReplicaDeath(replica=1, step=2)))
+        with faults.fault_plan(plan):
+            with pytest.raises(RuntimeError, match="no survivor"):
+                fleet.run(_trace())
+
+    def test_probation_reentry_after_revive(self, fleet_models):
+        """A revived replica re-enters rotation through the probation
+        probe path: clean ticks earn PROBATION, a seeded probe carries
+        real traffic, a clean probe earns HEALTHY — never a blind
+        re-add."""
+        fleet = _fleet(fleet_models, ledger=_fast_ledger())
+        plan = FaultPlan(seed=1,
+                         faults=(ReplicaDeath(replica=1, step=2),))
+        with faults.fault_plan(plan):
+            fleet.run(_trace())
+        assert fleet.rotation() == (0,)
+
+        m, p = fleet_models[1]
+        fleet.revive(1, ServingEngine(m, p, EngineConfig(**ECFG),
+                                      use_pallas=False))
+        base = fleet.ticks
+        second = [_req(100 + i, base + 1.0 + i, max_new=4)
+                  for i in range(8)]
+        fleet.run(second)
+        assert fleet.stats.lost_requests == 0
+        assert fleet.stats.probes >= 1
+        assert fleet.health.state("replica:1") is PeerState.HEALTHY
+        assert fleet.rotation() == (0, 1)
+        assert fleet.stats.routed.get(1, 0) >= 1
+
+    def test_revive_requires_dead(self, fleet_models):
+        fleet = _fleet(fleet_models)
+        with pytest.raises(ValueError, match="not dead"):
+            fleet.revive(0)
+
+
+# --------------------------------------------------------- determinism
+
+class TestDeterminism:
+    def _placements(self, fleet_models, seed):
+        fleet = _fleet(fleet_models, seed=seed)
+        placed = []
+        orig = FleetRouter.route
+
+        def spy(router, req, replicas, ledger):
+            r, sp = orig(router, req, replicas, ledger)
+            placed.append((req.rid, r.index, sp))
+            return r, sp
+
+        fleet.router.route = types.MethodType(spy, fleet.router)
+        fleet.run(_trace(n=10, session_every=3))
+        return placed, dict(fleet.stats.routed)
+
+    def test_same_seed_identical_placement(self, fleet_models):
+        p1, r1 = self._placements(fleet_models, seed=5)
+        p2, r2 = self._placements(fleet_models, seed=5)
+        assert p1 == p2
+        assert r1 == r2
+
+    def test_fleet_seed_in_interp_key(self):
+        base = config.interp_key()
+        config.set_fleet_seed(3)
+        keyed = config.interp_key()
+        assert keyed != base
+        assert 3 in keyed
+        config.set_fleet_seed(None)
+        assert config.interp_key() == base
+
+    def test_run_installs_fleet_seed(self, fleet_models):
+        seen = {}
+        fleet = _fleet(fleet_models, seed=9)
+        orig_tick = fleet.tick
+
+        def spy():
+            seen["seed"] = config.fleet_seed()
+            return orig_tick()
+
+        fleet.tick = spy
+        fleet.run(_trace(n=2))
+        assert seen["seed"] == 9
+        assert config.fleet_seed() is None
+
+    def test_parse_plan_replica_death_roundtrip(self):
+        plan = parse_plan("seed=2; ReplicaDeath(replica=1, step=8)")
+        assert plan.seed == 2
+        assert plan.faults == (ReplicaDeath(replica=1, step=8),)
+        assert plan.dead_replicas(7) == ()
+        assert plan.dead_replicas(8) == (1,)
+        assert plan.dead_replicas() == (1,)
+
+
+# --------------------------------------------------------- chaos sites
+
+class TestChaosSites:
+    def test_router_dispatch_stall_trips_watchdog(self, fleet_models):
+        """A fault-plan Stall at the router_dispatch site wedges the
+        WHOLE fleet's admission; an armed watchdog trips, names the
+        site, releases the gate, and the trace still completes."""
+        fleet = _fleet(fleet_models)
+        plan = FaultPlan(seed=0,
+                         faults=(Stall(site="router_dispatch", rank=0),))
+        box = {}
+        with faults.fault_plan(plan):
+            with pytest.raises(WatchdogTimeout):
+                with watchdog.collective_watchdog(deadline=0.2):
+                    box["stats"] = fleet.run(_trace(n=4))
+        assert box["stats"].lost_requests == 0
+        assert fleet.health.state("site:router_dispatch") \
+            is PeerState.UNHEALTHY
+
+    def test_xla_kv_ship_fallback_is_heartbeated(self):
+        """Satellite pin: the XLA collective-fallback KV ship transport
+        runs under the kv_ship watchdog instrument — the LAST
+        unheartbeated fallback entry point. A Stall there trips into
+        the ledger instead of wedging the transfer."""
+        from triton_distributed_tpu.tools import native
+
+        led = HealthLedger(seed=0)
+        payload = {"pages": np.ones((2, 4), np.int8)}
+        plan = FaultPlan(seed=0, faults=(Stall(site="kv_ship", rank=0),))
+        with faults.fault_plan(plan):
+            with pytest.raises(WatchdogTimeout):
+                with watchdog.collective_watchdog(deadline=0.2):
+                    out = native.xla_kv_ship(
+                        payload, {"pages": None})
+                    # stall released by the trip; bytes still intact
+                    assert np.array_equal(out["pages"],
+                                          payload["pages"])
+        assert led.state("site:kv_ship") is PeerState.UNHEALTHY

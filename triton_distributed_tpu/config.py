@@ -94,6 +94,26 @@ def fused_vmem_budget() -> int:
     return config.fused_vmem_budget
 
 
+_FLEET_SEED: int | None = None
+
+
+def set_fleet_seed(seed: int | None) -> None:
+    """Install (or clear, with ``None``) the fleet routing seed.
+
+    Every routing/spill/affinity tie-break in
+    :mod:`~triton_distributed_tpu.serving.fleet` hashes through this
+    seed, and like the :class:`~triton_distributed_tpu.runtime.faults.
+    FaultPlan` identity it is folded into :func:`interp_key` so cached
+    kernel builds cannot leak across fleets routed differently."""
+    global _FLEET_SEED
+    _FLEET_SEED = seed
+
+
+def fleet_seed() -> int | None:
+    """The active fleet routing seed (None outside a fleet)."""
+    return _FLEET_SEED
+
+
 def interp_key() -> tuple:
     """Hashable key of the config state captured at pallas BUILD time
     (chaos delays are traced in; detect_races is baked into the
@@ -106,12 +126,14 @@ def interp_key() -> tuple:
     identity and the collective-watchdog armed flag — both are traced
     into kernels (seeded delay/corruption ops; heartbeat callbacks), so
     activating/changing/clearing either must invalidate cached builds.
+    The fleet routing seed (:func:`set_fleet_seed`) rides along for the
+    same reason.
     """
     from triton_distributed_tpu.runtime import faults
 
     return (
         config.chaos_delay, config.detect_races, config.force_compile,
-        config.debug_checksum,
+        config.debug_checksum, _FLEET_SEED,
     ) + faults.trace_key()
 
 

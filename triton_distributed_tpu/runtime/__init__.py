@@ -16,6 +16,7 @@ from triton_distributed_tpu.runtime.faults import (
     Corrupt,
     Delay,
     FaultPlan,
+    ReplicaDeath,
     SignalFault,
     SliceDeath,
     Stall,
@@ -94,6 +95,7 @@ __all__ = [
     "fault_plan",
     "set_fault_plan",
     "SliceDeath",
+    "ReplicaDeath",
     "collective_watchdog",
     "WatchdogTimeout",
     "TripSummary",

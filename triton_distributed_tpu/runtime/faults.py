@@ -61,14 +61,16 @@ from dataclasses import dataclass, field
 logger = logging.getLogger(__name__)
 
 #: Site names used by the kernel hook points. "*" in a fault matches any.
-#: The last three are HOST-level sites (serving-engine instrumentation,
+#: The last four are HOST-level sites (serving-engine instrumentation,
 #: ``lang.maybe_instrument(axis=None)``): the ragged serving kernel's
-#: chaos hook, the jitted serving step, and the disaggregated KV-ship
-#: transport.
+#: chaos hook, the jitted serving step, the disaggregated KV-ship
+#: transport, and the fleet router's dispatch loop (a stalled router is
+#: a different outage than a stalled engine — every replica starves at
+#: once).
 SITES = (
     "allgather", "reduce_scatter", "all_to_all", "ag_gemm", "gemm_rs",
     "moe_dispatch", "flash_decode",
-    "ragged_paged", "serving_step", "kv_ship",
+    "ragged_paged", "serving_step", "kv_ship", "router_dispatch",
 )
 
 
@@ -142,7 +144,22 @@ class SliceDeath:
     step: int = 0
 
 
-_FAULT_TYPES = (Delay, Stall, SignalFault, Corrupt, SliceDeath)
+@dataclass(frozen=True)
+class ReplicaDeath:
+    """Kill a whole fleet replica at a tick: from ``step`` on, the
+    :class:`~triton_distributed_tpu.serving.fleet.ServingFleet` treats
+    replica ``replica`` — one complete engine (or disaggregated pair)
+    on its own carved mesh slice — as dead: a fatal ``replica_death``
+    health signal plus the router-driven drain of everything the
+    replica held back onto the survivors. Like :class:`SliceDeath` it
+    is an ENGINE-level fault: no kernel hook consumes it."""
+
+    replica: int = 1
+    step: int = 0
+
+
+_FAULT_TYPES = (Delay, Stall, SignalFault, Corrupt, SliceDeath,
+                ReplicaDeath)
 
 
 @dataclass(frozen=True)
@@ -253,6 +270,15 @@ class FaultPlan:
         return tuple(sorted({
             f.slice for f in self.faults
             if isinstance(f, SliceDeath)
+            and (step is None or f.step <= step)
+        }))
+
+    def dead_replicas(self, step: int | None = None) -> tuple:
+        """Fleet-replica indices dead at ``step`` — the
+        :class:`ReplicaDeath` twin of :meth:`dead_slices`."""
+        return tuple(sorted({
+            f.replica for f in self.faults
+            if isinstance(f, ReplicaDeath)
             and (step is None or f.step <= step)
         }))
 

@@ -78,7 +78,8 @@ class PeerState(enum.Enum):
 #: exception means the device path is broken NOW (the engine re-runs the
 #: batch on its XLA twin either way; probation decides when to re-trust).
 FATAL_KINDS = frozenset({
-    "slice_death", "watchdog_trip", "bootstrap_exhausted", "kernel_error",
+    "slice_death", "replica_death", "watchdog_trip",
+    "bootstrap_exhausted", "kernel_error",
 })
 
 

@@ -155,6 +155,37 @@ def device_coords(mesh: Mesh) -> np.ndarray | None:
     return np.array([d.coords for d in devs])
 
 
+# --------------------------------------------------------------- fleet carve
+
+def carve_replica_meshes(n_replicas: int, devices=None,
+                         axis: str = "x") -> list:
+    """Carve the device pool into ``n_replicas`` equal 1-D meshes, one
+    per fleet replica (:mod:`~triton_distributed_tpu.serving.fleet`).
+
+    Deterministic contiguous split: replica ``k`` gets devices
+    ``[k*w, (k+1)*w)`` where ``w = len(devices) // n_replicas`` —
+    contiguous ranges keep each replica's ICI locality intact on real
+    topologies. When the pool is smaller than the fleet (the 1-core CPU
+    test harness), replicas share devices round-robin rather than
+    refusing: the engines are host-stepped and the interpreter mesh is
+    virtual, so sharing is safe there and a loud refusal would make the
+    fleet untestable off-TPU.
+    """
+    import jax
+
+    if n_replicas < 1:
+        raise ValueError(f"carve_replica_meshes: n_replicas={n_replicas}")
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    w = len(devices) // n_replicas
+    if w == 0:
+        return [Mesh(np.array([devices[k % len(devices)]]), (axis,))
+                for k in range(n_replicas)]
+    return [Mesh(np.array(devices[k * w:(k + 1) * w]), (axis,))
+            for k in range(n_replicas)]
+
+
 # --------------------------------------------------------------- mesh shrink
 
 @dataclass(frozen=True)

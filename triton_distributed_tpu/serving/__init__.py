@@ -2,7 +2,9 @@
 kernel: the explicit :class:`ServingState` (page pools + block table +
 per-request cursors, donated and shard-resident) and the
 :class:`ServingEngine` request scheduler (admission and eviction over
-the page pool, chunked prefill interleaved into decode batches).
+the page pool, chunked prefill interleaved into decode batches), with
+:class:`ServingFleet` aggregating N engine replicas behind the health-
+and cache-aware :class:`FleetRouter`.
 
 See docs/SERVING.md for the lifecycle and knob catalog.
 """
@@ -15,6 +17,14 @@ from triton_distributed_tpu.serving.engine import (  # noqa: F401
     Request,
     ServingEngine,
     poisson_trace,
+)
+from triton_distributed_tpu.serving.fleet import (  # noqa: F401
+    FLEET_ENGINE_FAMILIES,
+    FleetRouter,
+    FleetStats,
+    Replica,
+    RouterConfig,
+    ServingFleet,
 )
 from triton_distributed_tpu.serving.state import (  # noqa: F401
     PagePool,

@@ -1,0 +1,663 @@
+"""Fleet-scale serving: N engine replicas behind a health- and
+cache-aware router.
+
+The north star says millions of users; one :class:`~triton_distributed_
+tpu.serving.engine.ServingEngine` (or one disaggregated pair) is the
+wrong unit for that. This module is the first layer that AGGREGATES
+engines: ``n`` replicas — colocated engines or
+:class:`~triton_distributed_tpu.serving.engine.DisaggregatedEngine`
+pairs, each on its own mesh slice carved by
+:func:`~triton_distributed_tpu.runtime.topology.carve_replica_meshes` —
+behind a :class:`FleetRouter` that scores admission per replica on
+
+    score(r, req) = (1 + w_prefix · overlap_pages(r, req))
+                    · health_factor(r)
+                    / (1 + w_load · load_ms(r) / mean_load)
+
+* ``overlap_pages`` — consecutive full prompt pages already RESIDENT in
+  the replica's :class:`~triton_distributed_tpu.serving.state.PagePool`
+  prefix registry (chain-hash lookups, the PR 7 machinery): routing a
+  request where its prefix lives skips recomputing it.
+* ``health_factor`` — the fleet :class:`~triton_distributed_tpu.runtime.
+  health.HealthLedger` state of peer ``"replica:k"``: HEALTHY 1.0,
+  SUSPECT 0.5, PROBATION probe-only, UNHEALTHY excluded — the same
+  signals :func:`~triton_distributed_tpu.runtime.topology.replan_mesh`
+  consumes, so the rotation grows and shrinks exactly when a replan
+  would.
+* ``load_ms`` — :func:`~triton_distributed_tpu.tune.perf_model.
+  replica_load_ms`: the analytic step time of the replica's resident
+  occupancy scaled by its queue depth, normalized by the fleet-mean
+  load so the knob is scale-free (the same ``w_load`` works for
+  microsecond CPU-sim steps and millisecond TPU steps). No
+  measurement, so scores are reproducible.
+
+Session affinity pins a ``req.session`` to the replica that served it
+last (its KV prefix lives there); when that replica is full AND its
+score (cache value vs queue depth) no longer justifies queueing, the
+request SPILLS to the best-scoring replica with room and the affinity
+follows the pages. Every tie-break hashes through the fleet seed (folded into
+``config.interp_key`` like the fault-plan identity), so same seed ⇒
+identical placement.
+
+Robustness headline — :class:`~triton_distributed_tpu.runtime.faults.
+ReplicaDeath`: when the active fault plan kills replica ``k`` at a
+tick, the fleet records the fatal ``replica_death`` signal, drains
+EVERYTHING the dead replica held (slots, queues, in-flight ships) back
+through the router onto the survivors at cursor 0 — the recompute-
+eviction discipline: re-prefilling prompt+generated resumes each
+stream at its exact cursor — and, because sampling is keyed
+``(seed, rid, n_generated)``, the re-placed streams are byte-identical
+to the fault-free run. Zero requests are lost. A revived replica
+re-enters rotation only through the PR 10 probation-probe path: clean
+idle ticks earn PROBATION, seeded probes earn traffic, enough clean
+probes earn HEALTHY — never a blind re-add. All replicas dead is a
+loud refusal, not a hang.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from collections import deque
+from dataclasses import dataclass, field
+
+
+def _u(*parts) -> float:
+    """crc32-seeded uniform in [0, 1) — the FaultPlan/HealthLedger
+    determinism idiom, reused for router tie-breaks."""
+    return (zlib.crc32(repr(parts).encode()) & 0xFFFFFFFF) / 2**32
+
+
+#: Kernel families a fleet replica's engines launch. ``bench.py
+#: --lint`` verifies each is registered with a RESOLVABLE degradation
+#: target: a replica whose engines cannot degrade cannot be safely
+#: failed over onto, so the fleet inherits the engine-level
+#: degradation-matrix guarantee by construction.
+FLEET_ENGINE_FAMILIES = (
+    "flash_decode.ragged_paged",   # every replica's serving step
+    "kv_ship.pages",               # disaggregated replicas' KV wire
+)
+
+
+# ------------------------------------------------------------- replica
+
+@dataclass
+class Replica:
+    """One fleet member: an engine (colocated ``ServingEngine`` or a
+    ``DisaggregatedEngine`` pair) plus its carved mesh. Duck-typed over
+    both engine shapes — ``_roles`` is the flat engine list."""
+
+    index: int
+    engine: object
+    mesh: object = None
+
+    @property
+    def peer(self) -> str:
+        return f"replica:{self.index}"
+
+    @property
+    def _roles(self) -> tuple:
+        e = self.engine
+        if hasattr(e, "prefill"):          # DisaggregatedEngine
+            return (e.prefill, e.decode)
+        return (e,)
+
+    @property
+    def admit_role(self):
+        """The engine new requests enter (the prefill half of a pair)."""
+        return self._roles[0]
+
+    def submit(self, req) -> None:
+        # straight into `waiting`: the request already passed the
+        # fleet-level arrival gate, the engine must not re-gate it
+        self.admit_role.waiting.append(req)
+
+    def step(self):
+        e = self.engine
+        return e.tick() if hasattr(e, "tick") else e.step()
+
+    @property
+    def idle(self) -> bool:
+        return self.engine.idle
+
+    def held(self) -> list:
+        """Every not-done request this replica currently owns (slots,
+        queues, both roles; parked/shipping requests sit in slots)."""
+        out, seen = [], set()
+        for role in self._roles:
+            for req in (list(role.slot_req) + list(role.waiting)
+                        + list(role.pending)):
+                if req is not None and not req.done \
+                        and id(req) not in seen:
+                    seen.add(id(req))
+                    out.append(req)
+        return out
+
+    def neutralize(self) -> None:
+        """The replica's device state died with its slice: host mirrors
+        must read empty so nothing ever schedules into it again."""
+        for role in self._roles:
+            role.slot_req = [None] * role.cfg.slots
+            role.table[:] = -1
+            role.waiting.clear()
+            role.pending.clear()
+        e = self.engine
+        if hasattr(e, "_ready"):
+            e._ready.clear()
+            e._inflight.clear()
+
+    # ------------------------------------------------- router signals
+
+    def overlap_pages(self, req) -> int:
+        """Consecutive full prompt pages resident in this replica's
+        prefix registry — the cache term of the router score."""
+        from triton_distributed_tpu.serving.state import page_chain_hash
+
+        best = 0
+        for role in self._roles:
+            pool = role.pool
+            if not pool.prefix_cache:
+                continue
+            page = role.cfg.page
+            seq = req.seq
+            h, n = 0, 0
+            for p in range((len(seq) - 1) // page):
+                h = page_chain_hash(h, seq[p * page:(p + 1) * page])
+                if pool.lookup(h) is None:
+                    break
+                n += 1
+            best = max(best, n)
+        return best
+
+    def load_ms(self) -> float:
+        """Queue-depth/step-time estimate — the perf term."""
+        from triton_distributed_tpu.tune import perf_model
+
+        return sum(perf_model.replica_load_ms(r) for r in self._roles)
+
+    def step_model_ms(self) -> float:
+        """Analytic cost of the step ABOUT to run (current occupancy)
+        — the deterministic clock the fleet accumulates per replica.
+        A prefilling slot bills its chunk, a decoding slot one token,
+        so prefix hits (skipped prefill) show up as modeled time
+        saved."""
+        from triton_distributed_tpu.tune import perf_model
+
+        return sum(perf_model.replica_step_ms(r) for r in self._roles
+                   if not r.idle)
+
+    def can_accept(self, req) -> bool:
+        """Would the admission role admit ``req`` NOW (free slot + page
+        headroom)? False means routing here queues the request."""
+        role = self.admit_role
+        if all(r is not None for r in role.slot_req):
+            return False
+        first = min(role.cfg.chunk, len(req.seq))
+        return (role._pages_held(first)
+                <= role.pool.available - role._committed_pages())
+
+
+# -------------------------------------------------------------- router
+
+@dataclass(frozen=True)
+class RouterConfig:
+    """Router knobs (see docs/SERVING.md § Fleet)."""
+
+    w_prefix: float = 1.0       # weight of the prefix-overlap term
+    w_load: float = 1.0         # weight of the fleet-mean-relative load
+    policy: str = "scored"      # "scored" | "round_robin" (baseline)
+    affinity: bool = True       # session stickiness
+
+
+class FleetRouter:
+    """Scores and places one request at a time. Stateless apart from
+    the round-robin cursor and the session-affinity map; every
+    tie-break is seeded, so same seed ⇒ identical placement."""
+
+    def __init__(self, seed: int, cfg: RouterConfig | None = None):
+        self.seed = seed
+        self.cfg = cfg or RouterConfig()
+        self._rr = 0
+        self.affinity: dict = {}           # session -> replica index
+
+    def health_factor(self, state) -> float | None:
+        """None = not routable. PROBATION returns None here — probe
+        admission is the fleet's job (``ServingFleet._route_probe``),
+        not a score."""
+        from triton_distributed_tpu.runtime.health import PeerState
+
+        if state is PeerState.HEALTHY:
+            return 1.0
+        if state is PeerState.SUSPECT:
+            return 0.5
+        return None                        # PROBATION / UNHEALTHY
+
+    def score(self, replica: Replica, req, state,
+              mean_load: float = 0.0) -> float | None:
+        """The admission score. The load term enters RELATIVE to
+        ``mean_load`` (the fleet mean, computed by :meth:`route`) so
+        ``w_load`` is scale-free — the same knob balances microsecond
+        CPU-sim steps and millisecond TPU steps."""
+        hf = self.health_factor(state)
+        if hf is None:
+            return None
+        c = self.cfg
+        rel = replica.load_ms() / mean_load if mean_load > 0 else 0.0
+        return ((1.0 + c.w_prefix * replica.overlap_pages(req)) * hf
+                / (1.0 + c.w_load * rel))
+
+    def route(self, req, replicas: list, ledger) -> tuple:
+        """Pick the replica for ``req`` among routable ``replicas``.
+        Returns ``(replica, spilled)`` — ``spilled`` True when session
+        affinity wanted a replica that is full (or gone) and the score
+        said re-homing beats queueing there."""
+        states = {r.index: ledger.state(r.peer) for r in replicas}
+        routable = [r for r in replicas
+                    if self.health_factor(states[r.index]) is not None]
+        if not routable:
+            raise RuntimeError(
+                "fleet router: no routable replica (every replica is "
+                "dead or condemned) — no survivor to fail over to")
+        if self.cfg.policy == "round_robin":
+            r = routable[self._rr % len(routable)]
+            self._rr += 1
+            return r, False
+        mean = sum(r.load_ms() for r in routable) / len(routable)
+        scored = [(r, self.score(r, req, states[r.index], mean))
+                  for r in routable]
+        # seeded tie-break: equal scores place identically under the
+        # same fleet seed regardless of construction order
+        scored.sort(key=lambda rs: (
+            -rs[1], _u(self.seed, "tie", req.rid, rs[0].index)))
+        best_with_room = next(
+            ((r, s) for r, s in scored if r.can_accept(req)), None)
+        sess = getattr(req, "session", None)
+        spilled = False
+        chosen = None
+        if self.cfg.affinity and sess is not None \
+                and sess in self.affinity:
+            home = next((rs for rs in scored
+                         if rs[0].index == self.affinity[sess]), None)
+            if home is None:
+                spilled = True       # home dead/condemned: re-home
+            elif home[0].can_accept(req) or best_with_room is None \
+                    or home[1] >= best_with_room[1]:
+                # queue at the home even when it is full, as long as
+                # its score (resident prefix vs queue depth) still
+                # beats the best replica with a free slot — waiting
+                # where the pages live beats re-prefilling them
+                chosen = home[0]
+            else:
+                spilled = True       # home full and outscored: spill
+        if chosen is None:
+            chosen = (best_with_room or scored[0])[0]
+        if self.cfg.affinity and sess is not None:
+            self.affinity[sess] = chosen.index   # affinity follows
+        return chosen, spilled
+
+
+# --------------------------------------------------------------- stats
+
+@dataclass
+class FleetStats:
+    """Fleet-level accounting. Per-request ticks (TTFT/TPOT) use the
+    deterministic tick clock; wall-time aggregates use the per-replica
+    step time the fleet accumulates (replicas run concurrently on
+    their own slices in production, so fleet wall = slowest replica)."""
+
+    submitted: int = 0
+    routed: dict = field(default_factory=dict)     # replica -> count
+    affinity_hits: int = 0
+    spills: int = 0
+    probes: int = 0
+    deaths: list = field(default_factory=list)     # (replica, tick)
+    failover_requeued: int = 0
+    failover_re_prefill_tokens: int = 0
+    replica_time: dict = field(default_factory=dict)  # replica -> s
+    # modeled (perf-model) step time per replica, ms — deterministic,
+    # and sensitive to compute actually saved (prefix hits skip
+    # prefill chunks), unlike host wall time on the CPU harness
+    replica_model_ms: dict = field(default_factory=dict)
+    # folded stats of engines that died/were replaced (revive swaps
+    # the engine object; its counters must not vanish)
+    retired_prefix_hits: int = 0
+    retired_evictions: int = 0
+    retired_generated: int = 0
+    records: dict = field(default_factory=dict)
+    # rid -> {arrival, first_token_tick, completion_tick, n, tokens}
+
+    @property
+    def completed(self) -> int:
+        return sum(1 for r in self.records.values()
+                   if r["completion_tick"] is not None)
+
+    @property
+    def lost_requests(self) -> int:
+        return self.submitted - self.completed
+
+    def _ttfts(self) -> list:
+        return [r["first_token_tick"] - r["arrival"]
+                for r in self.records.values()
+                if r["first_token_tick"] is not None]
+
+    def _tpots(self) -> list:
+        return [(r["completion_tick"] - r["first_token_tick"])
+                / max(r["n"] - 1, 1)
+                for r in self.records.values()
+                if r["completion_tick"] is not None]
+
+    @property
+    def p99_ttft_ticks(self) -> float:
+        import numpy as np
+
+        ts = self._ttfts()
+        return float(np.percentile(np.asarray(ts), 99)) if ts else 0.0
+
+    @property
+    def p99_tpot_ticks(self) -> float:
+        import numpy as np
+
+        ts = self._tpots()
+        return float(np.percentile(np.asarray(ts), 99)) if ts else 0.0
+
+
+# --------------------------------------------------------------- fleet
+
+class ServingFleet:
+    """N replicas + a router + a fleet health ledger, driven on one
+    deterministic tick clock. See the module docstring for the scoring
+    and failover contracts.
+
+    ``engines`` — list of built engines (one per replica; pair them
+    with meshes from ``carve_replica_meshes`` on real topologies).
+    ``seed`` — the fleet routing seed; installed via
+    ``config.set_fleet_seed`` for the duration of :meth:`run` so cached
+    kernel builds can't leak across differently-routed fleets.
+    """
+
+    def __init__(self, engines, *, seed: int = 0,
+                 router: RouterConfig | None = None, health=None,
+                 meshes=None):
+        from triton_distributed_tpu.runtime.health import HealthLedger
+
+        if not engines:
+            raise ValueError("a fleet needs at least one replica")
+        meshes = meshes or [None] * len(engines)
+        self.replicas = [Replica(i, e, m)
+                         for i, (e, m) in enumerate(zip(engines, meshes))]
+        self.seed = seed
+        self.health = health if health is not None else HealthLedger(
+            seed=seed)
+        self.router = FleetRouter(seed, router)
+        self.queue: deque = deque()        # fleet arrivals, by time
+        self.ticks = 0
+        self.stats = FleetStats()
+        self._dead: set = set()            # currently-dead replica idx
+        self._death_handled: set = set()   # faults already consumed
+        self._probing: dict = {}           # replica idx -> probe tick
+
+    # ---------------------------------------------------------- intake
+
+    def submit(self, req) -> None:
+        self.queue.append(req)
+        self.stats.submitted += 1
+        self.stats.records[req.rid] = {
+            "arrival": req.arrival, "first_token_tick": None,
+            "completion_tick": None, "n": 0, "tokens": None,
+            "req": req,
+        }
+
+    def submit_trace(self, trace) -> None:
+        for r in sorted(trace, key=lambda r: r.arrival):
+            self.submit(r)
+
+    @property
+    def idle(self) -> bool:
+        return (not self.queue
+                and all(r.idle for r in self._alive()))
+
+    def _alive(self) -> list:
+        return [r for r in self.replicas if r.index not in self._dead]
+
+    def rotation(self) -> tuple:
+        """Replica indices currently receiving scored traffic — the
+        ledger-driven grow/shrink surface (PROBATION members rejoin
+        probe-first; UNHEALTHY members are out)."""
+        from triton_distributed_tpu.runtime.health import PeerState
+
+        out = []
+        for r in self._alive():
+            st = self.health.state(r.peer)
+            if st not in (PeerState.UNHEALTHY, PeerState.PROBATION):
+                out.append(r.index)
+        return tuple(out)
+
+    # -------------------------------------------------------- dispatch
+
+    def _dispatch(self) -> int:
+        """Route every arrived request. Runs under the
+        ``router_dispatch`` chaos site: a fault-plan Stall there wedges
+        the WHOLE fleet's admission (every replica starves at once) and
+        an armed watchdog names it."""
+        from triton_distributed_tpu.lang.launch import maybe_instrument
+
+        body = maybe_instrument(
+            self._dispatch_body, axis=None, site="router_dispatch",
+            collective_id=("router_dispatch", self.ticks), n=1,
+            step=self.ticks,
+        )
+        return body()
+
+    def _dispatch_body(self) -> int:
+        n = 0
+        while self.queue and self.queue[0].arrival <= self.ticks:
+            req = self.queue.popleft()
+            target = self._route_probe(req)
+            spilled = False
+            if target is None:
+                target, spilled = self.router.route(
+                    req, self._alive(), self.health)
+            target.submit(req)
+            self.stats.routed[target.index] = (
+                self.stats.routed.get(target.index, 0) + 1)
+            if spilled:
+                self.stats.spills += 1
+            elif getattr(req, "session", None) is not None:
+                self.stats.affinity_hits += 1
+            n += 1
+        return n
+
+    def _route_probe(self, req):
+        """A PROBATION replica whose seeded probe is due gets this
+        request as its probe — traffic is the probe, exactly like the
+        engine-level kernel probes."""
+        from triton_distributed_tpu.runtime.health import PeerState
+
+        for r in self._alive():
+            if r.index in self._probing:
+                continue
+            if self.health.state(r.peer) is PeerState.PROBATION \
+                    and self.health.probe_due(r.peer, self.ticks):
+                self._probing[r.index] = self.ticks
+                self.stats.probes += 1
+                return r
+        return None
+
+    # ------------------------------------------------------------ tick
+
+    def tick(self) -> dict:
+        """One fleet tick: consume replica deaths, route arrivals, step
+        every live replica (concurrent slices in production; the host
+        harness serializes them on one clock)."""
+        from triton_distributed_tpu.runtime.health import PeerState
+
+        self._check_replica_deaths()
+        routed = self._dispatch()
+        stepped = 0
+        for r in self._alive():
+            st = self.health.state(r.peer)
+            if st is PeerState.UNHEALTHY:
+                # a revived replica idles cleanly until the ledger
+                # grants PROBATION — the gate before any probe traffic
+                self.health.observe_clean(r.peer, step=self.ticks)
+                continue
+            if r.idle:
+                continue
+            self.stats.replica_model_ms[r.index] = (
+                self.stats.replica_model_ms.get(r.index, 0.0)
+                + r.step_model_ms())
+            t0 = time.perf_counter()
+            try:
+                r.step()
+            except Exception:
+                if r.index in self._probing:
+                    del self._probing[r.index]
+                    self.health.probe_result(r.peer, False,
+                                             step=self.ticks)
+                    continue
+                raise
+            self.stats.replica_time[r.index] = (
+                self.stats.replica_time.get(r.index, 0.0)
+                + time.perf_counter() - t0)
+            stepped += 1
+            if r.index in self._probing:
+                del self._probing[r.index]
+                self.health.probe_result(r.peer, True, step=self.ticks)
+        self._update_records()
+        self.ticks += 1
+        return {"tick": self.ticks, "routed": routed,
+                "stepped": stepped, "queued": len(self.queue)}
+
+    def _update_records(self) -> None:
+        # the Request objects are shared with the engines (engines
+        # mutate them in place), so the fleet reads progress directly
+        for rec in self.stats.records.values():
+            req = rec["req"]
+            if req.generated and rec["first_token_tick"] is None:
+                rec["first_token_tick"] = self.ticks
+            if req.done and rec["completion_tick"] is None:
+                rec["completion_tick"] = self.ticks
+                rec["n"] = len(req.generated)
+                rec["tokens"] = list(req.generated)
+
+    def run(self, trace=None, max_ticks: int = 10_000) -> FleetStats:
+        from triton_distributed_tpu import config as _config
+
+        if trace is not None:
+            self.submit_trace(trace)
+        prev = _config.fleet_seed()
+        _config.set_fleet_seed(self.seed)
+        try:
+            for _ in range(max_ticks):
+                if self.idle:
+                    break
+                self.tick()
+        finally:
+            _config.set_fleet_seed(prev)
+        return self.stats
+
+    # -------------------------------------------------------- failover
+
+    def _check_replica_deaths(self) -> None:
+        """Consume the active plan's :class:`ReplicaDeath` faults —
+        the fleet twin of ``DisaggregatedEngine._check_slice_deaths``."""
+        from triton_distributed_tpu.runtime import faults as _faults
+
+        plan = _faults.active_plan()
+        if plan is None:
+            return
+        for k in plan.dead_replicas(self.ticks):
+            if k in self._death_handled or k >= len(self.replicas):
+                continue
+            self._death_handled.add(k)
+            self._kill(k)
+
+    def _kill(self, k: int) -> None:
+        self._dead.add(k)
+        if not self._alive():
+            raise RuntimeError(
+                f"fault plan killed every fleet replica by tick "
+                f"{self.ticks} — no survivor to fail over to")
+        replica = self.replicas[k]
+        self.health.record(
+            "replica_death", replica.peer, step=self.ticks,
+            detail=f"replica {k} died at tick {self.ticks}")
+        self.stats.deaths.append((k, self.ticks))
+        self._retire_engine(replica)
+        # drain: everything the replica held re-enters the FLEET queue
+        # at cursor 0 (the recompute-eviction discipline: re-prefilling
+        # prompt+generated resumes the exact cursor) and re-routes onto
+        # the survivors this same tick — zero lost requests, and the
+        # request-keyed sampler keeps the streams byte-identical
+        drained = sorted(replica.held(), key=lambda r: r.arrival)
+        for req in drained:
+            self.stats.failover_re_prefill_tokens += req.cursor
+            if req.cursor > 0:
+                req.evictions += 1
+            req.cursor = 0
+            req.slot = None
+            req.parked = False
+        self.stats.failover_requeued += len(drained)
+        for req in reversed(drained):
+            self.queue.appendleft(req)
+        replica.neutralize()
+        # the dead replica's sessions must re-home on their next request
+        for sess, idx in list(self.router.affinity.items()):
+            if idx == k:
+                del self.router.affinity[sess]
+
+    def _retire_engine(self, replica: Replica) -> None:
+        for role in replica._roles:
+            self.stats.retired_prefix_hits += role.stats.prefix_hits
+            self.stats.retired_evictions += role.stats.evictions
+            self.stats.retired_generated += role.stats.generated_tokens
+
+    def revive(self, k: int, engine=None) -> None:
+        """Bring replica ``k`` back with a FRESH engine (its old device
+        state died with it). The ledger still holds the fatal
+        ``replica_death`` record, so the replica re-enters rotation
+        only through probation probes — never a blind re-add."""
+        if k not in self._dead:
+            raise ValueError(f"replica {k} is not dead")
+        if engine is not None:
+            self.replicas[k].engine = engine
+        self._dead.discard(k)
+
+    # ------------------------------------------------------ aggregates
+
+    @property
+    def prefix_hits(self) -> int:
+        return self.stats.retired_prefix_hits + sum(
+            role.stats.prefix_hits
+            for r in self.replicas for role in r._roles
+            if r.index not in self._dead)
+
+    @property
+    def evictions(self) -> int:
+        return self.stats.retired_evictions + sum(
+            role.stats.evictions
+            for r in self.replicas for role in r._roles
+            if r.index not in self._dead)
+
+    @property
+    def generated_tokens(self) -> int:
+        return sum(r["n"] for r in self.stats.records.values()
+                   if r["completion_tick"] is not None)
+
+    @property
+    def goodput_tok_per_s(self) -> float:
+        """Generated tokens of completed requests per MODELED wall
+        second, where fleet wall = the SLOWEST replica's accumulated
+        perf-model step time (replicas run concurrently on their own
+        slices). Modeled, not measured: deterministic across runs, and
+        it credits compute the router actually avoided — a prefix hit
+        skips prefill chunks the model would otherwise bill. The
+        measured host wall lives in ``stats.replica_time``."""
+        wall = max(self.stats.replica_model_ms.values(), default=0.0)
+        return self.generated_tokens / (wall / 1e3) if wall > 0 else 0.0
+
+    def token_streams(self) -> dict:
+        """rid -> completed token list (None while incomplete) — what
+        the bench diffs against the fault-free reference run."""
+        return {rid: rec["tokens"]
+                for rid, rec in self.stats.records.items()}

@@ -430,11 +430,26 @@ def xla_kv_ship(payload, shardings):
     one), which is exactly the predictability a degraded path wants.
     The payload stays in its quantized pool form (int8 pages + f32
     per-row scale planes), so even the fallback never widens the wire
-    — a demotion changes the transport, never the bytes."""
+    — a demotion changes the transport, never the bytes.
+
+    Heartbeated like every other transport: the ``device_put`` is a
+    cross-mesh transfer that can wedge exactly like a collective (a
+    peer slice going away mid-flight hangs the runtime's copy), so the
+    body runs under the host-mode ``kv_ship`` watchdog instrument —
+    this was the LAST unheartbeated fallback entry point (``xla_ag_gemm``
+    and ``xla_gemm_rs`` instrument inside their shard_map bodies)."""
     import jax
 
-    return jax.tree.map(
-        lambda x, s: x if s is None else jax.device_put(x, s),
-        payload, shardings,
-        is_leaf=lambda x: x is None,
-    )
+    from triton_distributed_tpu import lang
+
+    def body():
+        return jax.tree.map(
+            lambda x, s: x if s is None else jax.device_put(x, s),
+            payload, shardings,
+            is_leaf=lambda x: x is None,
+        )
+
+    return lang.maybe_instrument(
+        body, axis=None, site="kv_ship", collective_id="xla_fallback",
+        n=1,
+    )()

@@ -293,6 +293,42 @@ def ragged_serving_step_ms(kv_lens, q_lens, *, page: int, hkv: int,
     )
 
 
+def replica_step_ms(engine, *, spec: TpuSpec | None = None) -> float:
+    """Analytic time of one engine step at the CURRENT resident
+    occupancy (:func:`ragged_serving_step_ms` over the active slots'
+    kv/cursor state): a slot still prefilling contributes its next
+    chunk of prompt tokens, a decoding slot one token. Duck-typed over
+    ``ServingEngine`` and either half of a disaggregated pair:
+    anything with ``slot_req``, ``cfg``/``model.config``-shaped knobs.
+    Cheap (no kernel runs) and deterministic — this is the modeled
+    step clock the fleet accumulates for reproducible goodput, and the
+    base of the router's :func:`replica_load_ms` perf term."""
+    spec = spec or detect_spec()
+    mc = engine.model.config
+    active = [r for r in engine.slot_req if r is not None]
+    kv_lens = [max(r.cursor, 1) for r in active] or [1]
+    q_lens = [
+        max(1, min(engine.cfg.chunk, len(r.prompt) - r.cursor))
+        if r.cursor < len(r.prompt) else 1
+        for r in active
+    ] or [1]
+    hkv = mc.n_kv_heads
+    return ragged_serving_step_ms(
+        kv_lens, q_lens, page=engine.cfg.page, hkv=hkv,
+        g=mc.n_heads // max(hkv, 1), d=mc.head_dim, hidden=mc.hidden,
+        n_layers=mc.n_layers, spec=spec,
+        quant=getattr(mc, "kv_quant", None) is not None,
+    )
+
+
+def replica_load_ms(engine, *, spec: TpuSpec | None = None) -> float:
+    """Queue-depth load estimate for one fleet replica: the analytic
+    :func:`replica_step_ms` scaled by how many admissions are already
+    queued ahead — the router's perf term."""
+    queued = len(engine.waiting) + len(engine.pending)
+    return replica_step_ms(engine, spec=spec) * (1.0 + queued)
+
+
 # ------------------------------------------------ hop critical-path term
 #
 # The dataflow pass (analysis/dataflow.py) counts, per element of every
