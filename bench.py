@@ -272,10 +272,19 @@ def _parse_args(argv=None):
         "benched collective.",
     )
     ap.add_argument(
+        "--n-layers", type=int, default=None, metavar="L",
+        help="serving benches: override the model depth (default 1). "
+        "The serving-state donation path only shows its cost at depth "
+        "> 1 — per-layer pool bytes are reported so the sweep is "
+        "explainable (ISSUE-12 satellite / ISSUE-6 follow-on)",
+    )
+    ap.add_argument(
         "scenario", nargs="?", default=None,
         help="run ONLY this named scenario (currently: serving_fleet "
-        "— the multi-replica router bench; composes with --dryrun and "
-        "--faults, e.g. the ISSUE-11 acceptance line "
+        "— the multi-replica router bench — or serving_speculative — "
+        "the draft-k speculative engine vs the plain engine, colocated "
+        "AND disaggregated; both compose with --dryrun and --faults, "
+        "e.g. the ISSUE-11 acceptance line "
         "'serving_fleet --dryrun --faults \"seed=1; "
         "ReplicaDeath(replica=1, step=8)\"')",
     )
@@ -365,14 +374,37 @@ def _run_lint() -> None:
             file=sys.stderr, flush=True,
         )
 
+    # speculative gate (ISSUE 12): the kernel families the speculative
+    # engine launches — by design the SAME ragged family as the plain
+    # engine — must be registered with a resolvable degradation target,
+    # so a speculative deployment degrades onto the XLA twin exactly
+    # like a plain one (verify rows are ordinary ragged rows there too)
+    from triton_distributed_tpu.serving.spec import SPEC_ENGINE_FAMILIES
+
+    spec_gaps = []
+    for fam in SPEC_ENGINE_FAMILIES:
+        if fam not in fams:
+            spec_gaps.append(
+                (fam, "speculative engine family not registered"))
+        elif fam in gap_names:
+            spec_gaps.append(
+                (fam, "speculative engine family has a degradation gap"))
+    for fam, problem in spec_gaps:
+        print(
+            json.dumps({"lint_spec_gap":
+                        {"family": fam, "problem": problem}}),
+            file=sys.stderr, flush=True,
+        )
+
     errs = (sum(f.severity >= Severity.ERROR for f in findings)
-            + len(gaps) + len(fleet_gaps))
+            + len(gaps) + len(fleet_gaps) + len(spec_gaps))
     print(
         json.dumps({"metric": "shmemlint", "errors": errs,
                     "findings": len(findings),
                     "rule_counts": rule_counts(findings),
                     "degradation_gaps": len(gaps),
                     "fleet_gaps": len(fleet_gaps),
+                    "spec_gaps": len(spec_gaps),
                     "mosaic_scanned": len(report["scanned"]),
                     "mosaic_refused": len(report["refused"])}),
         file=sys.stderr, flush=True,
@@ -407,7 +439,12 @@ def main(argv=None) -> None:
     if args.scenario is not None:
         from triton_distributed_tpu.tune.perf_model import detect_spec
 
-        if args.scenario != "serving_fleet":
+        scenarios = {
+            "serving_fleet": _bench_serving_fleet,
+            "serving_speculative": _bench_serving_speculative,
+        }
+        bench_fn = scenarios.get(args.scenario)
+        if bench_fn is None:
             print(json.dumps({"error":
                               f"unknown scenario {args.scenario!r}"}),
                   file=sys.stderr, flush=True)
@@ -415,7 +452,7 @@ def main(argv=None) -> None:
         devs = jax.devices()
         mesh = Mesh(np.asarray(devs), ("x",))
         on_tpu = jax.default_backend() == "tpu"
-        out = _bench_serving_fleet(
+        out = bench_fn(
             mesh, len(devs), on_tpu, detect_spec(),
             tiny=args.dryrun or not on_tpu,
         )
@@ -431,6 +468,7 @@ def main(argv=None) -> None:
         on_tpu = jax.default_backend() == "tpu"
         out = _bench_serving_continuous(
             mesh, len(devs), on_tpu, detect_spec(), tiny=True,
+            n_layers=args.n_layers,
         )
         out["faults"] = args.faults
         print(json.dumps(out), flush=True)
@@ -1354,12 +1392,15 @@ def _bench_serving_paged(mesh, n, on_tpu, spec):
     return out
 
 
-def _serving_continuous_config(n, on_tpu, tiny=False):
+def _serving_continuous_config(n, on_tpu, tiny=False, n_layers=None):
     """(model config, engine config, trace knobs) for the continuous
     bench. TPU: the serving headline model (hidden 7168, EP-MoE, every
     int8 knob) under the ISSUE-6 traffic shape — B≫128 requests,
     lengths ~U[S/8, 3S/4] against S=2048. Off-TPU (and --dryrun):
-    interpreter-sized shapes, same shape of traffic."""
+    interpreter-sized shapes, same shape of traffic. ``n_layers``
+    overrides the model depth (the ``--n-layers`` donation sweep —
+    depth > 1 exercises the per-layer serving-state donation path the
+    default depth-1 bench never touches)."""
     import jax.numpy as jnp
 
     from triton_distributed_tpu.models import TransformerConfig
@@ -1402,10 +1443,19 @@ def _serving_continuous_config(n, on_tpu, tiny=False):
             len_lo=s_cap // 8, len_hi=3 * s_cap // 4,
             max_new_lo=3, max_new_hi=8, vocab=256,
         )
+    if n_layers is not None and n_layers != cfg.n_layers:
+        from dataclasses import replace as _rep2
+
+        # keep the MoE layer set valid at the new depth (drop layers
+        # past it; added depth is dense — the donation path under test
+        # is per-layer KV state, not expert count)
+        moe_layers = tuple(l for l in cfg.moe_layers if l < n_layers)
+        cfg = _rep2(cfg, n_layers=int(n_layers), moe_layers=moe_layers)
     return cfg, ecfg, trace_kw, s_cap
 
 
-def _bench_serving_continuous(mesh, n, on_tpu, spec, tiny=False):
+def _bench_serving_continuous(mesh, n, on_tpu, spec, tiny=False,
+                              n_layers=None):
     """CONTINUOUS-BATCHING serving on the ragged paged-attention kernel
     (ISSUE 6 tentpole acceptance): a seeded Poisson arrival trace with
     ~U[S/8, 3S/4] prompt lengths drives the ServingEngine — admission/
@@ -1427,7 +1477,7 @@ def _bench_serving_continuous(mesh, n, on_tpu, spec, tiny=False):
     )
 
     cfg, ecfg, trace_kw, s_cap = _serving_continuous_config(
-        n, on_tpu, tiny
+        n, on_tpu, tiny, n_layers=n_layers
     )
     model = Transformer(cfg, mesh, tp_axis="x")
     params = jax.tree.map(
@@ -1447,6 +1497,12 @@ def _bench_serving_continuous(mesh, n, on_tpu, spec, tiny=False):
         stats = eng.run(trace)
     assert stats.completed == trace_kw["n_requests"], (
         stats.completed, stats.deferrals)
+    # per-layer KV pool footprint: at depth > 1 the engine carries one
+    # (k_pool, v_pool) pair PER LAYER, all donated through the jitted
+    # step — the `--n-layers` sweep's reported quantity
+    per_layer_pool_bytes = sum(
+        int(x.nbytes) for x in jax.tree.leaves(eng.state.layers[0])
+    )
 
     # ---- fixed-batch paged baseline on the SAME trace: FCFS
     # rectangles of `slots` requests, padded prompts, every row decoded
@@ -1504,7 +1560,7 @@ def _bench_serving_continuous(mesh, n, on_tpu, spec, tiny=False):
     model_ms = ragged_serving_step_ms(
         [mean_len] * ecfg.slots, [1] * ecfg.slots, page=page,
         hkv=cfg.n_kv_heads // n, g=cfg.n_heads // cfg.n_kv_heads,
-        d=cfg.head_dim, hidden=cfg.hidden,
+        d=cfg.head_dim, hidden=cfg.hidden, n_layers=cfg.n_layers,
         spec=spec, quant=cfg.kv_quant is not None,
         # the backend's MEASURED per-page issue cost (ROADMAP
         # follow-on): off-TPU the interpreter pays milliseconds per
@@ -1529,6 +1585,9 @@ def _bench_serving_continuous(mesh, n, on_tpu, spec, tiny=False):
         "fixed_batch_goodput": round(base_goodput, 1),
         "goodput_vs_fixed_batch": round(ratio, 3),
         "model_steady_step_ms": round(model_ms, 3),
+        "n_layers": cfg.n_layers,
+        "per_layer_pool_bytes": per_layer_pool_bytes,
+        "pool_bytes_total": per_layer_pool_bytes * cfg.n_layers,
         "config": (
             f"n={n} slots={ecfg.slots} budget={ecfg.token_budget} "
             f"chunk={ecfg.chunk} page={page} npages={ecfg.npages} "
@@ -1761,6 +1820,230 @@ def _bench_serving_disaggregated(mesh, n, on_tpu, spec, tiny=False):
             f"requests={trace_kw['n_requests']} "
             f"lens~U[{trace_kw['len_lo']},{trace_kw['len_hi']}] "
             f"temp=0.7 top_k=40 kvq={cfg.kv_quant} "
+            + ("tiny-dryrun" if tiny or not on_tpu else "headline")
+        ),
+    }
+
+
+def _bench_serving_speculative(mesh, n, on_tpu, spec, tiny=False):
+    """SPECULATIVE decoding (ISSUE 12 tentpole acceptance): the PR-6
+    Poisson trace with MOTIF-HEAVY prompts (repeated 5-token motifs —
+    the traffic shape prompt-lookup speculation exists for) served
+    three ways: (1) the plain colocated engine — the token-stream
+    reference; (2) the colocated SpeculativeEngine (n-gram drafter,
+    spec_k=4) — must reproduce the reference streams byte-identically
+    while emitting >1 accepted token per verify row; (3) the
+    disaggregated engine with a speculative decode role — same streams
+    again, with KV still shipping on the quantized DCN wire at the
+    CHANGED cadence (fewer, wider decode steps). Reports the decode
+    p50/p99 deltas speculation buys and the perf-model rows that price
+    the cadence change for placement (`spec_step_ms`, the truncated-
+    geometric accepted/step prior, and `refuse_disaggregation` with
+    and without `spec_k` in the traffic dict)."""
+    import jax
+
+    from triton_distributed_tpu.models import Transformer
+    from triton_distributed_tpu.serving import (
+        DisaggregatedEngine,
+        NGramDrafter,
+        ServingEngine,
+        SpeculativeEngine,
+        poisson_trace,
+    )
+    from triton_distributed_tpu.tune.perf_model import (
+        DEFAULT_SPEC_ACCEPTANCE,
+        expected_accepted_per_step,
+        measured_page_issue_ms,
+        ragged_serving_step_ms,
+        refuse_disaggregation,
+        spec_step_ms,
+    )
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return {"metric": "serving_speculative",
+                "error": "needs >= 2 devices for the disaggregated leg"}
+    half = len(devs) // 2
+    mesh_p = Mesh(np.asarray(devs[:half]), ("x",))
+    mesh_d = Mesh(np.asarray(devs[half:2 * half]), ("x",))
+    hybrid = Mesh(
+        np.asarray(devs[:2 * half]).reshape(2, half), ("dcn", "x")
+    )
+
+    cfg, ecfg, trace_kw, s_cap = _serving_continuous_config(
+        half, on_tpu, tiny
+    )
+    from dataclasses import replace as _rep
+
+    # GREEDY decode: at temperature 0 every engine argmaxes the same
+    # logits, so acceptance is purely "did the drafter guess the
+    # model's next token" — the honest accepted/step for prompt-lookup
+    ecfg = _rep(ecfg, temperature=0.0, seed=11)
+    if not on_tpu or tiny:
+        # decode-heavy traffic: long generation tails (greedy decode on
+        # a tiny model settles into repetitive continuations — the
+        # regime prompt-lookup drafting feeds on) and pool headroom for
+        # the provisional draft pages
+        trace_kw = dict(
+            trace_kw, len_lo=8, len_hi=32,
+            max_new_lo=16, max_new_hi=32,
+        )
+        ecfg = _rep(ecfg, npages=64)
+    spec_k = 4
+
+    def build(mesh_role):
+        model = Transformer(cfg, mesh_role, tp_axis="x")
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s),
+            model.init(jax.random.PRNGKey(7)), model.shardings(),
+        )
+        params = model.quantize_moe_weights(params)
+        params = model.quantize_dense_weights(params)
+        return model, params
+
+    model_p, params_p = build(mesh_p)
+    model_d, params_d = build(mesh_d)
+
+    def fresh_trace():
+        """The Poisson arrivals/max_new, with every prompt rewritten
+        into a repeated 5-token motif (fresh Request objects per call —
+        engines mutate them in place). Deterministic."""
+        base = poisson_trace(seed=11, **trace_kw)
+        rng = np.random.default_rng(29)
+        for r in base:
+            ln = len(r.prompt)
+            motif = rng.integers(
+                0, trace_kw["vocab"], (5,)).astype(np.int32)
+            r.prompt = np.tile(motif, -(-ln // 5))[:ln]
+        return base
+
+    # ---- (1) plain colocated reference (warm run pays compiles)
+    for _warm in (False, True):
+        trace_ref = fresh_trace()
+        eng_ref = ServingEngine(model_p, params_p, ecfg)
+        stats_ref = eng_ref.run(trace_ref)
+    assert stats_ref.completed == trace_kw["n_requests"], (
+        stats_ref.completed, stats_ref.deferrals)
+
+    # ---- (2) colocated speculative, n-gram drafter
+    for _warm in (False, True):
+        trace_s = fresh_trace()
+        eng_s = SpeculativeEngine(
+            model_p, params_p, ecfg, spec_k=spec_k,
+            drafter=NGramDrafter(),
+        )
+        stats_s = eng_s.run(trace_s)
+    assert stats_s.completed == trace_kw["n_requests"], (
+        stats_s.completed, stats_s.deferrals)
+    mism_coloc = sum(
+        a.generated != b.generated for a, b in zip(trace_ref, trace_s)
+    )
+
+    # ---- (3) disaggregated with a speculative decode role
+    for _warm in (False, True):
+        trace_d = fresh_trace()
+        eng_d = DisaggregatedEngine(
+            model_p, params_p, model_d, params_d, ecfg,
+            hybrid_mesh=hybrid, dcn_axis="dcn", transport="dcn",
+            ship_delay_steps=1, spec_k=spec_k, drafter=NGramDrafter(),
+        )
+        stats_d = eng_d.run(trace_d)
+    assert stats_d.completed == trace_kw["n_requests"], (
+        stats_d.completed, len(eng_d._ready), len(eng_d._inflight))
+    mism_disagg = sum(
+        a.generated != b.generated for a, b in zip(trace_ref, trace_d)
+    )
+
+    # ---- perf-model: the priced ship-cadence change. Speculation
+    # widens each decode row to q=1+k and shrinks the decode window to
+    # max_new/accepted steps — the rows placement reasons with.
+    mean_len = (trace_kw["len_lo"] + trace_kw["len_hi"]) // 2
+    hkv_l = max(1, cfg.n_kv_heads // half)
+    g = cfg.n_heads // cfg.n_kv_heads
+    plain_ms = ragged_serving_step_ms(
+        [mean_len] * ecfg.slots, [1] * ecfg.slots, page=ecfg.page,
+        hkv=hkv_l, g=g, d=cfg.head_dim, hidden=cfg.hidden,
+        n_layers=cfg.n_layers, spec=spec,
+        quant=cfg.kv_quant is not None,
+        issue_ms=measured_page_issue_ms(),
+    )
+    spec_ms = spec_step_ms(
+        [mean_len] * ecfg.slots, spec_k=spec_k, page=ecfg.page,
+        hkv=hkv_l, g=g, d=cfg.head_dim, hidden=cfg.hidden,
+        n_layers=cfg.n_layers, spec=spec,
+        quant=cfg.kv_quant is not None,
+        issue_ms=measured_page_issue_ms(),
+    )
+    prior_acc = expected_accepted_per_step(
+        spec_k, DEFAULT_SPEC_ACCEPTANCE
+    )
+    measured_acc = stats_s.accepted_tokens_per_step
+    traffic = {
+        "prompt_len": mean_len,
+        "max_new": (trace_kw["max_new_lo"]
+                    + trace_kw["max_new_hi"]) // 2,
+    }
+    refusal_plain = refuse_disaggregation(cfg, ecfg.page, traffic, spec)
+    p_meas = (min(1.0, stats_s.draft_acceptance_rate)
+              if stats_s.draft_tokens else DEFAULT_SPEC_ACCEPTANCE)
+    refusal_spec = refuse_disaggregation(
+        cfg, ecfg.page,
+        dict(traffic, spec_k=spec_k, spec_acceptance=p_meas),
+        spec,
+    )
+
+    p50_ref, p99_ref = (stats_ref.decode_p50_step_ms,
+                        stats_ref.decode_p99_step_ms)
+    p50_s, p99_s = (stats_s.decode_p50_step_ms,
+                    stats_s.decode_p99_step_ms)
+    return {
+        "metric": "serving_speculative",
+        "value": round(measured_acc, 3),
+        "unit": "accepted tok/verify-step",
+        "accepted_tokens_per_step": round(measured_acc, 3),
+        "draft_acceptance_rate": round(
+            stats_s.draft_acceptance_rate, 3),
+        "token_mismatches_vs_nonspeculative": mism_coloc,
+        "token_mismatches_disaggregated": mism_disagg,
+        "spec_rows": stats_s.spec_rows,
+        "draft_tokens": stats_s.draft_tokens,
+        "rolled_back_tokens": stats_s.rolled_back_tokens,
+        "steps": len(stats_s.step_times),
+        "steps_nonspeculative": len(stats_ref.step_times),
+        "decode_p50_step_ms": round(p50_s, 2),
+        "decode_p99_step_ms": round(p99_s, 2),
+        "decode_p50_delta_ms": round(p50_s - p50_ref, 2),
+        "decode_p99_delta_ms": round(p99_s - p99_ref, 2),
+        "goodput_tok_per_s": round(stats_s.goodput_tok_per_s, 1),
+        "goodput_vs_nonspeculative": round(
+            stats_s.goodput_tok_per_s / stats_ref.goodput_tok_per_s, 3
+        ) if stats_ref.goodput_tok_per_s else None,
+        "disagg_accepted_tokens_per_step": round(
+            stats_d.decode.accepted_tokens_per_step, 3),
+        "disagg_ships": stats_d.ships,
+        "disagg_decode_p99_ms": round(stats_d.decode_p99_step_ms, 2),
+        # the priced cadence change: ms per EMITTED token, before and
+        # after speculation — what replica_load_ms and auto placement
+        # now reason with
+        "model_plain_step_ms": round(plain_ms, 4),
+        "model_spec_step_ms": round(spec_ms, 4),
+        "model_accepted_per_step_prior": round(prior_acc, 3),
+        "model_ms_per_token_plain": round(plain_ms, 4),
+        "model_ms_per_token_spec": round(
+            spec_ms / max(measured_acc, 1.0), 4),
+        "auto_placement_plain": (
+            ("refused: " + refusal_plain) if refusal_plain
+            else "accepted"),
+        "auto_placement_spec": (
+            ("refused: " + refusal_spec) if refusal_spec
+            else "accepted"),
+        "config": (
+            f"2x{half} hybrid mesh, spec_k={spec_k} ngram drafter "
+            f"slots={ecfg.slots} budget={ecfg.token_budget} "
+            f"chunk={ecfg.chunk} page={ecfg.page} "
+            f"npages={ecfg.npages} requests={trace_kw['n_requests']} "
+            f"motif-prompts lens~U[{trace_kw['len_lo']},"
+            f"{trace_kw['len_hi']}] greedy "
             + ("tiny-dryrun" if tiny or not on_tpu else "headline")
         ),
     }

@@ -113,3 +113,62 @@ print(f"fleet smoke: {stats.completed}/{stats.submitted} completed, "
       f"0 lost across ReplicaDeath(replica=1, step=6), "
       f"requeued={stats.failover_requeued}")
 EOF
+
+# Speculative decoding smoke (ISSUE 12 acceptance): a short motif-heavy
+# trace through the SpeculativeEngine (n-gram drafter) vs the plain
+# engine — exits nonzero unless the streams are BYTE-IDENTICAL
+# (token_mismatches == 0, the rejection-sampling identity) AND the
+# drafter actually earned its keep (accepted_tokens_per_step > 1.0).
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from triton_distributed_tpu.models import Transformer, TransformerConfig
+from triton_distributed_tpu.serving import (
+    EngineConfig, NGramDrafter, ServingEngine, SpeculativeEngine,
+    poisson_trace,
+)
+
+cfg = TransformerConfig(
+    vocab=128, n_layers=2, hidden=64, ffn=128, n_heads=4, n_kv_heads=2,
+    head_dim=16, dtype=jnp.float32, param_dtype=jnp.float32)
+ecfg = EngineConfig(slots=4, token_budget=48, chunk=16, page=8,
+                    npages=40)
+mesh = Mesh(np.asarray(jax.devices()[:1]), ("tp",))
+model = Transformer(cfg, mesh, "tp", ())
+params = model.init(jax.random.PRNGKey(0))
+
+def mk_trace():
+    base = poisson_trace(seed=7, n_requests=6, mean_interarrival=0.5,
+                         len_lo=8, len_hi=30, max_new_lo=8,
+                         max_new_hi=16, vocab=128)
+    rng = np.random.default_rng(1007)
+    for r in base:
+        ln = len(r.prompt)
+        motif = rng.integers(0, 128, (5,)).astype(np.int32)
+        r.prompt = np.tile(motif, -(-ln // 5))[:ln]
+    return base
+
+t_ref = mk_trace()
+ServingEngine(model, params, ecfg, use_pallas=False).run(
+    t_ref, max_steps=600)
+t_spec = mk_trace()
+eng = SpeculativeEngine(model, params, ecfg, spec_k=4,
+                        drafter=NGramDrafter(), use_pallas=False)
+stats = eng.run(t_spec, max_steps=600)
+mismatches = sum(
+    a.generated != b.generated for a, b in zip(t_ref, t_spec))
+acc = stats.accepted_tokens_per_step
+assert mismatches == 0, (
+    f"speculative smoke: {mismatches} token-stream mismatches vs the "
+    f"non-speculative engine")
+assert acc > 1.0, (
+    f"speculative smoke: accepted_tokens_per_step={acc:.3f} <= 1.0 "
+    f"(spec_rows={stats.spec_rows}, drafted={stats.draft_tokens})")
+print(f"speculative smoke: 0 mismatches across {stats.completed} "
+      f"requests, accepted_tokens_per_step={acc:.2f} "
+      f"(verify rows={stats.spec_rows}, "
+      f"rolled_back={stats.rolled_back_tokens})")
+EOF

@@ -426,3 +426,86 @@ class TestChaosSites:
                     assert np.array_equal(out["pages"],
                                           payload["pages"])
         assert led.state("site:kv_ship") is PeerState.UNHEALTHY
+
+
+# -------------------------------------------- admission control (cap)
+
+class TestAdmissionControl:
+    """RouterConfig.queue_cap: a flooded trace is REJECTED with a
+    priced retry-after once every routable replica's queue is at cap —
+    `waiting` stops growing without bound, and nothing is lost (the
+    harness re-enters rejected requests at their retry tick, standing
+    in for a client honoring Retry-After)."""
+
+    def _flooded_fleet(self, fleet_models, cap, slots=2):
+        kw = dict(ECFG, slots=slots, npages=24)
+        engines = [ServingEngine(m, p, EngineConfig(**kw),
+                                 use_pallas=False)
+                   for m, p in fleet_models]
+        return ServingFleet(engines, seed=1,
+                            router=RouterConfig(queue_cap=cap))
+
+    def _flood(self, n):
+        return [_req(i, arrival=0, plen=10, max_new=4)
+                for i in range(n)]
+
+    def test_flood_rejects_with_priced_retry_after(self, fleet_models):
+        fleet = self._flooded_fleet(fleet_models, cap=2)
+        stats = fleet.run(self._flood(14))
+        assert stats.admission_rejections > 0
+        assert stats.lost_requests == 0
+        # the retry-after is PRICED (perf-model ms), never a blind 0
+        assert len(stats.retry_after_ms) == stats.admission_rejections
+        assert all(ms > 0 for ms in stats.retry_after_ms)
+        # the cap held: no replica's queue ever exceeded cap + the
+        # one-tick dispatch batch the cap is applied within
+        assert all(r.queue_depth() == 0 for r in fleet.replicas)
+
+    def test_cap_bounds_queue_depth_vs_uncapped(self, fleet_models):
+        """The uncapped fleet buffers the whole flood in `waiting`; the
+        capped fleet never queues deeper than cap at dispatch time."""
+        kw = dict(ECFG, slots=2, npages=24)
+
+        def depth_trace(router):
+            engines = [ServingEngine(m, p, EngineConfig(**kw),
+                                     use_pallas=False)
+                       for m, p in fleet_models]
+            fleet = ServingFleet(engines, seed=1, router=router)
+            fleet.submit_trace(self._flood(14))
+            depths = []
+            for _ in range(200):
+                if fleet.idle:
+                    break
+                fleet.tick()
+                depths.append(max(r.queue_depth()
+                                  for r in fleet.replicas))
+            return fleet.stats, max(depths)
+
+        un_stats, un_depth = depth_trace(RouterConfig())
+        cap_stats, cap_depth = depth_trace(RouterConfig(queue_cap=2))
+        assert un_stats.lost_requests == 0
+        assert cap_stats.lost_requests == 0
+        assert un_stats.admission_rejections == 0
+        assert cap_stats.admission_rejections > 0
+        assert cap_depth < un_depth, (cap_depth, un_depth)
+        # dispatch admits into slots before queueing, so post-tick
+        # depth stays bounded by the cap itself
+        assert cap_depth <= 2
+
+    def test_flood_with_replica_death_chaos(self, fleet_models):
+        """Chaos pin: the cap keeps rejecting (on the survivor's queue
+        alone) across a mid-flood ReplicaDeath, and the drain + retry
+        paths compose — zero lost requests."""
+        fleet = self._flooded_fleet(fleet_models, cap=2)
+        plan = faults.parse_plan(
+            "seed=1; ReplicaDeath(replica=1, step=3)")
+        with faults.fault_plan(plan):
+            stats = fleet.run(self._flood(12))
+        assert stats.deaths == [(1, 3)]
+        assert stats.admission_rejections > 0
+        assert stats.lost_requests == 0
+        assert stats.failover_requeued >= 0
+
+    def test_zero_cap_refused(self, fleet_models):
+        with pytest.raises(ValueError, match="queue_cap"):
+            self._flooded_fleet(fleet_models, cap=0)

@@ -1,0 +1,427 @@
+"""Speculative decoding: drafters, the verify/accept row, and the
+rejection-sampling identity.
+
+The ISSUE-12 pin: every token stream the SpeculativeEngine produces is
+BYTE-IDENTICAL to the non-speculative engine's — across chunked-prefill
+contention, recompute-eviction mid-draft, tp=2 head sharding, and the
+disaggregated ship cadence. The accept rule samples each position from
+the verify row's logits with the request-keyed draw and accepts a draft
+only on exact match, so wrong drafts can never perturb the stream (the
+rejection-sampling identity under deterministic keyed draws); these
+tests make that claim falsifiable everywhere scheduling could differ.
+
+Also covered: drafter determinism (pure functions of the token history,
+invariant under ``config.interp_key()`` perturbations), rollback page
+accounting (rejected tails leak no pool pages), and the perf-model spec
+terms the fleet router and `auto` placement price speculation with.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from triton_distributed_tpu.models import Transformer, TransformerConfig
+from triton_distributed_tpu.serving import (
+    DisaggregatedEngine,
+    DraftModelDrafter,
+    Drafter,
+    EngineConfig,
+    NGramDrafter,
+    Request,
+    ServingEngine,
+    SpeculativeEngine,
+    make_drafter,
+    poisson_trace,
+)
+
+pytestmark = pytest.mark.fast
+
+CFG = dict(
+    vocab=128, n_layers=2, hidden=64, ffn=128,
+    n_heads=4, n_kv_heads=2, head_dim=16,
+    dtype=jnp.float32, param_dtype=jnp.float32,
+)
+
+ECFG = dict(slots=4, token_budget=48, chunk=16, page=8, npages=40)
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return Mesh(np.asarray(jax.devices()[:1]), ("tp",))
+
+
+@pytest.fixture(scope="module")
+def model_params(mesh1):
+    model = Transformer(TransformerConfig(**CFG), mesh1, "tp", ())
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _motif_trace(seed, n, mean_ia, len_lo, len_hi, new_lo, new_hi,
+                 vocab=128):
+    """Poisson arrivals with prompts rewritten into repeated 5-token
+    motifs — the traffic prompt-lookup drafting feeds on. Fresh Request
+    objects per call (engines mutate them in place)."""
+    base = poisson_trace(seed, n, mean_ia, len_lo, len_hi, new_lo,
+                         new_hi, vocab)
+    rng = np.random.default_rng(seed + 1000)
+    for r in base:
+        ln = len(r.prompt)
+        motif = rng.integers(0, vocab, (5,)).astype(np.int32)
+        r.prompt = np.tile(motif, -(-ln // 5))[:ln]
+    return base
+
+
+def _req(prompt, max_new=4, rid=0):
+    return Request(rid=rid, prompt=np.asarray(prompt, np.int32),
+                   max_new=max_new, arrival=0.0)
+
+
+class _WrongDrafter(Drafter):
+    """Adversarial drafter: always proposes the SAME (usually wrong)
+    token — maximal rejection pressure on the rollback path. Still a
+    deterministic pure function of the history, so streams must stay
+    token-exact no matter how much it drafts wrong."""
+
+    name = "wrong"
+
+    def draft(self, req, k):
+        tok = (int(req.seq[-1]) + 1) % 128
+        return np.full((k,), tok, np.int32)
+
+
+class TestDrafters:
+    def test_ngram_draft_is_pure_and_deterministic(self):
+        d = NGramDrafter()
+        req = _req([1, 2, 3, 9, 1, 2, 3])
+        a = d.draft(req, 3)
+        b = d.draft(req, 3)
+        np.testing.assert_array_equal(a, b)
+        # proposes the continuation of the earlier [1, 2, 3]
+        np.testing.assert_array_equal(a, [9, 1, 2])
+
+    def test_ngram_rightmost_match_wins(self):
+        # [5, 7] occurs twice with different continuations; recency
+        # (the rightmost earlier occurrence) must win the tie-break
+        req = _req([5, 7, 1, 5, 7, 2, 5, 7])
+        out = NGramDrafter().draft(req, 1)
+        np.testing.assert_array_equal(out, [2])
+
+    def test_ngram_no_match_degrades_to_empty(self):
+        out = NGramDrafter().draft(_req([1, 2, 3, 4]), 4)
+        assert out.shape == (0,)
+
+    def test_ngram_invariant_under_interp_key_knobs(self):
+        """Drafting is a pure function of the token history — the
+        chaos/fleet knobs folded into config.interp_key() must not
+        reach it (a drafter that varied with them would break the
+        determinism the accept rule's token-exactness rests on)."""
+        from triton_distributed_tpu.config import config, set_fleet_seed
+
+        d = NGramDrafter()
+        req = _req([4, 6, 4, 6, 4, 6, 8])
+        base = d.draft(req, 3)
+        old_delay = config.chaos_delay
+        try:
+            set_fleet_seed(1234)
+            config.chaos_delay = 7
+            np.testing.assert_array_equal(d.draft(req, 3), base)
+        finally:
+            set_fleet_seed(None)
+            config.chaos_delay = old_delay
+
+    def test_draft_model_walks_the_bigram_table(self, model_params):
+        model, params = model_params
+        d = DraftModelDrafter(model, params)
+        out = d.draft(_req([3, 5]), 3)
+        table = d._bigram_table()
+        assert table.shape == (128,)
+        # greedy walk from the frontier token
+        want, tok = [], 5
+        for _ in range(3):
+            tok = int(table[tok])
+            want.append(tok)
+        np.testing.assert_array_equal(out, want)
+
+    def test_draft_model_dequantizes_int8_lm_head(self, model_params,
+                                                  mesh1):
+        model, params = model_params
+        qmodel = Transformer(
+            TransformerConfig(**CFG, dense_weight_quant="int8"),
+            mesh1, "tp", (),
+        )
+        qparams = qmodel.quantize_dense_weights(
+            jax.tree.map(lambda x: x, params))
+        assert isinstance(qparams["lm_head"], dict)
+        t_f = DraftModelDrafter(model, params)._bigram_table()
+        t_q = DraftModelDrafter(qmodel, qparams)._bigram_table()
+        # int8 rounding may flip near-tie argmaxes on a random init;
+        # the tables must still substantially agree
+        assert (t_f == t_q).mean() > 0.8
+
+    def test_make_drafter(self, model_params):
+        model, params = model_params
+        assert isinstance(make_drafter("ngram", max_ngram=2),
+                          NGramDrafter)
+        assert isinstance(
+            make_drafter("draft_model", model, params),
+            DraftModelDrafter)
+        with pytest.raises(ValueError, match="needs model"):
+            make_drafter("draft_model")
+        with pytest.raises(ValueError, match="unknown drafter"):
+            make_drafter("nope")
+
+
+class TestRejectionSamplingIdentity:
+    """Speculative streams byte-identical to the plain engine's."""
+
+    def _streams(self, model, params, trace_fn, ecfg, **spec_kw):
+        t_ref = trace_fn()
+        ref = ServingEngine(model, params, EngineConfig(**ecfg))
+        s_ref = ref.run(t_ref, max_steps=800)
+        t_spec = trace_fn()
+        eng = SpeculativeEngine(model, params, EngineConfig(**ecfg),
+                                **spec_kw)
+        s_spec = eng.run(t_spec, max_steps=800)
+        assert s_ref.completed == s_spec.completed == len(t_ref)
+        return t_ref, t_spec, s_spec, eng
+
+    def test_token_exact_under_chunked_contention(self, model_params):
+        """Verify rows interleaved with other requests' chunked
+        prefill — the mixed-batch case the ragged kernel makes free."""
+        model, params = model_params
+        t_ref, t_spec, stats, _ = self._streams(
+            model, params,
+            lambda: _motif_trace(7, 6, 0.5, 8, 30, 8, 16),
+            ECFG, spec_k=4, drafter=NGramDrafter(),
+        )
+        assert stats.spec_rows > 0
+        assert stats.accepted_draft_tokens > 0, (
+            "trace never exercised an accepted draft")
+        for a, b in zip(t_ref, t_spec):
+            assert a.generated == b.generated, a.rid
+
+    def test_token_exact_with_eviction_mid_draft(self, model_params):
+        """Pool far smaller than the load: recompute-evictions fire
+        while drafts are in flight; evicted requests re-prefill
+        prompt+generated and the streams still match."""
+        model, params = model_params
+        t_ref, t_spec, stats, _ = self._streams(
+            model, params,
+            lambda: _motif_trace(9, 8, 0.4, 8, 30, 8, 16),
+            dict(ECFG, npages=14), spec_k=4, drafter=NGramDrafter(),
+        )
+        assert stats.evictions > 0, "config failed to force an eviction"
+        assert stats.spec_rows > 0
+        for a, b in zip(t_ref, t_spec):
+            assert a.generated == b.generated, a.rid
+
+    def test_token_exact_under_rejection_pressure(self, model_params):
+        """An always-wrong drafter maximizes rollback traffic — every
+        verify row rejects its whole tail — and the streams must be
+        untouched (the identity does not depend on drafter quality)."""
+        model, params = model_params
+        t_ref, t_spec, stats, eng = self._streams(
+            model, params,
+            lambda: _motif_trace(11, 5, 0.6, 8, 24, 6, 10),
+            ECFG, spec_k=3, drafter=_WrongDrafter(),
+        )
+        assert stats.spec_rows > 0
+        assert stats.rolled_back_tokens > 0
+        assert stats.accepted_draft_tokens == 0
+        for a, b in zip(t_ref, t_spec):
+            assert a.generated == b.generated, a.rid
+        # rollback page accounting: with every slot drained, the
+        # rejected tails' pages are all back in the pool
+        assert all(r is None for r in eng.slot_req)
+        assert eng.pool.available == eng.cfg.npages
+
+    def test_token_exact_sampled_temperature(self, model_params):
+        """temperature/top-k sampling: the keyed draws make acceptance
+        rarer but the identity is unconditional."""
+        model, params = model_params
+        ecfg = dict(ECFG, temperature=0.7, top_k=40, seed=5)
+        t_ref, t_spec, stats, _ = self._streams(
+            model, params,
+            lambda: _motif_trace(13, 5, 0.6, 8, 24, 6, 10),
+            ecfg, spec_k=4, drafter=NGramDrafter(),
+        )
+        assert stats.spec_rows > 0
+        for a, b in zip(t_ref, t_spec):
+            assert a.generated == b.generated, a.rid
+
+    def test_tp2_head_sharding_token_exact(self):
+        """tp=2: the verify row's logits come off a head-sharded
+        ragged step; the accept loop must see identical draws."""
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("needs 2 devices")
+        mesh2 = Mesh(np.asarray(devs[:2]), ("tp",))
+        model = Transformer(TransformerConfig(**CFG), mesh2, "tp", ())
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s),
+            model.init(jax.random.PRNGKey(0)), model.shardings(),
+        )
+        t_ref = _motif_trace(7, 5, 0.5, 8, 30, 8, 14)
+        ServingEngine(model, params, EngineConfig(**ECFG)).run(
+            t_ref, max_steps=600)
+        t_spec = _motif_trace(7, 5, 0.5, 8, 30, 8, 14)
+        eng = SpeculativeEngine(
+            model, params, EngineConfig(**ECFG), spec_k=4,
+            drafter=NGramDrafter(),
+        )
+        stats = eng.run(t_spec, max_steps=600)
+        assert stats.completed == 5 and stats.spec_rows > 0
+        for a, b in zip(t_ref, t_spec):
+            assert a.generated == b.generated, a.rid
+
+    def test_run_is_deterministic(self, model_params):
+        model, params = model_params
+        outs = []
+        for _ in range(2):
+            trace = _motif_trace(3, 5, 0.6, 8, 24, 6, 10)
+            eng = SpeculativeEngine(
+                model, params, EngineConfig(**ECFG), spec_k=4,
+                drafter=NGramDrafter(),
+            )
+            eng.run(trace, max_steps=600)
+            outs.append([tuple(r.generated) for r in trace])
+        assert outs[0] == outs[1]
+
+    def test_max_new_is_exact(self, model_params):
+        """A full-accept verify row near the emission target must not
+        overshoot max_new — stream lengths match the plain engine."""
+        model, params = model_params
+        trace = _motif_trace(17, 4, 0.5, 10, 20, 3, 5)
+        eng = SpeculativeEngine(
+            model, params, EngineConfig(**ECFG), spec_k=4,
+            drafter=NGramDrafter(),
+        )
+        eng.run(trace, max_steps=400)
+        for r in trace:
+            assert len(r.generated) == r.max_new, r.rid
+
+    def test_spec_k_wider_than_chunk_rejected(self, model_params):
+        model, params = model_params
+        with pytest.raises(ValueError, match="chunk"):
+            SpeculativeEngine(
+                model, params,
+                EngineConfig(slots=2, token_budget=32, chunk=4, page=8,
+                             npages=16),
+                spec_k=4,
+            )
+        with pytest.raises(ValueError, match="spec_k"):
+            SpeculativeEngine(model, params, EngineConfig(**ECFG),
+                              spec_k=0)
+
+
+class TestSpeculativeDisaggregated:
+    def test_disagg_ship_cadence_token_exact(self):
+        """DisaggregatedEngine(spec_k=4): prefill KV ships on the DCN
+        wire, the decode role verifies drafts — fewer, wider decode
+        steps (the changed cadence) with streams still equal to the
+        colocated PLAIN engine's."""
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("needs 2 devices")
+        mesh_p = Mesh(np.asarray(devs[:1]), ("tp",))
+        mesh_d = Mesh(np.asarray(devs[1:2]), ("tp",))
+        hybrid = Mesh(np.asarray(devs[:2]).reshape(2, 1), ("dcn", "tp"))
+        cfg = TransformerConfig(**{**CFG, "kv_quant": "int8"})
+        mp = Transformer(cfg, mesh_p, "tp", ())
+        md = Transformer(cfg, mesh_d, "tp", ())
+        params = mp.init(jax.random.PRNGKey(0))
+        pp = jax.tree.map(lambda x, s: jax.device_put(x, s), params,
+                          mp.shardings())
+        pd = jax.tree.map(lambda x, s: jax.device_put(x, s), params,
+                          md.shardings())
+        ecfg = EngineConfig(**ECFG)
+        t_ref = _motif_trace(9, 5, 0.7, 8, 30, 8, 14)
+        ServingEngine(mp, pp, ecfg).run(t_ref, max_steps=600)
+        t_d = _motif_trace(9, 5, 0.7, 8, 30, 8, 14)
+        eng = DisaggregatedEngine(
+            mp, pp, md, pd, ecfg, hybrid_mesh=hybrid, dcn_axis="dcn",
+            transport="dcn", ship_delay_steps=1, spec_k=4,
+            drafter=NGramDrafter(),
+        )
+        stats = eng.run(t_d, max_ticks=900)
+        assert stats.completed == 5
+        assert stats.ships > 0
+        assert isinstance(eng.decode, SpeculativeEngine)
+        assert stats.decode.spec_rows > 0
+        assert stats.decode.accepted_draft_tokens > 0
+        for a, b in zip(t_ref, t_d):
+            assert a.generated == b.generated, a.rid
+
+
+class TestSpecPerfModel:
+    def test_expected_accepted_bounds_and_monotonicity(self):
+        from triton_distributed_tpu.tune.perf_model import (
+            expected_accepted_per_step,
+        )
+
+        assert expected_accepted_per_step(4, 0.0) == 1.0
+        assert expected_accepted_per_step(4, 1.0) == 5.0
+        prev = 0.0
+        for p in (0.1, 0.3, 0.5, 0.7, 0.9):
+            cur = expected_accepted_per_step(4, p)
+            assert 1.0 < cur < 5.0 and cur > prev
+            prev = cur
+
+    def test_spec_step_costs_more_than_plain(self):
+        from triton_distributed_tpu.tune.perf_model import (
+            ragged_serving_step_ms,
+            spec_step_ms,
+        )
+
+        kw = dict(page=32, hkv=2, g=4, d=128, hidden=1024)
+        plain = ragged_serving_step_ms([512] * 8, [1] * 8, **kw)
+        spec = spec_step_ms([512] * 8, spec_k=4, **kw)
+        assert spec > plain
+        # ...but far less than 5 plain steps — the speculation win
+        assert spec < 5 * plain
+
+    def test_placement_flips_under_speculation(self):
+        """The priced ship-cadence change: traffic whose ship hides
+        under a plain decode window is REFUSED once spec_k shrinks the
+        window to max_new/accepted steps. decode_step_ms pins the
+        window so the flip is deterministic across TpuSpec defaults."""
+        from triton_distributed_tpu.tune.perf_model import (
+            refuse_disaggregation,
+        )
+
+        cfg = TransformerConfig(**{**CFG, "kv_quant": "int8"})
+        traffic = dict(prompt_len=4096, max_new=8, decode_step_ms=0.02)
+        assert refuse_disaggregation(cfg, 32, traffic) is None
+        why = refuse_disaggregation(
+            cfg, 32,
+            dict(traffic, spec_k=4, spec_acceptance=0.9),
+        )
+        assert why is not None and "spec_k=4" in why
+
+    def test_replica_load_prices_measured_acceptance(self, model_params):
+        """A speculative replica that measured >1 accepted/step must
+        price CHEAPER per token than its plain twin at the same
+        occupancy — the router term that keeps speculative replicas
+        fully routed."""
+        from triton_distributed_tpu.tune.perf_model import (
+            replica_load_ms,
+        )
+
+        model, params = model_params
+        trace = _motif_trace(7, 5, 0.5, 8, 30, 10, 16)
+        eng = SpeculativeEngine(
+            model, params, EngineConfig(**ECFG), spec_k=4,
+            drafter=NGramDrafter(),
+            on_complete=lambda r, s: False,   # park: keep slots resident
+        )
+        eng.run(trace, max_steps=600)
+        assert eng.stats.accepted_tokens_per_step > 1.0
+        plain = ServingEngine(
+            model, params, EngineConfig(**ECFG),
+            on_complete=lambda r, s: False,
+        )
+        plain.run(_motif_trace(7, 5, 0.5, 8, 30, 10, 16),
+                  max_steps=600)
+        assert replica_load_ms(eng) < replica_load_ms(plain)

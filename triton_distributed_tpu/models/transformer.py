@@ -1460,7 +1460,8 @@ class Transformer:
 
     def serving_step(self, params, state, tokens, token_rows, token_pos,
                      q_starts, q_lens, moe_state=None, *,
-                     block_q: int = 8, use_pallas: bool = True):
+                     block_q: int = 8, use_pallas: bool = True,
+                     all_logits: bool = False):
         """One CONTINUOUS-BATCHING step: a ragged mixed batch of prefill
         chunks and decode tokens through every layer in one program.
 
@@ -1585,8 +1586,17 @@ class Transformer:
                     ).astype(jnp.float32)
                 x = x + y.astype(x.dtype)
         x = self._rmsnorm(x, params["norm_f"])
-        last_idx = jnp.clip(q_starts + q_lens - 1, 0, t - 1)
-        x_last = x[last_idx]                                 # (slots, H)
+        if all_logits:
+            # logits at EVERY packed position — the speculative verify
+            # pass needs the next-token distribution after each draft
+            # token, not just each slot's frontier. Per-token matmul
+            # rows are independent, so logits[q_starts[s]+j] is
+            # bit-identical to what a non-speculative step would have
+            # produced at that sequence position.
+            x_last = x                                       # (T, H)
+        else:
+            last_idx = jnp.clip(q_starts + q_lens - 1, 0, t - 1)
+            x_last = x[last_idx]                             # (slots, H)
         if isinstance(params["lm_head"], dict):
             logits = self._dmm(
                 x_last, params["lm_head"], out_dtype=jnp.float32,
@@ -1611,6 +1621,26 @@ class Transformer:
             return self.serving_step(
                 params, state, tokens, token_rows, token_pos, q_starts,
                 q_lens, moe_state, block_q=block_q, use_pallas=use_pallas,
+            )
+
+        return step
+
+    @functools.cached_property
+    def _serving_all_logits_jit(self):
+        # the speculative engine's serving step: identical batch
+        # contract, but logits come back for EVERY packed position
+        # ((T, vocab), not (slots, vocab)) so the engine can read the
+        # verify row's distribution after each draft token. Same
+        # donation discipline as `_serving_jit`.
+        @functools.partial(
+            jax.jit, static_argnums=(8, 9), donate_argnums=(1, 7)
+        )
+        def step(params, state, tokens, token_rows, token_pos, q_starts,
+                 q_lens, moe_state, block_q, use_pallas):
+            return self.serving_step(
+                params, state, tokens, token_rows, token_pos, q_starts,
+                q_lens, moe_state, block_q=block_q, use_pallas=use_pallas,
+                all_logits=True,
             )
 
         return step

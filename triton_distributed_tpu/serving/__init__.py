@@ -4,7 +4,10 @@ per-request cursors, donated and shard-resident) and the
 :class:`ServingEngine` request scheduler (admission and eviction over
 the page pool, chunked prefill interleaved into decode batches), with
 :class:`ServingFleet` aggregating N engine replicas behind the health-
-and cache-aware :class:`FleetRouter`.
+and cache-aware :class:`FleetRouter`. :class:`SpeculativeEngine` adds
+draft-k speculative decoding as a ragged-batch scenario (verify pass =
+one ``q_len=k+1`` row, token-exact accept via the request-keyed
+sampler).
 
 See docs/SERVING.md for the lifecycle and knob catalog.
 """
@@ -25,6 +28,14 @@ from triton_distributed_tpu.serving.fleet import (  # noqa: F401
     Replica,
     RouterConfig,
     ServingFleet,
+)
+from triton_distributed_tpu.serving.spec import (  # noqa: F401
+    SPEC_ENGINE_FAMILIES,
+    DraftModelDrafter,
+    Drafter,
+    NGramDrafter,
+    SpeculativeEngine,
+    make_drafter,
 )
 from triton_distributed_tpu.serving.state import (  # noqa: F401
     PagePool,

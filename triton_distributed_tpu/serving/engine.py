@@ -129,6 +129,12 @@ class EngineStats:
     # re-promotion clears it — see HealthLedger)
     degraded: bool = False
     repromotions: int = 0              # probe-driven returns to the fused path
+    # --- speculative decoding (serving/spec.py; zero on plain engines) ---
+    spec_rows: int = 0                 # verify rows run (one per spec step)
+    draft_tokens: int = 0              # draft tokens proposed into verify rows
+    accepted_draft_tokens: int = 0     # drafts that matched the keyed sample
+    spec_tokens_out: int = 0           # tokens EMITTED by verify rows
+    rolled_back_tokens: int = 0        # rejected draft positions rewound
 
     @property
     def total_time(self) -> float:
@@ -161,6 +167,23 @@ class EngineStats:
         return float(np.percentile(np.asarray(self.step_times), 50) * 1e3)
 
     @property
+    def accepted_tokens_per_step(self) -> float:
+        """Tokens a speculative verify row emits per engine step it
+        runs in — the speculation multiplier. Every verify row emits at
+        least 1 (the keyed sample that corrects the first rejected
+        draft, or the bonus token after a clean sweep), so > 1.0 means
+        drafts are genuinely being accepted. 0.0 on a plain engine."""
+        if not self.spec_rows:
+            return 0.0
+        return self.spec_tokens_out / self.spec_rows
+
+    @property
+    def draft_acceptance_rate(self) -> float:
+        if not self.draft_tokens:
+            return 0.0
+        return self.accepted_draft_tokens / self.draft_tokens
+
+    @property
     def decode_p99_step_ms(self) -> float:
         """p99 over the steps that generated at least one token — the
         latency a decoding request actually observes. In a colocated
@@ -174,6 +197,18 @@ class EngineStats:
         if not ts:
             return 0.0
         return float(np.percentile(np.asarray(ts), 99) * 1e3)
+
+    @property
+    def decode_p50_step_ms(self) -> float:
+        """Median of the token-generating steps — the speculative
+        bench's headline pair with :attr:`decode_p99_step_ms`."""
+        ts = [
+            t for t, g in zip(self.step_times, self.step_generated)
+            if g > 0
+        ]
+        if not ts:
+            return 0.0
+        return float(np.percentile(np.asarray(ts), 50) * 1e3)
 
 
 def poisson_trace(seed: int, n_requests: int, mean_interarrival: float,
@@ -338,6 +373,12 @@ class ServingEngine:
 
     # ---------------------------------------------------------------- step
 
+    def _row_take_bound(self, req) -> int:
+        """Upper bound on the tokens this request's next row packs —
+        the admission/reservation headroom term. The speculative engine
+        widens it by its draft budget."""
+        return min(self.cfg.chunk, len(req.seq) - req.cursor)
+
     def _committed_pages(self) -> int:
         """Pages the already-admitted slots will claim for their NEXT
         chunk but have not allocated yet — admission must not promise
@@ -346,7 +387,7 @@ class ServingEngine:
         for req in self.slot_req:
             if req is None or req.parked or req.done:
                 continue
-            take = min(self.cfg.chunk, len(req.seq) - req.cursor)
+            take = self._row_take_bound(req)
             tot += max(
                 self._pages_held(req.cursor + take)
                 - self._pages_held(req.cursor), 0,
@@ -427,6 +468,15 @@ class ServingEngine:
         for p in range(first, last):
             self.pool.register(int(self.table[slot, p]), hashes[p])
 
+    def _plan_row(self, req) -> np.ndarray:
+        """The tokens this request's row packs THIS step. Base engine:
+        the next ``min(chunk, remaining)`` sequence tokens. The
+        speculative engine appends provisional draft tokens to steady
+        decode rows (its override records which tail is draft)."""
+        take = min(self.cfg.chunk, len(req.seq) - req.cursor)
+        return np.asarray(req.seq[req.cursor:req.cursor + take],
+                          np.int32)
+
     def _assemble(self):
         cfg = self.cfg
         R, T = cfg.slots, self._t_pad
@@ -445,8 +495,10 @@ class ServingEngine:
             req = self.slot_req[s]
             if req is None or req.parked or req.done:
                 continue
-            seq = req.seq
-            take = min(cfg.chunk, len(seq) - req.cursor)
+            if len(req.seq) - req.cursor <= 0:
+                continue
+            row = self._plan_row(req)
+            take = len(row)
             if take <= 0:
                 continue
             if next_start + _ceil8(take) > cfg.token_budget:
@@ -460,7 +512,7 @@ class ServingEngine:
             else:
                 # allocation succeeded
                 span = slice(next_start, next_start + take)
-                tokens[span] = seq[req.cursor:req.cursor + take]
+                tokens[span] = row
                 token_rows[span] = s
                 token_pos[span] = np.arange(
                     req.cursor, req.cursor + take, dtype=np.int32
@@ -476,6 +528,12 @@ class ServingEngine:
             self.stats.deferrals += 1
         return (tokens, token_rows, token_pos, q_starts, q_lens, kv_dev,
                 batched, takes)
+
+    def _step_jit(self):
+        """The jitted device step this engine launches. The speculative
+        engine overrides this with the all-positions-logits twin (same
+        batch contract, (T, vocab) logits)."""
+        return self.model._serving_jit
 
     def _run_device(self, arrays, block_q):
         jnp = self._jnp
@@ -494,7 +552,7 @@ class ServingEngine:
         # sees a wedged serving step (site "serving_step"), and a
         # fault-plan Stall at that site gates here
         step_fn = maybe_instrument(
-            self.model._serving_jit, axis=None, site="serving_step",
+            self._step_jit(), axis=None, site="serving_step",
             collective_id=("serving_step", self.health_peer), n=1,
             step=self.step_count,
         )
@@ -583,32 +641,17 @@ class ServingEngine:
                     self.stats.repromotions += 1
         dt = time.perf_counter() - t0
         gen_this_step = 0
+        prefill_this_step = 0
         for s in sorted(batched):
             req = self.slot_req[s]
-            take = takes[s]
-            old_cursor = req.cursor
-            req.cursor += take
-            if self.pool.prefix_cache:
-                self._register_frozen(req, s, old_cursor)
-            if req.cursor == len(req.seq):
-                # the row's last packed token was its sequence frontier:
-                # the logits row is the next-token distribution
-                tok = self._sample(logits[s], req)
-                req.generated.append(tok)
-                gen_this_step += 1
-                target = 1 if self.cfg.prefill_only else req.max_new
-                if len(req.generated) >= target:
-                    req.completion_step = self.step_count
-                    self.stats.completed += 1
-                    self.stats.generated_tokens += len(req.generated)
-                    if not self.cfg.prefill_only:
-                        req.done = True
-                    if self.on_complete is None or self.on_complete(req, s):
-                        self._free_slot(s)
+            emitted, prefill_toks = self._advance_row(
+                s, req, takes[s], logits, q_starts, q_lens)
+            gen_this_step += emitted
+            prefill_this_step += prefill_toks
         self.stats.step_times.append(dt)
         self.stats.step_tokens.append(int(q_lens.sum()))
         self.stats.step_generated.append(gen_this_step)
-        self.stats.prefill_tokens += int(q_lens.sum()) - gen_this_step
+        self.stats.prefill_tokens += prefill_this_step
         report.update(
             ms=round(dt * 1e3, 3), generated=gen_this_step,
             free_pages=self.pool.available,
@@ -616,6 +659,42 @@ class ServingEngine:
         )
         self.step_count += 1
         return report
+
+    def _advance_row(self, s: int, req, take: int, logits,
+                     q_starts, q_lens) -> tuple:
+        """Advance one batched row after the device step: move the
+        cursor past the packed tokens, publish newly-frozen pages, and
+        sample at the sequence frontier. Returns ``(emitted,
+        prefill_tokens)`` — tokens this row EMITTED into its stream and
+        packed tokens that were prefill (not generation) work. The
+        speculative engine overrides this with the verify/accept loop
+        (multi-token emission + rejected-draft rollback)."""
+        old_cursor = req.cursor
+        req.cursor += take
+        if self.pool.prefix_cache:
+            self._register_frozen(req, s, old_cursor)
+        if req.cursor == len(req.seq):
+            # the row's last packed token was its sequence frontier:
+            # the logits row is the next-token distribution
+            tok = self._sample(logits[s], req)
+            req.generated.append(tok)
+            self._maybe_complete(req, s)
+            return 1, take - 1
+        return 0, take
+
+    def _maybe_complete(self, req, s: int) -> None:
+        """Completion check after a row emitted into ``req.generated``;
+        frees (or parks, via ``on_complete``) the slot when the request
+        reaches its target."""
+        target = 1 if self.cfg.prefill_only else req.max_new
+        if len(req.generated) >= target:
+            req.completion_step = self.step_count
+            self.stats.completed += 1
+            self.stats.generated_tokens += len(req.generated)
+            if not self.cfg.prefill_only:
+                req.done = True
+            if self.on_complete is None or self.on_complete(req, s):
+                self._free_slot(s)
 
     def _sample(self, row_logits, req) -> int:
         """Next token for one completed row. Greedy argmax at
@@ -821,7 +900,8 @@ class DisaggregatedEngine:
                  hybrid_mesh=None, dcn_axis: str = "dcn",
                  transport: str = "auto", ship_delay_steps: int = 0,
                  placement: str = "force", traffic: dict | None = None,
-                 moe_state="auto", use_pallas: bool = True, health=None):
+                 moe_state="auto", use_pallas: bool = True, health=None,
+                 spec_k: int = 0, drafter=None):
         from dataclasses import replace as _rep
 
         from triton_distributed_tpu.runtime.health import HealthLedger
@@ -857,6 +937,13 @@ class DisaggregatedEngine:
         if placement == "auto":
             from triton_distributed_tpu.tune import perf_model
 
+            traffic = dict(traffic or {})
+            if spec_k:
+                # speculation changes the ship cadence: the decode
+                # window the wire must hide under SHRINKS by the
+                # accepted-tokens-per-step factor — the perf model
+                # prices that (tune/perf_model.spec_step_ms)
+                traffic.setdefault("spec_k", spec_k)
             reason = perf_model.refuse_disaggregation(
                 decode_model.config, cfg.page, traffic or {},
                 ledger=self.health,
@@ -876,12 +963,30 @@ class DisaggregatedEngine:
             moe_state=moe_state, use_pallas=use_pallas,
             on_complete=self._on_prefill_complete, health=self.health,
         )
-        self.decode = ServingEngine(
-            decode_model, decode_params,
-            _rep(dcfg, prefill_only=False),
-            moe_state=moe_state, use_pallas=use_pallas,
-            health=self.health,
-        )
+        self.spec_k = int(spec_k)
+        if spec_k:
+            # speculation lives on the DECODE role only: the prefill
+            # role emits at most one token per request (its frontier
+            # draw), so there is nothing to draft there. Local import —
+            # spec.py subclasses ServingEngine from this module.
+            from triton_distributed_tpu.serving.spec import (
+                SpeculativeEngine,
+            )
+
+            self.decode = SpeculativeEngine(
+                decode_model, decode_params,
+                _rep(dcfg, prefill_only=False),
+                spec_k=spec_k, drafter=drafter,
+                moe_state=moe_state, use_pallas=use_pallas,
+                health=self.health,
+            )
+        else:
+            self.decode = ServingEngine(
+                decode_model, decode_params,
+                _rep(dcfg, prefill_only=False),
+                moe_state=moe_state, use_pallas=use_pallas,
+                health=self.health,
+            )
         self._ready: deque = deque()       # (req, prefill slot) awaiting ship
         self._inflight: list = []
         self._dead_role: str | None = None  # set by slice-death failover

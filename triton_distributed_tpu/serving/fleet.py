@@ -186,6 +186,12 @@ class Replica:
         return sum(perf_model.replica_step_ms(r) for r in self._roles
                    if not r.idle)
 
+    def queue_depth(self) -> int:
+        """Requests queued at the admission role (not yet in slots) —
+        the quantity the router's ``queue_cap`` bounds."""
+        role = self.admit_role
+        return len(role.waiting) + len(role.pending)
+
     def can_accept(self, req) -> bool:
         """Would the admission role admit ``req`` NOW (free slot + page
         headroom)? False means routing here queues the request."""
@@ -207,6 +213,12 @@ class RouterConfig:
     w_load: float = 1.0         # weight of the fleet-mean-relative load
     policy: str = "scored"      # "scored" | "round_robin" (baseline)
     affinity: bool = True       # session stickiness
+    # admission control: when EVERY routable replica already has this
+    # many requests queued (waiting + pending on its admission role),
+    # the fleet REJECTS the arrival with a priced retry-after instead
+    # of letting `waiting` grow without bound. None = unbounded (the
+    # pre-cap behavior).
+    queue_cap: int | None = None
 
 
 class FleetRouter:
@@ -310,6 +322,11 @@ class FleetStats:
     affinity_hits: int = 0
     spills: int = 0
     probes: int = 0
+    # admission control (RouterConfig.queue_cap): arrivals rejected
+    # because every routable replica's queue was at cap, and the priced
+    # retry-after each rejection was told to wait (perf-model ms)
+    admission_rejections: int = 0
+    retry_after_ms: list = field(default_factory=list)
     deaths: list = field(default_factory=list)     # (replica, tick)
     failover_requeued: int = 0
     failover_re_prefill_tokens: int = 0
@@ -382,6 +399,11 @@ class ServingFleet:
 
         if not engines:
             raise ValueError("a fleet needs at least one replica")
+        if router is not None and router.queue_cap is not None \
+                and router.queue_cap < 1:
+            raise ValueError(
+                f"queue_cap must be >= 1 (got {router.queue_cap}) — "
+                "a zero cap rejects every arrival forever")
         meshes = meshes or [None] * len(engines)
         self.replicas = [Replica(i, e, m)
                          for i, (e, m) in enumerate(zip(engines, meshes))]
@@ -452,6 +474,8 @@ class ServingFleet:
         n = 0
         while self.queue and self.queue[0].arrival <= self.ticks:
             req = self.queue.popleft()
+            if self._reject_overload(req):
+                continue
             target = self._route_probe(req)
             spilled = False
             if target is None:
@@ -466,6 +490,49 @@ class ServingFleet:
                 self.stats.affinity_hits += 1
             n += 1
         return n
+
+    def _reject_overload(self, req) -> bool:
+        """Admission control (``RouterConfig.queue_cap``): when every
+        routable replica's queue is at cap, the arrival is REJECTED
+        with a priced retry-after instead of deepening some replica's
+        ``waiting`` without bound. The retry-after is the perf model's
+        estimate of when the LIGHTEST queue will have drained —
+        :func:`~triton_distributed_tpu.tune.perf_model.replica_load_ms`
+        of the least-loaded routable replica, converted to fleet ticks
+        by its modeled step time — so a client backs off proportionally
+        to real congestion, not by a blind constant. The rejected
+        request re-enters the fleet queue at the retry tick (the
+        harness's stand-in for the client honoring Retry-After), so a
+        flooded trace finishes with zero LOST requests — later, not
+        never."""
+        import math
+
+        cap = self.router.cfg.queue_cap
+        if cap is None:
+            return False
+        routable = [
+            r for r in self._alive()
+            if self.router.health_factor(self.health.state(r.peer))
+            is not None
+        ]
+        if not routable:
+            return False       # route() raises the every-replica-dead error
+        if min(r.queue_depth() for r in routable) < cap:
+            return False
+        light = min(routable, key=lambda r: (r.queue_depth(),
+                                             r.load_ms(), r.index))
+        retry_ms = light.load_ms()
+        step_ms = light.step_model_ms()
+        retry_ticks = (max(1, math.ceil(retry_ms / step_ms))
+                       if step_ms > 0 else 1)
+        req.arrival = self.ticks + retry_ticks
+        req.admission_retries = getattr(req, "admission_retries", 0) + 1
+        self.stats.admission_rejections += 1
+        self.stats.retry_after_ms.append(retry_ms)
+        # re-enter in arrival order (stable sort keeps FIFO among ties)
+        self.queue.append(req)
+        self.queue = deque(sorted(self.queue, key=lambda r: r.arrival))
+        return True
 
     def _route_probe(self, req):
         """A PROBATION replica whose seeded probe is due gets this

@@ -1,0 +1,311 @@
+"""Speculative decoding as a ragged-batch scenario.
+
+Decode pays a full model step per emitted token; the ragged
+paged-attention kernel already runs mixed ``q_len`` rows in one launch,
+so the verify pass of draft-k speculation is literally a ``q_len=k+1``
+row in the normal mixed batch — no new kernel (the Ragged Paged
+Attention paper's stated point).
+
+The pieces:
+
+``Drafter``
+    Proposes up to ``k`` provisional next tokens for one request from
+    information the engine already holds. Implementations must be
+    DETERMINISTIC functions of the request's token history — the accept
+    rule below only preserves token-exactness because a draft can never
+    inject randomness into the stream (wrong drafts are rejected, right
+    drafts emit exactly what the keyed sampler would have drawn anyway).
+
+``NGramDrafter``
+    Prompt-lookup drafting: match the longest recent n-gram suffix of
+    the request's own token history (prompt + generated) against an
+    earlier occurrence and propose its continuation. Free (no extra
+    model weights, no device work) and strong on motif-heavy traffic.
+
+``DraftModelDrafter``
+    A shared-weights draft model: the target model's own embedding and
+    lm_head composed into a greedy bigram table
+    (``argmax(embed @ lm_head)`` per source token). Host-side, built
+    lazily once, deterministic. Stands in for a genuinely smaller
+    checkpoint without shipping one.
+
+``SpeculativeEngine``
+    A :class:`~triton_distributed_tpu.serving.engine.ServingEngine`
+    mode. Steady decode rows (one remaining sequence token) are widened
+    to ``[frontier, d_1 .. d_k]`` — the drafts are appended as
+    PROVISIONAL page content, verified by the same jitted step as every
+    other row (the all-positions-logits twin), and accepted via the
+    request-keyed sampler draws:
+
+    for ``j = 0..nd``: sample ``t_j`` from the logits at packed index
+    ``q_starts[s] + j`` with the request's draw key
+    ``(seed, rid, n0 + j)`` (``n0`` = tokens generated before the
+    step); emit ``t_j``; accept draft ``j+1`` iff ``t_j == d_{j+1}``,
+    else stop — ``t_j`` is the correction. All drafts accepted → the
+    last draw is the bonus token. Because the engine's sampler draws
+    are deterministic keyed functions of (seed, rid, position), this
+    exact-match rule IS the rejection-sampling identity: every emitted
+    token is byte-identical to what the non-speculative engine would
+    have produced at that position, so streams stay token-exact across
+    chunking, eviction, tp sharding and disaggregation.
+
+    Rejected drafts roll back through the recompute-eviction
+    discipline: the cursor rewinds to the surviving prefix and pages
+    past it return to the pool. KV above the cursor is garbage the
+    same way post-eviction pool pages are — ``kv_lens`` is recomputed
+    from host cursors every step, so it is never attended and is
+    overwritten by the next append.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from triton_distributed_tpu.serving.engine import ServingEngine
+
+# kernel families the speculative engine launches — identical to the
+# plain engine's (the verify pass is the SAME ragged kernel; that is
+# the point). bench --lint gates that each resolves a degradation
+# target so a speculative fleet degrades exactly like a plain one.
+SPEC_ENGINE_FAMILIES = ("flash_decode.ragged_paged",)
+
+
+# ===================================================================
+# Drafters
+# ===================================================================
+
+class Drafter:
+    """Proposes provisional next tokens for one request.
+
+    Contract: ``draft(req, k)`` returns an ``int32`` array of length
+    ``<= k`` (empty is always legal — the row degrades to a plain
+    decode step). The result must be a deterministic pure function of
+    ``req.seq`` (prompt + generated so far): no RNG, no mutable state
+    that scheduling order could perturb. ``observe`` is optional
+    feedback (accepted/rejected counts) for adaptive drafters; the
+    built-ins ignore it."""
+
+    name = "null"
+
+    def draft(self, req, k: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def observe(self, req, accepted: int, rejected: int) -> None:
+        pass
+
+
+class NGramDrafter(Drafter):
+    """Prompt-lookup drafting over the request's OWN token history.
+
+    Matches the longest suffix n-gram (``max_ngram`` down to
+    ``min_ngram``) of ``req.seq`` against its most recent earlier
+    occurrence and proposes the tokens that followed it. Rightmost
+    match wins — recency beats primacy on repetitive traffic, and the
+    tie-break keeps the proposal deterministic."""
+
+    name = "ngram"
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError((min_ngram, max_ngram))
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def draft(self, req, k: int) -> np.ndarray:
+        seq = [int(t) for t in req.seq]
+        for n in range(self.max_ngram, self.min_ngram - 1, -1):
+            if len(seq) <= n:
+                continue
+            tail = seq[-n:]
+            for i in range(len(seq) - n - 1, -1, -1):
+                if seq[i:i + n] == tail:
+                    cont = seq[i + n:i + n + k]
+                    if cont:
+                        return np.asarray(cont, np.int32)
+                    break               # suffix matched itself only
+        return np.zeros((0,), np.int32)
+
+
+class DraftModelDrafter(Drafter):
+    """Shared-weights draft model: the target's embedding composed with
+    its lm_head as a greedy bigram predictor.
+
+    ``table[v] = argmax(embed[v] @ lm_head)`` is materialized host-side
+    once (O(vocab² · hidden) on tiny serving models; lazily, so
+    building the engine costs nothing) and drafting k tokens walks the
+    table from the frontier token. Quantized lm_heads
+    (``{"q", "scale"}``) are dequantized through the same
+    per-out-channel convention the device uses."""
+
+    name = "draft_model"
+
+    def __init__(self, model, params):
+        self._model = model
+        self._params = params
+        self._table: np.ndarray | None = None
+
+    def _bigram_table(self) -> np.ndarray:
+        if self._table is None:
+            embed = np.asarray(self._params["embed"], np.float32)
+            w = self._params["lm_head"]
+            if isinstance(w, dict):
+                w = (np.asarray(w["q"], np.float32)
+                     * np.asarray(w["scale"], np.float32)[None, :])
+            else:
+                w = np.asarray(w, np.float32)
+            self._table = np.argmax(embed @ w, axis=-1).astype(np.int32)
+        return self._table
+
+    def draft(self, req, k: int) -> np.ndarray:
+        table = self._bigram_table()
+        out, tok = [], int(req.seq[-1])
+        for _ in range(k):
+            tok = int(table[tok])
+            out.append(tok)
+        return np.asarray(out, np.int32)
+
+
+def make_drafter(kind: str, model=None, params=None, **kw) -> Drafter:
+    """Build a drafter by name (``"ngram"`` / ``"draft_model"``) —
+    the bench/CI entry point."""
+    if kind == "ngram":
+        return NGramDrafter(**kw)
+    if kind == "draft_model":
+        if model is None or params is None:
+            raise ValueError("draft_model drafter needs model + params")
+        return DraftModelDrafter(model, params)
+    raise ValueError(f"unknown drafter kind: {kind!r}")
+
+
+# ===================================================================
+# SpeculativeEngine
+# ===================================================================
+
+class SpeculativeEngine(ServingEngine):
+    """:class:`ServingEngine` with draft-k speculative decode rows.
+
+    Scheduling, admission, eviction, prefix caching, health/probation
+    and degradation are all inherited untouched — speculation only
+    changes what a steady decode row PACKS (``1 + k`` tokens instead of
+    1) and how its logits are consumed (the verify/accept loop in
+    :meth:`_advance_row`). With ``spec_k <= 7`` the widened row costs
+    no extra packed budget: ``_ceil8(k+1) == _ceil8(1)``."""
+
+    def __init__(self, model, params, cfg, *, drafter: Drafter | None = None,
+                 spec_k: int = 4, **kw):
+        super().__init__(model, params, cfg, **kw)
+        if spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        if spec_k + 1 > cfg.chunk:
+            # the chunk bound sizes the kernel's block_q cap and the
+            # packed array's parking zone — a verify row wider than a
+            # prefill chunk would invalidate both
+            raise ValueError(
+                f"spec_k={spec_k} verify row exceeds chunk={cfg.chunk}")
+        self.spec_k = int(spec_k)
+        self.drafter = drafter if drafter is not None else NGramDrafter()
+        # slot -> this step's proposed draft tail (cleared every
+        # assembly: a deferred row's entry must not leak into a later
+        # step where the slot packs something else)
+        self._step_drafts: dict = {}
+
+    # ------------------------------------------------------- planning
+
+    def _row_take_bound(self, req) -> int:
+        take = super()._row_take_bound(req)
+        if len(req.seq) - req.cursor == 1:
+            # steady decode row: may widen by the draft budget —
+            # admission headroom must assume the widest case
+            take = min(1 + self.spec_k,
+                       self.state.capacity - req.cursor)
+        return take
+
+    def _plan_row(self, req) -> np.ndarray:
+        if len(req.seq) - req.cursor != 1:
+            return super()._plan_row(req)     # prefill/chunk row
+        # steady decode row: widen to [frontier, d_1 .. d_nd]. Drafting
+        # past the request's remaining emission target is pure rollback
+        # work, so nd is also capped by (max_new - generated - 1).
+        nd = min(self.spec_k,
+                 self.state.capacity - (req.cursor + 1),
+                 req.max_new - len(req.generated) - 1)
+        drafts = (self.drafter.draft(req, nd) if nd > 0
+                  else np.zeros((0,), np.int32))
+        drafts = np.asarray(drafts, np.int32)[:max(nd, 0)]
+        self._step_drafts[req.slot] = drafts
+        return np.concatenate(
+            [np.asarray(req.seq[req.cursor:], np.int32), drafts])
+
+    def _assemble(self):
+        self._step_drafts = {}
+        return super()._assemble()
+
+    # ------------------------------------------------------- verify
+
+    def _step_jit(self):
+        # same batch contract, but logits at EVERY packed position —
+        # the accept loop needs the next-token distribution after each
+        # draft token, not just each slot's frontier
+        return self.model._serving_all_logits_jit
+
+    def _advance_row(self, s: int, req, take: int, logits,
+                     q_starts, q_lens) -> tuple:
+        drafts = self._step_drafts.get(s)
+        base = int(q_starts[s])
+        if drafts is None or len(drafts) == 0:
+            # plain chunk/decode row — base bookkeeping, but the
+            # frontier distribution lives at the row's LAST packed
+            # index (logits here are per-token, not per-slot)
+            old_cursor = req.cursor
+            req.cursor += take
+            if self.pool.prefix_cache:
+                self._register_frozen(req, s, old_cursor)
+            if req.cursor == len(req.seq):
+                tok = self._sample(logits[base + take - 1], req)
+                req.generated.append(tok)
+                self._maybe_complete(req, s)
+                return 1, take - 1
+            return 0, take
+        # verify row: [frontier, d_1 .. d_nd] at positions
+        # cursor .. cursor+nd. logits[base + j] is the next-token
+        # distribution given seq[:cursor+1] + d_1..d_j — valid exactly
+        # while every earlier draft was accepted, which is exactly how
+        # far the loop below reads.
+        nd = len(drafts)
+        assert take == nd + 1, (take, nd)
+        old_cursor = req.cursor
+        emitted = accepted = 0
+        for j in range(nd + 1):
+            tok = self._sample(logits[base + j], req)
+            req.generated.append(tok)
+            emitted += 1
+            if len(req.generated) >= req.max_new:
+                break                  # stream length must match exactly
+            if j < nd and tok == int(drafts[j]):
+                accepted += 1          # draft j's provisional KV is real
+                continue
+            break                      # tok is the correction (j < nd)
+            # ... or the bonus draw after a full accept (j == nd)
+        # rollback: rewind to the surviving prefix and free the pages
+        # the rejected tail claimed at assembly. Garbage KV above the
+        # cursor is never attended (kv_lens is recomputed from host
+        # cursors) and the next append overwrites it in place.
+        req.cursor = old_cursor + 1 + accepted
+        keep = self._pages_held(req.cursor)
+        got = self._pages_held(old_cursor + take)
+        for pg in range(keep, got):
+            if self.table[s, pg] >= 0:
+                self.pool.release(int(self.table[s, pg]))
+                self.table[s, pg] = -1
+        if self.pool.prefix_cache:
+            # register AFTER the rewind — only pages below the FINAL
+            # cursor are frozen (pure functions of the chained prefix)
+            self._register_frozen(req, s, old_cursor)
+        st = self.stats
+        st.spec_rows += 1
+        st.draft_tokens += nd
+        st.accepted_draft_tokens += accepted
+        st.spec_tokens_out += emitted
+        st.rolled_back_tokens += nd - accepted
+        self.drafter.observe(req, accepted, nd - accepted)
+        self._maybe_complete(req, s)
+        return emitted, 0

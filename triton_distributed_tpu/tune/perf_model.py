@@ -293,6 +293,57 @@ def ragged_serving_step_ms(kv_lens, q_lens, *, page: int, hkv: int,
     )
 
 
+# ---------------------------------------------------- speculation term
+#
+# Speculative decoding (serving/spec.py) changes WHAT a decode step is:
+# a verify row packs 1 + k tokens and emits 1..k+1 of them, so the
+# per-step cost rises a little (wider q traffic, k extra provisional KV
+# appends) while the per-TOKEN cost falls by the accepted-tokens-per-
+# step factor. Both the fleet router's load term and the
+# disaggregation placement gate consume these: speculation SHRINKS the
+# decode window a KV ship must hide under, so a split that was priced
+# viable at 1 token/step can stop being viable at 2.
+
+#: analytic prior for the per-draft acceptance probability before any
+#: verify row has run — deliberately conservative (the n-gram drafter
+#: measured ~0.5 on motif-heavy greedy traffic, near zero on
+#: incompressible random tokens; the prior sits where under-promising
+#: only makes the router/placement err toward the plain engine).
+DEFAULT_SPEC_ACCEPTANCE = 0.3
+
+
+def expected_accepted_per_step(spec_k: int, acceptance_rate: float) -> float:
+    """Expected tokens EMITTED by one draft-k verify row under an
+    i.i.d. per-draft acceptance probability ``p``:
+    ``1 + p + p² + … + p^k`` (truncated geometric — every emitted token
+    is an accepted draft or the final correction/bonus draw). Bounded
+    in ``[1, k+1]``; the analytic prior where no measured
+    ``EngineStats.accepted_tokens_per_step`` exists yet."""
+    p = min(max(float(acceptance_rate), 0.0), 1.0)
+    if p >= 1.0:
+        return float(spec_k + 1)
+    return (1.0 - p ** (spec_k + 1)) / (1.0 - p)
+
+
+def spec_step_ms(kv_lens, *, spec_k: int, page: int, hkv: int, g: int,
+                 d: int, hidden: int, n_layers: int = 1,
+                 spec: TpuSpec | None = None, quant: bool = True,
+                 issue_ms: float | None = None) -> float:
+    """Analytic cost of one speculative VERIFY step: the plain ragged
+    step with every decode row widened to ``q_len = 1 + spec_k`` (the
+    frontier token plus k provisional drafts). The page walk reads the
+    k extra appended pages' worth of KV; the token traffic term scales
+    with the widened pack. Divide by
+    :func:`expected_accepted_per_step` for the per-emitted-token
+    clock."""
+    wide = [int(l) + spec_k for l in kv_lens]
+    return ragged_serving_step_ms(
+        wide, [1 + spec_k] * len(kv_lens), page=page, hkv=hkv, g=g,
+        d=d, hidden=hidden, n_layers=n_layers, spec=spec, quant=quant,
+        issue_ms=issue_ms,
+    )
+
+
 def replica_step_ms(engine, *, spec: TpuSpec | None = None) -> float:
     """Analytic time of one engine step at the CURRENT resident
     occupancy (:func:`ragged_serving_step_ms` over the active slots'
@@ -305,11 +356,15 @@ def replica_step_ms(engine, *, spec: TpuSpec | None = None) -> float:
     base of the router's :func:`replica_load_ms` perf term."""
     spec = spec or detect_spec()
     mc = engine.model.config
+    # a speculative engine's decode rows are ``1 + spec_k`` wide (the
+    # verify pack) — price the step it actually launches
+    k = int(getattr(engine, "spec_k", 0))
     active = [r for r in engine.slot_req if r is not None]
-    kv_lens = [max(r.cursor, 1) for r in active] or [1]
+    kv_lens = [max(r.cursor, 1) + (k if r.cursor >= len(r.prompt) else 0)
+               for r in active] or [1]
     q_lens = [
         max(1, min(engine.cfg.chunk, len(r.prompt) - r.cursor))
-        if r.cursor < len(r.prompt) else 1
+        if r.cursor < len(r.prompt) else 1 + k
         for r in active
     ] or [1]
     hkv = mc.n_kv_heads
@@ -324,9 +379,25 @@ def replica_step_ms(engine, *, spec: TpuSpec | None = None) -> float:
 def replica_load_ms(engine, *, spec: TpuSpec | None = None) -> float:
     """Queue-depth load estimate for one fleet replica: the analytic
     :func:`replica_step_ms` scaled by how many admissions are already
-    queued ahead — the router's perf term."""
+    queued ahead — the router's perf term. A speculative replica's
+    step EMITS more than one token, so its effective per-token clock is
+    the step divided by accepted-tokens-per-step (the measured engine
+    rate once verify rows have run, the geometric prior before) — a
+    replica that drains its queue k× faster must price k× cheaper, or
+    the router under-routes exactly the replicas speculation sped
+    up."""
     queued = len(engine.waiting) + len(engine.pending)
-    return replica_step_ms(engine, spec=spec) * (1.0 + queued)
+    step = replica_step_ms(engine, spec=spec)
+    k = int(getattr(engine, "spec_k", 0))
+    if k:
+        st = getattr(engine, "stats", None)
+        if st is not None and getattr(st, "spec_rows", 0) > 0:
+            accepted = max(st.accepted_tokens_per_step, 1.0)
+        else:
+            accepted = expected_accepted_per_step(
+                k, DEFAULT_SPEC_ACCEPTANCE)
+        step /= accepted
+    return step * (1.0 + queued)
 
 
 # ------------------------------------------------ hop critical-path term
@@ -390,7 +461,14 @@ def refuse_disaggregation(model_cfg, page: int, traffic: dict,
     refusal reason. ``traffic``: expected request shape —
     ``prompt_len`` (tokens whose pages ship) and ``max_new`` (decode
     steps the ship can overlap with); optional ``decode_step_ms``
-    overrides the analytic steady-step estimate. ``ledger`` (a
+    overrides the analytic steady-step estimate. ``spec_k`` (plus
+    optional ``spec_acceptance``) prices speculative decode on the
+    decode role: each verify step costs a little more
+    (:func:`spec_step_ms`) but emits
+    :func:`expected_accepted_per_step` tokens, so the request's decode
+    WINDOW shrinks — a ship that hid under ``max_new`` plain steps may
+    not hide under ``max_new / accepted`` verify steps, and the gate
+    must refuse what speculation made unviable. ``ledger`` (a
     ``runtime.health.HealthLedger``) adds the health gate: a split
     topology is refused while a slice is condemned or the kv_ship wire
     itself is unhealthy — placement consults health, not just perf."""
@@ -419,20 +497,43 @@ def refuse_disaggregation(model_cfg, page: int, traffic: dict,
     ship = kv_ship_ms(
         n_pages, page, hkv, d, model_cfg.n_layers, quant, spec
     )
+    spec_k = int(traffic.get("spec_k", 0))
+    accepted = 1.0
+    g = model_cfg.n_heads // max(hkv, 1)
     step_ms = traffic.get("decode_step_ms")
     if step_ms is None:
-        step_ms = ragged_serving_step_ms(
-            [prompt], [1], page=page, hkv=hkv,
-            g=model_cfg.n_heads // max(hkv, 1), d=d,
-            hidden=model_cfg.hidden, n_layers=model_cfg.n_layers,
-            spec=spec, quant=quant,
-        )
-    window = max_new * float(step_ms)
+        if spec_k:
+            step_ms = spec_step_ms(
+                [prompt], spec_k=spec_k, page=page, hkv=hkv, g=g, d=d,
+                hidden=model_cfg.hidden, n_layers=model_cfg.n_layers,
+                spec=spec, quant=quant,
+            )
+        else:
+            step_ms = ragged_serving_step_ms(
+                [prompt], [1], page=page, hkv=hkv, g=g, d=d,
+                hidden=model_cfg.hidden, n_layers=model_cfg.n_layers,
+                spec=spec, quant=quant,
+            )
+    if spec_k:
+        # a measured decode_step_ms is taken as the verify-step cost as
+        # given (measurements outrank the analytic widening); the
+        # window still shrinks by the emission rate
+        accepted = expected_accepted_per_step(
+            spec_k, float(traffic.get("spec_acceptance",
+                                      DEFAULT_SPEC_ACCEPTANCE)))
+    n_steps = max_new / accepted
+    window = n_steps * float(step_ms)
     if ship <= window:
         return None
+    spec_note = (
+        f" (speculative decode spec_k={spec_k} emits {accepted:.2f} "
+        f"tokens/step — the window shrank to {n_steps:.1f} steps)"
+        if spec_k else ""
+    )
     return (
         f"kv_ship_ms={ship:.3f} exceeds the decode window "
-        f"{window:.3f} ms ({max_new} steps x {float(step_ms):.3f} ms) — "
+        f"{window:.3f} ms ({n_steps:.1f} steps x {float(step_ms):.3f} "
+        f"ms){spec_note} — "
         f"shipping {n_pages} pages over {spec.dcn_gbps} GB/s DCN "
         "dominates the decode work it buys; keep prefill and decode "
         "colocated for this traffic"
