@@ -279,13 +279,22 @@ def _parse_args(argv=None):
         "explainable (ISSUE-12 satellite / ISSUE-6 follow-on)",
     )
     ap.add_argument(
+        "--spec-k", type=int, default=None, metavar="K",
+        help="serving_fleet: run SPECULATIVE replicas (draft-k K, "
+        "ngram drafter) against a non-speculative fleet on the "
+        "IDENTICAL trace — per-replica accepted-tokens/step and the "
+        "goodput ratio are reported (ISSUE-13 satellite)",
+    )
+    ap.add_argument(
         "scenario", nargs="?", default=None,
         help="run ONLY this named scenario (currently: serving_fleet "
-        "— the multi-replica router bench — or serving_speculative — "
-        "the draft-k speculative engine vs the plain engine, colocated "
-        "AND disaggregated; both compose with --dryrun and --faults, "
-        "e.g. the ISSUE-11 acceptance line "
-        "'serving_fleet --dryrun --faults \"seed=1; "
+        "— the multi-replica router bench, --spec-k K for speculative "
+        "replicas — serving_speculative — the draft-k speculative "
+        "engine vs the plain engine, colocated AND disaggregated — or "
+        "serving_elastic — autoscale grow from a reserve mesh, a "
+        "mid-trace drain with live KV-page migration; all compose "
+        "with --dryrun and --faults, e.g. the ISSUE-13 acceptance "
+        "line 'serving_elastic --dryrun --faults \"seed=1; "
         "ReplicaDeath(replica=1, step=8)\"')",
     )
     return ap.parse_args(argv)
@@ -396,8 +405,34 @@ def _run_lint() -> None:
             file=sys.stderr, flush=True,
         )
 
+    # migration gate (ISSUE 13): the fleet's replica→replica KV-page
+    # migration rides the kv_ship wire families — they must stay
+    # registered with a resolvable degradation target, or a drain's
+    # migrate-or-finish path would rest on an unverified transport
+    # (the fallback when the wire is refused is re-prefill, which is
+    # exactly the degradation target story this gate keeps honest)
+    from triton_distributed_tpu.serving.fleet import (
+        MIGRATION_ENGINE_FAMILIES,
+    )
+
+    migration_gaps = []
+    for fam in MIGRATION_ENGINE_FAMILIES:
+        if fam not in fams:
+            migration_gaps.append(
+                (fam, "migration wire family not registered"))
+        elif fam in gap_names:
+            migration_gaps.append(
+                (fam, "migration wire family has a degradation gap"))
+    for fam, problem in migration_gaps:
+        print(
+            json.dumps({"lint_migration_gap":
+                        {"family": fam, "problem": problem}}),
+            file=sys.stderr, flush=True,
+        )
+
     errs = (sum(f.severity >= Severity.ERROR for f in findings)
-            + len(gaps) + len(fleet_gaps) + len(spec_gaps))
+            + len(gaps) + len(fleet_gaps) + len(spec_gaps)
+            + len(migration_gaps))
     print(
         json.dumps({"metric": "shmemlint", "errors": errs,
                     "findings": len(findings),
@@ -405,6 +440,7 @@ def _run_lint() -> None:
                     "degradation_gaps": len(gaps),
                     "fleet_gaps": len(fleet_gaps),
                     "spec_gaps": len(spec_gaps),
+                    "migration_gaps": len(migration_gaps),
                     "mosaic_scanned": len(report["scanned"]),
                     "mosaic_refused": len(report["refused"])}),
         file=sys.stderr, flush=True,
@@ -442,6 +478,7 @@ def main(argv=None) -> None:
         scenarios = {
             "serving_fleet": _bench_serving_fleet,
             "serving_speculative": _bench_serving_speculative,
+            "serving_elastic": _bench_serving_elastic,
         }
         bench_fn = scenarios.get(args.scenario)
         if bench_fn is None:
@@ -452,9 +489,12 @@ def main(argv=None) -> None:
         devs = jax.devices()
         mesh = Mesh(np.asarray(devs), ("x",))
         on_tpu = jax.default_backend() == "tpu"
+        kw = {}
+        if args.scenario == "serving_fleet" and args.spec_k:
+            kw["spec_k"] = args.spec_k
         out = bench_fn(
             mesh, len(devs), on_tpu, detect_spec(),
-            tiny=args.dryrun or not on_tpu,
+            tiny=args.dryrun or not on_tpu, **kw,
         )
         out["faults"] = args.faults
         print(json.dumps(out), flush=True)
@@ -2088,7 +2128,8 @@ def _fleet_trace(trace_kw, page):
     return out
 
 
-def _bench_serving_fleet(mesh, n, on_tpu, spec, tiny=False):
+def _bench_serving_fleet(mesh, n, on_tpu, spec, tiny=False,
+                         spec_k=None):
     """FLEET serving (ISSUE 11 tentpole acceptance): 3 engine replicas,
     each on its own mesh slice carved by ``carve_replica_meshes``,
     behind the scored ``FleetRouter`` (prefix overlap × health × load
@@ -2098,7 +2139,16 @@ def _bench_serving_fleet(mesh, n, on_tpu, spec, tiny=False):
     back through the router onto the survivor: ``lost_requests`` must
     be 0 and the token streams byte-identical to the fault-free
     reference run (request-keyed sampling — placement cannot change
-    tokens)."""
+    tokens).
+
+    ``--spec-k K`` (ISSUE 13 satellite) swaps every replica for a
+    :class:`SpeculativeEngine` at draft budget K (ngram drafter, motif
+    prompts so prompt-lookup drafting has something to accept): the
+    NON-speculative scored fleet becomes the reference run, so the
+    token oracle simultaneously proves fleet-level speculative
+    token-exactness, and the output adds per-replica accepted
+    tokens/step plus the spec-vs-plain goodput ratio on the identical
+    trace."""
     import os as _os
 
     import jax
@@ -2109,7 +2159,11 @@ def _bench_serving_fleet(mesh, n, on_tpu, spec, tiny=False):
     from triton_distributed_tpu.runtime.topology import (
         carve_replica_meshes,
     )
-    from triton_distributed_tpu.serving import ServingEngine
+    from triton_distributed_tpu.serving import (
+        NGramDrafter,
+        ServingEngine,
+        SpeculativeEngine,
+    )
     from triton_distributed_tpu.serving.fleet import (
         RouterConfig,
         ServingFleet,
@@ -2134,7 +2188,11 @@ def _bench_serving_fleet(mesh, n, on_tpu, spec, tiny=False):
         # routing decision
         trace_kw = dict(
             n_requests=12, mean_interarrival=1.0,
-            len_lo=8, len_hi=40, max_new_lo=3, max_new_hi=7,
+            len_lo=8, len_hi=40,
+            # spec fleets need decode room for the drafter to earn
+            # accepts; the plain fleet headline keeps short tails
+            max_new_lo=8 if spec_k else 3,
+            max_new_hi=14 if spec_k else 7,
             vocab=trace_kw["vocab"],
         )
         ecfg = _rep(ecfg, slots=4, token_budget=48, chunk=16, page=8,
@@ -2154,13 +2212,30 @@ def _bench_serving_fleet(mesh, n, on_tpu, spec, tiny=False):
         models.append((model, params))
 
     def fresh_trace():
-        return _fleet_trace(trace_kw, ecfg.page)
+        out = _fleet_trace(trace_kw, ecfg.page)
+        if spec_k:
+            # motif prompts: prompt-lookup drafting needs repeats to
+            # accept — without them the spec fleet degenerates to a
+            # k=0 fleet and the ratio measures only verify overhead
+            rng = np.random.default_rng(23)
+            for r in out:
+                ln = len(r.prompt)
+                motif = rng.integers(
+                    0, trace_kw["vocab"], (5,)).astype(np.int32)
+                r.prompt = np.tile(motif, -(-ln // 5))[:ln]
+        return out
 
     n_total = len(fresh_trace())
 
-    def build_fleet(policy):
-        engines = [ServingEngine(model, params, ecfg)
-                   for model, params in models]
+    def build_fleet(policy, k=None):
+        if k:
+            engines = [SpeculativeEngine(model, params, ecfg,
+                                         spec_k=k,
+                                         drafter=NGramDrafter())
+                       for model, params in models]
+        else:
+            engines = [ServingEngine(model, params, ecfg)
+                       for model, params in models]
         return ServingFleet(
             engines, seed=1, router=RouterConfig(policy=policy),
             meshes=meshes,
@@ -2198,13 +2273,15 @@ def _bench_serving_fleet(mesh, n, on_tpu, spec, tiny=False):
     ref_tokens = ref_fleet.token_streams()
     assert ref_fleet.stats.lost_requests == 0, ref_fleet.stats
 
-    # ---- the routed fleet under the active plan (the headline run)
-    fleet = build_fleet("scored")
+    # ---- the routed fleet under the active plan (the headline run;
+    # with --spec-k these replicas are SPECULATIVE and the non-spec
+    # reference above doubles as the goodput baseline)
+    fleet = build_fleet("scored", k=spec_k)
     stats = _guarded(lambda: fleet.run(fresh_trace()))
     assert stats is not None, wd_trips
 
     # ---- round-robin baseline under the SAME plan
-    rr = build_fleet("round_robin")
+    rr = build_fleet("round_robin", k=spec_k)
     rr_stats = _guarded(lambda: rr.run(fresh_trace()))
     assert rr_stats is not None, wd_trips
 
@@ -2221,7 +2298,7 @@ def _bench_serving_fleet(mesh, n, on_tpu, spec, tiny=False):
 
     goodput = fleet.goodput_tok_per_s
     rr_goodput = rr.goodput_tok_per_s
-    return {
+    out = {
         "metric": "serving_fleet",
         "value": round(goodput, 1),
         "unit": "tok/s fleet goodput (modeled wall)",
@@ -2255,6 +2332,234 @@ def _bench_serving_fleet(mesh, n, on_tpu, spec, tiny=False):
             f"page={ecfg.page} npages={ecfg.npages} "
             f"requests={n_total} temp=0.7 top_k=40 "
             f"prefix_cache=on fleet_seed=1 "
+            + (f"spec_k={spec_k} ngram-drafter " if spec_k else "")
+            + ("tiny-dryrun" if tiny or not on_tpu else "headline")
+        ),
+    }
+    if spec_k:
+        nonspec_goodput = ref_fleet.goodput_tok_per_s
+        out.update({
+            "spec_k": spec_k,
+            # per-replica accepted tokens per verify step — the spec
+            # win the router's load term prices replicas by
+            "accepted_tokens_per_step": {
+                str(r.index): round(
+                    r.engine.stats.accepted_tokens_per_step, 3)
+                for r in fleet.replicas},
+            "spec_rows": {
+                str(r.index): r.engine.stats.spec_rows
+                for r in fleet.replicas},
+            "nonspec_goodput": round(nonspec_goodput, 1),
+            "goodput_vs_nonspec": round(goodput / nonspec_goodput, 3)
+            if nonspec_goodput else None,
+        })
+    return out
+
+
+def _bench_serving_elastic(mesh, n, on_tpu, spec, tiny=False):
+    """ELASTIC fleet (ISSUE 13 tentpole acceptance): 2 active replicas
+    plus one RESERVE slice carved by ``carve_replica_meshes(...,
+    reserve=1)``, a seeded :class:`FleetAutoscaler` that spawns from
+    the reserve under sustained priced pressure (the newcomer earns
+    admission through the PR-10 probation-probe path), then a planned
+    ``drain`` of replica 0 once the newcomer is HEALTHY — its resident
+    rows MIGRATE their committed KV pages over the kv_ship wire when
+    ``perf_model.migrate_vs_reprefill_ms`` prices the wire under the
+    recompute. Composes with the --faults acceptance plan
+    ``ReplicaDeath(replica=1, step=N)``: the death, the grow and the
+    drain all land in one run, and still lost_requests == 0 with every
+    stream byte-identical to the fault-free reference. The whole
+    grow/drain/migrate event log is replayed twice under the same
+    fleet seed and must come back identical."""
+    import os as _os
+
+    import jax
+
+    from triton_distributed_tpu import config as _config
+    from triton_distributed_tpu.models import Transformer
+    from triton_distributed_tpu.runtime import faults as _rt_faults
+    from triton_distributed_tpu.runtime import watchdog as _rt_watchdog
+    from triton_distributed_tpu.runtime.health import (
+        HealthLedger,
+        PeerState,
+    )
+    from triton_distributed_tpu.runtime.topology import (
+        carve_replica_meshes,
+    )
+    from triton_distributed_tpu.serving import ServingEngine
+    from triton_distributed_tpu.serving.fleet import (
+        AutoscalerConfig,
+        RouterConfig,
+        ServingFleet,
+    )
+
+    devs = jax.devices()
+    n_active = 2
+    active_meshes, spare_meshes = carve_replica_meshes(
+        n_active, devs, reserve=1)
+    w = int(active_meshes[0].devices.size)
+    cfg, ecfg, trace_kw, s_cap = _serving_continuous_config(
+        w, on_tpu, tiny
+    )
+    from dataclasses import replace as _rep
+
+    if not on_tpu or tiny:
+        trace_kw = dict(
+            n_requests=14, mean_interarrival=0.6,
+            len_lo=8, len_hi=40, max_new_lo=4, max_new_hi=8,
+            vocab=trace_kw["vocab"],
+        )
+        ecfg = _rep(ecfg, slots=4, token_budget=48, chunk=16, page=8,
+                    npages=64)
+    ecfg = _rep(ecfg, prefix_cache=True, temperature=0.7, top_k=40,
+                seed=11)
+
+    models = []
+    for m in list(active_meshes) + list(spare_meshes):
+        model = Transformer(cfg, m, tp_axis="x")
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s),
+            model.init(jax.random.PRNGKey(7)), model.shardings(),
+        )
+        params = model.quantize_moe_weights(params)
+        params = model.quantize_dense_weights(params)
+        models.append((model, params))
+
+    def fresh_trace():
+        return _fleet_trace(trace_kw, ecfg.page)
+
+    n_total = len(fresh_trace())
+    grown_peer = f"replica:{n_active}"
+
+    def build_fleet(elastic=True):
+        engines = [ServingEngine(model, params, ecfg)
+                   for model, params in models[:n_active]]
+        spare_model, spare_params = models[n_active]
+        if not elastic:
+            return ServingFleet(
+                engines, seed=1, router=RouterConfig(),
+                meshes=list(active_meshes))
+        return ServingFleet(
+            engines, seed=1,
+            router=RouterConfig(queue_cap=4),
+            # fast probation so the grown replica earns admission
+            # within the trace (the PR-10 knobs, not a blind add)
+            health=HealthLedger(seed=1, probation_after=1,
+                                promote_after=1, probe_interval=2),
+            meshes=list(active_meshes),
+            reserve=[(lambda: ServingEngine(spare_model, spare_params,
+                                            ecfg),
+                      spare_meshes[0])],
+            autoscaler=AutoscalerConfig(slo_ms=0.0, window=2,
+                                        cooldown=50, max_replicas=3),
+        )
+
+    def drive(fleet, max_ticks=2000):
+        """fleet.run plus the drain trigger: once the grown replica is
+        HEALTHY, replica 0 is drained — the planned-retirement half of
+        the elastic story, with the autoscaler's grow and the fault
+        plan's death composing around it."""
+        fleet.submit_trace(fresh_trace())
+        prev = _config.fleet_seed()
+        _config.set_fleet_seed(fleet.seed)
+        drained = False
+        try:
+            for _ in range(max_ticks):
+                if fleet.idle:
+                    break
+                if (not drained and fleet.stats.grows
+                        and fleet.health.state(grown_peer)
+                        is PeerState.HEALTHY):
+                    fleet.drain(0)
+                    drained = True
+                fleet.tick()
+        finally:
+            _config.set_fleet_seed(prev)
+        return fleet.stats
+
+    wd_trips = []
+
+    def _guarded(run_fn):
+        if _rt_faults.active_plan() is None:
+            return run_fn()
+        deadline = float(_os.environ.get("TDTPU_BENCH_WATCHDOG", "10.0"))
+        box = {}
+        try:
+            with _rt_watchdog.collective_watchdog(deadline=deadline):
+                box["out"] = run_fn()
+        except _rt_watchdog.WatchdogTimeout as e:
+            wd_trips.append(str(e).splitlines()[0])
+        finally:
+            _rt_watchdog.clear_trip()
+        return box.get("out")
+
+    # ---- fault-free static reference (the token oracle; run twice —
+    # the first run pays every jit compile for the replica models)
+    plan = _rt_faults.active_plan()
+    _rt_faults.set_fault_plan(None)
+    try:
+        for _warm in (False, True):
+            ref_fleet = build_fleet(elastic=False)
+            ref_fleet.run(fresh_trace())
+    finally:
+        _rt_faults.set_fault_plan(plan)
+    ref_tokens = ref_fleet.token_streams()
+    assert ref_fleet.stats.lost_requests == 0, ref_fleet.stats
+
+    # ---- the elastic run under the active plan (grow + drain +
+    # migrate + whatever the plan throws at it)
+    fleet = build_fleet()
+    stats = _guarded(lambda: drive(fleet))
+    assert stats is not None, wd_trips
+
+    # ---- replay determinism: the same fleet seed and trace must
+    # produce the byte-identical grow/drain/migration event log
+    fleet2 = build_fleet()
+    stats2 = _guarded(lambda: drive(fleet2))
+    assert stats2 is not None, wd_trips
+    events_deterministic = list(stats.events) == list(stats2.events)
+
+    tokens = fleet.token_streams()
+    mismatches = sum(
+        1 for rid, t in ref_tokens.items() if tokens.get(rid) != t
+    )
+    goodput = fleet.goodput_tok_per_s
+    priced = [(round(wms, 6), round(rms, 6))
+              for wms, rms in stats.migration_priced]
+    return {
+        "metric": "serving_elastic",
+        "value": round(goodput, 1),
+        "unit": "tok/s fleet goodput (modeled wall)",
+        "ticks": fleet.ticks,
+        "completed": stats.completed,
+        "lost_requests": stats.lost_requests,
+        "token_mismatches_vs_fault_free": mismatches,
+        "grows": stats.grows,
+        "drains": stats.drains,
+        "drain_requeued": stats.drain_requeued,
+        "migrations": stats.migrations,
+        "migrations_cheaper_than_reprefill": stats.migrations_cheaper,
+        "migrated_pages": stats.migrated_pages,
+        "migration_wire_bytes": stats.migration_wire_bytes,
+        "migration_priced_ms": priced[:8],
+        "migration_refusals": stats.migration_refusals,
+        "migration_failures": stats.migration_failures,
+        "deaths": stats.deaths,
+        "failover_requeued": stats.failover_requeued,
+        "admission_rejections": stats.admission_rejections,
+        "probes": stats.probes,
+        "routed": {str(k): v for k, v in sorted(stats.routed.items())},
+        "rotation": list(fleet.rotation()),
+        "event_log": [list(e) for e in stats.events[:24]],
+        "event_log_deterministic": events_deterministic,
+        "watchdog_trips": wd_trips,
+        "health": fleet.health.snapshot(),
+        "config": (
+            f"active={n_active}x{w} reserve=1x{w} slots={ecfg.slots} "
+            f"budget={ecfg.token_budget} chunk={ecfg.chunk} "
+            f"page={ecfg.page} npages={ecfg.npages} "
+            f"requests={n_total} queue_cap=4 slo_ms=0.0 window=2 "
+            f"temp=0.7 top_k=40 prefix_cache=on fleet_seed=1 "
             + ("tiny-dryrun" if tiny or not on_tpu else "headline")
         ),
     }

@@ -16,7 +16,8 @@
 # machine + determinism, mesh shrink, slice-death failover
 # token-exactness, probation re-promotion) and the fleet router suite
 # (tests/test_fleet.py: scoring/affinity/spill, ReplicaDeath failover,
-# probe re-entry, chaos-site heartbeats) — everything that answers
+# probe re-entry, chaos-site heartbeats, elastic grow/drain and the
+# live KV-page-migration chaos soak) — everything that answers
 # "did I just break a protocol, a contract, or the host plumbing?"
 # without paying for the big interpreted model suites. Use it as the
 # inner-loop gate; the full tier-1 run remains the merge gate.
@@ -171,4 +172,104 @@ print(f"speculative smoke: 0 mismatches across {stats.completed} "
       f"requests, accepted_tokens_per_step={acc:.2f} "
       f"(verify rows={stats.spec_rows}, "
       f"rolled_back={stats.rolled_back_tokens})")
+EOF
+
+# Elastic fleet smoke (ISSUE 13 acceptance): a 1-replica fleet with one
+# reserve engine scales UP under queue pressure (the grown replica must
+# earn admission through the probation-probe path), then replica 0 is
+# DRAINED onto the newcomer — exits nonzero unless lost_requests == 0,
+# at least one autoscale grow landed, and at least one live KV-page
+# migration was priced cheaper than re-prefilling the same pages.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2").strip()
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from triton_distributed_tpu import config
+from triton_distributed_tpu.models import Transformer, TransformerConfig
+from triton_distributed_tpu.runtime.health import HealthLedger, PeerState
+from triton_distributed_tpu.serving import (
+    AutoscalerConfig, EngineConfig, ServingEngine, ServingFleet,
+)
+from triton_distributed_tpu.serving.engine import Request
+
+cfg = TransformerConfig(
+    vocab=128, n_layers=2, hidden=64, ffn=128, n_heads=4, n_kv_heads=2,
+    head_dim=16, dtype=jnp.float32, param_dtype=jnp.float32,
+    kv_quant="int8")
+ecfg = EngineConfig(slots=4, token_budget=48, chunk=16, page=8,
+                    npages=32, prefix_cache=True, temperature=0.7,
+                    top_k=40, seed=11)
+devs = jax.devices()
+models = []
+params0 = None
+for k in range(2):
+    mesh = Mesh(np.asarray(devs[k % len(devs):k % len(devs) + 1]),
+                ("tp",))
+    model = Transformer(cfg, mesh, "tp", ())
+    if params0 is None:
+        params0 = model.init(jax.random.PRNGKey(0))
+    p = jax.tree.map(lambda x, s: jax.device_put(x, s), params0,
+                     model.shardings())
+    models.append((model, p))
+
+spare = lambda: ServingEngine(models[1][0], models[1][1], ecfg,
+                              use_pallas=False)
+ledger = HealthLedger(seed=0, probation_after=1, promote_after=1,
+                      probe_interval=2)
+fleet = ServingFleet(
+    [ServingEngine(models[0][0], models[0][1], ecfg, use_pallas=False)],
+    seed=3, health=ledger, reserve=[spare],
+    autoscaler=AutoscalerConfig(slo_ms=0.0, window=2, cooldown=50,
+                                max_replicas=2))
+
+rng = np.random.default_rng(5)
+trace = [Request(rid=i,
+                 prompt=rng.integers(0, 128, (12,)).astype(np.int32),
+                 max_new=5, arrival=i * 0.5)
+         for i in range(18)]
+
+prev = config.fleet_seed()
+config.set_fleet_seed(fleet.seed)
+drained = False
+try:
+    fleet.submit_trace(trace)
+    for _ in range(500):
+        if fleet.idle:
+            break
+        if (not drained and fleet.stats.grows
+                and ledger.state("replica:1") is PeerState.HEALTHY
+                and 1 in fleet.rotation()
+                and fleet.replicas[0].held()):
+            fleet.drain(0)
+            drained = True
+        fleet.tick()
+finally:
+    config.set_fleet_seed(prev)
+
+stats = fleet.stats
+assert stats.lost_requests == 0, (
+    f"elastic smoke lost {stats.lost_requests} requests: {stats}")
+assert stats.completed == len(trace), stats.completed
+assert len(stats.grows) >= 1, f"no autoscale grow landed: {stats.grows}"
+assert drained and len(stats.drains) == 1, (
+    f"drain never completed: drained={drained} drains={stats.drains}")
+assert stats.migrations >= 1, (
+    f"drain finished without migrating any KV pages: {stats}")
+assert stats.migrations_cheaper >= 1, (
+    f"no migration was priced under re-prefill: "
+    f"{stats.migration_priced}")
+print(f"elastic smoke: {stats.completed}/{stats.submitted} completed, "
+      f"0 lost across grow@{stats.grows[0][1]} + "
+      f"drain{stats.drains[0]}, migrations={stats.migrations} "
+      f"({stats.migrated_pages} pages, "
+      f"{stats.migrations_cheaper} priced under re-prefill)")
 EOF

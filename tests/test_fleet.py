@@ -509,3 +509,267 @@ class TestAdmissionControl:
     def test_zero_cap_refused(self, fleet_models):
         with pytest.raises(ValueError, match="queue_cap"):
             self._flooded_fleet(fleet_models, cap=0)
+
+
+# ------------------------------------------- elastic fleet (ISSUE-13)
+
+def _spare_factory(fleet_models, k=1, **ecfg):
+    m, p = fleet_models[k]
+    kw = dict(ECFG, **ecfg)
+    return lambda: ServingEngine(m, p, EngineConfig(**kw),
+                                 use_pallas=False)
+
+
+class TestCarveReserve:
+    def test_reserve_split_and_back_compat(self):
+        from triton_distributed_tpu.runtime.topology import (
+            carve_replica_meshes,
+        )
+
+        devs = jax.devices()
+        active, spares = carve_replica_meshes(2, devs, reserve=1)
+        assert len(active) == 2 and len(spares) == 1
+        # reserve=0 keeps returning the pre-elastic flat list
+        flat = carve_replica_meshes(2, devs)
+        assert isinstance(flat, list) and len(flat) == 2
+        with pytest.raises(ValueError, match="reserve"):
+            carve_replica_meshes(2, devs, reserve=-1)
+
+
+class _ScriptedScaler:
+    """FleetAutoscaler with a scripted pressure signal — isolates the
+    window/cooldown flap damping from the perf model."""
+
+    def __init__(self, cfg, script):
+        from triton_distributed_tpu.serving import FleetAutoscaler
+
+        self.inner = FleetAutoscaler(cfg)
+        self.inner.pressure = lambda fleet: bool(script.pop(0))
+
+    def run(self, n):
+        import types as _t
+
+        decisions = []
+        for t in range(n):
+            fleet = _t.SimpleNamespace(ticks=t, _alive=lambda: [None])
+            if self.inner.should_grow(fleet):
+                decisions.append(t)
+                self.inner.last_grow = t
+                self.inner.pressured = 0
+        return decisions
+
+
+class TestAutoscaler:
+    def test_window_and_cooldown_damping(self):
+        from triton_distributed_tpu.serving import AutoscalerConfig
+
+        cfg = AutoscalerConfig(slo_ms=1.0, window=2, cooldown=4)
+        # pressure: sustained from t=1..9 with a one-tick dip at t=5
+        script = [False, True, True, True, True, False,
+                  True, True, True, True]
+        grows = _ScriptedScaler(cfg, script).run(10)
+        # first grow needs TWO consecutive pressured ticks (t=2); the
+        # dip resets the window, then the second grow waits out BOTH
+        # the rebuilt window (t=7) and the cooldown (7 - 2 >= 4)
+        assert grows == [2, 7]
+
+    def test_grow_spawns_probation_gated_replica(self, fleet_models):
+        from triton_distributed_tpu.serving import AutoscalerConfig
+
+        m0, p0 = fleet_models[0]
+        engines = [ServingEngine(m0, p0, EngineConfig(**ECFG),
+                                 use_pallas=False)]
+        fleet = ServingFleet(
+            engines, seed=1, router=RouterConfig(),
+            health=_fast_ledger(),
+            reserve=[_spare_factory(fleet_models)],
+            autoscaler=AutoscalerConfig(slo_ms=0.0, window=2,
+                                        cooldown=3, max_replicas=2))
+        # staggered arrivals: the flood keeps arriving PAST the grow,
+        # so the probe path has dispatch-time traffic to feed on
+        trace = [_req(i, i * 0.5, plen=12, max_new=5)
+                 for i in range(18)]
+        stats = fleet.run(trace)
+        assert stats.lost_requests == 0
+        assert len(stats.grows) == 1          # max_replicas damped
+        grown, at = stats.grows[0]
+        assert grown == 1 and at >= 1         # window needed 2 ticks
+        # the newcomer walked the PR-10 path: ledger entry, probes,
+        # then real traffic — and ended HEALTHY in the rotation
+        assert fleet.health.state("replica:1") is PeerState.HEALTHY
+        assert stats.probes >= 1
+        assert stats.routed.get(1, 0) >= 1
+        assert 1 in fleet.rotation()
+        kinds = [e[0] for e in stats.events]
+        assert "grow" in kinds
+        assert not fleet._reserve             # spare consumed
+
+    def test_grow_without_reserve_refused(self, fleet_models):
+        fleet = _fleet(fleet_models)
+        with pytest.raises(ValueError, match="reserve"):
+            fleet.grow()
+
+
+class TestDrainMigration:
+    def _pinned_trace(self, n_each=2, max_new=8):
+        out = []
+        for i in range(n_each):
+            out.append(_req(i, 0.0, session="a", plen=20,
+                            max_new=max_new))
+        for i in range(n_each):
+            out.append(_req(10 + i, 0.0, session="b", plen=20,
+                            max_new=max_new))
+        return out
+
+    def _run_drained(self, fleet_models, drain_at=3, drain=1,
+                     perf_spec=None, plan=None, death=None):
+        fleet = _fleet(fleet_models, "scored")
+        fleet.perf_spec = perf_spec
+        fleet.router.affinity["a"] = 0
+        fleet.router.affinity["b"] = 1
+        fleet.submit_trace(self._pinned_trace())
+        for t in range(400):
+            if fleet.idle:
+                break
+            if t == drain_at:
+                fleet.drain(drain)
+            fleet.tick()
+        return fleet
+
+    def test_drain_migrates_pages_token_exact(self, fleet_models):
+        ref = _fleet(fleet_models, "scored")
+        ref.router.affinity["a"] = 0
+        ref.router.affinity["b"] = 1
+        ref.run(self._pinned_trace())
+        assert ref.stats.lost_requests == 0
+
+        fleet = self._run_drained(fleet_models)
+        st = fleet.stats
+        assert st.lost_requests == 0
+        assert st.completed == 4
+        # resident rows moved their committed pages over the wire —
+        # and every shipped migration priced under the re-prefill
+        assert st.migrations >= 1
+        assert st.migrated_pages >= 1
+        assert st.migration_wire_bytes > 0
+        assert st.migrations_cheaper == st.migrations
+        assert all(w < r for w, r in st.migration_priced)
+        # the drained replica retired cleanly and left the rotation
+        assert len(st.drains) == 1
+        k, start, done = st.drains[0]
+        assert k == 1 and start == 3 and done >= start
+        assert fleet.rotation() == (0,)
+        assert 1 in fleet._retired
+        kinds = [e[0] for e in st.events]
+        assert "drain_start" in kinds and "drain_done" in kinds
+        assert "migrate" in kinds
+        # placement changed, bytes did not
+        assert fleet.token_streams() == ref.token_streams()
+
+    def test_pricing_flip_refuses_migration(self, fleet_models):
+        """A DCN priced absurdly slow flips migrate_vs_reprefill: the
+        drain REFUSES the wire, rows finish in place, and the streams
+        stay byte-identical — the degradation is time, never tokens."""
+        from triton_distributed_tpu.tune.perf_model import TpuSpec
+
+        slow = TpuSpec(name="torture-dcn", bf16_tflops=200.0,
+                       hbm_gbps=800.0, ici_gbps=50.0, ici_links=4,
+                       dcn_gbps=1e-12)
+        ref = self._run_drained(fleet_models)
+        fleet = self._run_drained(fleet_models, perf_spec=slow)
+        st = fleet.stats
+        assert st.migrations == 0
+        assert st.migration_refusals >= 1
+        assert st.lost_requests == 0
+        assert st.completed == 4
+        assert 1 in fleet._retired
+        assert fleet.token_streams() == ref.token_streams()
+
+    def test_drain_last_routable_refused(self, fleet_models):
+        fleet = _fleet(fleet_models)
+        fleet.drain(1)
+        with pytest.raises(RuntimeError, match="last routable"):
+            fleet.drain(0)
+        with pytest.raises(ValueError, match="dead/retired"):
+            fleet.drain(7)
+
+    def test_event_log_replays_deterministically(self, fleet_models):
+        logs = []
+        for _ in range(2):
+            fleet = self._run_drained(fleet_models)
+            logs.append(list(fleet.stats.events))
+        assert logs[0] == logs[1]
+
+
+# -------------------------------------------------- chaos soak (soak)
+
+class TestChaosSoak:
+    """The ISSUE-13 composition pin: a flood past ``queue_cap`` × a
+    ReplicaDeath DURING an active drain × a migration-transport Stall,
+    all in one run — lost_requests stays 0 and every stream is
+    byte-exact against the fault-free fleet. Robustness features must
+    compose, not merely pass alone."""
+
+    def _soak_trace(self):
+        out = []
+        for i in range(3):
+            out.append(_req(i, 0.0, session="a", plen=20, max_new=10))
+        for i in range(3):
+            out.append(_req(10 + i, 0.0, session="b", plen=20,
+                            max_new=10))
+        # late fillers: they flood the lone survivor after the death
+        out += [_req(20 + i, 6.0, plen=10, max_new=4)
+                for i in range(6)]
+        return out
+
+    def _soak_fleet(self, fleet_models):
+        kw = dict(ECFG)
+        engines = [ServingEngine(m, p, EngineConfig(**kw),
+                                 use_pallas=False)
+                   for m, p in fleet_models]
+        fleet = ServingFleet(engines, seed=1,
+                             router=RouterConfig(queue_cap=2))
+        fleet.router.affinity["a"] = 0
+        fleet.router.affinity["b"] = 1
+        return fleet
+
+    def test_flood_death_mid_drain_migration_stall(self, fleet_models):
+        ref = self._soak_fleet(fleet_models)
+        ref.run(self._soak_trace())
+        assert ref.stats.lost_requests == 0
+
+        fleet = self._soak_fleet(fleet_models)
+        plan = FaultPlan(seed=1, faults=(
+            ReplicaDeath(replica=0, step=5),
+            Stall(site="kv_migrate", rank=0)))
+        fleet.submit_trace(self._soak_trace())
+        with faults.fault_plan(plan):
+            # warm ticks before the watchdog arms: admission + first
+            # chunks compile here, so only the STALL can look stalled
+            for t in range(2):
+                fleet.tick()
+            with pytest.raises(WatchdogTimeout):
+                with watchdog.collective_watchdog(deadline=0.2):
+                    for t in range(2, 400):
+                        if fleet.idle:
+                            break
+                        if t == 3:
+                            fleet.drain(0)
+                        fleet.tick()
+        st = fleet.stats
+        assert st.lost_requests == 0
+        assert st.completed == 12
+        # all three chaos ingredients actually fired
+        assert st.admission_rejections > 0          # the cap rejected
+        assert st.migrations >= 1                   # stalled, then shipped
+        assert st.deaths == [(0, 5)]                # died MID-drain
+        death = next(e for e in st.events if e[0] == "death")
+        assert "mid-drain" in death[3]
+        assert fleet.health.state("site:kv_migrate") \
+            is PeerState.UNHEALTHY
+        # the interrupted drain never completes; the failover path
+        # finished the job instead — with zero lost work
+        assert st.drains == []
+        assert not fleet._draining
+        assert st.failover_requeued >= 1
+        assert fleet.token_streams() == ref.token_streams()

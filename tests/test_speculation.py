@@ -425,3 +425,67 @@ class TestSpecPerfModel:
         plain.run(_motif_trace(7, 5, 0.5, 8, 30, 10, 16),
                   max_steps=600)
         assert replica_load_ms(eng) < replica_load_ms(plain)
+
+
+# ------------------------------------------- adaptive draft-k (ISSUE-13)
+
+class TestAdaptiveK:
+    """Per-request AIMD draft budget: a rejection clamps the next draft
+    to ``accepted + 1``, a fully-accepted row grows it back by one,
+    always inside ``[1, spec_k]``. Pure bookkeeping over the verify
+    outcome, so streams stay byte-exact and the k trajectory is
+    seeded-deterministic."""
+
+    def test_token_exact_and_bounded(self, model_params):
+        model, params = model_params
+        t_ref = _motif_trace(9, 5, 0.5, 8, 30, 8, 14)
+        ServingEngine(model, params, EngineConfig(**ECFG)).run(
+            t_ref, max_steps=600)
+        t_ad = _motif_trace(9, 5, 0.5, 8, 30, 8, 14)
+        eng = SpeculativeEngine(
+            model, params, EngineConfig(**ECFG), spec_k=4,
+            drafter=NGramDrafter(), adaptive_k=True)
+        stats = eng.run(t_ad, max_steps=600)
+        assert stats.completed == 5
+        for a, b in zip(t_ref, t_ad):
+            assert a.generated == b.generated, a.rid
+        hist = stats.adaptive_k_histogram
+        assert hist and sum(hist.values()) > 0
+        # the budget never leaves [1, spec_k]
+        assert all(1 <= k <= 4 for k in hist)
+
+    def test_shrinks_under_rejection_pressure(self, model_params):
+        """The always-wrong drafter drives every row to a rejection;
+        the budget must collapse to 1 and stay there (each request's
+        FIRST row still opens at spec_k)."""
+        model, params = model_params
+        trace = _motif_trace(5, 3, 0.5, 8, 20, 6, 8)
+        eng = SpeculativeEngine(
+            model, params, EngineConfig(**ECFG), spec_k=4,
+            drafter=_WrongDrafter(), adaptive_k=True)
+        stats = eng.run(trace, max_steps=600)
+        assert stats.completed == 3
+        hist = stats.adaptive_k_histogram
+        assert hist.get(1, 0) > hist.get(4, 0)
+
+    def test_histogram_and_streams_deterministic(self, model_params):
+        model, params = model_params
+        outs = []
+        for _ in range(2):
+            trace = _motif_trace(3, 5, 0.6, 8, 24, 6, 10)
+            eng = SpeculativeEngine(
+                model, params, EngineConfig(**ECFG), spec_k=4,
+                drafter=NGramDrafter(), adaptive_k=True)
+            stats = eng.run(trace, max_steps=600)
+            outs.append((stats.adaptive_k_histogram,
+                         [tuple(r.generated) for r in trace]))
+        assert outs[0] == outs[1]
+
+    def test_off_by_default_no_histogram(self, model_params):
+        model, params = model_params
+        trace = _motif_trace(11, 3, 0.5, 8, 20, 4, 6)
+        eng = SpeculativeEngine(
+            model, params, EngineConfig(**ECFG), spec_k=4,
+            drafter=NGramDrafter())
+        stats = eng.run(trace, max_steps=400)
+        assert stats.adaptive_k_histogram == {}

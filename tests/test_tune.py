@@ -249,6 +249,31 @@ class TestPerfModel:
         assert overlap_efficiency(2.0, 1.0) == 1.0
         assert overlap_efficiency(1.0, 2.0) == 0.5
 
+    def test_migrate_vs_reprefill_pricing(self):
+        """The fleet's migration gate (ISSUE-13): shipping pages over a
+        fast DCN beats recomputing the prefix; a slow DCN flips the
+        verdict while the re-prefill side (DCN-independent) holds."""
+        from triton_distributed_tpu.tune.perf_model import (
+            TpuSpec,
+            migrate_vs_reprefill_ms,
+        )
+
+        kw = dict(page=8, hkv=2, g=2, d=16, hidden=64, n_layers=2)
+        fast = TpuSpec(name="fast-dcn", bf16_tflops=200.0,
+                       hbm_gbps=800.0, ici_gbps=50.0, ici_links=4,
+                       dcn_gbps=100.0)
+        w, r = migrate_vs_reprefill_ms(4, spec=fast, **kw)
+        assert 0 < w < r
+        slow = TpuSpec(name="slow-dcn", bf16_tflops=200.0,
+                       hbm_gbps=800.0, ici_gbps=50.0, ici_links=4,
+                       dcn_gbps=1e-9)
+        w2, r2 = migrate_vs_reprefill_ms(4, spec=slow, **kw)
+        assert w2 > r2
+        assert r2 == pytest.approx(r)
+        # both sides grow with the prefix length
+        w3, r3 = migrate_vs_reprefill_ms(8, spec=fast, **kw)
+        assert w3 > w and r3 > r
+
 
 class TestTunedEngineSelection:
     """method=None consults the measured tuner with a persistent on-disk

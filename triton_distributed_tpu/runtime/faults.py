@@ -61,16 +61,18 @@ from dataclasses import dataclass, field
 logger = logging.getLogger(__name__)
 
 #: Site names used by the kernel hook points. "*" in a fault matches any.
-#: The last four are HOST-level sites (serving-engine instrumentation,
+#: The last five are HOST-level sites (serving-engine instrumentation,
 #: ``lang.maybe_instrument(axis=None)``): the ragged serving kernel's
 #: chaos hook, the jitted serving step, the disaggregated KV-ship
-#: transport, and the fleet router's dispatch loop (a stalled router is
+#: transport, the fleet router's dispatch loop (a stalled router is
 #: a different outage than a stalled engine — every replica starves at
-#: once).
+#: once), and the fleet's replica→replica KV-page migration wire (a
+#: stalled migration must degrade to re-prefill, never wedge a drain).
 SITES = (
     "allgather", "reduce_scatter", "all_to_all", "ag_gemm", "gemm_rs",
     "moe_dispatch", "flash_decode",
     "ragged_paged", "serving_step", "kv_ship", "router_dispatch",
+    "kv_migrate",
 )
 
 

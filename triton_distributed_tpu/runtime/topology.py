@@ -158,7 +158,7 @@ def device_coords(mesh: Mesh) -> np.ndarray | None:
 # --------------------------------------------------------------- fleet carve
 
 def carve_replica_meshes(n_replicas: int, devices=None,
-                         axis: str = "x") -> list:
+                         axis: str = "x", reserve: int = 0):
     """Carve the device pool into ``n_replicas`` equal 1-D meshes, one
     per fleet replica (:mod:`~triton_distributed_tpu.serving.fleet`).
 
@@ -170,20 +170,34 @@ def carve_replica_meshes(n_replicas: int, devices=None,
     refusing: the engines are host-stepped and the interpreter mesh is
     virtual, so sharing is safe there and a loud refusal would make the
     fleet untestable off-TPU.
+
+    ``reserve`` carves ``reserve`` ADDITIONAL equal slices and returns
+    ``(active, spares)`` instead of a flat list — the spare-device pool
+    the :class:`~triton_distributed_tpu.serving.fleet.FleetAutoscaler`
+    spawns grow replicas onto. The split is over ``n_replicas +
+    reserve`` ways, so spares are real carved capacity (same width as
+    an active replica), not an overcommit.
     """
     import jax
 
     if n_replicas < 1:
         raise ValueError(f"carve_replica_meshes: n_replicas={n_replicas}")
+    if reserve < 0:
+        raise ValueError(f"carve_replica_meshes: reserve={reserve}")
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
-    w = len(devices) // n_replicas
+    total = n_replicas + reserve
+    w = len(devices) // total
     if w == 0:
-        return [Mesh(np.array([devices[k % len(devices)]]), (axis,))
-                for k in range(n_replicas)]
-    return [Mesh(np.array(devices[k * w:(k + 1) * w]), (axis,))
-            for k in range(n_replicas)]
+        meshes = [Mesh(np.array([devices[k % len(devices)]]), (axis,))
+                  for k in range(total)]
+    else:
+        meshes = [Mesh(np.array(devices[k * w:(k + 1) * w]), (axis,))
+                  for k in range(total)]
+    if reserve == 0:
+        return meshes
+    return meshes[:n_replicas], meshes[n_replicas:]
 
 
 # --------------------------------------------------------------- mesh shrink

@@ -23,6 +23,9 @@ from triton_distributed_tpu.serving.engine import (  # noqa: F401
 )
 from triton_distributed_tpu.serving.fleet import (  # noqa: F401
     FLEET_ENGINE_FAMILIES,
+    MIGRATION_ENGINE_FAMILIES,
+    AutoscalerConfig,
+    FleetAutoscaler,
     FleetRouter,
     FleetStats,
     Replica,

@@ -135,6 +135,9 @@ class EngineStats:
     accepted_draft_tokens: int = 0     # drafts that matched the keyed sample
     spec_tokens_out: int = 0           # tokens EMITTED by verify rows
     rolled_back_tokens: int = 0        # rejected draft positions rewound
+    # adaptive drafter k (spec.py adaptive_k=True): verify rows planned
+    # at each per-request draft budget k — empty on fixed-k engines
+    adaptive_k_rows: dict = field(default_factory=dict)
 
     @property
     def total_time(self) -> float:
@@ -182,6 +185,12 @@ class EngineStats:
         if not self.draft_tokens:
             return 0.0
         return self.accepted_draft_tokens / self.draft_tokens
+
+    @property
+    def adaptive_k_histogram(self) -> dict:
+        """k -> verify-row count under the adaptive drafter, ascending
+        k — shows where the per-request budget actually settled."""
+        return dict(sorted(self.adaptive_k_rows.items()))
 
     @property
     def decode_p99_step_ms(self) -> float:
@@ -780,6 +789,51 @@ class ServingEngine:
         req.parked = False
         self._free_slot(slot)
 
+    # The wire-form page plumbing below is shared by every pool→pool
+    # transfer this engine is an endpoint of: the disaggregated
+    # prefill→decode ship and the fleet's replica→replica migration
+    # (serving/fleet.py) both move the pool's NATIVE quantized bytes,
+    # so a page that travels is byte-identical to one that never moved.
+
+    def _kv_wire_jits(self) -> tuple:
+        jits = getattr(self, "_kv_wire_cache", None)
+        if jits is None:
+            import jax
+
+            from triton_distributed_tpu.kernels.kv_ship import (
+                gather_kv_pages,
+                scatter_kv_pages,
+            )
+
+            jits = (jax.jit(gather_kv_pages),
+                    jax.jit(scatter_kv_pages, donate_argnums=(0,)))
+            self._kv_wire_cache = jits
+        return jits
+
+    def gather_pages(self, pids) -> tuple:
+        """Pull pool pages ``pids`` into the kv_ship wire layout
+        (``(q, s)`` — int8 payload + f32 scale rail under
+        ``kv_quant``)."""
+        import jax.numpy as jnp
+
+        gather, _ = self._kv_wire_jits()
+        return gather(self.state.layers,
+                      jnp.asarray(list(pids), jnp.int32))
+
+    def land_pages(self, pids, q_payload, s_payload) -> None:
+        """Scatter an arrived wire payload into this engine's pools at
+        page slots ``pids`` (donating scatter + landing fence, the
+        ``_commit_ships`` discipline)."""
+        import jax
+        import jax.numpy as jnp
+
+        _, scatter = self._kv_wire_jits()
+        new_layers = scatter(self.state.layers,
+                             jnp.asarray(list(pids), jnp.int32),
+                             q_payload, s_payload)
+        jax.block_until_ready(new_layers)
+        self.state = self.state.replace(layers=new_layers)
+
 
 # ===================================================================
 # Disaggregated prefill/decode: two role engines, KV shipped between
@@ -901,7 +955,8 @@ class DisaggregatedEngine:
                  transport: str = "auto", ship_delay_steps: int = 0,
                  placement: str = "force", traffic: dict | None = None,
                  moe_state="auto", use_pallas: bool = True, health=None,
-                 spec_k: int = 0, drafter=None):
+                 spec_k: int = 0, drafter=None,
+                 adaptive_k: bool = False):
         from dataclasses import replace as _rep
 
         from triton_distributed_tpu.runtime.health import HealthLedger
@@ -976,7 +1031,7 @@ class DisaggregatedEngine:
             self.decode = SpeculativeEngine(
                 decode_model, decode_params,
                 _rep(dcfg, prefill_only=False),
-                spec_k=spec_k, drafter=drafter,
+                spec_k=spec_k, drafter=drafter, adaptive_k=adaptive_k,
                 moe_state=moe_state, use_pallas=use_pallas,
                 health=self.health,
             )

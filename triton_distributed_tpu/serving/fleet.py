@@ -78,6 +78,17 @@ FLEET_ENGINE_FAMILIES = (
     "kv_ship.pages",               # disaggregated replicas' KV wire
 )
 
+#: Kernel families the replica→replica KV-page MIGRATION wire rides —
+#: the kv_ship machinery routed fleet-internally instead of
+#: prefill→decode. ``bench.py --lint`` gates that each resolves a
+#: degradation target (``migration_gaps == 0``): the migration path's
+#: own fallback is re-prefill at the destination, but the wire it
+#: prefers must inherit the engine-level degradation guarantee or a
+#: drain would wedge on the first transport fault.
+MIGRATION_ENGINE_FAMILIES = (
+    "kv_ship.pages",
+)
+
 
 # ------------------------------------------------------------- replica
 
@@ -308,6 +319,79 @@ class FleetRouter:
         return chosen, spilled
 
 
+# ---------------------------------------------------------- autoscaler
+
+@dataclass(frozen=True)
+class AutoscalerConfig:
+    """Grow-side elasticity knobs (docs/SERVING.md § Elastic fleet).
+
+    The pressure signal is PRICED, not counted: a tick is pressured
+    when even the LIGHTEST routable replica's
+    :func:`~triton_distributed_tpu.tune.perf_model.replica_load_ms`
+    (the modeled wait the best possible placement pays — the projected
+    p99 admission wait, since every other placement waits longer)
+    exceeds ``slo_ms`` while work is actually backed up. ``window``
+    consecutive pressured ticks trigger a grow; ``cooldown`` ticks must
+    then pass before the next — together the flap damping that keeps a
+    burst from oscillating the fleet."""
+
+    slo_ms: float                  # projected-admission-wait SLO (model ms)
+    window: int = 3                # consecutive pressured ticks to grow
+    cooldown: int = 10             # min ticks between grows (flap damp)
+    max_replicas: int | None = None
+
+
+class FleetAutoscaler:
+    """Watches the ledger-filtered routing set plus the windowed
+    queue-depth/``replica_load_ms`` signal and decides WHEN the fleet
+    should spawn from its reserve pool. Pure bookkeeping over
+    deterministic inputs (the perf model and the tick clock), seeded
+    like every fleet component — same seed and trace ⇒ identical grow
+    ticks. The fleet owns HOW to grow (:meth:`ServingFleet.grow`:
+    reserve mesh, probation warm-up, probe-gated admission)."""
+
+    def __init__(self, cfg: AutoscalerConfig, seed: int = 0):
+        self.cfg = cfg
+        self.seed = seed
+        self.pressured = 0             # consecutive pressured ticks
+        self.last_grow: int | None = None
+        self.history: list = []        # (tick, projected_ms, backlog)
+
+    def pressure(self, fleet) -> bool:
+        """Is THIS tick pressured? Projected wait at the lightest
+        routable replica vs the SLO, gated on a real backlog."""
+        routable = [
+            r for r in fleet._route_candidates()
+            if fleet.router.health_factor(
+                fleet.health.state(r.peer)) is not None
+        ]
+        if not routable:
+            return False
+        projected = min(r.load_ms() for r in routable)
+        backlog = (len(fleet.queue)
+                   + sum(r.queue_depth() for r in routable))
+        self.history.append((fleet.ticks, projected, backlog))
+        return projected > self.cfg.slo_ms and backlog > 0
+
+    def should_grow(self, fleet) -> bool:
+        """One observation per fleet tick: update the sustained-pressure
+        window, then apply the flap damps (window, cooldown,
+        max_replicas)."""
+        if self.pressure(fleet):
+            self.pressured += 1
+        else:
+            self.pressured = 0
+        if self.pressured < max(1, self.cfg.window):
+            return False
+        if self.last_grow is not None \
+                and fleet.ticks - self.last_grow < self.cfg.cooldown:
+            return False
+        if self.cfg.max_replicas is not None \
+                and len(fleet._alive()) >= self.cfg.max_replicas:
+            return False
+        return True
+
+
 # --------------------------------------------------------------- stats
 
 @dataclass
@@ -342,6 +426,30 @@ class FleetStats:
     retired_generated: int = 0
     records: dict = field(default_factory=dict)
     # rid -> {arrival, first_token_tick, completion_tick, n, tokens}
+    # --- elastic fleet (grow / drain / migrate) ---
+    # the replay-determinism object: every scale/drain/migration event
+    # as (kind, replica, tick, detail) in occurrence order — same fleet
+    # seed and trace ⇒ byte-identical list (test-pinned)
+    events: list = field(default_factory=list)
+    grows: list = field(default_factory=list)      # (replica, tick)
+    drains: list = field(default_factory=list)     # (replica, start, done)
+    drain_requeued: int = 0        # queued work handed back by a drain
+    migrations: int = 0
+    migrated_pages: int = 0
+    migration_wire_bytes: int = 0
+    # (migrate_ms, reprefill_ms) per migration — the perf_model.
+    # migrate_vs_reprefill_ms verdict that justified each wire trip
+    migration_priced: list = field(default_factory=list)
+    migration_refusals: int = 0    # priced: re-prefill beat the wire
+    migration_failures: int = 0    # wire exhausted; re-prefill fallback
+
+    @property
+    def migrations_cheaper(self) -> int:
+        """Migrations whose shipped wire priced UNDER the modeled
+        re-prefill — by construction all of them (the fleet refuses the
+        rest), so this equals ``migrations`` unless the pricing gate is
+        broken; the CI smoke asserts it is nonzero."""
+        return sum(1 for w, r in self.migration_priced if w < r)
 
     @property
     def completed(self) -> int:
@@ -390,11 +498,21 @@ class ServingFleet:
     ``seed`` — the fleet routing seed; installed via
     ``config.set_fleet_seed`` for the duration of :meth:`run` so cached
     kernel builds can't leak across differently-routed fleets.
+    ``reserve`` — spare capacity the autoscaler may spawn from: a list
+    of engines, zero-arg engine factories, or ``(factory, mesh)`` pairs
+    (meshes from ``carve_replica_meshes(..., reserve=n)``). Factories
+    defer building until the grow actually happens.
+    ``autoscaler`` — an :class:`AutoscalerConfig`; None disables
+    ledger-driven grow (the pre-elastic behavior).
+    ``perf_spec`` — optional TpuSpec override for the migration pricing
+    (tests flip the migrate-vs-reprefill verdict by shrinking
+    ``dcn_gbps``).
     """
 
     def __init__(self, engines, *, seed: int = 0,
                  router: RouterConfig | None = None, health=None,
-                 meshes=None):
+                 meshes=None, reserve=None, autoscaler=None,
+                 perf_spec=None):
         from triton_distributed_tpu.runtime.health import HealthLedger
 
         if not engines:
@@ -417,6 +535,12 @@ class ServingFleet:
         self._dead: set = set()            # currently-dead replica idx
         self._death_handled: set = set()   # faults already consumed
         self._probing: dict = {}           # replica idx -> probe tick
+        self._draining: dict = {}          # replica idx -> drain start
+        self._retired: set = set()         # cleanly drained, gone
+        self._reserve = list(reserve or [])
+        self.autoscaler = (FleetAutoscaler(autoscaler, seed=seed)
+                           if autoscaler is not None else None)
+        self.perf_spec = perf_spec
 
     # ---------------------------------------------------------- intake
 
@@ -436,19 +560,30 @@ class ServingFleet:
     @property
     def idle(self) -> bool:
         return (not self.queue
+                and not self._draining
                 and all(r.idle for r in self._alive()))
 
     def _alive(self) -> list:
-        return [r for r in self.replicas if r.index not in self._dead]
+        return [r for r in self.replicas
+                if r.index not in self._dead
+                and r.index not in self._retired]
+
+    def _route_candidates(self) -> list:
+        """Replicas the router may place NEW work on: alive and not
+        draining — a draining replica finishes (or migrates) what it
+        holds and admits nothing."""
+        return [r for r in self._alive()
+                if r.index not in self._draining]
 
     def rotation(self) -> tuple:
         """Replica indices currently receiving scored traffic — the
         ledger-driven grow/shrink surface (PROBATION members rejoin
-        probe-first; UNHEALTHY members are out)."""
+        probe-first; UNHEALTHY members are out; draining members have
+        stopped admitting)."""
         from triton_distributed_tpu.runtime.health import PeerState
 
         out = []
-        for r in self._alive():
+        for r in self._route_candidates():
             st = self.health.state(r.peer)
             if st not in (PeerState.UNHEALTHY, PeerState.PROBATION):
                 out.append(r.index)
@@ -479,8 +614,17 @@ class ServingFleet:
             target = self._route_probe(req)
             spilled = False
             if target is None:
+                sess = getattr(req, "session", None)
+                home_idx = (self.router.affinity.get(sess)
+                            if sess is not None else None)
                 target, spilled = self.router.route(
-                    req, self._alive(), self.health)
+                    req, self._route_candidates(), self.health)
+                if spilled and home_idx is not None \
+                        and home_idx != target.index:
+                    # the session re-homed but its prefix pages still
+                    # live at the old home: ship them instead of
+                    # letting admission re-prefill (when priced)
+                    self._migrate_prefix(req, home_idx, target)
             target.submit(req)
             self.stats.routed[target.index] = (
                 self.stats.routed.get(target.index, 0) + 1)
@@ -511,7 +655,7 @@ class ServingFleet:
         if cap is None:
             return False
         routable = [
-            r for r in self._alive()
+            r for r in self._route_candidates()
             if self.router.health_factor(self.health.state(r.peer))
             is not None
         ]
@@ -540,7 +684,7 @@ class ServingFleet:
         engine-level kernel probes."""
         from triton_distributed_tpu.runtime.health import PeerState
 
-        for r in self._alive():
+        for r in self._route_candidates():
             if r.index in self._probing:
                 continue
             if self.health.state(r.peer) is PeerState.PROBATION \
@@ -553,13 +697,16 @@ class ServingFleet:
     # ------------------------------------------------------------ tick
 
     def tick(self) -> dict:
-        """One fleet tick: consume replica deaths, route arrivals, step
-        every live replica (concurrent slices in production; the host
-        harness serializes them on one clock)."""
+        """One fleet tick: consume replica deaths, maybe grow, route
+        arrivals, advance drains (migrate-or-finish), step every live
+        replica (concurrent slices in production; the host harness
+        serializes them on one clock)."""
         from triton_distributed_tpu.runtime.health import PeerState
 
         self._check_replica_deaths()
+        self._maybe_grow()
         routed = self._dispatch()
+        self._advance_drains()
         stepped = 0
         for r in self._alive():
             st = self.health.state(r.peer)
@@ -641,6 +788,10 @@ class ServingFleet:
 
     def _kill(self, k: int) -> None:
         self._dead.add(k)
+        # a death interrupts any in-progress drain of the same replica:
+        # the remaining resident rows take the failover path below
+        # (cursor-0 requeue) instead of migrating — still zero lost
+        interrupted = self._draining.pop(k, None)
         if not self._alive():
             raise RuntimeError(
                 f"fault plan killed every fleet replica by tick "
@@ -650,6 +801,10 @@ class ServingFleet:
             "replica_death", replica.peer, step=self.ticks,
             detail=f"replica {k} died at tick {self.ticks}")
         self.stats.deaths.append((k, self.ticks))
+        self._log_event(
+            "death", k,
+            f"mid-drain (started@{interrupted})"
+            if interrupted is not None else "")
         self._retire_engine(replica)
         # drain: everything the replica held re-enters the FLEET queue
         # at cursor 0 (the recompute-eviction discipline: re-prefilling
@@ -689,6 +844,350 @@ class ServingFleet:
         if engine is not None:
             self.replicas[k].engine = engine
         self._dead.discard(k)
+
+    # ---------------------------------------------------------- elastic
+
+    def _log_event(self, kind: str, replica: int,
+                   detail: str = "") -> None:
+        self.stats.events.append((kind, replica, self.ticks, detail))
+
+    def _maybe_grow(self) -> None:
+        if self.autoscaler is None or not self._reserve:
+            return
+        if self.autoscaler.should_grow(self):
+            self.grow()
+
+    def grow(self) -> int:
+        """Spawn one replica from the reserve pool. The newcomer enters
+        through the ledger, never blindly: the spawn is recorded as a
+        fatal signal (UNHEALTHY), clean idle ticks earn PROBATION, and
+        the router hands it traffic only as seeded probes until
+        ``promote_after`` clean probes promote it to HEALTHY — the same
+        PR 10 path a revived replica walks. Returns the new index."""
+        if not self._reserve:
+            raise ValueError("grow: the reserve pool is empty")
+        spare = self._reserve.pop(0)
+        mesh = None
+        if isinstance(spare, tuple):
+            spare, mesh = spare
+        engine = spare() if callable(spare) else spare
+        idx = len(self.replicas)
+        replica = Replica(idx, engine, mesh)
+        self.replicas.append(replica)
+        self.health.record(
+            "autoscale_spawn", replica.peer, step=self.ticks,
+            detail=f"replica {idx} spawned from the reserve pool",
+            fatal=True)
+        if self.autoscaler is not None:
+            self.autoscaler.last_grow = self.ticks
+            self.autoscaler.pressured = 0
+        self.stats.grows.append((idx, self.ticks))
+        self._log_event("grow", idx, "spawned from reserve")
+        return idx
+
+    def drain(self, k: int) -> None:
+        """Planned retirement — the dual of :meth:`_kill`. Replica
+        ``k`` stops admitting immediately (out of the routing set and
+        the rotation); its queued-but-not-resident work re-enters the
+        fleet queue now; resident rows either finish in place or
+        MIGRATE their committed KV pages to a surviving replica (when
+        :func:`~triton_distributed_tpu.tune.perf_model.
+        migrate_vs_reprefill_ms` prices the wire under the recompute
+        and a destination can reserve landing pages); once empty the
+        replica retires cleanly. A chaos ``ReplicaDeath`` mid-drain
+        falls back to the failover path — zero requests lost either
+        way."""
+        if k in self._dead or k in self._retired \
+                or k >= len(self.replicas):
+            raise ValueError(f"replica {k} is dead/retired/unknown")
+        if k in self._draining:
+            return
+        others = [r for r in self._route_candidates()
+                  if r.index != k and self.router.health_factor(
+                      self.health.state(r.peer)) is not None]
+        if not others:
+            raise RuntimeError(
+                f"cannot drain replica {k}: it is the last routable "
+                "replica — grow or revive first")
+        self._draining[k] = self.ticks
+        replica = self.replicas[k]
+        requeued = 0
+        for role in replica._roles:
+            moved = [r for r in list(role.waiting) + list(role.pending)
+                     if not r.done]
+            role.waiting.clear()
+            role.pending.clear()
+            for req in moved:
+                req.slot = None
+                self.queue.append(req)
+            requeued += len(moved)
+        if requeued:
+            self.queue = deque(sorted(self.queue,
+                                      key=lambda r: r.arrival))
+            self.stats.drain_requeued += requeued
+        # session affinities stay pointed here until their next request
+        # re-routes — the spill-migration path needs the old home
+        self._log_event("drain_start", k, f"requeued={requeued}")
+
+    def _advance_drains(self) -> None:
+        """One drain step per draining replica: try to migrate every
+        resident row off it (parked rows ride their own ship machinery
+        and finish first), retire when nothing is left."""
+        for k in sorted(self._draining):
+            replica = self.replicas[k]
+            for role in replica._roles:
+                for req in list(role.slot_req):
+                    if req is None or req.done or req.parked:
+                        continue
+                    self._try_migrate_live(req, replica, role)
+            if not replica.held() and replica.idle:
+                self._retire(k)
+
+    def _retire(self, k: int) -> None:
+        replica = self.replicas[k]
+        start = self._draining.pop(k)
+        self._retired.add(k)
+        self._retire_engine(replica)
+        replica.neutralize()
+        for sess, idx in list(self.router.affinity.items()):
+            if idx == k:
+                del self.router.affinity[sess]
+        self.stats.drains.append((k, start, self.ticks))
+        self._log_event("drain_done", k, f"started@{start}")
+
+    # ------------------------------------------------------- migration
+
+    def _price_migration(self, role, n_pages: int) -> tuple:
+        from triton_distributed_tpu.tune import perf_model
+
+        mc = role.model.config
+        hkv = mc.n_kv_heads
+        return perf_model.migrate_vs_reprefill_ms(
+            n_pages, page=role.cfg.page, hkv=hkv,
+            g=mc.n_heads // max(hkv, 1), d=mc.head_dim,
+            hidden=mc.hidden, n_layers=mc.n_layers,
+            chunk=role.cfg.chunk,
+            quant=getattr(mc, "kv_quant", None) is not None,
+            spec=self.perf_spec)
+
+    def _landing_shardings(self, role, with_scale: bool) -> tuple:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        # payload (L·2, P, Hkv, page[, D]): KV heads stay sharded over
+        # the destination's tp axis, like the pools they land in — the
+        # DisaggregatedEngine wire discipline
+        q = NamedSharding(role.model.mesh, P(None, None, role.model.tp_axis))
+        return q, (q if with_scale else None)
+
+    def _migrate_transport(self, payload, dst_role):
+        """The replica→replica wire: the kv_ship XLA transfer onto the
+        destination mesh under the ``kv_migrate`` chaos site, with the
+        PR 10 capped-jittered retry/backoff. Returns the landed payload
+        or None when exhausted — the caller rolls back and the row
+        falls back to re-prefill (this path's degradation target)."""
+        import os as _os
+
+        from triton_distributed_tpu.lang.launch import maybe_instrument
+        from triton_distributed_tpu.tools.native import xla_kv_ship
+
+        qpay, spay = payload
+        shard = self._landing_shardings(dst_role, spay is not None)
+        send = maybe_instrument(
+            lambda: xla_kv_ship((qpay, spay), shard), axis=None,
+            site="kv_migrate",
+            collective_id=("kv_migrate", self.ticks), n=1,
+            step=self.ticks)
+        retries = max(1, int(_os.environ.get("TDTPU_SHIP_RETRIES", "3")))
+        backoff = float(_os.environ.get("TDTPU_SHIP_BACKOFF", "0.2"))
+        cap = float(_os.environ.get("TDTPU_SHIP_BACKOFF_CAP", "2.0"))
+        for attempt in range(retries):
+            try:
+                return send()
+            except Exception:
+                if attempt == retries - 1:
+                    self.health.record(
+                        "migrate_transport_error", "site:kv_migrate",
+                        step=self.ticks)
+                    return None
+                delay = min(cap, backoff * (2.0 ** attempt))
+                delay *= 0.5 + self.health.uniform(
+                    "migrate_backoff", self.ticks, attempt)
+                time.sleep(delay)
+
+    def _try_migrate_live(self, req, src: Replica, role) -> bool:
+        """Migrate one RESIDENT row off ``src``: reserve landing pages
+        at the best-scoring destination with room, ship the committed
+        pages (everything below the cursor) in pool-native wire form,
+        commit, release the source. Token-exact: the cursor survives
+        the move and sampling is keyed ``(seed, rid, n_generated)``, so
+        the stream continues as if it never moved. False = the row
+        stays (priced against us, no destination room, or the wire
+        failed) and finishes in place."""
+        pslot = req.slot
+        npg = role._pages_held(req.cursor)
+        if npg == 0:
+            # nothing committed yet: hand the request straight back to
+            # the fleet queue instead of burning drain time on it
+            if pslot is not None:
+                role._free_slot(pslot)
+            req.slot = None
+            self.queue.append(req)
+            self.queue = deque(sorted(self.queue,
+                                      key=lambda r: r.arrival))
+            self.stats.drain_requeued += 1
+            return False
+        wire_ms, reprefill_ms = self._price_migration(role, npg)
+        if wire_ms >= reprefill_ms:
+            self.stats.migration_refusals += 1
+            return False
+        cands = [r for r in self._route_candidates()
+                 if r.index != src.index
+                 and self.router.health_factor(
+                     self.health.state(r.peer)) is not None]
+        mean = (sum(r.load_ms() for r in cands) / len(cands)
+                if cands else 0.0)
+        cands.sort(key=lambda r: (
+            -(self.router.score(r, req, self.health.state(r.peer),
+                                mean) or 0.0),
+            _u(self.seed, "migrate", req.rid, r.index)))
+        for dst in cands:
+            dst_role = dst.admit_role
+            if dst_role.cfg.page != role.cfg.page:
+                continue               # pages ship verbatim
+            got = dst_role.reserve_shipped(req)
+            if got is None:
+                continue               # no slot/pages there; try next
+            dslot, dpids = got
+            src_pids = [int(p) for p in role.table[pslot, :npg]]
+            payload = role.gather_pages(src_pids)
+            shipped = self._migrate_transport(payload, dst_role)
+            if shipped is None:
+                # roll the reservation back; the row stays at src and
+                # can still finish in place (or requeue on a kill)
+                dst_role.release_parked(dslot)
+                req.slot = pslot
+                req.parked = False
+                self.stats.migration_failures += 1
+                self._log_event("migrate_failed", src.index,
+                                f"rid={req.rid} dst={dst.index}")
+                return False
+            dst_role.land_pages(dpids, *shipped)
+            # handoff order matters (the _commit_ships discipline): the
+            # source frees its pinned pages, THEN the row becomes
+            # schedulable at the destination
+            role.release_parked(pslot)
+            dst_role.commit_shipped(req)
+            self._warm_migrated_prefix(req, dst_role, dpids)
+            sess = getattr(req, "session", None)
+            if sess is not None:
+                self.router.affinity[sess] = dst.index
+            self._account_migration(role, npg, wire_ms, reprefill_ms)
+            self._log_event(
+                "migrate", src.index,
+                f"rid={req.rid} pages={npg} -> replica {dst.index}")
+            return True
+        return False
+
+    def _migrate_prefix(self, req, home_idx: int, dst: Replica) -> bool:
+        """Spill-path migration: the request re-homed, but its prefix
+        pages still live in the OLD home's pool (a draining, full, or
+        outscored replica). Ship the resident full-page chain into
+        destination CACHE pages — alloc, land, register under the same
+        chain hashes, then release to the reclaimable cache — so
+        admission at the new home attaches the pages instead of
+        re-prefilling them. Priced like every migration; skipped
+        whenever the wire loses."""
+        from triton_distributed_tpu.serving.state import page_chain_hash
+
+        if home_idx in self._dead or home_idx in self._retired \
+                or home_idx >= len(self.replicas) \
+                or home_idx == dst.index:
+            return False
+        src_role = self.replicas[home_idx].admit_role
+        dst_role = dst.admit_role
+        if not (src_role.pool.prefix_cache
+                and dst_role.pool.prefix_cache):
+            return False
+        if src_role.cfg.page != dst_role.cfg.page:
+            return False
+        page = src_role.cfg.page
+        seq = req.seq
+        src_pids, hashes, h = [], [], 0
+        for p in range((len(seq) - 1) // page):
+            h = page_chain_hash(h, seq[p * page:(p + 1) * page])
+            pg = src_role.pool.lookup(h)
+            if pg is None:
+                break
+            src_pids.append(int(pg))
+            hashes.append(h)
+        npg = len(src_pids)
+        if npg == 0 or dst.overlap_pages(req) >= npg:
+            return False
+        wire_ms, reprefill_ms = self._price_migration(src_role, npg)
+        if wire_ms >= reprefill_ms:
+            self.stats.migration_refusals += 1
+            return False
+        if npg > dst_role.pool.available - dst_role._committed_pages():
+            return False
+        dpids = [dst_role.pool.alloc() for _ in range(npg)]
+        if any(pg is None for pg in dpids):
+            for pg in dpids:
+                if pg is not None:
+                    dst_role.pool.release(pg)
+            return False
+        payload = src_role.gather_pages(src_pids)
+        shipped = self._migrate_transport(payload, dst_role)
+        if shipped is None:
+            for pg in dpids:
+                dst_role.pool.release(pg)
+            self.stats.migration_failures += 1
+            self._log_event("migrate_failed", home_idx,
+                            f"rid={req.rid} dst={dst.index}")
+            return False
+        dst_role.land_pages(dpids, *shipped)
+        for pg, hh in zip(dpids, hashes):
+            dst_role.pool.register(int(pg), hh)
+        for pg in dpids:
+            # refcount 0 + registered = reclaimable cache residency:
+            # attachable by the arriving request, reclaimed under
+            # pressure, never leaked
+            dst_role.pool.release(int(pg))
+        self._account_migration(src_role, npg, wire_ms, reprefill_ms)
+        self._log_event(
+            "migrate", home_idx,
+            f"rid={req.rid} pages={npg} -> replica {dst.index} "
+            f"(prefix)")
+        return True
+
+    def _account_migration(self, role, npg: int, wire_ms: float,
+                           reprefill_ms: float) -> None:
+        from triton_distributed_tpu.kernels.kv_ship import (
+            ship_wire_bytes,
+        )
+
+        mc = role.model.config
+        st = self.stats
+        st.migrations += 1
+        st.migrated_pages += npg
+        st.migration_wire_bytes += ship_wire_bytes(
+            npg, role.cfg.page, mc.n_kv_heads, mc.head_dim,
+            mc.n_layers, getattr(mc, "kv_quant", None) is not None)
+        st.migration_priced.append((wire_ms, reprefill_ms))
+
+    def _warm_migrated_prefix(self, req, dst_role, dpids) -> None:
+        """The landed pages below the cursor are frozen: register their
+        chain hashes at the destination (the ``_warm_prefix_cache``
+        discipline) so siblings sharing the prefix attach without
+        another wire trip. Partial trailing pages stay private."""
+        if not dst_role.pool.prefix_cache:
+            return
+        full = min(req.cursor // dst_role.cfg.page, len(dpids))
+        if full <= 0:
+            return
+        hashes = dst_role._page_hashes(req, full)
+        for p in range(full):
+            dst_role.pool.register(int(dpids[p]), hashes[p])
 
     # ------------------------------------------------------ aggregates
 

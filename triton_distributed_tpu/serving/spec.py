@@ -191,7 +191,7 @@ class SpeculativeEngine(ServingEngine):
     no extra packed budget: ``_ceil8(k+1) == _ceil8(1)``."""
 
     def __init__(self, model, params, cfg, *, drafter: Drafter | None = None,
-                 spec_k: int = 4, **kw):
+                 spec_k: int = 4, adaptive_k: bool = False, **kw):
         super().__init__(model, params, cfg, **kw)
         if spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {spec_k}")
@@ -203,6 +203,16 @@ class SpeculativeEngine(ServingEngine):
                 f"spec_k={spec_k} verify row exceeds chunk={cfg.chunk}")
         self.spec_k = int(spec_k)
         self.drafter = drafter if drafter is not None else NGramDrafter()
+        # adaptive per-request draft budget: consume the observe()
+        # feedback to walk each request's k inside [1, spec_k] — AIMD
+        # over the verify outcomes (grow +1 on a clean sweep, shrink to
+        # what the row actually earned on a rejection). A deterministic
+        # pure function of the request's accept history, so two replays
+        # of a trace budget identically; ``spec_k`` stays the admission
+        # headroom bound (``_row_take_bound`` must assume the widest
+        # row a request may ever pack).
+        self.adaptive_k = bool(adaptive_k)
+        self._req_k: dict = {}             # rid -> current draft budget
         # slot -> this step's proposed draft tail (cleared every
         # assembly: a deferred row's entry must not leak into a later
         # step where the slot packs something else)
@@ -225,7 +235,13 @@ class SpeculativeEngine(ServingEngine):
         # steady decode row: widen to [frontier, d_1 .. d_nd]. Drafting
         # past the request's remaining emission target is pure rollback
         # work, so nd is also capped by (max_new - generated - 1).
-        nd = min(self.spec_k,
+        budget = self.spec_k
+        if self.adaptive_k:
+            budget = self._req_k.setdefault(req.rid, self.spec_k)
+            st = self.stats
+            st.adaptive_k_rows[budget] = (
+                st.adaptive_k_rows.get(budget, 0) + 1)
+        nd = min(budget,
                  self.state.capacity - (req.cursor + 1),
                  req.max_new - len(req.generated) - 1)
         drafts = (self.drafter.draft(req, nd) if nd > 0
@@ -307,5 +323,22 @@ class SpeculativeEngine(ServingEngine):
         st.spec_tokens_out += emitted
         st.rolled_back_tokens += nd - accepted
         self.drafter.observe(req, accepted, nd - accepted)
+        if self.adaptive_k:
+            self._observe_k(req, accepted, nd - accepted, nd)
         self._maybe_complete(req, s)
         return emitted, 0
+
+    def _observe_k(self, req, accepted: int, rejected: int,
+                   nd: int) -> None:
+        """Walk the request's draft budget on one verify outcome:
+        a clean sweep earns +1 (additive growth, capped at ``spec_k``),
+        a rejection shrinks the budget to ``accepted + 1`` (what the
+        row proved it could use, floor 1) — rejected drafts are pure
+        rollback work, so the budget tracks the stream's measured
+        compressibility instead of paying ``spec_k`` everywhere."""
+        k = self._req_k.get(req.rid, self.spec_k)
+        if rejected > 0:
+            k = max(1, accepted + 1)
+        elif nd > 0:
+            k = min(self.spec_k, k + 1)
+        self._req_k[req.rid] = k

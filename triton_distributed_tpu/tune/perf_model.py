@@ -453,6 +453,34 @@ def kv_ship_ms(n_pages: int, page: int, hkv: int, d: int, n_layers: int,
             / (spec.dcn_gbps * 1e9) * 1e3)
 
 
+def migrate_vs_reprefill_ms(n_pages: int, *, page: int, hkv: int, g: int,
+                            d: int, hidden: int, n_layers: int = 1,
+                            chunk: int = 16, quant: bool = True,
+                            spec: TpuSpec | None = None,
+                            issue_ms: float | None = None) -> tuple:
+    """Price a cross-replica KV-page migration against recomputing the
+    same prefix at the new home. Returns ``(migrate_ms, reprefill_ms)``:
+    the DCN wire time of shipping ``n_pages`` in native quantized pool
+    form (:func:`kv_ship_ms` — the bytes never widen) vs the chunked
+    prefill steps that would rebuild the same ``n_pages · page`` tokens
+    from scratch (:func:`ragged_serving_step_ms` per chunk, each chunk
+    attending everything already rebuilt). The fleet migrates only when
+    the wire beats the recompute — long committed prefixes ship, short
+    ones re-prefill, and the crossover moves with ``dcn_gbps`` exactly
+    like the disaggregation gate's."""
+    spec = spec or detect_spec()
+    migrate = kv_ship_ms(n_pages, page, hkv, d, n_layers, quant, spec)
+    tokens = n_pages * page
+    reprefill, done = 0.0, 0
+    while done < tokens:
+        take = min(chunk, tokens - done)
+        done += take
+        reprefill += ragged_serving_step_ms(
+            [done], [take], page=page, hkv=hkv, g=g, d=d, hidden=hidden,
+            n_layers=n_layers, spec=spec, quant=quant, issue_ms=issue_ms)
+    return migrate, reprefill
+
+
 def refuse_disaggregation(model_cfg, page: int, traffic: dict,
                           spec: TpuSpec | None = None, *,
                           ledger=None) -> str | None:
