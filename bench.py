@@ -295,7 +295,9 @@ def _parse_args(argv=None):
         "mid-trace drain with live KV-page migration; all compose "
         "with --dryrun and --faults, e.g. the ISSUE-13 acceptance "
         "line 'serving_elastic --dryrun --faults \"seed=1; "
-        "ReplicaDeath(replica=1, step=8)\"')",
+        "ReplicaDeath(replica=1, step=8)\"' — or train_step — the "
+        "dp×tp×cp train step on the int8 EF gradient ring vs the "
+        "single-device reference and the exact psum twin, ISSUE-14)",
     )
     return ap.parse_args(argv)
 
@@ -430,9 +432,31 @@ def _run_lint() -> None:
             file=sys.stderr, flush=True,
         )
 
+    # training gate (ISSUE 14): the train step's collective families —
+    # the CP attention rings and the quantized gradient ring — must be
+    # registered with a resolvable degradation target, or the trainer's
+    # ledger demotion (wire ring → exact psum twin) would rest on an
+    # unverified fallback
+    from triton_distributed_tpu.train import TRAIN_ENGINE_FAMILIES
+
+    train_gaps = []
+    for fam in TRAIN_ENGINE_FAMILIES:
+        if fam not in fams:
+            train_gaps.append(
+                (fam, "training family not registered"))
+        elif fam in gap_names:
+            train_gaps.append(
+                (fam, "training family has a degradation gap"))
+    for fam, problem in train_gaps:
+        print(
+            json.dumps({"lint_train_gap":
+                        {"family": fam, "problem": problem}}),
+            file=sys.stderr, flush=True,
+        )
+
     errs = (sum(f.severity >= Severity.ERROR for f in findings)
             + len(gaps) + len(fleet_gaps) + len(spec_gaps)
-            + len(migration_gaps))
+            + len(migration_gaps) + len(train_gaps))
     print(
         json.dumps({"metric": "shmemlint", "errors": errs,
                     "findings": len(findings),
@@ -441,6 +465,7 @@ def _run_lint() -> None:
                     "fleet_gaps": len(fleet_gaps),
                     "spec_gaps": len(spec_gaps),
                     "migration_gaps": len(migration_gaps),
+                    "train_gaps": len(train_gaps),
                     "mosaic_scanned": len(report["scanned"]),
                     "mosaic_refused": len(report["refused"])}),
         file=sys.stderr, flush=True,
@@ -479,6 +504,7 @@ def main(argv=None) -> None:
             "serving_fleet": _bench_serving_fleet,
             "serving_speculative": _bench_serving_speculative,
             "serving_elastic": _bench_serving_elastic,
+            "train_step": _bench_train_step,
         }
         bench_fn = scenarios.get(args.scenario)
         if bench_fn is None:
@@ -2758,6 +2784,91 @@ def _bench_flash_decode(mesh, n, on_tpu, spec):
         "hbm_pct": round(100 * gbps / spec.hbm_gbps, 1),
         "int8_kv_us": round(t_q8 * 1e6, 1),
         "config": f"B={b} Hq={hq} Hkv={hkv} D={d} S={s_len} bf16 (+int8-KV twin)",
+    }
+
+
+def _bench_train_step(mesh, n, on_tpu, spec, tiny=False):
+    """TRAINING (ISSUE 14 acceptance): the dp2×tp2×cp2 train step on
+    the int8 EF gradient ring — CP ring attention over "cp", Megatron
+    MLP over "tp", the wire-quantized dp all-reduce — vs the
+    single-device dense reference and the exact psum twin. One row
+    reports: the ring's wire bytes vs the bf16 baseline (~2× down),
+    the final-loss delta against its pinned tolerance, and the EF
+    link-aggregate error strictly below the no-EF control."""
+    import numpy as _np
+
+    from jax.sharding import Mesh as _Mesh, PartitionSpec as _P
+
+    from triton_distributed_tpu import train
+    from triton_distributed_tpu.train import grad_wire, step as _stepmod
+
+    steps = 5 if tiny else 20
+    cfg = train.TrainConfig()
+    trainer = train.Trainer(cfg)
+    batches = [trainer.make_batch(k) for k in range(steps)]
+    t0 = time.perf_counter()
+    dist = [trainer.step(tok, tgt)["loss"] for tok, tgt in batches]
+    dt = time.perf_counter() - t0
+
+    params = _stepmod.init_params(cfg)
+    opt = _stepmod.init_opt_state(params)
+    ref = []
+    for tok, tgt in batches:
+        params, opt, loss = train.train_step_reference(
+            params, opt, tok, tgt, cfg)
+        ref.append(float(loss))
+    loss_tol = 0.05
+    delta = abs(dist[-1] - ref[-1])
+
+    # EF vs the no-EF control on the metric EF bounds: the
+    # link-aggregate (stripe-summed) reduce-scatter error (see
+    # train/grad_wire.py — per-element error is the SR noise floor
+    # either way)
+    nring, srows, cols = 4, 8, 128
+    ring_mesh = _Mesh(_np.asarray(jax.devices()[:nring]), ("x",))
+
+    def agg_err(ef):
+        errs = []
+        for seed in (0, 1, 2):
+            rng = _np.random.RandomState(seed)
+            x = rng.standard_normal(
+                (nring * nring * srows, cols)).astype(_np.float32)
+            exact = x.reshape(nring, nring * srows, cols).sum(axis=0)
+            fn = jax.shard_map(
+                lambda v: grad_wire.ef_ring_reduce_scatter(
+                    v, "x", n=nring, wire="int8", seed=seed + 7, ef=ef),
+                mesh=ring_mesh, in_specs=_P("x", None),
+                out_specs=_P("x", None), check_vma=False,
+            )
+            err = _np.asarray(jax.jit(fn)(x)) - exact
+            errs.append(
+                float(_np.abs(
+                    err.reshape(nring, srows, cols).sum(axis=0)).mean()))
+        return float(_np.mean(errs))
+
+    ef_err, ctl_err = agg_err(True), agg_err(False)
+    wires = trainer.wire_report()
+    ok = (delta < loss_tol and ef_err < ctl_err
+          and wires["ratio"] > 1.9)
+    return {
+        "metric": "train_step",
+        "value": round(dt / steps * 1e3, 2),
+        "unit": "ms/step",
+        "config": (f"dp{cfg.dp}×tp{cfg.tp}×cp{cfg.cp} "
+                   f"attn={cfg.attn} wire={trainer.wire} "
+                   f"microbatches={cfg.microbatches}"),
+        "steps": steps,
+        "final_loss": round(dist[-1], 6),
+        "final_loss_ref": round(ref[-1], 6),
+        "final_loss_delta": round(delta, 6),
+        "loss_tol": loss_tol,
+        "grad_ring_bytes": wires["wire_bytes"],
+        "grad_ring_bf16_bytes": wires["bf16_bytes"],
+        "grad_ring_byte_ratio": round(wires["ratio"], 3),
+        "ef_agg_err": round(ef_err, 6),
+        "no_ef_agg_err": round(ctl_err, 6),
+        "ef_below_control": ef_err < ctl_err,
+        "ok": ok,
     }
 
 

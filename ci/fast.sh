@@ -17,7 +17,10 @@
 # token-exactness, probation re-promotion) and the fleet router suite
 # (tests/test_fleet.py: scoring/affinity/spill, ReplicaDeath failover,
 # probe re-entry, chaos-site heartbeats, elastic grow/drain and the
-# live KV-page-migration chaos soak) — everything that answers
+# live KV-page-migration chaos soak) and the training suite
+# (tests/test_train.py: EF gradient-ring numerics + determinism, the
+# dp×tp×cp train step vs the dense reference, backward wire duals,
+# grad-ring chaos degradation/probation) — everything that answers
 # "did I just break a protocol, a contract, or the host plumbing?"
 # without paying for the big interpreted model suites. Use it as the
 # inner-loop gate; the full tier-1 run remains the merge gate.
@@ -36,6 +39,13 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'fast and not slow' \
 # IDs) AND produced a lint-clean pick. Exits 2 if the gate is unwired.
 JAX_PLATFORMS=cpu python -m triton_distributed_tpu.tune.schedule \
   --family ag_gemm.fused --mesh 8
+
+# Same oracle over the ISSUE-14 gradient ring: the scale_rail=payload
+# mutation must be rejected with a stable rule ID (SL009 — scales must
+# ride the sideband rail, never the int8 payload) and the clean
+# schedule must win.
+JAX_PLATFORMS=cpu python -m triton_distributed_tpu.tune.schedule \
+  --family grad_ring.stream_int8w --mesh 8
 
 # Degradation-target gate (the `bench.py --lint` check, standalone):
 # every registered kernel family must name a degradation target that
@@ -272,4 +282,60 @@ print(f"elastic smoke: {stats.completed}/{stats.submitted} completed, "
       f"drain{stats.drains[0]}, migrations={stats.migrations} "
       f"({stats.migrated_pages} pages, "
       f"{stats.migrations_cheaper} priced under re-prefill)")
+EOF
+
+# Training smoke (ISSUE 14 acceptance): a tiny dp2×tp2×cp2 step on the
+# int8 EF gradient ring vs the single-device dense reference — exits
+# nonzero unless the loss trajectories agree within tolerance, the ring
+# actually moved fewer bytes than bf16 (ratio ~2×), and the three
+# training families lint clean with declared degradation targets
+# (train_gaps == 0, the `bench.py --lint` gate, standalone).
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+
+from triton_distributed_tpu.analysis.lint import lint_family
+from triton_distributed_tpu.kernels.registry import (
+    missing_degradation_targets,
+)
+from triton_distributed_tpu.train import (
+    TRAIN_ENGINE_FAMILIES, TrainConfig, Trainer, train_step_reference,
+)
+from triton_distributed_tpu.train.step import init_opt_state, init_params
+
+cfg = TrainConfig()  # dp2×tp2×cp2, wire=int8, ef=True
+tr = Trainer(cfg)
+params = init_params(cfg)
+opt = init_opt_state(params)
+delta = 0.0
+loss = loss_ref = None
+for k in range(5):
+    tokens, targets = tr.make_batch(k)
+    loss = tr.step(tokens, targets)["loss"]
+    params, opt, loss_ref = train_step_reference(
+        params, opt, tokens, targets, cfg)
+    delta = max(delta, abs(float(loss) - float(loss_ref)))
+assert delta < 0.05, (
+    f"train smoke: wire-ring loss diverged from the dense reference "
+    f"by {delta:.4f} (tol 0.05)")
+rep = tr.wire_report()
+assert rep["ratio"] > 1.9, (
+    f"train smoke: int8 ring moved {rep['wire_bytes']}B vs "
+    f"{rep['bf16_bytes']}B bf16 (ratio {rep['ratio']:.2f} <= 1.9)")
+gaps = {f.name for f in missing_degradation_targets()}
+for fam in TRAIN_ENGINE_FAMILIES:
+    findings = lint_family(fam, n=8)
+    assert findings == [], f"train smoke: {fam} lints dirty: {findings}"
+    assert fam not in gaps, f"train smoke: {fam} has a degradation gap"
+print(f"train smoke: 5 steps dp2×tp2×cp2 wire=int8, "
+      f"max loss delta {delta:.4f} < 0.05 vs dense reference, "
+      f"wire bytes ratio {rep['ratio']:.2f}x, "
+      f"{len(TRAIN_ENGINE_FAMILIES)} families lint-clean with "
+      f"declared fallbacks")
 EOF

@@ -809,3 +809,45 @@ class TestRaggedFamily:
             kernel_name="unit_collapse",
         )
         assert [x.rule for x in f] == []
+
+
+# -------------------------------------- CP + grad-ring train families
+
+class TestCPTrainFamilies:
+    """The training subsystem's lint families (ISSUE 14): the
+    context-parallel attention rings (``cp.ring_attention`` KV
+    rotation, ``cp.ulysses`` a2a) and the quantized gradient ring
+    (``grad_ring.stream_int8w``), plus their seeded schedule-mutation
+    fixtures."""
+
+    FAMILIES = (
+        "cp.ring_attention", "cp.ulysses", "grad_ring.stream_int8w",
+    )
+
+    def test_families_lint_clean_both_meshes(self):
+        for name in self.FAMILIES:
+            for n in (4, 8):
+                findings = lint_family(name, n=n)
+                assert findings == [], (name, [f.format() for f in findings])
+
+    def test_families_are_preflighted(self):
+        for name in self.FAMILIES:
+            status, f = mosaic_compat.preflight_family(families()[name], 8)
+            assert status == "scanned" and f == [], (name, f)
+
+    def test_families_have_degradation_targets(self):
+        from triton_distributed_tpu.kernels.registry import (
+            missing_degradation_targets,
+        )
+
+        missing = {f.name for f in missing_degradation_targets()}
+        assert not (missing & set(self.FAMILIES))
+
+    def test_skipped_block_fixture_is_sl008(self):
+        rec, findings = _analyze_df_fixture(fixtures.cp_ring_skipped_block)
+        assert _rules(findings) == ["SL008"], [f.format() for f in findings]
+        assert all(f.severity == Severity.ERROR for f in findings)
+
+    def test_unpaired_scale_fixture_is_sl009(self):
+        rec, findings = _analyze_df_fixture(fixtures.grad_ring_unpaired_scale)
+        assert _rules(findings) == ["SL009"], [f.format() for f in findings]

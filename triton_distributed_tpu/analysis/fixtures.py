@@ -853,3 +853,62 @@ def lane_reshape(axis="x"):
               out_shapes=[((16, 128), _F32)]),
         lambda n: [((8, 256), _F32)],
     )
+
+
+def cp_ring_skipped_block(axis="x"):
+    """The context-parallel KV rotation ring one BLOCK short: the
+    schedule mutation ``chunk_order='skip_last'`` threaded through the
+    production cp.ring_attention builder drops the final hop's
+    start+wait+consume, so each rank's rotated-KV workspace terminates
+    one source block short — an attention output silently missing one
+    rank's keys/values. Semaphores balance, rails stay paired; only the
+    SL008 delivery replay against the gather contract can reject it
+    (``own_absent_ok``: the harness never copies the local block, ring
+    attention consumes it straight from the operand)."""
+    from dataclasses import replace
+
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.analysis.lint import lint_mesh
+    from triton_distributed_tpu.kernels.cp_ring import build_kv_rotate_lint
+    from triton_distributed_tpu.lang.launch import captured_launch
+    from triton_distributed_tpu.tune.schedule import RingSchedule
+
+    n = 8
+    build_kv_rotate_lint(
+        lint_mesh(n, axis), n, token=_schedule_token(),
+        schedule=RingSchedule(chunk_order="skip_last"),
+    )
+    spec = captured_launch("cp_ring_kv_rotate")
+    return (
+        replace(spec, name="fixture_cp_ring_skipped_block"),
+        lambda _n: [((8, 128), _F32)],
+        DeliveryContract(kind="gather", dst="ag_ref", own_absent_ok=True),
+    )
+
+
+def grad_ring_unpaired_scale(axis="x"):
+    """The gradient ring's quantized wire with the scale rail riding the
+    PAYLOAD semaphore: ``scale_rail='payload'`` threaded through the
+    production grad_ring.stream_int8w builder signals scale arrivals on
+    the payload's recv semaphore. Credits balance (reduce_ring waits the
+    right totals) — a gradient can dequantize against a scale from the
+    WRONG hop; only the SL009 rail-pairing replay can reject it."""
+    from dataclasses import replace
+
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.analysis.lint import lint_mesh
+    from triton_distributed_tpu.kernels.cp_ring import build_grad_ring_lint
+    from triton_distributed_tpu.lang.launch import captured_launch
+    from triton_distributed_tpu.tune.schedule import RingSchedule
+
+    n = 8
+    build_grad_ring_lint(
+        lint_mesh(n, axis), n, token=_schedule_token(),
+        schedule=RingSchedule(scale_rail="payload"),
+    )
+    spec = captured_launch("grad_ring_stream_int8w")
+    return (
+        replace(spec, name="fixture_grad_ring_unpaired_scale"),
+        lambda _n: [((8 * _n, 2048), _F32)],
+        DeliveryContract(kind="reduce", dst="out_hbm"),
+    )

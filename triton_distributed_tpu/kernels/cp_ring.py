@@ -1,0 +1,289 @@
+"""Lint-family kernels for the training collectives (CP + grad ring).
+
+The training path's collectives run as XLA programs off-TPU
+(``kernels.ring_attention``'s ppermute/a2a bodies, ``train.grad_wire``'s
+quantized rings) — but the wire/lint/schedule investment only pays if
+those protocols are ANALYZABLE like every serving family. This module
+is the Pallas twin of each training collective, built through
+``lang.shmem_call`` so shmemlint and the Mosaic pre-flight see the real
+launch (the ``kv_ship`` precedent: lint/preflight evidence and the
+on-TPU fast path; production dev-box steps ride the XLA bodies):
+
+* ``cp.ring_attention`` (collective id 15) — the KV-rotation ring:
+  each hop forwards the current KV block to the ring neighbor while
+  the attention partial consumes it. Runs on the shared
+  :func:`~triton_distributed_tpu.kernels.ring.ag_forward_ring`
+  harness, so ``RingSchedule`` traversal freedoms (direction) execute
+  and the mutated ``skip_last`` candidate drops a block on the floor —
+  visible ONLY to the gather delivery contract (SL008): one attention
+  step silently never sees one sequence block.
+* ``cp.ulysses`` (collective id 16) — the head-scatter all-to-all
+  (dense, equal splits), the Ulysses re-shard's transport.
+* ``grad_ring.stream_int8w`` (collective id 17) — the gradient ring:
+  HBM-streaming reduce ring on the int8 wire (per-hop quant pipelines
+  + scale rail, f32 dequant-accumulate), the Pallas shape of
+  ``train.grad_wire``'s EF reduce-scatter. Schedule depth 2/3 executes;
+  the mutated ``scale_rail="payload"`` candidate ships scales on the
+  payload's semaphore — the SL009 torn-scale hazard.
+
+The collective ids are shared with the XLA bodies' heartbeat
+instrumentation (``ring_attention.RING_ATTENTION_COLLECTIVE_ID`` etc.)
+so a watchdog trip report and the lint evidence name the same launch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu import lang
+from triton_distributed_tpu.lang import wire as wirelib
+
+#: lint geometry: KV blocks of 8 rows × 128 lanes (ring + a2a), wide
+#: 2048-lane grad stripes (the streaming wire's scale planes only
+#: compress when the stripe payload dwarfs them — same reasoning as
+#: reduce_scatter.stream_int8w's lint columns).
+CP_RING_GEOM = dict(rows=8, cols=128, grad_cols=2048)
+
+CP_RING_COLLECTIVE_ID = 15
+CP_ULYSSES_COLLECTIVE_ID = 16
+GRAD_RING_COLLECTIVE_ID = 17
+
+
+# ------------------------------------------------ cp.ring_attention (15)
+
+def _kv_rotate_kernel(n, axis, mesh_axes, schedule,
+                      kv_ref, ag_ref, send_sem, recv_sem):
+    """KV-rotation ring: forward the (rows, cols) KV block around the
+    ring while each step's arrival is consumed by the attention partial.
+    The local block is consumed at step 0 straight from the input and
+    never enters the workspace (``own_absent_ok`` in the contract) —
+    exactly the XLA body's peeled step 0."""
+    from triton_distributed_tpu.kernels.ring import ag_forward_ring
+
+    rows = kv_ref.shape[0]
+
+    def consume(s, src, a_hbm, row_off):
+        # the attention partial: pure local compute over the arrived
+        # block — no provenance the delivery contract needs to see
+        del s, src, a_hbm, row_off
+
+    ag_forward_ring(
+        n, axis, mesh_axes, kv_ref, ag_ref, rows, send_sem, recv_sem,
+        consume, site="cp_ring", schedule=schedule,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _build_kv_rotate(mesh, axis, rows, cols, collective_id, token=(),
+                     schedule=None):
+    del token
+    n = mesh.shape[axis]
+    return lang.shmem_call(
+        functools.partial(
+            _kv_rotate_kernel, n, axis, mesh.axis_names, schedule
+        ),
+        # the rotated-KV workspace rides as an ANY output (no HBM scratch)
+        out_shape=[jax.ShapeDtypeStruct((n * rows, cols), jnp.float32)],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+        ],
+        collective_id=collective_id,
+        name="cp_ring_kv_rotate",
+    )
+
+
+def build_kv_rotate_lint(mesh, n, token=(), schedule=None):
+    """Registry/pre-flight entry for ``cp.ring_attention``."""
+    del n
+    g = CP_RING_GEOM
+    return _build_kv_rotate(
+        mesh, "x", g["rows"], g["cols"], CP_RING_COLLECTIVE_ID,
+        token, schedule,
+    )
+
+
+# ----------------------------------------------------- cp.ulysses (16)
+
+def _ulysses_a2a_kernel(n, axis, mesh_axes, x_ref, out_ref,
+                        send_sem, recv_sem):
+    """Head-scatter a2a: slice j of the local (n·rows, cols) slab goes
+    to peer j's slot ``me`` — the dense equal-split transport under the
+    Ulysses seq→heads re-shard (the XLA body's lax.all_to_all)."""
+    from triton_distributed_tpu.utils.testing import chaos_delay
+
+    me = lang.my_pe(axis)
+    m = x_ref.shape[0] // n
+
+    out_ref[pl.ds(me * m, m)] = x_ref[pl.ds(me * m, m)]
+    lang.barrier_all(axis, mesh_axes)
+
+    handles = []
+    for i in range(n - 1):
+        pi = jax.lax.rem(me + 1 + i, n)
+        peer = lang.pe_flat(axis, pi, mesh_axes)
+        chaos_delay(site="cp_ring", step=i, me=me, n=n)
+        handles.append(
+            lang.putmem_signal_nbi_block(
+                out_ref.at[pl.ds(me * m, m)],
+                x_ref.at[pl.ds(pi * m, m)],
+                send_sem.at[i],
+                recv_sem.at[i],
+                peer,
+            )
+        )
+    lang.quiet(*handles)
+    for h in handles:
+        h.wait_recv()
+
+
+@functools.lru_cache(maxsize=64)
+def _build_ulysses(mesh, axis, rows, cols, collective_id, token=()):
+    del token
+    n = mesh.shape[axis]
+    return lang.shmem_call(
+        functools.partial(_ulysses_a2a_kernel, n, axis, mesh.axis_names),
+        out_shape=jax.ShapeDtypeStruct((n * rows, cols), jnp.float32),
+        in_specs=lang.vmem_specs(1),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+        ],
+        collective_id=collective_id,
+        name="cp_ulysses_a2a",
+    )
+
+
+def build_ulysses_lint(mesh, n, token=()):
+    """Registry/pre-flight entry for ``cp.ulysses``."""
+    del n
+    g = CP_RING_GEOM
+    return _build_ulysses(
+        mesh, "x", g["rows"], g["cols"], CP_ULYSSES_COLLECTIVE_ID, token,
+    )
+
+
+# --------------------------------------------- grad_ring.stream_int8w (17)
+
+def _grad_ring_kernel_w(
+    n, axis, mesh_axes, fmt, schedule,
+    x_hbm, out_hbm, w0, w1,
+    wq0, wq1, ws0, ws1, rq0, rq1, rs0, rs1,
+    copy_sem, send_sem, recv_sem, ack_sem, s_send_sem, s_recv_sem,
+):
+    """The gradient ring's Pallas shape: HBM-streaming reduce ring on
+    the quantized wire (per-hop quant into the wq/ws rails, f32
+    dequant-accumulate on receive) — protocol kernels/ring.py, wire
+    layout lang.wire. The EF residual/stochastic-rounding numerics live
+    in the XLA body (``train.grad_wire``); the PROTOCOL (slot indexing,
+    ack credits, paired scale rail) is what this twin puts under lint."""
+    from triton_distributed_tpu.kernels.ring import RSWireRefs, reduce_ring
+
+    m = out_hbm.shape[0]
+    cols = out_hbm.shape[1]
+
+    def partial_into(dst, dst_ref):
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(dst * m, m)], dst_ref, copy_sem
+        )
+        cp.start()
+        cp.wait()
+
+    wire = RSWireRefs(
+        fmt=fmt, wq=(wq0, wq1), ws=(ws0, ws1), rq=(rq0, rq1),
+        rs=(rs0, rs1),
+        s_send_sem=s_send_sem, s_recv_sem=s_recv_sem,
+        quantize=wirelib.quant_pipeline(m, cols, fmt),
+        dequant_add=wirelib.dequant_add_pipeline(m, cols, fmt),
+    )
+    reduce_ring(
+        n, axis, mesh_axes, out_hbm, (w0, w1), (None, None),
+        send_sem, recv_sem, ack_sem, partial_into, None,
+        site="grad_ring", wire=wire, schedule=schedule,
+    )
+
+
+def _grad_ring_kernel_w3(
+    n, axis, mesh_axes, fmt, schedule,
+    x_hbm, out_hbm, w0, w1, w2,
+    wq0, wq1, wq2, ws0, ws1, ws2, rq0, rq1, rq2, rs0, rs1, rs2,
+    copy_sem, send_sem, recv_sem, ack_sem, s_send_sem, s_recv_sem,
+):
+    """Depth-3 twin of :func:`_grad_ring_kernel_w` (schedule depth 3)."""
+    from triton_distributed_tpu.kernels.ring import RSWireRefs, reduce_ring
+
+    m = out_hbm.shape[0]
+    cols = out_hbm.shape[1]
+
+    def partial_into(dst, dst_ref):
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(dst * m, m)], dst_ref, copy_sem
+        )
+        cp.start()
+        cp.wait()
+
+    wire = RSWireRefs(
+        fmt=fmt, wq=(wq0, wq1, wq2), ws=(ws0, ws1, ws2),
+        rq=(rq0, rq1, rq2), rs=(rs0, rs1, rs2),
+        s_send_sem=s_send_sem, s_recv_sem=s_recv_sem,
+        quantize=wirelib.quant_pipeline(m, cols, fmt),
+        dequant_add=wirelib.dequant_add_pipeline(m, cols, fmt),
+    )
+    reduce_ring(
+        n, axis, mesh_axes, out_hbm, (w0, w1, w2), (None, None, None),
+        send_sem, recv_sem, ack_sem, partial_into, None,
+        site="grad_ring", wire=wire, schedule=schedule,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _build_grad_ring_w(mesh, axis, rows, cols, collective_id, wire,
+                       token=(), schedule=None):
+    del token
+    n = mesh.shape[axis]
+    m_local = rows // n
+    d = 2 if schedule is None else int(schedule.depth)
+    fmt = wirelib.make_wire_format(wire, m_local)
+    assert fmt is not None, (wire, m_local)
+    slab = jax.ShapeDtypeStruct((m_local, cols), jnp.float32)
+    qslab = jax.ShapeDtypeStruct((m_local, cols), fmt.wire_dtype)
+    sslab = jax.ShapeDtypeStruct(
+        (fmt.chunks(m_local), wirelib.SCALE_LANES), jnp.float32
+    )
+    kernel = _grad_ring_kernel_w if d == 2 else _grad_ring_kernel_w3
+    return lang.shmem_call(
+        functools.partial(kernel, n, axis, mesh.axis_names, fmt, schedule),
+        # out + bf16 work slots + quantized work/scale + recv/scale slots
+        # (HBM workspaces ride as ANY outputs — Mosaic has no HBM scratch)
+        out_shape=[slab] + [slab] * d
+                  + [qslab] * d + [sslab] * d
+                  + [qslab] * d + [sslab] * d,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (1 + 5 * d),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((d,)),
+            pltpu.SemaphoreType.DMA((d,)),
+            pltpu.SemaphoreType.REGULAR,
+            pltpu.SemaphoreType.DMA((d,)),   # scale rail
+            pltpu.SemaphoreType.DMA((d,)),
+        ],
+        collective_id=collective_id,
+        name=f"grad_ring_stream_{wire}w",
+    )
+
+
+def build_grad_ring_lint(mesh, n, token=(), schedule=None):
+    """Registry/pre-flight entry for ``grad_ring.stream_int8w``."""
+    g = CP_RING_GEOM
+    return _build_grad_ring_w(
+        mesh, "x", g["rows"] * n, g["grad_cols"], GRAD_RING_COLLECTIVE_ID,
+        "int8", token, schedule,
+    )

@@ -167,6 +167,17 @@ DEGRADATION_TARGETS = {
     "kv_ship.pages": "triton_distributed_tpu.tools.native.xla_kv_ship",
     "moe_dispatch.a2a": "jax.lax.all_to_all",
     "moe_combine.a2a": "jax.lax.all_to_all",
+    # training: both CP schemes degrade onto dense attention (gather KV,
+    # attend locally — exact, no ring to deadlock); the grad ring onto
+    # the plain-psum all-reduce (exact bf16 wire, no quantization)
+    "cp.ring_attention":
+        "triton_distributed_tpu.kernels.ring_attention."
+        "dense_attention_reference",
+    "cp.ulysses":
+        "triton_distributed_tpu.kernels.ring_attention."
+        "dense_attention_reference",
+    "grad_ring.stream_int8w":
+        "triton_distributed_tpu.train.grad_wire.grad_allreduce_xla",
 }
 
 
@@ -471,6 +482,31 @@ def _kv_ship_elems() -> int:
     from triton_distributed_tpu.kernels.kv_ship import KV_SHIP_GEOM as g
 
     return g["pages"] * g["rows"] * g["cols"]
+
+
+def _cp_kv_rotate(mesh, n, token):
+    """The ring-attention KV-rotation ring (kernels/cp_ring.py): the
+    training CP transport's Pallas twin on the shared AG forward-ring
+    harness, schedule-threaded so PR 9's search applies."""
+    from triton_distributed_tpu.kernels.cp_ring import build_kv_rotate_lint
+
+    build_kv_rotate_lint(mesh, n, token=(token, n))
+
+
+def _cp_ulysses(mesh, n, token):
+    """The Ulysses head-scatter a2a (kernels/cp_ring.py)."""
+    from triton_distributed_tpu.kernels.cp_ring import build_ulysses_lint
+
+    build_ulysses_lint(mesh, n, token=(token, n))
+
+
+def _grad_ring(mesh, n, token):
+    """The wire-quantized gradient ring (kernels/cp_ring.py): streaming
+    reduce ring on the int8 wire — the Pallas protocol twin of
+    ``train.grad_wire``'s EF reduce-scatter."""
+    from triton_distributed_tpu.kernels.cp_ring import build_grad_ring_lint
+
+    build_grad_ring_lint(mesh, n, token=(token, n))
 
 
 def _ragged_paged(mesh, n, token):
@@ -793,6 +829,36 @@ def families() -> dict:
                 ),
                 src_only=lambda rank, n: {(rank - n // 2) % n},
             ),
+        ),
+        KernelFamily(
+            # training CP: the KV-rotation ring under ring attention.
+            # The local KV block is consumed at step 0 straight from
+            # the input (the XLA body's peeled step 0) and never enters
+            # the workspace — own_absent_ok, like the int8-MXU gathers.
+            # A skip_last schedule mutation drops one block entirely;
+            # only this gather contract (SL008) can see the hole.
+            "cp.ring_attention", "cp_ring", "cp_ring_kv_rotate",
+            _cp_kv_rotate,
+            lambda n: [((8, 128), _F32)],
+            contract=gather("ag_ref", own_absent_ok=True),
+        ),
+        KernelFamily(
+            # training CP: the Ulysses seq→heads re-shard's dense a2a
+            "cp.ulysses", "cp_ring", "cp_ulysses_a2a",
+            _cp_ulysses,
+            lambda n: [((8 * n, 128), _F32)],
+            contract=DeliveryContract(kind="permute", dst="out_ref"),
+        ),
+        KernelFamily(
+            # the gradient ring: streaming reduce on the int8 wire (wide
+            # lint columns — scale planes only compress when the stripe
+            # payload dwarfs them). The EF/stochastic-rounding numerics
+            # live in train.grad_wire; this twin pins the PROTOCOL
+            # (slot/ack discipline, paired scale rail → SL009).
+            "grad_ring.stream_int8w", "grad_ring", "grad_ring_stream_int8w",
+            _grad_ring,
+            lambda n: [((8 * n, 2048), _F32)],
+            contract=reduce("out_hbm"),
         ),
         KernelFamily(
             "moe_dispatch.a2a", "moe_dispatch", "moe_chunked_a2a",

@@ -20,6 +20,15 @@ the same mesh axes the rest of the framework uses:
 
 Both consume (B, S, H, D) with S sharded over ``axis`` and are
 numerically the same computation as dense causal attention.
+
+Robustness: the host entries wrap the per-device bodies in
+``lang.maybe_instrument`` heartbeats (site ``"cp_ring"``) — CP rings
+were the last collectives that could wedge silently. A chaos
+``Stall(site="cp_ring")`` under an armed watchdog trips with the ring's
+collective id in the report, and the lint-family twins in
+``kernels.cp_ring`` carry the same ids so evidence lines up. The
+degradation target is :func:`dense_attention_reference` (gather KV,
+attend densely — exact, no ring to deadlock).
 """
 
 from __future__ import annotations
@@ -32,6 +41,12 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1.0e30
+
+#: collective ids of the CP rings (shared with the ``kernels.cp_ring``
+#: lint families so watchdog reports and lint evidence name the same
+#: launch): ring KV-rotation = 15, Ulysses head-scatter a2a = 16.
+RING_ATTENTION_COLLECTIVE_ID = 15
+ULYSSES_COLLECTIVE_ID = 16
 
 
 def _block_attn(q, k, v, scale, mask):
@@ -149,14 +164,27 @@ def ulysses_attention_device(q, k, v, axis, *, causal: bool = True, scale=None):
 
 
 @functools.lru_cache(maxsize=64)
-def _build(mesh, axis, kind, causal, batch_axes):
+def _build(mesh, axis, kind, causal, batch_axes, ikey=None):
+    # ikey: config.interp_key() — folds faults.trace_key, so arming the
+    # watchdog / activating a fault plan rebuilds with heartbeats on
+    from triton_distributed_tpu import lang
+
     body = {
         "ring": ring_attention_device,
         "ulysses": ulysses_attention_device,
     }[kind]
+    cid = {
+        "ring": RING_ATTENTION_COLLECTIVE_ID,
+        "ulysses": ULYSSES_COLLECTIVE_ID,
+    }[kind]
+    mapped = lang.maybe_instrument(
+        functools.partial(body, axis=axis, causal=causal),
+        axis=axis, site="cp_ring", collective_id=cid,
+        n=mesh.shape[axis],
+    )
     spec = P(tuple(batch_axes) if batch_axes else None, axis)
     fn = jax.shard_map(
-        functools.partial(body, axis=axis, causal=causal),
+        mapped,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -165,18 +193,28 @@ def _build(mesh, axis, kind, causal, batch_axes):
     return jax.jit(fn)
 
 
+def _ikey():
+    from triton_distributed_tpu.config import interp_key
+
+    return interp_key()
+
+
 def ring_attention(q, k, v, mesh, axis="x", *, causal: bool = True,
                    batch_axes: tuple = ()):
     """Host entry: (B, S, H, D) with S sharded over ``axis`` (and B over
     ``batch_axes``, if given)."""
-    return _build(mesh, axis, "ring", causal, tuple(batch_axes))(q, k, v)
+    return _build(
+        mesh, axis, "ring", causal, tuple(batch_axes), _ikey()
+    )(q, k, v)
 
 
 def ulysses_attention(q, k, v, mesh, axis="x", *, causal: bool = True,
                       batch_axes: tuple = ()):
     """Host entry: (B, S, H, D) with S sharded over ``axis`` (and B over
     ``batch_axes``, if given)."""
-    return _build(mesh, axis, "ulysses", causal, tuple(batch_axes))(q, k, v)
+    return _build(
+        mesh, axis, "ulysses", causal, tuple(batch_axes), _ikey()
+    )(q, k, v)
 
 
 def dense_attention_reference(q, k, v, *, causal: bool = True, scale=None):

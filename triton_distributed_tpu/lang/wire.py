@@ -34,8 +34,12 @@ Semantics:
   (n-1) independent per-hop roundings rather than compounding through
   the accumulator.
 
-Gradient-opaque (quantize rounds); the wire knob is an inference /
-forward-path transport option, mirroring the MoE transport.
+The value-level transforms are gradient-opaque (quantize rounds), so
+the forward ops treat the wire knob as a transport option, mirroring
+the MoE transport. Gradient RINGS ride the wire too — via the seeded
+stochastic-rounding twin :func:`quantize_slab_sr` plus the per-hop
+error feedback in ``train.grad_wire``, which together keep the
+accumulated backward error bounded instead of compounding.
 """
 
 from __future__ import annotations
@@ -190,6 +194,39 @@ def dequantize_slab(q, scales, fmt: WireFormat, out_dtype):
     y = q.astype(jnp.float32).reshape(ch, fmt.chunk_rows * cols)
     y = y * scales[:, :1]
     return y.reshape(rows, cols).astype(out_dtype)
+
+
+def quantize_slab_sr(x, fmt: WireFormat, key):
+    """:func:`quantize_slab` with SEEDED STOCHASTIC ROUNDING — the
+    gradient-ring quantizer (``train.grad_wire`` and the quantized
+    backward duals of ``ops.overlap``).
+
+    Same scale convention as the deterministic twin (symmetric
+    per-chunk, scale = amax / QMAX clamped at 1e-12), but the int8 grid
+    rounds ``floor(y + u)`` with ``u ~ U[0, 1)`` drawn from ``key`` —
+    unbiased per element (``E[q·s] = x``), so the ring's reduction
+    error averages out instead of accumulating a systematic
+    round-to-nearest bias across hops. The fp8 grid is non-uniform (no
+    uniform-offset SR exists for it), so fp8 keeps round-to-nearest and
+    the grad ring's error feedback carries the bias instead.
+
+    Deterministic under a fixed ``key``: same seed, same bits — the
+    trainer derives keys from ``config.interp_key()``-stable seeds so a
+    replayed step requantizes identically."""
+    import jax
+
+    rows, cols = x.shape
+    ch = fmt.chunks(rows)
+    xf = x.astype(jnp.float32).reshape(ch, fmt.chunk_rows * cols)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-12) / fmt.qmax
+    q = xf / scale[:, None]
+    if fmt.quant == "int8":
+        u = jax.random.uniform(key, q.shape, dtype=jnp.float32)
+        q = jnp.clip(jnp.floor(q + u), -127, 127)
+    q = q.reshape(rows, cols).astype(fmt.wire_dtype)
+    scales = jnp.broadcast_to(scale[:, None], (ch, SCALE_LANES))
+    return q, scales.astype(jnp.float32)
 
 
 # -------------------------------------------------- in-kernel pipelines

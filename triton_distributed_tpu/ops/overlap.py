@@ -26,6 +26,13 @@ The weight gradients overlap too:
   hides it under the GEMM. Costs tp× more residual memory for that
   tensor; set ``save_gathered=False`` to re-gather in backward instead
   (plain all_gather + matmul).
+
+Backward wire: ``ctx.bwd_wire_dtype`` puts the DUAL rings on the
+compressed wire too — dA's GEMM-RS / AG-GEMM ride
+``train.grad_wire``'s error-feedback + stochastic-rounding rings
+(1-byte payload + scale column) instead of the exact bf16 duals. Same
+resolve vocabulary and refusal contract as the forward ``wire_dtype``;
+``None`` (default) keeps the duals byte-identical to before.
 """
 
 from __future__ import annotations
@@ -65,16 +72,30 @@ class OverlapContext:
     collective_id: int = 8
     # Quantized ring wire for the FORWARD op (lang.wire): None/'bf16',
     # 'fp8', 'int8', or 'auto' (perf-model/tuner comm-bound selection).
-    # The backward duals always ship the bf16 wire — gradient rings stay
-    # exact; the compressed wire is a forward/inference transport, like
-    # the MoE A2A's quant= knob.
     wire_dtype: object = None
+    # Quantized ring wire for the BACKWARD duals (train.grad_wire) —
+    # same vocabulary, same resolve contract as the forward knob:
+    # 'auto' demotes SILENTLY when the cotangent slab admits no ring
+    # chunking; a pinned 'fp8'/'int8' that cannot be carried RAISES at
+    # backward trace time (a pinned wire format is a contract, not a
+    # hint). The dual rings ship seeded stochastic rounding + per-hop
+    # error feedback so accumulated gradient error stays bounded across
+    # the n-1 hops (docs/TRAINING.md). None (default) keeps the exact
+    # bf16 duals.
+    bwd_wire_dtype: object = None
     # ag_gemm training: keep the forward engine's gathered-A output as
     # the VJP residual so the weight gradient is gather-free (see module
     # docstring). tp× residual memory for A; disable to re-gather in bwd.
     # Only engages when the FUSED engine resolves (an XLA engine would
     # pay a second standalone all_gather just to produce the residual).
     save_gathered: bool = True
+
+    def __post_init__(self):
+        # fail fast (at context build, not deep in a backward trace) on
+        # a bwd wire string outside the lang.wire vocabulary
+        from triton_distributed_tpu.lang import wire as wirelib
+
+        wirelib.normalize_wire(self.bwd_wire_dtype)
 
     @property
     def tp(self) -> int:
@@ -94,6 +115,24 @@ def create_gemm_rs_context(mesh, axis="x", **kw) -> OverlapContext:
 
 def _psum_if(x, axes):
     return jax.lax.psum(x, axes) if axes else x
+
+
+def _resolve_bwd(ctx: OverlapContext, g, cols: int):
+    """The wire the backward dual ring will ACTUALLY ship for cotangent
+    ``g`` — None (exact bf16 duals, the historical path) or a concrete
+    'fp8'/'int8'. ``cols`` is the dual ring slab's column count (K for
+    ag_gemm's dA reduce-scatter, N for gemm_rs's dA all-gather). A
+    pinned-but-uncarryable bwd wire raises here, at backward trace
+    time — loud, per the resolve_*_wire contract."""
+    if ctx.bwd_wire_dtype is None:
+        return None
+    from triton_distributed_tpu.runtime import mesh_axes_size
+    from triton_distributed_tpu.train import grad_wire
+
+    dp = mesh_axes_size(ctx.mesh, tuple(ctx.batch_axes))
+    return grad_wire.resolve_grad_wire(
+        ctx.bwd_wire_dtype, g.shape[0] // dp, cols, ctx.tp
+    )
 
 
 @functools.lru_cache(maxsize=256)
@@ -221,11 +260,25 @@ def _ag_gemm_fwd(a, b, ctx):
 def _ag_gemm_bwd(ctx, res, g):
     a_res, b = res
     # dA: the dual overlap op — GEMM(dC, Bᵀ) fused with ReduceScatter.
-    da = _gemm_rs_raw(
-        g, b.T, ctx.mesh, ctx.axis,
-        batch_axes=ctx.batch_axes, method=_dual_method(ctx.method, GemmRSMethod),
-        out_dtype=a_res.dtype, collective_id=ctx.collective_id + 1,
-    )
+    # With a resolved bwd wire the reduce-scatter runs on the EF +
+    # stochastic-rounding quantized ring instead of the exact dual.
+    wire = _resolve_bwd(ctx, g, b.shape[0])
+    if wire is not None:
+        from triton_distributed_tpu.train import grad_wire
+
+        da = grad_wire.ef_gemm_rs(
+            g, b.T, ctx.mesh, ctx.axis,
+            batch_axes=ctx.batch_axes, out_dtype=a_res.dtype,
+            wire=wire,
+            seed=grad_wire.derive_seed(ctx.collective_id, "ag_gemm.bwd"),
+        )
+    else:
+        da = _gemm_rs_raw(
+            g, b.T, ctx.mesh, ctx.axis,
+            batch_axes=ctx.batch_axes,
+            method=_dual_method(ctx.method, GemmRSMethod),
+            out_dtype=a_res.dtype, collective_id=ctx.collective_id + 1,
+        )
     ba = tuple(ctx.batch_axes)
     if ctx.save_gathered and _fused_forward(ctx, a_res, b):
         # a_res is the forward-saved gathered A (same global shape as a)
@@ -262,13 +315,29 @@ def _gemm_rs_bwd(ctx, res, g):
     # dA: the dual overlap op — AllGather(dC) fused with GEMM(·, Bᵀ).
     # Its ring gathers dC as a free by-product (return_gathered), which
     # is exactly the AG(dC) the weight gradient needs: dB becomes a
-    # local matmul with no collective of its own.
-    da, g_full = _ag_gemm_raw(
-        g, b.T, ctx.mesh, ctx.axis,
-        batch_axes=ctx.batch_axes, method=_dual_method(ctx.method, AGGemmMethod),
-        out_dtype=a.dtype, collective_id=ctx.collective_id + 1,
-        return_gathered=True,
-    )
+    # local matmul with no collective of its own. With a resolved bwd
+    # wire the gather ships quantize-once stochastic-rounded bytes and
+    # g_full is their dequantization — dB sees the same wire error dA
+    # does, by construction.
+    wire = _resolve_bwd(ctx, g, g.shape[1])
+    if wire is not None:
+        from triton_distributed_tpu.train import grad_wire
+
+        da, g_full = grad_wire.ef_ag_gemm(
+            g, b.T, ctx.mesh, ctx.axis,
+            batch_axes=ctx.batch_axes, out_dtype=a.dtype,
+            wire=wire,
+            seed=grad_wire.derive_seed(ctx.collective_id, "gemm_rs.bwd"),
+            return_gathered=True,
+        )
+    else:
+        da, g_full = _ag_gemm_raw(
+            g, b.T, ctx.mesh, ctx.axis,
+            batch_axes=ctx.batch_axes,
+            method=_dual_method(ctx.method, AGGemmMethod),
+            out_dtype=a.dtype, collective_id=ctx.collective_id + 1,
+            return_gathered=True,
+        )
     db = _build_gathered_wgrad(
         ctx.mesh, ctx.axis, tuple(ctx.batch_axes), True
     )(a, g_full)

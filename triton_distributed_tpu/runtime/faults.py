@@ -68,11 +68,15 @@ logger = logging.getLogger(__name__)
 #: a different outage than a stalled engine — every replica starves at
 #: once), and the fleet's replica→replica KV-page migration wire (a
 #: stalled migration must degrade to re-prefill, never wedge a drain).
+#: Training adds the context-parallel attention rings (``cp_ring``:
+#: ring KV-rotation + Ulysses a2a) and the wire-quantized gradient
+#: rings (``grad_ring``: the EF reduce/gather duals and the trainer's
+#: dp all-reduce) — the last collectives that could wedge silently.
 SITES = (
     "allgather", "reduce_scatter", "all_to_all", "ag_gemm", "gemm_rs",
     "moe_dispatch", "flash_decode",
     "ragged_paged", "serving_step", "kv_ship", "router_dispatch",
-    "kv_migrate",
+    "kv_migrate", "cp_ring", "grad_ring",
 )
 
 

@@ -1,0 +1,54 @@
+"""Training subsystem: wire-quantized gradient rings + dp×tp×cp step.
+
+The reference leaves training parallelism to torch.distributed; here
+the wire/lint/schedule/health stack extends to the backward pass:
+
+* :mod:`~triton_distributed_tpu.train.grad_wire` — error-feedback +
+  seeded stochastic-rounding quantized gradient rings (the backward
+  duals' wire, ``OverlapContext(bwd_wire_dtype=...)``) and the dp
+  gradient all-reduce.
+* :mod:`~triton_distributed_tpu.train.step` — the dp×tp×cp train step
+  (ring-attention CP, Megatron TP, quantized dp grad ring, Adam,
+  gradient accumulation) with HealthLedger degradation/probation on
+  the grad ring.
+"""
+
+from triton_distributed_tpu.train.grad_wire import (
+    GRAD_RING_COLLECTIVE_ID,
+    derive_seed,
+    ef_ag_gemm,
+    ef_gemm_rs,
+    ef_ring_reduce_scatter,
+    grad_allreduce_device,
+    grad_allreduce_xla,
+    grad_tree_allreduce,
+    quantized_allgather,
+    resolve_grad_wire,
+    ring_wire_bytes,
+    tree_slab,
+)
+from triton_distributed_tpu.train.step import (
+    TRAIN_ENGINE_FAMILIES,
+    TrainConfig,
+    Trainer,
+    train_step_reference,
+)
+
+__all__ = [
+    "GRAD_RING_COLLECTIVE_ID",
+    "TRAIN_ENGINE_FAMILIES",
+    "TrainConfig",
+    "Trainer",
+    "derive_seed",
+    "ef_ag_gemm",
+    "ef_gemm_rs",
+    "ef_ring_reduce_scatter",
+    "grad_allreduce_device",
+    "grad_allreduce_xla",
+    "grad_tree_allreduce",
+    "quantized_allgather",
+    "resolve_grad_wire",
+    "ring_wire_bytes",
+    "train_step_reference",
+    "tree_slab",
+]

@@ -1,0 +1,389 @@
+"""Wire-quantized gradient rings with per-hop error feedback.
+
+The forward/serving path compresses its rings through ``lang.wire``
+(fp8/int8 payload + scale rails); the backward duals used to be pinned
+bf16 ("gradient rings stay exact", PR 3). This module is the training
+half of the wire story: XLA ``ppermute`` rings that ship 1-byte
+gradient payloads with two numerics guards the forward wire never
+needed —
+
+* **Seeded stochastic rounding** (``lang.wire.quantize_slab_sr``): the
+  int8 grid rounds ``floor(y + u)``, ``u ~ U[0,1)`` from a key derived
+  deterministically from ``(seed, config.interp_key(), rank, hop)`` —
+  unbiased per element, bit-identical under the same seed, so a
+  replayed step requantizes exactly.
+* **Per-hop error feedback**: the reduce ring quantizes a NEW partial
+  sum every hop, so plain rounding injects up to n-1 independent
+  errors per link. Here each rank carries the f32 residual
+  ``outgoing - dequant(quant(outgoing))`` and folds it into the NEXT
+  hop's outgoing slab before quantizing — so the total a rank ships
+  down its link TELESCOPES: ``sum_t dequant(q_t) = sum_t outgoing_t -
+  resid_last``, exact up to ONE final residual instead of n-1
+  accumulated roundings. What this bounds is the aggregate
+  (stripe-summed) gradient error — the gradient mass a link delivers —
+  which stays O(1) in hop count where the no-EF control grows with
+  n-1. The PER-ELEMENT error is dominated by the unbiased SR noise
+  either way (EF cannot beat independent-noise variance inside a
+  single reduction — its job is killing the accumulated drift). The
+  property tests in tests/test_train.py pin exactly this split:
+  aggregate error strictly below the no-EF control and sublinear in
+  hops; per-element error bounded vs the bf16 reference.
+
+The ring layout mirrors :func:`~triton_distributed_tpu.kernels.
+reduce_scatter.reduce_scatter_xla`'s wire branch (per-hop quantize →
+``ppermute`` payload+scales → f32 dequant-accumulate), with COMPACT
+(ch, 1) scale columns on the wire — both ends are ours, so the lane-
+replicated (ch, 128) plane the Pallas rails need would be 128× wasted
+ppermute bytes here. The all-gather half quantizes ONCE at the source
+and forwards verbatim (one rounding total, no feedback needed), and
+every rank — owners included — consumes the DEQUANTIZED bytes, so the
+replicated optimizer states stay bit-identical across data-parallel
+ranks after the sync.
+
+The Pallas twin of this ring (lint/preflight evidence, RingSchedule
+threading, SL008/SL009 coverage) is ``kernels.cp_ring``'s
+``grad_ring.stream_int8w`` family; production training steps off-TPU
+run these XLA rings, degrading to :func:`grad_allreduce_xla` (plain
+``psum``) when the grad-ring site is condemned by the health ledger.
+"""
+
+from __future__ import annotations
+
+import functools
+import zlib
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.lang import wire as wirelib
+
+_SITE = "grad_ring"
+
+#: collective id of the dp gradient ring (the cp_ring lint family's id).
+GRAD_RING_COLLECTIVE_ID = 17
+
+
+# ------------------------------------------------------------- resolve
+
+def resolve_grad_wire(wire_dtype, rows: int, cols: int,
+                      n: int) -> str | None:
+    """The wire format a gradient ring will ACTUALLY ship for an
+    (rows, cols) per-rank f32 slab reduced over ``n`` ranks — the
+    ``resolve_*_wire`` contract of the forward ops applied to the
+    backward:
+
+    * ``None``/``'bf16'`` → None (raw wire, today's exact rings);
+    * ``'auto'`` → 'int8' when the slab admits the ring layout and the
+      compressed bytes actually win, else a SILENT demotion to None;
+    * a pinned ``'fp8'``/``'int8'`` that cannot be carried RAISES — a
+      pinned wire format is a contract, not a hint.
+
+    'int8-mxu' demotes to its 'int8' payload (these rings dequantize
+    before any MXU sees the bytes, like the DCN rail legs)."""
+    w = wirelib.normalize_wire(wire_dtype)
+    if w is None:
+        return None
+    if n <= 1:
+        return None if w == "auto" else wirelib.wire_payload(w)
+    eligible = (
+        rows % n == 0
+        and rows // n >= 1
+        # payload + compact scale column must beat the bf16 wire
+        and (rows // n) * cols * 1 + (rows // n) * 4
+        < (rows // n) * cols * 2
+    )
+    if w == "auto":
+        return "int8" if eligible else None
+    payload = wirelib.wire_payload(w)
+    if not eligible:
+        raise ValueError(
+            f"grad ring wire_dtype={w!r}: slab ({rows}, {cols}) over "
+            f"n={n} admits no legal wire chunking (a pinned wire format "
+            "is a contract); use wire_dtype='auto' or the bf16 wire"
+        )
+    return payload
+
+
+def _fmt(wire: str) -> wirelib.WireFormat:
+    # per-ROW scales (chunk_rows=1): the KV-pool / VMEM-ring granularity,
+    # robust for the arbitrary stripe heights a flattened grad slab has
+    return wirelib.WireFormat(quant=wirelib.wire_payload(wire),
+                              chunk_rows=1)
+
+
+def derive_seed(seed: int, *tags) -> int:
+    """A 31-bit seed folding the caller's ``seed``, the config/fault
+    trace identity (``config.interp_key()`` — re-arming the watchdog or
+    changing the fault plan re-derives, exactly like the kernel build
+    caches), and any extra ``tags``. Concrete host-side int, so it can
+    key the jitted-builder caches."""
+    from triton_distributed_tpu.config import interp_key
+
+    return zlib.crc32(repr((int(seed), interp_key(), tags)).encode()) \
+        & 0x7FFFFFFF
+
+
+# ----------------------------------------------------- device-level rings
+
+def _sr_quant_compact(x, fmt, key):
+    """quantize_slab_sr → (payload, COMPACT (ch, 1) f32 scale column) —
+    the XLA-ring wire (both ends ours; the 128-lane replication is a
+    Pallas blocking requirement, not a numerics one)."""
+    q, sc = wirelib.quantize_slab_sr(x, fmt, key)
+    return q, sc[:, :1]
+
+
+def _dequant_compact(q, sc1, fmt):
+    rows, cols = q.shape
+    ch = fmt.chunks(rows)
+    y = q.astype(jnp.float32).reshape(ch, fmt.chunk_rows * cols) * sc1
+    return y.reshape(rows, cols)
+
+
+def ef_ring_reduce_scatter(x, axis, *, n, wire, seed, ef=True):
+    """Quantized ring reduce-scatter with error feedback, callable
+    inside shard_map over ``axis``.
+
+    ``x``: (n·srows, cols) f32 — stripe ``i`` is this rank's partial
+    contribution to the stripe rank ``i`` will own. Returns the fully
+    reduced (srows, cols) f32 stripe owned by this rank. ``wire`` must
+    be a concrete 'fp8'/'int8' (resolve first); ``ef=False`` is the
+    no-feedback control the property tests compare against (see the
+    module docstring for what EF does and does not bound: the shipped
+    aggregate telescopes to one residual; per-element noise is the
+    unbiased SR floor either way)."""
+    me = jax.lax.axis_index(axis)
+    rows, cols = x.shape
+    srows = rows // n
+    fmt = _fmt(wire)
+    base = jax.random.fold_in(jax.random.PRNGKey(seed), me)
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    def stripe(i):
+        return jax.lax.dynamic_slice_in_dim(x, i * srows, srows).astype(
+            jnp.float32
+        )
+
+    def hop(h, carry):
+        acc, resid = carry
+        outgoing = acc + resid
+        q, sc1 = _sr_quant_compact(
+            outgoing, fmt, jax.random.fold_in(base, h)
+        )
+        sent = _dequant_compact(q, sc1, fmt)
+        resid_next = jnp.where(ef, outgoing - sent, 0.0)
+        q = jax.lax.ppermute(q, axis, perm=perm)
+        sc1 = jax.lax.ppermute(sc1, axis, perm=perm)
+        arrived = _dequant_compact(q, sc1, fmt)
+        nxt = jax.lax.rem(me + 2 + h, n)
+        return arrived + stripe(nxt), resid_next
+
+    acc0 = stripe(jax.lax.rem(me + 1, n))
+    acc, _ = jax.lax.fori_loop(
+        0, n - 1, hop, (acc0, jnp.zeros_like(acc0))
+    )
+    return acc
+
+
+def quantized_allgather(x, axis, *, n, wire, seed):
+    """Quantize-once ring all-gather, callable inside shard_map:
+    (srows, cols) f32 per-rank stripe → (n·srows, cols) f32 with every
+    stripe dequantized from the SAME shipped bytes on every rank —
+    owners included, so replicated consumers (optimizer state) stay
+    bit-identical across ranks. One rounding per element total; no
+    error feedback needed on the AG side."""
+    me = jax.lax.axis_index(axis)
+    fmt = _fmt(wire)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), me)
+    q, sc1 = _sr_quant_compact(x.astype(jnp.float32), fmt, key)
+    q_all = jax.lax.all_gather(q, axis, tiled=True)
+    s_all = jax.lax.all_gather(sc1, axis, tiled=True)
+    return _dequant_compact(q_all, s_all, fmt)
+
+
+def grad_allreduce_device(g, axis, *, n, wire, seed, ef=True):
+    """Quantized-ring gradient all-reduce (RS + AG halves), callable
+    inside shard_map: (rows, cols) f32 per-rank partials → the
+    (rows, cols) f32 sum, identical bits on every rank. ``wire=None``
+    falls back to the exact ``psum`` (the bf16/raw wire)."""
+    if wire is None or n <= 1:
+        return jax.lax.psum(g.astype(jnp.float32), axis)
+    red = ef_ring_reduce_scatter(
+        g, axis, n=n, wire=wire, seed=seed, ef=ef
+    )
+    return quantized_allgather(
+        red, axis, n=n, wire=wire, seed=seed + 1
+    )
+
+
+def tree_slab(grads, n, cols: int = 128):
+    """Flatten a gradient pytree into one ring-reducible (rows, cols)
+    f32 slab, rows padded to a multiple of ``n``. Returns
+    (slab, unflatten) — ``unflatten(slab)`` restores the pytree with
+    the original leaf shapes/dtypes."""
+    leaves, treedef = jax.tree.flatten(grads)
+    sizes = [int(l.size) for l in leaves]
+    flat = jnp.concatenate(
+        [l.reshape(-1).astype(jnp.float32) for l in leaves]
+    )
+    total = int(flat.size)
+    rows = -(-total // cols)               # ceil
+    rows += (-rows) % n
+    slab = jnp.pad(flat, (0, rows * cols - total)).reshape(rows, cols)
+
+    def unflatten(s):
+        out_flat = s.reshape(-1)[:total]
+        outs, off = [], 0
+        for leaf, size in zip(leaves, sizes):
+            outs.append(
+                out_flat[off:off + size].reshape(leaf.shape).astype(
+                    leaf.dtype
+                )
+            )
+            off += size
+        return jax.tree.unflatten(treedef, outs)
+
+    return slab, unflatten
+
+
+def grad_tree_allreduce(grads, axis, *, n, wire, seed, ef=True):
+    """Pytree all-reduce over the dp axis on the quantized gradient
+    ring (device-level): flatten → :func:`grad_allreduce_device` →
+    unflatten. The trainer's data-parallel gradient sync."""
+    slab, unflatten = tree_slab(grads, n)
+    return unflatten(
+        grad_allreduce_device(
+            slab, axis, n=n, wire=wire, seed=seed, ef=ef
+        )
+    )
+
+
+# -------------------------------------------------- host-level dual engines
+
+@functools.lru_cache(maxsize=128)
+def _ef_gemm_rs_fn(mesh, axis, batch_axes, out_dtype, wire, seed, ef,
+                   ikey=None):
+    from triton_distributed_tpu import lang
+
+    ba = tuple(batch_axes)
+    n = mesh.shape[axis]
+
+    def body(a_loc, b_loc):
+        part = jnp.dot(
+            a_loc, b_loc, preferred_element_type=jnp.float32
+        )
+        red = ef_ring_reduce_scatter(
+            part, axis, n=n, wire=wire, seed=seed, ef=ef
+        )
+        return red.astype(out_dtype)
+
+    body = lang.maybe_instrument(
+        body, axis=axis, site=_SITE,
+        collective_id=GRAD_RING_COLLECTIVE_ID, n=n,
+    )
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(ba if ba else None, axis), P(axis, None)),
+        out_specs=P(ba + (axis,) if ba else axis, None),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def ef_gemm_rs(a, b, mesh, axis, *, batch_axes=(), out_dtype=None,
+               wire, seed=0, ef=True):
+    """GEMM → error-feedback quantized reduce-scatter ring: the
+    backward dual engine of ``ag_gemm`` when ``bwd_wire_dtype``
+    resolves (dA = GEMM-RS(dC, Bᵀ) on the 1-byte wire). Layout contract
+    of ``kernels.gemm_rs``: ``a`` (M, K) rows batch-sharded / cols
+    ``axis``-sharded, ``b`` (K, N) rows ``axis``-sharded, out (M, N)
+    rows sharded (*batch_axes, axis). ``wire`` must already be resolved
+    ('fp8'/'int8')."""
+    out_dtype = jnp.dtype(out_dtype or a.dtype)
+    return _ef_gemm_rs_fn(
+        mesh, axis, tuple(batch_axes), out_dtype, str(wire), int(seed),
+        bool(ef), _ikey(),
+    )(a, b)
+
+
+@functools.lru_cache(maxsize=128)
+def _ef_ag_gemm_fn(mesh, axis, batch_axes, out_dtype, wire, seed,
+                   return_gathered, ikey=None):
+    from triton_distributed_tpu import lang
+
+    ba = tuple(batch_axes)
+    n = mesh.shape[axis]
+
+    def body(a_loc, b_loc):
+        a_full = quantized_allgather(
+            a_loc, axis, n=n, wire=wire, seed=seed
+        ).astype(a_loc.dtype)
+        out = jnp.dot(
+            a_full, b_loc, preferred_element_type=jnp.float32
+        ).astype(out_dtype)
+        if return_gathered:
+            return out, a_full
+        return out
+
+    body = lang.maybe_instrument(
+        body, axis=axis, site=_SITE,
+        collective_id=GRAD_RING_COLLECTIVE_ID + 1, n=n,
+    )
+    out_specs = (P(ba if ba else None, axis), P(ba if ba else None, None)) \
+        if return_gathered else P(ba if ba else None, axis)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(ba + (axis,) if ba else axis, None), P(None, axis)),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def ef_ag_gemm(a, b, mesh, axis, *, batch_axes=(), out_dtype=None,
+               wire, seed=0, return_gathered=False):
+    """Quantized-allgather → GEMM: the backward dual engine of
+    ``gemm_rs`` when ``bwd_wire_dtype`` resolves (dA = AG-GEMM(dC, Bᵀ)
+    with dC gathered on the 1-byte wire; ``return_gathered`` hands the
+    DEQUANTIZED gathered dC back for the weight gradient, exactly like
+    the fused engine's free by-product). Layout contract of
+    ``kernels.ag_gemm``."""
+    out_dtype = jnp.dtype(out_dtype or a.dtype)
+    return _ef_ag_gemm_fn(
+        mesh, axis, tuple(batch_axes), out_dtype, str(wire), int(seed),
+        bool(return_gathered), _ikey(),
+    )(a, b)
+
+
+def _ikey():
+    from triton_distributed_tpu.config import interp_key
+
+    return interp_key()
+
+
+# ------------------------------------------------------- twin + accounting
+
+def grad_allreduce_xla(g, mesh, axis: str = "x"):
+    """Plain ``psum`` all-reduce — the grad ring's degradation target
+    (exact, no wire, nothing to deadlock): what a training step runs
+    after the health ledger condemns ``site:grad_ring``, until
+    probation re-promotes the quantized ring."""
+    fn = jax.shard_map(
+        lambda x: jax.lax.psum(x, axis), mesh=mesh,
+        in_specs=P(), out_specs=P(), check_vma=False,
+    )
+    return jax.jit(fn)(g)
+
+
+def ring_wire_bytes(rows: int, cols: int, n: int,
+                    wire: str | None) -> int:
+    """Analytic wire bytes ONE rank ships for one (rows, cols) slab
+    all-reduce on the ring (RS n-1 hops + AG n-1 forwarded stripes):
+    the bench row's byte accounting. Compact (ch, 1) scale columns
+    (chunk_rows=1 → one f32 per row)."""
+    srows = max(rows // max(n, 1), 1)
+    hops = 2 * (n - 1)
+    if wire in (None, "bf16"):
+        return hops * srows * cols * 2
+    return hops * (srows * cols * 1 + srows * 4)

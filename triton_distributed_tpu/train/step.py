@@ -1,0 +1,527 @@
+"""The dp×tp×cp train step: CP ring attention, Megatron TP, and the
+wire-quantized dp gradient ring under one shard_map.
+
+The reference repo trains with torch.distributed and leaves the
+backward pass on raw NCCL; here the full serving-side stack — wire
+formats, watchdog instrumentation, HealthLedger degradation — extends
+to training:
+
+* **cp** shards the sequence; attention runs the
+  :func:`~triton_distributed_tpu.kernels.ring_attention.ring_attention_device`
+  body (or the Ulysses a2a body) over the ``"cp"`` axis inside the
+  step's own shard_map.
+* **tp** shards the MLP Megatron-style. The f-operator (identity
+  forward, psum-over-tp backward) is spelled explicitly with a
+  ``custom_vjp``: after backward, every tp rank holds the FULL input
+  cotangent, so replicated-parameter gradients come out tp-replicated
+  and the gradient sync never reduces over ``"tp"`` (doing so would
+  double-count the attention path — the classic mixed
+  replicated/sharded transpose trap).
+* **dp** syncs gradients on the quantized ring
+  (:func:`~triton_distributed_tpu.train.grad_wire.grad_tree_allreduce`):
+  flatten the grad tree to one slab, EF+SR int8/fp8 reduce-scatter,
+  quantize-once all-gather. ``wire=None`` is the exact ``psum`` twin —
+  the degradation target the HealthLedger demotes to.
+
+Gradient reductions are therefore: exact ``psum`` over ``"cp"``
+(distinct tokens per cp rank), the wire ring over ``"dp"``, nothing
+over ``"tp"``.
+
+Degradation follows the serving-engine idiom: the jitted step runs
+under a host-mode ``maybe_instrument`` heartbeat at site
+``"grad_ring"`` — an armed watchdog that trips on a wedged step
+broadcasts ``site:grad_ring`` FATAL into live ledgers, the next step
+demotes to the XLA psum twin, and the ledger's probation schedule
+re-promotes through clean probes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.kernels.ring_attention import (
+    dense_attention_reference,
+    ring_attention_device,
+    ulysses_attention_device,
+)
+from triton_distributed_tpu.train import grad_wire
+
+#: The registry families this subsystem owns — bench.py's ``--lint``
+#: gate requires each to be registered with a delivery contract, a
+#: degradation target, and zero lint findings (``train_gaps == 0``).
+TRAIN_ENGINE_FAMILIES = (
+    "cp.ring_attention",
+    "cp.ulysses",
+    "grad_ring.stream_int8w",
+)
+
+_SITE = "grad_ring"
+_PEER = "site:grad_ring"          # the ledger key a watchdog trip lands on
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Static train-step configuration (hashable: it keys the jit
+    cache). The defaults are the dryrun geometry — a tiny transformer
+    block on the dp2×tp2×cp2 virtual mesh."""
+
+    vocab: int = 64
+    d_model: int = 32
+    n_heads: int = 4
+    d_ff: int = 64
+    seq: int = 16
+    batch: int = 8
+    dp: int = 2
+    tp: int = 2
+    cp: int = 2
+    microbatches: int = 2
+    attn: str = "ring"            # "ring" | "ulysses"
+    #: the dp gradient ring's wire: None/'bf16' = exact psum,
+    #: 'fp8'/'int8' = pinned (raises if the slab admits no legal
+    #: chunking), 'auto' = demote silently to the exact wire
+    wire_dtype: object = "int8"
+    ef: bool = True               # error feedback on the ring
+    lr: float = 1e-2
+    beta1: float = 0.9
+    beta2: float = 0.999
+    adam_eps: float = 1e-8
+    seed: int = 0
+
+    def __post_init__(self):
+        from triton_distributed_tpu.lang import wire as wirelib
+
+        wirelib.normalize_wire(self.wire_dtype)   # loud on junk
+        if self.d_model % self.n_heads:
+            raise ValueError(f"d_model {self.d_model} % n_heads "
+                             f"{self.n_heads} != 0")
+        if self.seq % self.cp:
+            raise ValueError(f"seq {self.seq} % cp {self.cp} != 0")
+        if self.batch % self.dp:
+            raise ValueError(f"batch {self.batch} % dp {self.dp} != 0")
+        if (self.batch // self.dp) % self.microbatches:
+            raise ValueError(
+                f"per-dp batch {self.batch // self.dp} % microbatches "
+                f"{self.microbatches} != 0")
+        if self.d_ff % self.tp:
+            raise ValueError(f"d_ff {self.d_ff} % tp {self.tp} != 0")
+        if self.attn not in ("ring", "ulysses"):
+            raise ValueError(f"attn must be 'ring'|'ulysses', "
+                             f"got {self.attn!r}")
+        if self.attn == "ulysses" and self.n_heads % self.cp:
+            raise ValueError(f"ulysses needs n_heads {self.n_heads} % "
+                             f"cp {self.cp} == 0")
+
+
+def default_train_mesh(cfg: TrainConfig) -> Mesh:
+    """The (dp, tp, cp) mesh over the first dp·tp·cp local devices."""
+    need = cfg.dp * cfg.tp * cfg.cp
+    devs = jax.devices()
+    if len(devs) < need:
+        raise ValueError(
+            f"TrainConfig wants dp×tp×cp = {need} devices, "
+            f"have {len(devs)}")
+    return Mesh(np.asarray(devs[:need]).reshape(cfg.dp, cfg.tp, cfg.cp),
+                ("dp", "tp", "cp"))
+
+
+# ------------------------------------------------------------- model
+
+
+def init_params(cfg: TrainConfig) -> dict:
+    """The tiny one-block transformer's parameters, f32, unplaced.
+    ``w1``/``w2`` are the Megatron-sharded pair (cols/rows over tp);
+    everything else is replicated."""
+    ks = jax.random.split(jax.random.PRNGKey(cfg.seed), 8)
+    d, ff, v = cfg.d_model, cfg.d_ff, cfg.vocab
+
+    def init(k, shape, scale):
+        return jax.random.normal(k, shape, jnp.float32) * scale
+
+    return {
+        "embed": init(ks[0], (v, d), 1.0),
+        "wq": init(ks[1], (d, d), d ** -0.5),
+        "wk": init(ks[2], (d, d), d ** -0.5),
+        "wv": init(ks[3], (d, d), d ** -0.5),
+        "wo": init(ks[4], (d, d), d ** -0.5),
+        "w1": init(ks[5], (d, ff), d ** -0.5),
+        "w2": init(ks[6], (ff, d), ff ** -0.5),
+        "head": init(ks[7], (d, v), d ** -0.5),
+    }
+
+
+def init_opt_state(params: dict) -> dict:
+    """Adam state: step count + f32 first/second moments (same tree
+    structure and shardings as the parameters — donated every step)."""
+    return {
+        "t": jnp.zeros((), jnp.int32),
+        "m": jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32),
+                          params),
+        "v": jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32),
+                          params),
+    }
+
+
+def _param_specs(cfg: TrainConfig) -> dict:
+    return {
+        k: (P(None, "tp") if k == "w1"
+            else P("tp", None) if k == "w2" else P())
+        for k in ("embed", "wq", "wk", "wv", "wo", "w1", "w2", "head")
+    }
+
+
+def _megatron_f(axis: str):
+    """Megatron's f-operator: identity forward, psum-over-tp backward.
+    Placed on the MLP INPUT so the input cotangent — partial per tp
+    rank (each rank backprops only its own w1/w2 shard's path) — is
+    summed to the full dx before it reaches the replicated attention/
+    embedding parameters. Their grads then come out tp-REPLICATED, and
+    the gradient sync must not reduce over tp at all."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None),
+             lambda _, g: (jax.lax.psum(g, axis),))
+    return f
+
+
+def _token_xent_sum(logits, targets):
+    """Summed (not meaned) next-token cross-entropy in f32."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.sum(ll)
+
+
+def _forward_device(cfg: TrainConfig, params, tokens):
+    """Per-device forward (inside shard_map): tokens (b_loc, s_loc) →
+    logits (b_loc, s_loc, vocab). Attention over ``"cp"``, Megatron
+    MLP over ``"tp"``."""
+    x = params["embed"][tokens]                        # (b, s, d)
+    b, s, d = x.shape
+    h, dh = cfg.n_heads, d // cfg.n_heads
+
+    def heads(w):
+        return (x @ w).reshape(b, s, h, dh)
+
+    attn = (ring_attention_device if cfg.attn == "ring"
+            else ulysses_attention_device)
+    o = attn(heads(params["wq"]), heads(params["wk"]),
+             heads(params["wv"]), "cp", causal=True)
+    x = x + o.reshape(b, s, d) @ params["wo"]
+    xf = _megatron_f("tp")(x)
+    mlp = jax.lax.psum(
+        jax.nn.gelu(xf @ params["w1"]) @ params["w2"], "tp")
+    x = x + mlp
+    return x @ params["head"]
+
+
+def _adam(cfg: TrainConfig, params, grads, opt):
+    t = opt["t"] + 1
+    b1, b2 = cfg.beta1, cfg.beta2
+    m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g,
+                     opt["m"], grads)
+    v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g,
+                     opt["v"], grads)
+    tf = t.astype(jnp.float32)
+    c1 = 1.0 - b1 ** tf
+    c2 = 1.0 - b2 ** tf
+
+    def upd(p, m_, v_):
+        step = cfg.lr * (m_ / c1) / (jnp.sqrt(v_ / c2) + cfg.adam_eps)
+        return (p - step).astype(p.dtype)
+
+    return jax.tree.map(upd, params, m, v), {"t": t, "m": m, "v": v}
+
+
+def _device_step(cfg: TrainConfig, wire, base_seed,
+                 params, opt, tokens, targets):
+    """One per-device train step (the shard_map body): microbatched
+    loss+grad, cp psum, dp wire ring, Adam. Returns the global mean
+    loss replicated on every rank."""
+    n_total = cfg.batch * cfg.seq
+    mb = tokens.shape[0] // cfg.microbatches
+
+    def loss_fn(p, tok, tgt):
+        return _token_xent_sum(_forward_device(cfg, p, tok),
+                               tgt) / n_total
+
+    grads, loss_sum = None, jnp.float32(0)
+    for i in range(cfg.microbatches):
+        sl = slice(i * mb, (i + 1) * mb)
+        li, gi = jax.value_and_grad(loss_fn)(
+            params, tokens[sl], targets[sl])
+        loss_sum = loss_sum + li
+        grads = gi if grads is None else jax.tree.map(jnp.add, grads, gi)
+
+    # cp ranks hold distinct tokens: exact psum. tp needs NO reduction
+    # (the Megatron f-operator already made grads tp-replicated).
+    grads = jax.tree.map(lambda g: jax.lax.psum(g, "cp"), grads)
+    if cfg.dp > 1:
+        if wire is None:
+            grads = jax.tree.map(lambda g: jax.lax.psum(g, "dp"), grads)
+        else:
+            # SR seed varies per step (fold the Adam step count) so the
+            # rounding noise is independent across steps
+            grads = grad_wire.grad_tree_allreduce(
+                grads, "dp", n=cfg.dp, wire=wire,
+                seed=base_seed + opt["t"], ef=cfg.ef)
+    loss = jax.lax.psum(loss_sum, ("dp", "cp"))
+    params, opt = _adam(cfg, params, grads, opt)
+    return params, opt, loss
+
+
+@functools.lru_cache(maxsize=32)
+def _train_step_fn(cfg: TrainConfig, mesh: Mesh, wire, base_seed: int):
+    """The jitted distributed step, cached per (cfg, mesh, wire). The
+    ``wire=None`` entry is the XLA psum twin the ledger demotes to.
+    Params and optimizer state are donated."""
+    pspec = _param_specs(cfg)
+    ospec = {"t": P(), "m": pspec, "v": pspec}
+    data = P("dp", "cp")
+    body = functools.partial(_device_step, cfg, wire, base_seed)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, ospec, data, data),
+        out_specs=(pspec, ospec, P()),
+        check_vma=False,
+    )
+    return jax.jit(fn, donate_argnums=(0, 1))
+
+
+# ---------------------------------------------------------- reference
+
+
+@functools.lru_cache(maxsize=8)
+def _reference_fn(cfg: TrainConfig):
+    """Single-device dense twin of the distributed step: dense
+    attention over the full sequence, unsharded MLP, exact f32 grads,
+    same microbatch accumulation and Adam. The loss-delta pins in
+    tests/bench compare against this."""
+
+    def loss_fn(p, tok, tgt):
+        x = p["embed"][tok]
+        b, s, d = x.shape
+        h, dh = cfg.n_heads, d // cfg.n_heads
+
+        def heads(w):
+            return (x @ w).reshape(b, s, h, dh)
+
+        o = dense_attention_reference(
+            heads(p["wq"]), heads(p["wk"]), heads(p["wv"]),
+            causal=True)
+        x = x + o.reshape(b, s, d) @ p["wo"]
+        x = x + jax.nn.gelu(x @ p["w1"]) @ p["w2"]
+        return _token_xent_sum(x @ p["head"],
+                               tgt) / (cfg.batch * cfg.seq)
+
+    def body(params, opt, tokens, targets):
+        mb = tokens.shape[0] // cfg.microbatches
+        grads, loss_sum = None, jnp.float32(0)
+        for i in range(cfg.microbatches):
+            sl = slice(i * mb, (i + 1) * mb)
+            li, gi = jax.value_and_grad(loss_fn)(
+                params, tokens[sl], targets[sl])
+            loss_sum = loss_sum + li
+            grads = gi if grads is None \
+                else jax.tree.map(jnp.add, grads, gi)
+        params, opt = _adam(cfg, params, grads, opt)
+        return params, opt, loss_sum
+
+    return jax.jit(body)
+
+
+def train_step_reference(params, opt_state, tokens, targets,
+                         cfg: TrainConfig):
+    """One single-device reference step → (params, opt_state, loss)."""
+    return _reference_fn(cfg)(
+        params, opt_state,
+        jnp.asarray(tokens, jnp.int32), jnp.asarray(targets, jnp.int32))
+
+
+# ------------------------------------------------------------ trainer
+
+
+class Trainer:
+    """Stateful dp×tp×cp trainer with ledger-driven wire degradation.
+
+    Owns placed params + Adam state and a step counter. Every step runs
+    the jitted distributed step under a host-mode watchdog heartbeat at
+    site ``"grad_ring"``; a trip (or a recorded kernel error) demotes
+    the dp gradient sync from the quantized ring to the exact XLA psum
+    twin, and the HealthLedger's probation schedule re-promotes it
+    through clean probes — the serving engine's degradation contract,
+    applied to training.
+    """
+
+    def __init__(self, cfg: TrainConfig, mesh: Mesh | None = None,
+                 health=None):
+        from triton_distributed_tpu.runtime.health import HealthLedger
+
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else default_train_mesh(cfg)
+        for ax in ("dp", "tp", "cp"):
+            if self.mesh.shape.get(ax) != getattr(cfg, ax):
+                raise ValueError(
+                    f"mesh axis {ax!r} is {self.mesh.shape.get(ax)}, "
+                    f"TrainConfig wants {getattr(cfg, ax)}")
+        self.health = health if health is not None \
+            else HealthLedger(seed=cfg.seed)
+
+        pspec = _param_specs(cfg)
+        params = init_params(cfg)
+        self.params = {
+            k: jax.device_put(v, NamedSharding(self.mesh, pspec[k]))
+            for k, v in params.items()
+        }
+        opt = init_opt_state(self.params)
+        put = lambda tree: {
+            k: jax.device_put(v, NamedSharding(self.mesh, pspec[k]))
+            for k, v in tree.items()
+        }
+        self.opt_state = {
+            "t": jax.device_put(opt["t"],
+                                NamedSharding(self.mesh, P())),
+            "m": put(opt["m"]),
+            "v": put(opt["v"]),
+        }
+
+        # the grad slab's geometry decides wire eligibility up front —
+        # a pinned-but-illegal wire refuses HERE, not mid-training
+        total = sum(int(np.prod(v.shape)) for v in params.values())
+        rows = -(-total // 128)
+        rows += (-rows) % cfg.dp
+        self.slab_rows = rows
+        self.wire = grad_wire.resolve_grad_wire(
+            cfg.wire_dtype, rows, 128, cfg.dp)
+        self.base_seed = grad_wire.derive_seed(cfg.seed, "train.dp_ring")
+
+        self.use_wire = self.wire is not None
+        self.degraded = False
+        self.repromotions = 0
+        self.step_count = 0
+
+    # -- data ---------------------------------------------------------
+
+    def make_batch(self, step: int):
+        """Deterministic synthetic LM batch for step ``step``:
+        (tokens, targets) of shape (batch, seq) int32, targets the
+        next token (sequence rolled left)."""
+        rng = np.random.RandomState(
+            (self.cfg.seed * 100003 + step) % (2 ** 31 - 1))
+        tokens = rng.randint(
+            0, self.cfg.vocab,
+            size=(self.cfg.batch, self.cfg.seq)).astype(np.int32)
+        return tokens, np.roll(tokens, -1, axis=1).astype(np.int32)
+
+    # -- stepping -----------------------------------------------------
+
+    def _run(self, tokens, targets) -> np.ndarray:
+        from triton_distributed_tpu.lang.launch import maybe_instrument
+
+        wire = self.wire if self.use_wire else None
+        fn = _train_step_fn(self.cfg, self.mesh, wire, self.base_seed)
+        # host-mode heartbeat: an armed watchdog sees a wedged step, a
+        # fault-plan Stall(site="grad_ring") gates here
+        step_fn = maybe_instrument(
+            fn, axis=None, site=_SITE,
+            collective_id=(_SITE, _PEER), n=1, step=self.step_count)
+        sh = NamedSharding(self.mesh, P("dp", "cp"))
+        tok = jax.device_put(jnp.asarray(tokens, jnp.int32), sh)
+        tgt = jax.device_put(jnp.asarray(targets, jnp.int32), sh)
+        self.params, self.opt_state, loss = step_fn(
+            self.params, self.opt_state, tok, tgt)
+        return np.asarray(loss)          # host fetch = the fence
+
+    def step(self, tokens=None, targets=None) -> dict:
+        """One train step (synthesizing a batch when none is given).
+        Returns a small report: loss, the wire actually used, and the
+        degradation flags."""
+        from triton_distributed_tpu.runtime.health import PeerState
+
+        if tokens is None:
+            tokens, targets = self.make_batch(self.step_count)
+        wire_avail = self.wire is not None
+        if self.use_wire \
+                and self.health.state(_PEER) is PeerState.UNHEALTHY:
+            # the ledger condemned the ring out-of-band (a watchdog
+            # trip's broadcast): demote before launching
+            self.use_wire = False
+            self.degraded = True
+        probing = (wire_avail and not self.use_wire
+                   and self.health.probe_due(_PEER, self.step_count))
+        if probing:
+            self.use_wire = True
+        try:
+            loss = self._run(tokens, targets)
+        except Exception:
+            if not self.use_wire:
+                raise
+            # degradation: retry the SAME step on the exact psum twin.
+            # A probe failure drops straight back to UNHEALTHY; a first
+            # failure is fatal (kernel_error) so re-entry to the ring
+            # only ever happens through clean probes.
+            if probing:
+                self.health.probe_result(_PEER, False,
+                                         step=self.step_count)
+            else:
+                self.health.record("kernel_error", _PEER,
+                                   step=self.step_count)
+            self.use_wire = False
+            self.degraded = True
+            loss = self._run(tokens, targets)
+        else:
+            if probing:
+                st = self.health.probe_result(_PEER, True,
+                                              step=self.step_count)
+                if st is PeerState.HEALTHY:
+                    # enough clean probes: stay on the ring
+                    self.degraded = False
+                    self.repromotions += 1
+                else:
+                    self.use_wire = False   # keep earning probes
+            elif self.degraded and not self.use_wire:
+                # clean degraded steps earn PROBATION (and clear a
+                # non-fatal SUSPECT straight back to HEALTHY)
+                st = self.health.observe_clean(_PEER,
+                                               step=self.step_count)
+                if st is PeerState.HEALTHY:
+                    self.use_wire = wire_avail
+                    self.degraded = False
+                    self.repromotions += 1
+        report = {
+            "step": self.step_count,
+            "loss": float(loss),
+            "wire": self.wire if self.use_wire else None,
+            "degraded": self.degraded,
+            "probing": probing,
+        }
+        self.step_count += 1
+        return report
+
+    def run(self, steps: int) -> list:
+        """Run ``steps`` synthetic-batch steps, returning the reports."""
+        return [self.step() for _ in range(steps)]
+
+    # -- reporting ----------------------------------------------------
+
+    def wire_report(self) -> dict:
+        """Analytic per-step dp-ring wire bytes (one rank): the bf16
+        baseline vs the resolved wire, and their ratio."""
+        bf16 = grad_wire.ring_wire_bytes(
+            self.slab_rows, 128, self.cfg.dp, None)
+        wired = grad_wire.ring_wire_bytes(
+            self.slab_rows, 128, self.cfg.dp, self.wire)
+        return {
+            "slab_rows": self.slab_rows,
+            "bf16_bytes": bf16,
+            "wire_bytes": wired,
+            "ratio": (bf16 / wired) if wired else math.nan,
+        }

@@ -134,6 +134,15 @@ _FREEDOMS: dict = {
     "reduce_scatter.stream": dict(
         depth=(2, 3),
     ),
+    # training: the ring-attention KV rotation may traverse either way;
+    # the gradient ring's depth generalizes like the streaming RS it is
+    # built on (kernels/cp_ring.py)
+    "cp.ring_attention": dict(
+        direction=("fwd", "rev"),
+    ),
+    "grad_ring.stream_int8w": dict(
+        depth=(2, 3),
+    ),
 }
 
 #: one-field illegal mutations per family — the oracle's test diet
@@ -145,6 +154,11 @@ _MUTATIONS: dict = {
                           dict(scale_rail="payload")),
     "allgather.ring_bidir": (),
     "reduce_scatter.stream": (dict(scale_rail="payload"),),
+    # skip_last drops one KV block — one attention step never sees one
+    # sequence block; only the gather contract can tell (SL008)
+    "cp.ring_attention": (dict(chunk_order="skip_last"),),
+    # scales on the payload's semaphore — the torn-scale hazard (SL009)
+    "grad_ring.stream_int8w": (dict(scale_rail="payload"),),
 }
 
 
@@ -286,12 +300,39 @@ def _gate_rs_stream(schedule, n, mesh):
             DeliveryContract(kind="reduce", dst="out_hbm"), "reduce_scatter")
 
 
+def _gate_cp_ring(schedule, n, mesh):
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.cp_ring import build_kv_rotate_lint
+
+    build_kv_rotate_lint(
+        mesh, n, token=("schedule-gate", next(_TOKENS)), schedule=schedule,
+    )
+    shapes = [((8, 128), _F32)]
+    return ("cp_ring_kv_rotate", (lambda _n: shapes),
+            DeliveryContract(kind="gather", dst="ag_ref",
+                             own_absent_ok=True), "cp_ring")
+
+
+def _gate_grad_ring(schedule, n, mesh):
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.cp_ring import build_grad_ring_lint
+
+    build_grad_ring_lint(
+        mesh, n, token=("schedule-gate", next(_TOKENS)), schedule=schedule,
+    )
+    shapes = [((8 * n, 2048), _F32)]
+    return ("grad_ring_stream_int8w", (lambda _n: shapes),
+            DeliveryContract(kind="reduce", dst="out_hbm"), "grad_ring")
+
+
 _GATES: dict = {
     "ag_gemm.fused": _gate_ag_gemm,
     "gemm_rs.fused": _gate_gemm_rs,
     "allgather.ring_1d": _gate_ag_ring,
     "allgather.ring_bidir": _gate_ag_bidir,
     "reduce_scatter.stream": _gate_rs_stream,
+    "cp.ring_attention": _gate_cp_ring,
+    "grad_ring.stream_int8w": _gate_grad_ring,
 }
 
 
