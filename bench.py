@@ -327,7 +327,11 @@ def _run_lint() -> None:
     for key, entry in sched_lib.stored_entries().items():
         fam = entry.get("family")
         try:
-            sched = sched_lib.RingSchedule.from_dict(entry["schedule"])
+            # kind-aware rebuild: grid winners replay as GridSchedule
+            # through the same gate as ring winners
+            sched = sched_lib.schedule_from_entry(entry)
+            if sched is None:
+                raise ValueError(f"unparseable store entry {key!r}")
             extra = sched_lib.check_schedule(fam, sched, 8)
         except Exception as e:
             print(
@@ -1569,6 +1573,42 @@ def _bench_serving_continuous(mesh, n, on_tpu, spec, tiny=False,
     per_layer_pool_bytes = sum(
         int(x.nbytes) for x in jax.tree.leaves(eng.state.layers[0])
     )
+    # donation precondition at depth: every layer's pool leaves carry
+    # their own buffers (the step jit donates the whole ServingState —
+    # a buffer shared across layers would alias the in-place appends).
+    # Verified at ANY depth, but only depth > 1 exercises it.
+    _leaves = jax.tree.leaves(eng.state.layers)
+    try:
+        _ptrs = {
+            x.addressable_shards[0].data.unsafe_buffer_pointer()
+            for x in _leaves
+        }
+        donation_distinct = len(_ptrs) == len(_leaves)
+    except Exception:
+        donation_distinct = None
+
+    # ---- traffic-tuned grid schedules: the run's shape ledger feeds a
+    # dryrun schedule search per hot key (oracle-gated, perf-model
+    # priced); winners persist in the store and the REBUILT engine
+    # resolves them with zero search cost on its build path
+    from triton_distributed_tpu.tune import traffic as traffic_lib
+
+    wire_key = "int8" if cfg.kv_quant is not None else None
+    tune_reports = traffic_lib.retune_hot_shapes(
+        stats, mesh_shape=(model.tp,), wire=wire_key, dryrun=True,
+    )
+    tuned_vs_default = [
+        {
+            "key": str(rep.get("key", "")),
+            "default_ms": round(rep["default_ms"], 4),
+            "tuned_ms": round(rep["winner_ms"], 4),
+            "winner": rep["winner"],
+            "cached": rep["cached"],
+        }
+        for rep in tune_reports if "error" not in rep
+    ]
+    eng_tuned = ServingEngine(model, params, ecfg)
+    resolved_schedule = eng_tuned.grid_schedule.to_dict()
 
     # ---- fixed-batch paged baseline on the SAME trace: FCFS
     # rectangles of `slots` requests, padded prompts, every row decoded
@@ -1654,6 +1694,13 @@ def _bench_serving_continuous(mesh, n, on_tpu, spec, tiny=False,
         "n_layers": cfg.n_layers,
         "per_layer_pool_bytes": per_layer_pool_bytes,
         "pool_bytes_total": per_layer_pool_bytes * cfg.n_layers,
+        "donation_distinct_buffers": donation_distinct,
+        "tuned_vs_default": tuned_vs_default,
+        "tuned_strictly_better": sum(
+            1 for r in tuned_vs_default
+            if r["tuned_ms"] < r["default_ms"]
+        ),
+        "resolved_grid_schedule": resolved_schedule,
         "config": (
             f"n={n} slots={ecfg.slots} budget={ecfg.token_budget} "
             f"chunk={ecfg.chunk} page={page} npages={ecfg.npages} "

@@ -47,6 +47,20 @@ JAX_PLATFORMS=cpu python -m triton_distributed_tpu.tune.schedule \
 JAX_PLATFORMS=cpu python -m triton_distributed_tpu.tune.schedule \
   --family grad_ring.stream_int8w --mesh 8
 
+# Bounded GRID-schedule smoke (PR-15): the three grid families —
+# ragged paged attention (block_q/n_bufs/pack_rows), kv_ship page
+# coalescing, and the GEMM-RS int8-MXU epilogue — each enumerate their
+# freedom product + mutations through the same oracle. Exits 2 unless
+# at least one candidate was rejected with a stable rule ID (the
+# over-wide block's SL008, the dropped/shared scale rail's SL009) AND
+# a lint-clean pick landed. Mesh 8 here; the pytest suite pins mesh 4.
+JAX_PLATFORMS=cpu python -m triton_distributed_tpu.tune.schedule \
+  --family flash_decode.ragged_paged --mesh 8
+JAX_PLATFORMS=cpu python -m triton_distributed_tpu.tune.schedule \
+  --family kv_ship.pages --mesh 8
+JAX_PLATFORMS=cpu python -m triton_distributed_tpu.tune.schedule \
+  --family gemm_rs.mx_epilogue --mesh 8
+
 # Degradation-target gate (the `bench.py --lint` check, standalone):
 # every registered kernel family must name a degradation target that
 # resolves to a real callable — a family without a declared fallback

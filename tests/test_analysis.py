@@ -811,6 +811,60 @@ class TestRaggedFamily:
         assert [x.rule for x in f] == []
 
 
+# -------------------------------------------- grid-schedule mutations
+
+class TestGridScheduleMutations:
+    """The PR-15 grid-schedule legality gate, pinned through its
+    mutation fixtures: each is the REAL production builder under a
+    mutated :class:`GridSchedule`, and each must land on its exact rule
+    ID — the shapes of wrongness the grid enumerator's oracle exists to
+    reject (a gate that cannot reject is not a gate)."""
+
+    def test_overwide_block_q_is_sl008(self):
+        """block_q=32 past the 16-token parking cap: the q-window and
+        out-DMA overrun the zero-slack gate buffer — OOB + coverage
+        SL008, nothing else (the protocol pass is blind to it)."""
+        rec, findings = _analyze_df_fixture(
+            fixtures.grid_ragged_overwide_block)
+        assert _rules(findings) == ["SL008"], [f.format() for f in findings]
+        assert all(f.severity == Severity.ERROR for f in findings)
+
+    def test_coalesced_drop_rail_is_sl009(self):
+        """coalesce=2 ticks shipping payload-only: every page lands at
+        its slot but no scale plane accompanies it and the install has
+        no fold — exactly SL009 (contract=None keeps the permute pass's
+        SL008 for the missing scale deliveries out of the pin)."""
+        rec, findings = _analyze_df_fixture(
+            fixtures.grid_kv_ship_dropped_scale)
+        assert _rules(findings) == ["SL009"], [f.format() for f in findings]
+        msgs = " | ".join(f.message for f in findings)
+        assert "scale" in msgs
+
+    def test_gemm_rs_shared_rail_is_sl009(self):
+        """rail='shared' on the int8-MXU fused GEMM-RS: scale arrivals
+        signal the payload's recv semaphore — credits balance, only the
+        rail-pairing replay can reject it."""
+        rec, findings = _analyze_df_fixture(
+            fixtures.grid_gemm_rs_shared_rail)
+        assert _rules(findings) == ["SL009"], [f.format() for f in findings]
+
+    def test_grid_families_lint_clean_default(self):
+        """The other half of the oracle pin: the DEFAULT grid schedule
+        gates clean for all three families at mesh 4 AND 8 (the
+        candidate production actually runs must never be rejected)."""
+        from triton_distributed_tpu.tune.schedule import (
+            GRID_DEFAULT,
+            check_schedule,
+            grid_families,
+        )
+
+        for fam in grid_families():
+            for n in (4, 8):
+                findings = check_schedule(fam, GRID_DEFAULT, n)
+                assert findings == [], (
+                    fam, n, [f.format() for f in findings])
+
+
 # -------------------------------------- CP + grad-ring train families
 
 class TestCPTrainFamilies:

@@ -67,8 +67,20 @@ class TestScheduleIR:
         """A mutation is never inside the family's legal freedom set."""
         for fam in S.searchable_families():
             legal = set(S.enumerate_schedules(fam))
-            for m in S.mutate(S.DEFAULT, fam):
+            for m in S.mutate(S.default_for(fam), fam):
                 assert m not in legal, (fam, m)
+
+    def test_grid_default_roundtrip_and_identity(self):
+        assert S.GRID_DEFAULT.is_default()
+        assert S.GridSchedule.from_dict(
+            S.GRID_DEFAULT.to_dict()) == S.GRID_DEFAULT
+        mutated = S.GridSchedule(n_bufs=3)
+        assert not mutated.is_default()
+        assert S.GridSchedule.from_dict(mutated.to_dict()) == mutated
+        # the kind discriminator is a CLASS attr, never serialized —
+        # duck-typed dispatch survives double-imported module paths
+        assert "kind" not in S.GRID_DEFAULT.to_dict()
+        assert S.GRID_DEFAULT.kind == "grid" and S.DEFAULT.kind == "ring"
 
 
 # ------------------------------------------------- default byte-identity
@@ -189,11 +201,32 @@ class TestDefaultByteIdentity:
 # --------------------------------------------------------------- oracle
 
 class TestLegalityOracle:
-    @pytest.mark.parametrize("family", S.searchable_families())
+    @pytest.mark.parametrize(
+        "family",
+        [f for f in S.searchable_families() if not S.is_grid_family(f)],
+    )
     def test_every_legal_candidate_gates_clean(self, family):
+        """Ring freedom sets are legal by construction — every
+        enumerated candidate must gate clean."""
         for cand in S.enumerate_schedules(family):
             findings = S.check_schedule(family, cand, 8)
             assert not findings, (family, cand, _rules(findings))
+
+    @pytest.mark.parametrize("family", sorted(S.grid_families()))
+    def test_grid_freedom_products_prune_through_the_oracle(self, family):
+        """Grid freedom PRODUCTS may contain illegal corners (the
+        proposer proposes, the oracle disposes): the default always
+        gates clean, every rejection carries rule IDs, and at least one
+        non-default candidate survives — there is something to tune."""
+        clean, rejected = [], []
+        for cand in S.enumerate_schedules(family):
+            findings = S.check_schedule(family, cand, 8)
+            (rejected if findings else clean).append(
+                (cand, _rules(findings)))
+        assert clean and clean[0][0].is_default(), (family, rejected)
+        assert any(not c.is_default() for c, _ in clean), family
+        for cand, rules in rejected:
+            assert rules, (family, cand)
 
     def test_skipped_hop_is_sl008(self):
         f = S.check_schedule(
@@ -354,3 +387,246 @@ class TestSearchMode:
                 "allgather.ring_bidir", rows=64, cols=1024,
                 mesh_shape=(8,), dryrun=True,
             )
+
+
+# ------------------------------------------------- grid-schedule suite
+
+def _grid_trace(launch, in_shapes, site, init=None, contract=None):
+    spec = captured_launch(launch)
+    assert spec is not None, launch
+    rec, findings = lint.analyze_spec(
+        spec, in_shapes, 8, kernel_name=launch, site=site,
+        contract=contract, init=init,
+    )
+    return [[repr(e) for e in tr] for tr in rec.traces], findings
+
+
+def _build_ragged_grid(sched):
+    from triton_distributed_tpu.kernels.ragged_paged_attention import (
+        LINT_GEOM,
+        build_grid_lint_kernel,
+        build_lint_kernel,
+    )
+
+    if sched is None:
+        # the pre-refactor builder: the registry's LINT_GEOM entry
+        build_lint_kernel(token=_tok())
+        g = dict(LINT_GEOM, kv_lens=(12, 8), q_lens=(8, 8),
+                 q_starts=(0, 8))
+    else:
+        g = build_grid_lint_kernel(token=_tok(), schedule=sched)
+    pool = (g["npages"], g["hkv"], g["page"], g["d"])
+    shapes = [
+        ((g["r"], g["pps"]), np.dtype(np.int32)),
+        ((g["r"],), np.dtype(np.int32)),
+        ((g["r"],), np.dtype(np.int32)),
+        ((g["r"],), np.dtype(np.int32)),
+        ((g["hkv"], g["t"] * g["g"], g["d"]), _F32),
+        (pool, _I8), (pool, _I8),
+        ((g["npages"], g["hkv"], 1, g["page"]), _F32),
+        ((g["npages"], g["hkv"], 1, g["page"]), _F32),
+    ]
+    init = {
+        0: np.arange(g["r"] * g["pps"], dtype=np.int32).reshape(
+            g["r"], g["pps"]),
+        1: np.asarray(g["kv_lens"], np.int32),
+        2: np.asarray(g["q_lens"], np.int32),
+        3: np.asarray(g["q_starts"], np.int32),
+    }
+    return "ragged_paged_attention_q8", shapes, "ragged_paged", init
+
+
+def _build_kv_ship_grid(sched):
+    from triton_distributed_tpu.kernels.kv_ship import (
+        KV_SHIP_GEOM,
+        build_lint_kernel,
+        coalesced_landing_table,
+    )
+
+    g = KV_SHIP_GEOM
+    build_lint_kernel(lint.lint_mesh(8), 8, token=_tok(), schedule=sched)
+    rows = g["pages"] * g["rows"]
+    shapes = [
+        ((g["pages"],), np.dtype(np.int32)),
+        ((rows, g["cols"]), _I8),
+        ((rows, 128), _F32),
+    ]
+    co = 1 if sched is None else int(sched.coalesce)
+    init = {0: np.asarray(
+        coalesced_landing_table(g["pages"], co), np.int32)}
+    return "kv_ship_pages", shapes, "kv_ship", init
+
+
+def _build_gemm_rs_mx(sched):
+    from triton_distributed_tpu.kernels.gemm_rs import _build_fused
+    from triton_distributed_tpu.lang import wire as wirelib
+
+    n = 8
+    _build_fused(
+        lint.lint_mesh(n), "x", (), (16 * n, 128 * n), (128 * n, 64),
+        jnp.dtype(jnp.float32), jnp.dtype(jnp.float32), 6, _tok(),
+        wire="int8-mxu", schedule=sched,
+    )
+    shapes = [((16 * n, 128), _I8), ((n, wirelib.SCALE_LANES), _F32),
+              ((128, 64), _I8), ((1, 64), _F32)]
+    return "gemm_rs_fused_int8mxw", shapes, "gemm_rs", None
+
+
+_GRID_CONSUMERS = {
+    "ragged_paged": _build_ragged_grid,
+    "kv_ship": _build_kv_ship_grid,
+    "gemm_rs_mx": _build_gemm_rs_mx,
+}
+
+
+class TestGridDefaultByteIdentity:
+    @pytest.mark.parametrize("name", sorted(_GRID_CONSUMERS))
+    def test_none_and_grid_default_trace_identically(self, name):
+        """schedule=None (the baked-in protocol) and the explicit
+        GRID_DEFAULT leave the SAME symbolic event trace on every rank
+        for all three grid families — the GridSchedule refactor moved
+        no bytes of the default kernels."""
+        build = _GRID_CONSUMERS[name]
+        launch, shapes, site, init = build(None)
+        base, f0 = _grid_trace(launch, shapes, site, init=init)
+        launch, shapes, site, init = build(S.GRID_DEFAULT)
+        dflt, f1 = _grid_trace(launch, shapes, site, init=init)
+        assert base == dflt, name
+        assert not f0 and not f1, (name, _rules(f0), _rules(f1))
+
+    def test_non_default_grid_schedule_changes_the_trace(self):
+        """Counter-pin against a vacuous identity: a coalesced kv_ship
+        and a deeper ragged page walk must each trace differently from
+        the default (the builders genuinely consume their schedule)."""
+        launch, shapes, site, init = _build_kv_ship_grid(None)
+        base, _ = _grid_trace(launch, shapes, site, init=init)
+        launch, shapes, site, init = _build_kv_ship_grid(
+            S.GridSchedule(coalesce=2))
+        co2, _ = _grid_trace(launch, shapes, site, init=init)
+        assert base != co2
+        launch, shapes, site, init = _build_ragged_grid(None)
+        rbase, _ = _grid_trace(launch, shapes, site, init=init)
+        launch, shapes, site, init = _build_ragged_grid(
+            S.GridSchedule(n_bufs=3))
+        nb3, _ = _grid_trace(launch, shapes, site, init=init)
+        assert rbase != nb3
+
+
+class TestGridSearchMode:
+    def test_grid_search_persists_and_reloads_with_zero_cost(
+            self, store_dir):
+        from triton_distributed_tpu.tune.autotuner import (
+            search_grid_schedule,
+        )
+
+        shape = S._GRID_SMOKE_SHAPES["kv_ship.pages"]
+        rep = search_grid_schedule(
+            "kv_ship.pages", shape=shape, mesh_shape=(8,), wire="int8",
+            dryrun=True,
+        )
+        assert not rep["cached"]
+        assert rep["winner_ms"] <= rep["default_ms"] + 1e-9
+        rules = sorted({r for _, rs in rep["rejected"] for r in rs})
+        assert "SL009" in rules
+        data = json.loads((store_dir / "schedules.json").read_text())
+        assert data["schema_version"] == 2
+        key = S.schedule_key("kv_ship.pages", shape, (8,), "int8")
+        assert data["entries"][key]["kind"] == "grid"
+        # second search: cached, zero candidates gated
+        rep2 = search_grid_schedule(
+            "kv_ship.pages", shape=shape, mesh_shape=(8,), wire="int8",
+            dryrun=True,
+        )
+        assert rep2["cached"] and rep2["candidates"] == 0
+        assert rep2["winner"] == rep["winner"]
+        # the op resolve path sees a GridSchedule winner
+        got = S.resolve_schedule("kv_ship.pages", shape, (8,), "int8")
+        assert got == S.GridSchedule.from_dict(rep["winner"])
+
+    def test_grid_resolve_precedence(self, store_dir):
+        """explicit > stored > default, with grid values."""
+        shape = (4, 64, 2, 2, 16, 8)
+        fam = "flash_decode.ragged_paged"
+        stored = S.GridSchedule(n_bufs=3)
+        S.store_schedule(fam, shape, (1,), None, stored)
+        explicit = S.GridSchedule(block_q=16)
+        assert S.resolve_schedule(fam, shape, (1,), None,
+                                  explicit) == explicit
+        assert S.resolve_schedule(fam, shape, (1,), None) == stored
+        assert S.resolve_schedule(fam, (9, 9, 9, 9, 9, 9), (1,),
+                                  None) is None
+
+    def test_grid_search_refuses_a_dead_oracle(self, store_dir,
+                                               monkeypatch):
+        from triton_distributed_tpu.tune.autotuner import (
+            search_grid_schedule,
+        )
+
+        monkeypatch.setitem(S._GRID_MUTATIONS, "kv_ship.pages", ())
+        with pytest.raises(RuntimeError, match="rejected nothing"):
+            search_grid_schedule(
+                "kv_ship.pages",
+                shape=S._GRID_SMOKE_SHAPES["kv_ship.pages"],
+                mesh_shape=(8,), dryrun=True,
+            )
+
+    def test_grid_search_rejects_non_grid_family(self, store_dir):
+        from triton_distributed_tpu.tune.autotuner import (
+            search_grid_schedule,
+        )
+
+        with pytest.raises(ValueError, match="not a grid family"):
+            search_grid_schedule(
+                "allgather.ring_1d", shape=(64, 2048), mesh_shape=(8,),
+            )
+
+
+class TestStoreMigration:
+    def test_v1_ring_store_migrates(self, store_dir):
+        """A pre-grid v1 store ({"v": 1}) loads: its ring entries get
+        kind='ring' stamped and resolve as RingSchedule values."""
+        win = S.RingSchedule(dequant="epilogue")
+        key = S.schedule_key("ag_gemm.fused", (1024, 8192), (8,), "int8")
+        (store_dir / "schedules.json").write_text(json.dumps({
+            "v": 1,
+            "entries": {key: {"family": "ag_gemm.fused",
+                              "schedule": win.to_dict(),
+                              "price_ms": 1.0}},
+        }))
+        S.load_schedule.cache_clear()
+        got = S.load_schedule("ag_gemm.fused", (1024, 8192), (8,),
+                              "int8")
+        assert got == win and got.kind == "ring"
+        assert S.stored_entries()[key]["kind"] == "ring"
+
+    def test_unknown_store_version_is_ignored(self, store_dir):
+        (store_dir / "schedules.json").write_text(json.dumps({
+            "schema_version": 99,
+            "entries": {"k": {"family": "x", "schedule": {}}},
+        }))
+        S.load_schedule.cache_clear()
+        assert S.stored_entries() == {}
+        assert S.load_schedule("ag_gemm.fused", (1, 1), (8,),
+                               None) is None
+
+    def test_v2_rewrite_preserves_migrated_entries(self, store_dir):
+        """Writing one new winner into a v1 store upgrades the file to
+        schema_version 2 WITHOUT dropping the migrated ring entries."""
+        ring_key = S.schedule_key("ag_gemm.fused", (1024, 8192), (8,),
+                                  "int8")
+        (store_dir / "schedules.json").write_text(json.dumps({
+            "v": 1,
+            "entries": {ring_key: {
+                "family": "ag_gemm.fused",
+                "schedule": S.RingSchedule(dequant="epilogue").to_dict(),
+            }},
+        }))
+        S.load_schedule.cache_clear()
+        S.store_schedule("kv_ship.pages", (16, 16, 2, 128, 4), (8,),
+                         "int8", S.GridSchedule(coalesce=2))
+        data = json.loads((store_dir / "schedules.json").read_text())
+        assert data["schema_version"] == 2
+        assert data["entries"][ring_key]["kind"] == "ring"
+        got = S.load_schedule("kv_ship.pages", (16, 16, 2, 128, 4),
+                              (8,), "int8")
+        assert got == S.GridSchedule(coalesce=2)

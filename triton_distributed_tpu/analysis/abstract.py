@@ -141,6 +141,7 @@ class AbsRef:
         if not isinstance(idx, tuple):
             idx = (idx,)
         np_idx, origin, dims, squeeze = [], list(self.origin), [], []
+        req_ext, oob = {}, False
         for d in range(self.ndim):
             rd = self.dims[d]
             dim = self.data.shape[d]
@@ -160,16 +161,27 @@ class AbsRef:
                 np_idx.append(slice(start, stop))
                 squeeze.append(d)
                 continue
+            if start < 0 or stop > dim:
+                oob = True                # numpy will clip silently —
+            req_ext[rd] = stop - start    # remember the REQUESTED window
             np_idx.append(slice(start, stop))
             origin[rd] += start
             dims.append(rd)
         sub = self.data[tuple(np_idx)]
         if squeeze:
             sub = np.squeeze(sub, axis=tuple(squeeze))
-        return AbsRef(
+        res = AbsRef(
             self.name, sub, self.space, self.rec,
             origin=origin, root=self.root, dims=dims,
         )
+        if oob and self.rec is not None:
+            lo = tuple(res.origin)
+            hi = tuple(
+                o + req_ext.get(rd, 1)
+                for rd, o in enumerate(res.origin)
+            )
+            self.rec.emit(ev.OobEvent(region=ev.Region(self.root, lo, hi)))
+        return res
 
     def region(self) -> ev.Region:
         extent = {rd: s for rd, s in zip(self.dims, self.data.shape)}

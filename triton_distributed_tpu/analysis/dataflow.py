@@ -172,8 +172,15 @@ def _copy(dst_st, dst_region, src_st, src_region, *, hop_inc=0,
     ds, ss = _slices(dst_region), _slices(src_region)
     for k in ("contrib", "wire", "scale", "hop"):
         src = src_st[k][ss]
-        if src.shape != dst_st[k][ds].shape:
-            src = src.reshape(dst_st[k][ds].shape)
+        dst = dst_st[k][ds]
+        if src.size != dst.size:
+            # one side was a numpy-CLIPPED out-of-bounds window (the
+            # evaluator emitted an OobEvent — SL008 reports the overrun
+            # itself); provenance transfer for the phantom region is
+            # undefined, so drop the copy instead of crashing the replay
+            return
+        if src.shape != dst.shape:
+            src = src.reshape(dst.shape)
         dst_st[k][ds] = src
     if hop_inc:
         dst_st["hop"][ds] += hop_inc
@@ -667,6 +674,35 @@ def _check_hop_depth(rec, state: _State, contract) -> list:
     )]
 
 
+def _check_oob(rec) -> list:
+    """SL008: the abstract evaluator recorded an index that extends past
+    a buffer's extent. numpy clips such windows silently, so the clipped
+    access already passed every provenance check as its narrower shadow
+    — the overrun itself is the bug (a grid kernel's out-DMA spilling
+    past the parking zone clobbers a neighbor row's delivered span)."""
+    findings, seen = [], set()
+    for e in rec.events(ev.OobEvent):
+        r = e.region
+        shape = None
+        meta = rec.ref_meta.get(r.ref)
+        if meta is not None:
+            shape = tuple(meta.shape)
+        key = (r.ref, r.lo, r.hi)
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(Finding(
+            "SL008", rec.info.kernel,
+            f"out-of-bounds access {r}: the requested window extends "
+            f"past the buffer extent{'' if shape is None else f' {shape}'}"
+            " — the access was silently clipped, so the bytes past the "
+            "edge were never read/written (an out-block overrunning the "
+            "parking zone violates the delivery contract)",
+            site=rec.info.site, ranks=(e.rank,), phase=e.phase,
+        ))
+    return findings
+
+
 # ------------------------------------------------------------------- entry
 
 def check_dataflow(rec, sim, contract: DeliveryContract | None) -> list:
@@ -674,9 +710,11 @@ def check_dataflow(rec, sim, contract: DeliveryContract | None) -> list:
     hop-critical-path check over one completed replay."""
     if rec.n > MAX_RANKS:
         return []
+    findings = _check_oob(rec)
     state = _State(rec)
     state.seed_inputs()
-    _puts, findings = _replay(rec, sim, state)
+    _puts, more = _replay(rec, sim, state)
+    findings += more
     findings += _check_rail_pairing(rec)
     if contract is not None:
         findings += _check_contract(rec, state, contract)

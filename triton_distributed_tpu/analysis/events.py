@@ -145,6 +145,18 @@ class DequantEvent(Event):
 
 
 @dataclass
+class OobEvent(Event):
+    """A ref index that extends past the buffer's extent. numpy slicing
+    silently CLIPS out-of-range windows, so without this marker an
+    over-wide access would be analyzed as its clipped shadow and pass
+    every check; the evaluator records the REQUESTED region here and the
+    dataflow pass surfaces it as a contract violation (SL008 — e.g. a
+    grid kernel's out-DMA overrunning the parking zone)."""
+
+    region: Region = None
+
+
+@dataclass
 class AddEvent(Event):
     """A streamed elementwise fold ``dst = a + b`` (the HBM ring folds'
     ew_add_pipeline). Provenance of both operands accumulates into

@@ -607,7 +607,7 @@ def kv_ship_skipped_page(axis="x"):
     short = _ft.partial(
         _kv_ship_kernel, n, axis, (axis,),
         g["pages"] - 1,                      # BUG: one page never ships
-        g["rows"],
+        g["rows"], 1, "paired",
     )
 
     def kernel(dstpg_ref, src_q, src_s, dst_q, dst_s,
@@ -701,6 +701,155 @@ def kv_ship_unpaired_scale(axis="x"):
             ((total, 128), _F32),
         ],
         None,
+    )
+
+
+def grid_ragged_overwide_block(axis="x"):
+    """GRID-schedule MUTATION through the production ragged builder:
+    ``block_q=32`` against the gate geometry's 16-token parking cap.
+    The packed buffer reserves exactly ``min(block_q, GRID_BLOCK_CAP)``
+    tokens of tail slack, so a 32-wide query block's q-window reads and
+    out-DMA writes overrun the buffer — the evaluator's OOB events and
+    the zero-slack local contract both land on SL008. Every semaphore
+    balances and the page walk is protocol-clean: only the dataflow
+    pass can reject this candidate, which is why it sits in the
+    schedule enumerator's mutation set."""
+    from dataclasses import replace
+
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.ragged_paged_attention import (
+        build_grid_lint_kernel,
+    )
+    from triton_distributed_tpu.lang.launch import captured_launch
+    from triton_distributed_tpu.tune.schedule import GridSchedule
+
+    g = build_grid_lint_kernel(
+        token=_schedule_token(), schedule=GridSchedule(block_q=32)
+    )
+    real = captured_launch("ragged_paged_attention_q8")
+
+    def kernel(*refs):
+        table, kv_lens, q_lens, q_starts = refs[:4]
+        table[...] = np.arange(
+            g["r"] * g["pps"], dtype=np.int32
+        ).reshape(g["r"], g["pps"])
+        kv_lens[...] = np.asarray(g["kv_lens"], np.int32)
+        q_lens[...] = np.asarray(g["q_lens"], np.int32)
+        q_starts[...] = np.asarray(g["q_starts"], np.int32)
+        real.kernel(*refs)
+
+    def in_shapes(n):
+        del n
+        pool = (g["npages"], g["hkv"], g["page"], g["d"])
+        return [
+            ((g["r"], g["pps"]), np.dtype(np.int32)),
+            ((g["r"],), np.dtype(np.int32)),
+            ((g["r"],), np.dtype(np.int32)),
+            ((g["r"],), np.dtype(np.int32)),
+            ((g["hkv"], g["t"] * g["g"], g["d"]), _F32),
+            (pool, np.dtype(np.int8)),
+            (pool, np.dtype(np.int8)),
+            ((g["npages"], g["hkv"], 1, g["page"]), _F32),
+            ((g["npages"], g["hkv"], 1, g["page"]), _F32),
+        ]
+
+    return (
+        replace(real, kernel=kernel,
+                name="fixture_grid_ragged_overwide_block"),
+        in_shapes,
+        DeliveryContract(kind="local", dst=9),
+    )
+
+
+def grid_kv_ship_dropped_scale(axis="x"):
+    """GRID-schedule MUTATION through the production kv_ship builder:
+    a 2-page coalesced tick whose scale rail is DROPPED
+    (``coalesce=2, rail='drop'``). The int8 page payloads fly and land
+    coalesced (the permute is still exact), but no per-row scale plane
+    ships and the landing installs with no scale fold — SL009, the
+    same silent-wrong-logits bug as :func:`kv_ship_unpaired_scale`,
+    produced by the real builder under a mutated schedule instead of a
+    hand-written replica."""
+    from dataclasses import replace
+
+    from triton_distributed_tpu.analysis.lint import lint_mesh
+    from triton_distributed_tpu.kernels.kv_ship import (
+        KV_SHIP_GEOM,
+        build_lint_kernel,
+        coalesced_landing_table,
+    )
+    from triton_distributed_tpu.lang.launch import captured_launch
+    from triton_distributed_tpu.tune.schedule import GridSchedule
+
+    g = KV_SHIP_GEOM
+    n = 8
+    build_lint_kernel(
+        lint_mesh(n, axis), n, token=_schedule_token(),
+        schedule=GridSchedule(coalesce=2, rail="drop"),
+    )
+    real = captured_launch("kv_ship_pages")
+    table = np.asarray(coalesced_landing_table(g["pages"], 2), np.int32)
+
+    def kernel(dstpg_ref, *refs):
+        dstpg_ref[...] = table
+        real.kernel(dstpg_ref, *refs)
+
+    def in_shapes(n):
+        del n
+        rows = g["pages"] * g["rows"]
+        return [
+            ((g["pages"],), np.dtype(np.int32)),
+            ((rows, g["cols"]), np.dtype(np.int8)),
+            ((rows, 128), _F32),
+        ]
+
+    # contract=None (the kv_ship_unpaired_scale precedent): the rail
+    # pairing is the bug under test, so the pin is EXACTLY ["SL009"] —
+    # the permute contract would add its own SL008 for the missing
+    # scale-plane deliveries and blur the rule pin
+    return (
+        replace(real, kernel=kernel,
+                name="fixture_grid_kv_ship_dropped_scale"),
+        in_shapes,
+        None,
+    )
+
+
+def grid_gemm_rs_shared_rail(axis="x"):
+    """GRID-schedule MUTATION through the production fused GEMM-RS
+    builder on the int8-MXU wire: ``rail='shared'`` signals the scale
+    plane's arrival on the PAYLOAD's recv semaphore. Credits balance —
+    the reduce ring waits the right totals — but a rank can fold a
+    stale scale against a fresh payload; only the SL009 rail-pairing
+    replay rejects it."""
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.analysis.lint import lint_mesh
+    from triton_distributed_tpu.kernels.gemm_rs import _build_fused
+    from triton_distributed_tpu.lang import wire as wirelib
+    from triton_distributed_tpu.lang.launch import captured_launch
+    from triton_distributed_tpu.tune.schedule import GridSchedule
+
+    n = 8
+    _build_fused(
+        lint_mesh(n, axis), axis, (), (16 * n, 128 * n), (128 * n, 64),
+        jnp.dtype(jnp.float32), jnp.dtype(jnp.float32), 6,
+        _schedule_token(), wire="int8-mxu",
+        schedule=GridSchedule(rail="shared"),
+    )
+    spec = captured_launch("gemm_rs_fused_int8mxw")
+
+    def in_shapes(n):
+        return [((16 * n, 128), np.dtype(np.int8)),
+                ((n, wirelib.SCALE_LANES), _F32),
+                ((128, 64), np.dtype(np.int8)),
+                ((1, 64), _F32)]
+
+    return (
+        spec,
+        in_shapes,
+        DeliveryContract(kind="reduce", dst="out_hbm"),
     )
 
 

@@ -189,6 +189,62 @@ def mm_q8_rs_pipeline(mb, nb, kb, bm, bk, bn, fmt, acc_ref, *, m_off=0):
     return run
 
 
+def mm_q8_partial_pipeline(mb, nb, kb, bm, bk, bn, acc_ref, *, m_off=0):
+    """s8×s8→s32 producer WITHOUT the fused wire epilogue: the rescaled
+    f32 partial lands in the destination slab only, and the ring
+    harness's separate ``quant_pipeline`` read-back pass makes the wire
+    copy afterwards (the ``GridSchedule.epilogue="readback"`` placement
+    — one extra HBM round-trip per hop, but no ``nb == 1`` /
+    chunk-geometry constraint on the out tiling)."""
+
+    def inner(aq_ref, as_ref, bq_ref, bs_ref, o_ref):
+        @pl.when(pl.program_id(2) == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        acc_ref[...] += jax.lax.dot_general(
+            aq_ref[...], bq_ref[...],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+
+        @pl.when(pl.program_id(2) == kb - 1)
+        def _():
+            o_ref[...] = (
+                acc_ref[...].astype(jnp.float32)
+                * (as_ref[:, :1] * bs_ref[...])
+            ).astype(o_ref.dtype)
+
+    pipe = pltpu.emit_pipeline(
+        inner,
+        grid=(mb, nb, kb),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (m_off + i, kk)),
+            pl.BlockSpec(
+                (1, wirelib.SCALE_LANES), lambda i, j, kk: (m_off + i, 0)
+            ),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        ],
+    )
+
+    def run(aq_hbm, as_hbm, bq_hbm, bs_hbm, dst_hbm):
+        from triton_distributed_tpu.analysis import events
+
+        rec = events.active_recorder()
+        if rec is not None:
+            # symbolic twin: a locally computed partial in the work slab
+            # (the wire quantization is the harness's read-back pass)
+            rec.emit(events.WriteEvent(region=dst_hbm.region()))
+            return
+        pipe(aq_hbm, as_hbm, bq_hbm, bs_hbm, dst_hbm)
+
+    return run
+
+
 def _fused_kernel(
     n, axis, mesh_axes, blocks, schedule,
     a_hbm, b_hbm, out_hbm, w0, w1, r0, r1, acc_ref, send_sem, recv_sem, ack_sem,
@@ -266,10 +322,22 @@ def _fused_kernel_mxw(
 ):
     """int8-MXU-producer twin of :func:`_fused_kernel_w` (carried-forward
     ROADMAP item): with int8 weights + activations the producer matmul
-    runs the MXU's native s8×s8→s32 path and its epilogue quantizes the
-    partial for the wire straight off the accumulator into the wq/ws
-    rails — ``RSWireRefs.quantize=None`` tells the ring harness the
-    read-back quantize pass is gone."""
+    runs the MXU's native s8×s8→s32 path, and the wire is quantized off
+    an ACCUMULATOR at both places a hop's payload is born —
+    ``RSWireRefs.quantize=None`` tells the ring harness the read-back
+    quantize pass is gone:
+
+    * the FIRST send (a pure local partial) quantizes straight off the
+      producer's s32 accumulator (:func:`mm_q8_rs_pipeline`'s fused
+      epilogue into wq/ws slot 0);
+    * every later send must ship the FOLDED running sum, not the local
+      partial — the fold itself re-quantizes off its f32 accumulator
+      into the next send's rail pair
+      (:func:`lang.wire.dequant_add_requant_pipeline`). Shipping the
+      raw local partial here loses every upstream contribution — the
+      delivery contract (SL008: one fold per rank) is what catches
+      that, which is exactly why this family gates through shmemlint.
+    """
     m_local = out_hbm.shape[0]
     n_out = out_hbm.shape[1]
     k = aq_hbm.shape[1]
@@ -277,21 +345,80 @@ def _fused_kernel_mxw(
     mb, nb, kb = m_local // bm, n_out // bn, k // bk
     wq, ws = (wq0, wq1), (ws0, ws1)
     produced = [0]
+    folded = [0]
 
     def partial_into(dst, dst_ref):
-        # produce calls walk the ring slots in order (call i → slot i%2,
-        # matching reduce_ring's send slot for that partial), so the
-        # epilogue knows which wire rail pair it owns
-        slot = produced[0] % 2
+        i = produced[0]
         produced[0] += 1
-        mm_q8_rs_pipeline(
-            mb, nb, kb, bm, bk, bn, fmt, acc_ref, m_off=dst * mb
-        )(aq_hbm, as_hbm, bq_hbm, bs_hbm, dst_ref, wq[slot], ws[slot])
+        if i == 0:
+            # the hop-0 payload: local partial, wire-quantized off the
+            # producer accumulator into send slot 0
+            mm_q8_rs_pipeline(
+                mb, nb, kb, bm, bk, bn, fmt, acc_ref, m_off=dst * mb
+            )(aq_hbm, as_hbm, bq_hbm, bs_hbm, dst_ref, wq[0], ws[0])
+        else:
+            # later partials only feed the fold; their wire copy is the
+            # fold's requantize (writing a rail here would be dead work)
+            mm_q8_partial_pipeline(
+                mb, nb, kb, bm, bk, bn, acc_ref, m_off=dst * mb
+            )(aq_hbm, as_hbm, bq_hbm, bs_hbm, dst_ref)
+
+    deq_req = wirelib.dequant_add_requant_pipeline(m_local, n_out, fmt)
+    deq = wirelib.dequant_add_pipeline(m_local, n_out, fmt)
+
+    def dequant_add(a_hbm, q_hbm, s_hbm, dst_hbm):
+        s = folded[0]
+        folded[0] += 1
+        if s < n - 2:
+            # fold step s feeds send step s+1 (slot (s+1) % 2): requant
+            # the accumulated sum into that slot's rail pair
+            slot = (s + 1) % 2
+            deq_req(a_hbm, q_hbm, s_hbm, dst_hbm, wq[slot], ws[slot])
+        else:
+            # final fold lands in out_hbm; nothing ships after it
+            deq(a_hbm, q_hbm, s_hbm, dst_hbm)
 
     wire = RSWireRefs(
         fmt=fmt, wq=wq, ws=ws, rq=(rq0, rq1), rs=(rs0, rs1),
         s_send_sem=s_send_sem, s_recv_sem=s_recv_sem,
-        quantize=None,   # producer-quantized: the epilogue wrote wq/ws
+        quantize=None,   # producer/fold-quantized: the rails are written
+        dequant_add=dequant_add,
+    )
+    reduce_ring(
+        n, axis, mesh_axes, out_hbm, (w0, w1), (None, None),
+        send_sem, recv_sem, ack_sem, partial_into, None,
+        site="gemm_rs", wire=wire, schedule=schedule,
+    )
+
+
+def _fused_kernel_mxr(
+    n, axis, mesh_axes, blocks, fmt, schedule,
+    aq_hbm, as_hbm, bq_hbm, bs_hbm, out_hbm, w0, w1,
+    wq0, wq1, ws0, ws1, rq0, rq1, rs0, rs1,
+    acc_ref, send_sem, recv_sem, ack_sem, s_send_sem, s_recv_sem,
+):
+    """The READBACK epilogue placement of the int8-MXU producer (the
+    ``GridSchedule.epilogue="readback"`` alternative to
+    :func:`_fused_kernel_mxw`): the s8×s8→s32 producer writes only the
+    f32 partial, and the ring harness's ``quant_pipeline`` read-back
+    pass makes each hop's wire copy — the pre-fusion pipeline shape,
+    kept searchable so the grid schedule search prices the fused
+    epilogue AGAINST it instead of assuming it."""
+    m_local = out_hbm.shape[0]
+    n_out = out_hbm.shape[1]
+    k = aq_hbm.shape[1]
+    bm, bk, bn = blocks
+    mb, nb, kb = m_local // bm, n_out // bn, k // bk
+
+    def partial_into(dst, dst_ref):
+        mm_q8_partial_pipeline(
+            mb, nb, kb, bm, bk, bn, acc_ref, m_off=dst * mb
+        )(aq_hbm, as_hbm, bq_hbm, bs_hbm, dst_ref)
+
+    wire = RSWireRefs(
+        fmt=fmt, wq=(wq0, wq1), ws=(ws0, ws1), rq=(rq0, rq1), rs=(rs0, rs1),
+        s_send_sem=s_send_sem, s_recv_sem=s_recv_sem,
+        quantize=wirelib.quant_pipeline(m_local, n_out, fmt),
         dequant_add=wirelib.dequant_add_pipeline(m_local, n_out, fmt),
     )
     reduce_ring(
@@ -361,11 +488,35 @@ def _build_fused(
         collective_id = None  # degenerate path uses no barrier semaphore
     fmt = None
     rail_fmt = None
+    # the grid schedule (tune.schedule.GridSchedule) governs the MXU
+    # producer's epilogue placement and demotion policy; its rail knob
+    # maps onto the inner reduce ring's scale-rail assignment. A plain
+    # RingSchedule (or None) leaves today's behavior byte-identical.
+    # Duck-typed on the classes' `kind` tag, not isinstance — the tune
+    # module may be loaded twice (its CLI runs it as __main__), and two
+    # copies of GridSchedule must still dispatch here.
+    from triton_distributed_tpu.tune.schedule import RingSchedule
+
+    epilogue, demote = "accumulator", "auto"
+    if getattr(schedule, "kind", "ring") == "grid":
+        epilogue, demote = schedule.epilogue, schedule.demote
+        schedule = (
+            RingSchedule(scale_rail="payload")
+            if schedule.rail == "shared" else None
+        )
     mx = wire == "int8-mxu" and dcn_axis is None
     if mx and (n_out // blocks[2] != 1 or m_local % blocks[0]):
         # the accumulator-epilogue quantizer needs the out tile to span
         # every column (a row block IS a scale chunk); otherwise run the
         # ordinary int8 wire with its separate quantize pass
+        if demote == "strict":
+            raise ValueError(
+                f"gemm_rs int8-mxu: shard ({m_local}, {k_local}) @ "
+                f"({k_local}, {n_out}) blocks to {blocks} — the "
+                "accumulator epilogue needs a full-width out tile and "
+                "chunk-aligned rows, and the schedule pins "
+                "demote='strict'"
+            )
         mx = False
         wire = "int8"
     if mx:
@@ -403,9 +554,13 @@ def _build_fused(
             sslab = jax.ShapeDtypeStruct(
                 (fmt.chunks(m_local), wirelib.SCALE_LANES), jnp.float32
             )
+            mx_kernel = (
+                _fused_kernel_mxr if epilogue == "readback"
+                else _fused_kernel_mxw
+            )
             return lang.shmem_call(
                 functools.partial(
-                    _fused_kernel_mxw, n, axis, mesh.axis_names, blk, fmt,
+                    mx_kernel, n, axis, mesh.axis_names, blk, fmt,
                     schedule,
                 ),
                 out_shape=[slab, slab, slab,
@@ -997,9 +1152,14 @@ def gemm_rs(
             # reports the payload ('int8') since that is what the ring
             # ships — re-upgrade for the builder
             wire = "int8-mxu"
-        sched = resolve_schedule(
-            "gemm_rs.fused", a.shape, (n * nd,), wire, schedule
+        # the MXU-producer wire resolves the GRID family (epilogue
+        # placement / demotion policy, tune.schedule.GridSchedule); the
+        # plain wires resolve the ring family as before
+        fam = (
+            "gemm_rs.mx_epilogue" if wire == "int8-mxu"
+            else "gemm_rs.fused"
         )
+        sched = resolve_schedule(fam, a.shape, (n * nd,), wire, schedule)
         fn = _build_fused(
             mesh, axis, batch_axes, a.shape, b.shape, a.dtype, out_dtype,
             collective_id, interp_key(), dcn_axis, wire, sched,

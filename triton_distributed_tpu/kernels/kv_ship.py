@@ -115,24 +115,36 @@ def ship_wire_bytes(n_pages: int, page: int, hkv: int, d: int,
 # --------------------------------------------------- the Pallas transport
 
 def _kv_ship_kernel(
-    n, axis, mesh_axes, pages, rows,
+    n, axis, mesh_axes, pages, rows, coalesce, rail,
     dstpg_ref, src_q, src_s, dst_q, dst_s,
     send_sem, recv_sem, s_send_sem, s_recv_sem,
 ):
     """Pairwise page ship: every rank pushes its ``pages`` staged pages
     (each ``rows`` rows of payload + its per-row scale plane) to its
     partner rank's pool at the LANDING slots ``dstpg_ref`` assigned by
-    the receiver's block table, one dual-rail DMA pair per page.
+    the receiver's block table, one dual-rail DMA pair per TICK.
 
-    Per-page semaphore slots: page i's arrival can only credit slot i,
-    so a wait being satisfied proves THAT page (and its scale plane —
-    own rail, own semaphores) landed. After the waits, each landed
-    page/scale pair is installed-as-quantized: the pool keeps the int8
-    bytes and their scales (the attention kernel folds the scales at
-    read time), which :func:`lang.wire.epilogue_consume` records as the
-    consume-with-scale provenance edge — leaving a page uninstalled is
-    SL008 against the permute contract, installing one without its
-    scale plane is SL009."""
+    A tick moves ``coalesce`` consecutive staged pages in one
+    descriptor (``coalesce=1`` is the classic per-page ship, byte-
+    identical to the pre-schedule kernel); coalescing is only legal
+    when the landing table assigns each tick's pages a CONTIGUOUS slot
+    run (see :func:`coalesced_landing_ok`) — the caller, not this
+    kernel, guarantees that.
+
+    Per-tick semaphore slots: tick i's arrival can only credit slot i,
+    so a wait being satisfied proves THAT tick's pages (and their scale
+    planes) landed. ``rail`` places the scale plane's DMA:
+    ``"paired"`` rides its own semaphores (legal); ``"shared"`` signals
+    the payload's semaphores (a payload wait can be released by a scale
+    arrival — SL009); ``"drop"`` ships no scales at all (the landed
+    pages install as raw quantized bytes — SL009). After the waits,
+    each landed page/scale pair is installed-as-quantized: the pool
+    keeps the int8 bytes and their scales (the attention kernel folds
+    the scales at read time), which :func:`lang.wire.epilogue_consume`
+    records as the consume-with-scale provenance edge — leaving a page
+    uninstalled is SL008 against the permute contract, installing one
+    without its scale plane is SL009."""
+    assert pages % coalesce == 0, (pages, coalesce)
     me = lang.my_pe(axis)
     to = lang.pe_flat(axis, (me + n // 2) % n, mesh_axes)
 
@@ -140,35 +152,48 @@ def _kv_ship_kernel(
 
     from jax.experimental import pallas as pl
 
+    span = coalesce * rows
+    ticks = pages // coalesce
     handles = []
-    for i in range(pages):
-        slot = dstpg_ref[i]
+    for i in range(ticks):
+        slot = dstpg_ref[i * coalesce]
         dq = lang.remote_copy(
-            src_q.at[pl.ds(i * rows, rows)],
-            dst_q.at[pl.ds(slot * rows, rows)],
+            src_q.at[pl.ds(i * span, span)],
+            dst_q.at[pl.ds(slot * rows, span)],
             send_sem.at[i], recv_sem.at[i], to,
         )
+        if rail == "drop":
+            dq.start()
+            handles.append((dq, None))
+            continue
+        s_snd = send_sem if rail == "shared" else s_send_sem
+        s_rcv = recv_sem if rail == "shared" else s_recv_sem
         ds = lang.remote_copy(
-            src_s.at[pl.ds(i * rows, rows)],
-            dst_s.at[pl.ds(slot * rows, rows)],
-            s_send_sem.at[i], s_recv_sem.at[i], to,
+            src_s.at[pl.ds(i * span, span)],
+            dst_s.at[pl.ds(slot * rows, span)],
+            s_snd.at[i], s_rcv.at[i], to,
         )
         dq.start()
         ds.start()
         handles.append((dq, ds))
     for dq, ds in handles:
-        lang.quiet(dq, ds)
+        if ds is None:
+            lang.quiet(dq)
+        else:
+            lang.quiet(dq, ds)
     # the n//2-shifted inbound partner ships the same page count with
     # the same landing table, so waiting my own descriptors' recv side
-    # releases exactly when MY pool has page i + scales resident
+    # releases exactly when MY pool has tick i's pages + scales resident
     for dq, ds in handles:
         dq.wait_recv()
-        ds.wait_recv()
-    for i in range(pages):
-        slot = dstpg_ref[i]
+        if ds is not None:
+            ds.wait_recv()
+    for i in range(ticks):
+        slot = dstpg_ref[i * coalesce]
         wirelib.epilogue_consume(
-            dst_q.at[pl.ds(slot * rows, rows)],
-            dst_s.at[pl.ds(slot * rows, rows)],
+            dst_q.at[pl.ds(slot * rows, span)],
+            None if rail == "drop"
+            else dst_s.at[pl.ds(slot * rows, span)],
             None,
         )
 
@@ -179,23 +204,69 @@ def _kv_ship_kernel(
 KV_SHIP_GEOM = dict(pages=4, rows=8, cols=128)
 
 
+def coalesced_landing_table(pages: int, coalesce: int):
+    """A landing permutation every coalescing width can legally drive:
+    consecutive staged pages within a tick land at CONSECUTIVE slots
+    (one descriptor per tick needs one contiguous destination run),
+    while tick groups land reversed so the table stays a non-identity
+    permutation the contract must actually check. ``coalesce=1``
+    reproduces the classic fully-reversed lint table."""
+    ticks = pages // coalesce
+    return [
+        p
+        for blk in reversed(range(ticks))
+        for p in range(blk * coalesce, (blk + 1) * coalesce)
+    ]
+
+
+def coalesced_landing_ok(table, coalesce: int) -> bool:
+    """True when ``table`` assigns each ``coalesce``-page tick a
+    contiguous ascending slot run — the host-side legality check a
+    production launch must pass before running a coalesced schedule."""
+    table = [int(x) for x in table]
+    if coalesce <= 1:
+        return True
+    if len(table) % coalesce:
+        return False
+    for t in range(0, len(table), coalesce):
+        base = table[t]
+        if table[t:t + coalesce] != list(range(base, base + coalesce)):
+            return False
+    return True
+
+
 @functools.lru_cache(maxsize=32)
-def _build_kv_ship(mesh, axis, pages, rows, cols, collective_id, token=()):
+def _build_kv_ship(mesh, axis, pages, rows, cols, collective_id, token=(),
+                   schedule=None):
     """Construct the page-ship kernel via ``shmem_call`` (the LaunchSpec
     capture the analyzer and the Mosaic pre-flight read back). The
     dev-box serving engines ride the XLA transports; this is the
-    ICI-role-split fast path and the family's analyzable body."""
+    ICI-role-split fast path and the family's analyzable body.
+
+    ``schedule``: an optional :class:`tune.schedule.GridSchedule` whose
+    ``coalesce`` (pages per tick descriptor) and ``rail`` (scale-plane
+    semaphore placement) knobs this builder threads into the kernel;
+    None ≡ the default schedule, byte-identical to the pre-schedule
+    per-page dual-rail ship."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     del token
+    coalesce = 1 if schedule is None else int(schedule.coalesce)
+    rail = "paired" if schedule is None else str(schedule.rail)
+    if pages % coalesce:
+        raise ValueError(
+            f"kv_ship: coalesce={coalesce} does not divide the staged "
+            f"page count {pages}"
+        )
     n = mesh.shape[axis]
-    nsem = max(pages, 1)
+    nsem = max(pages // coalesce, 1)
     return lang.shmem_call(
         functools.partial(
-            _kv_ship_kernel, n, axis, mesh.axis_names, pages, rows
+            _kv_ship_kernel, n, axis, mesh.axis_names, pages, rows,
+            coalesce, rail,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((pages * rows, cols), jnp.int8),
@@ -216,12 +287,14 @@ def _build_kv_ship(mesh, axis, pages, rows, cols, collective_id, token=()):
     )
 
 
-def build_lint_kernel(mesh, n, token=()):
+def build_lint_kernel(mesh, n, token=(), schedule=None):
     """The registry/pre-flight entry: construct the ship kernel at
     :data:`KV_SHIP_GEOM` exactly as production would (the partner
-    rotation is baked from the mesh's rank count)."""
+    rotation is baked from the mesh's rank count). ``schedule`` threads
+    a grid schedule through to the kernel (see :func:`_build_kv_ship`)."""
     del n                                  # read from the mesh
     g = KV_SHIP_GEOM
     return _build_kv_ship(
         mesh, "x", g["pages"], g["rows"], g["cols"], 14, token,
+        schedule=schedule,
     )

@@ -553,6 +553,51 @@ def ragged_paged_attention_xla(
 LINT_GEOM = dict(r=2, pps=2, npages=4, t=16, hkv=2, g=1, d=128, page=8,
                  block_q=8)
 
+#: parking-zone slack the GRID lint geometry reserves past each row's
+#: packed span — the widest block_q a legal candidate may write into it.
+#: A schedule whose block overruns even this slack spills into the next
+#: row's delivered span (OOB on the zero-slack gate buffer → SL008).
+GRID_BLOCK_CAP = 16
+
+
+def grid_lint_geom(schedule=None) -> dict:
+    """The :data:`LINT_GEOM`-shaped geometry a grid schedule gates at:
+    the packing granularity ``pack_rows`` sets the per-row span, the
+    schedule's ``block_q`` (0 = the :func:`auto_block_q` ladder) sets
+    the query block, and the packed width reserves exactly
+    ``min(block_q, GRID_BLOCK_CAP)`` tokens of tail slack — so the
+    default schedule reproduces :data:`LINT_GEOM` exactly (byte-
+    identity pin) while an over-wide block has nowhere legal to park
+    its writes."""
+    g = 1
+    pack = 8 if schedule is None else int(schedule.pack_rows)
+    bq = 0 if schedule is None else int(schedule.block_q)
+    bq = bq or auto_block_q(pack, g)
+    page = 8
+    t = pack + min(bq, GRID_BLOCK_CAP)
+    kv0 = pack + 4                        # row 0 crosses a page boundary
+    pps = -(-kv0 // page)
+    return dict(
+        r=2, pps=pps, npages=2 * pps, t=t, hkv=2, g=g, d=128, page=page,
+        block_q=bq, n_bufs=2 if schedule is None else int(schedule.n_bufs),
+        kv_lens=(kv0, pack), q_lens=(pack, pack), q_starts=(0, pack),
+    )
+
+
+def build_grid_lint_kernel(token=(), schedule=None, quant=True):
+    """Grid-schedule gate entry: construct the ragged kernel at
+    :func:`grid_lint_geom` with the schedule's ``block_q``/``n_bufs``
+    threaded through the production builder. Returns the geometry dict
+    so the gate can derive matching input shapes and scalar-prefetch
+    init values."""
+    gm = grid_lint_geom(schedule)
+    _build_ragged(
+        gm["r"], gm["pps"], gm["npages"], gm["t"], gm["hkv"], gm["g"],
+        gm["d"], gm["page"], gm["block_q"], "float32", quant,
+        1.0 / math.sqrt(gm["d"]), 0.0, gm["n_bufs"], False, token,
+    )
+    return gm
+
 
 def build_lint_kernel(token=(), quant=True):
     """Construct the ragged kernel exactly as production would (via

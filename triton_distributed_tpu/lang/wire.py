@@ -401,6 +401,85 @@ def dequant_add_pipeline(rows: int, cols: int, fmt: WireFormat):
     return run
 
 
+def dequant_add_requant_pipeline(rows: int, cols: int, fmt: WireFormat):
+    """Fused RS-ring fold + wire requantize:
+    ``dst = a + dequant(q, s)`` AND ``(wq, ws) = quant(dst)`` with the
+    wire scale taken off the fold accumulator — the reduce ring's next
+    hop must ship the ACCUMULATED partial, so a producer-quantized wire
+    (gemm_rs int8-MXU) re-quantizes here, in the fold pass itself,
+    instead of a separate ``quant_pipeline`` read-back over HBM (the
+    fold writes dst + the scale row in one pass; only the payload
+    quantize re-reads dst — one slab read saved per hop)."""
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.experimental import pallas as pl
+
+    ch = fmt.chunks(rows)
+    qmax = fmt.qmax
+    bn = _wire_cols_block(cols, fmt.wire_dtype.itemsize)
+
+    def fold_inner(a_ref, q_ref, s_ref, o_ref, ws_ref):
+        # (1, 1) scale window → sublane+lane broadcast over the full
+        # chunk (the scale row is lane-replicated; mm_q8_rs_pipeline's
+        # ``as_ref[:, :1]`` idiom)
+        t = (a_ref[...].astype(jnp.float32)
+             + q_ref[...].astype(jnp.float32) * s_ref[:, :1])
+        o_ref[...] = t.astype(o_ref.dtype)
+        row = jnp.max(jnp.abs(t), axis=1, keepdims=True)
+        chunk = jnp.max(row, axis=0, keepdims=True)
+        ws_ref[...] = jnp.broadcast_to(
+            jnp.maximum(chunk, 1e-12) / qmax, (1, SCALE_LANES)
+        ).astype(jnp.float32)
+
+    fold_pipe = pltpu.emit_pipeline(
+        fold_inner,
+        grid=(ch,),
+        in_specs=[
+            pl.BlockSpec((fmt.chunk_rows, cols), lambda i: (i, 0)),
+            pl.BlockSpec((fmt.chunk_rows, cols), lambda i: (i, 0)),
+            pl.BlockSpec((1, SCALE_LANES), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((fmt.chunk_rows, cols), lambda i: (i, 0)),
+            pl.BlockSpec((1, SCALE_LANES), lambda i: (i, 0)),
+        ],
+    )
+
+    def quant_inner(src_ref, s_ref, q_ref):
+        y = src_ref[...].astype(jnp.float32) / s_ref[:, :bn]
+        if fmt.quant == "int8":
+            y = jnp.clip(jnp.round(y), -127, 127)
+        q_ref[...] = y.astype(q_ref.dtype)
+
+    quant_pipe = pltpu.emit_pipeline(
+        quant_inner,
+        grid=(ch, cols // bn),
+        in_specs=[
+            pl.BlockSpec((fmt.chunk_rows, bn), lambda i, j: (i, j)),
+            pl.BlockSpec((1, SCALE_LANES), lambda i, j: (i, 0)),
+        ],
+        out_specs=[pl.BlockSpec((fmt.chunk_rows, bn), lambda i, j: (i, j))],
+    )
+
+    def run(a_hbm, q_hbm, s_hbm, dst_hbm, wq_hbm, ws_hbm):
+        rec = _lint_recorder()
+        if rec is not None:
+            from triton_distributed_tpu.analysis import events as ev
+
+            rec.emit(ev.DequantEvent(
+                q_region=q_hbm.region(), s_region=s_hbm.region(),
+                dst_region=dst_hbm.region(), add_region=a_hbm.region(),
+            ))
+            rec.emit(ev.QuantEvent(
+                src_region=dst_hbm.region(), q_region=wq_hbm.region(),
+                s_region=ws_hbm.region(), chunk_rows=fmt.chunk_rows,
+            ))
+            return
+        fold_pipe(a_hbm, q_hbm, s_hbm, dst_hbm, ws_hbm)
+        quant_pipe(dst_hbm, ws_hbm, wq_hbm)
+
+    return run
+
+
 # ------------------------------------------------- VMEM-resident helpers
 #
 # The standalone ring kernels (allgather._ring_ag_kernel_w,

@@ -195,7 +195,7 @@ class RaggedPagedAttention:
     use_pallas: bool = True
 
     def __call__(self, qp, k_pool, v_pool, kv_lens, q_lens, q_starts,
-                 block_table, *, block_q: int = 8):
+                 block_table, *, block_q: int = 8, n_bufs: int = 2):
         """qp: (Hkv, T·G, D) packed rows sharded P(axis) on dim 0;
         k_pool/v_pool: (npages, Hkv, page, D) arrays or int8
         ``{"q","scale"}`` dicts, sharded P(None, axis); metadata
@@ -217,6 +217,7 @@ class RaggedPagedAttention:
             kw = dict(group=g, scale=self.scale, soft_cap=self.soft_cap)
             if use_pallas:
                 kw["block_q"] = block
+                kw["n_bufs"] = n_bufs
             if quant:
                 kq, ks, vq, vs = pools
                 out, _ = fn(qp, kq, vq, kv_lens, q_lens, q_starts,

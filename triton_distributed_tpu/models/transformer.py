@@ -1441,7 +1441,7 @@ class Transformer:
         )
 
     def _ragged_attn(self, qp, k_pool, v_pool, state, q_lens, q_starts,
-                     block_q, use_pallas):
+                     block_q, use_pallas, n_bufs=2):
         """One layer's ragged paged attention over the (updated) pools
         via the head-sharded serving layer. qp: (Hkv, T·G, D) packed
         GQA rows (already holding this step's tokens in the pools —
@@ -1455,13 +1455,13 @@ class Transformer:
         )
         return layer(
             qp, k_pool, v_pool, state.kv_lens, q_lens, q_starts,
-            state.block_table, block_q=block_q,
+            state.block_table, block_q=block_q, n_bufs=n_bufs,
         )
 
     def serving_step(self, params, state, tokens, token_rows, token_pos,
                      q_starts, q_lens, moe_state=None, *,
                      block_q: int = 8, use_pallas: bool = True,
-                     all_logits: bool = False):
+                     n_bufs: int = 2, all_logits: bool = False):
         """One CONTINUOUS-BATCHING step: a ragged mixed batch of prefill
         chunks and decode tokens through every layer in one program.
 
@@ -1557,7 +1557,7 @@ class Transformer:
             )
             o = self._ragged_attn(
                 qp, kp, vp, state.replace(layers=()), q_lens, q_starts,
-                block_q, use_pallas,
+                block_q, use_pallas, n_bufs,
             )
             o = unpack_gqa_rows(o, c.n_heads).reshape(t, c.q_dim)
             x = x + self._dmm(o.astype(c.dtype), blk["wo"])
@@ -1614,13 +1614,14 @@ class Transformer:
         # donate the ServingState (pool append aliases in place — the
         # same discipline as the decode jits) and the LL MoE workspaces
         @functools.partial(
-            jax.jit, static_argnums=(8, 9), donate_argnums=(1, 7)
+            jax.jit, static_argnums=(8, 9, 10), donate_argnums=(1, 7)
         )
         def step(params, state, tokens, token_rows, token_pos, q_starts,
-                 q_lens, moe_state, block_q, use_pallas):
+                 q_lens, moe_state, block_q, use_pallas, n_bufs=2):
             return self.serving_step(
                 params, state, tokens, token_rows, token_pos, q_starts,
                 q_lens, moe_state, block_q=block_q, use_pallas=use_pallas,
+                n_bufs=n_bufs,
             )
 
         return step
@@ -1633,14 +1634,14 @@ class Transformer:
         # verify row's distribution after each draft token. Same
         # donation discipline as `_serving_jit`.
         @functools.partial(
-            jax.jit, static_argnums=(8, 9), donate_argnums=(1, 7)
+            jax.jit, static_argnums=(8, 9, 10), donate_argnums=(1, 7)
         )
         def step(params, state, tokens, token_rows, token_pos, q_starts,
-                 q_lens, moe_state, block_q, use_pallas):
+                 q_lens, moe_state, block_q, use_pallas, n_bufs=2):
             return self.serving_step(
                 params, state, tokens, token_rows, token_pos, q_starts,
                 q_lens, moe_state, block_q=block_q, use_pallas=use_pallas,
-                all_logits=True,
+                n_bufs=n_bufs, all_logits=True,
             )
 
         return step

@@ -138,6 +138,26 @@ class EngineStats:
     # adaptive drafter k (spec.py adaptive_k=True): verify rows planned
     # at each per-request draft budget k — empty on fixed-k engines
     adaptive_k_rows: dict = field(default_factory=dict)
+    # per-shape-key step-time ledger: grid-schedule traffic key
+    # (slots, t_pad, hkv, g, d, page) -> [count, total_ms, max_pages].
+    # tune.traffic re-searches the hot keys after a run and persists
+    # winners the next engine build resolves.
+    shape_ledger: dict = field(default_factory=dict)
+
+    def note_shape(self, key, ms: float, pages: int) -> None:
+        """Record one step against its grid-schedule shape key."""
+        ent = self.shape_ledger.setdefault(tuple(key), [0, 0.0, 0])
+        ent[0] += 1
+        ent[1] += float(ms)
+        ent[2] = max(ent[2], int(pages))
+
+    def hot_shape_keys(self, top: int = 4) -> list:
+        """Shape keys ranked by total step time spent in them —
+        the keys worth paying a schedule search for."""
+        ranked = sorted(
+            self.shape_ledger.items(), key=lambda kv: -kv[1][1]
+        )
+        return [k for k, _ in ranked[:max(0, int(top))]]
 
     @property
     def total_time(self) -> float:
@@ -256,7 +276,8 @@ class ServingEngine:
     def __init__(self, model, params, cfg: EngineConfig, *,
                  moe_state="auto", use_pallas: bool = True,
                  on_complete=None, health=None,
-                 health_peer: str = "site:serving_step"):
+                 health_peer: str = "site:serving_step",
+                 grid_schedule=None):
         import jax.numpy as jnp
 
         from triton_distributed_tpu.runtime.health import HealthLedger
@@ -304,6 +325,30 @@ class ServingEngine:
         # their garbage writes there, where no valid span can be
         # clobbered by the kernel's sequential out DMAs
         self._t_pad = cfg.token_budget + self._block_q_cap
+        # grid-schedule resolution (explicit > stored > default): the
+        # traffic key this engine's every step lands on. A winner
+        # persisted by tune.traffic after an earlier run is picked up
+        # here on the next build — no search on the serving path.
+        from triton_distributed_tpu.tune.schedule import (
+            GRID_DEFAULT,
+            resolve_schedule,
+        )
+
+        c = model.config
+        self._grid_key = (cfg.slots, self._t_pad, c.n_kv_heads, g,
+                          c.head_dim, cfg.page)
+        sched = resolve_schedule(
+            "flash_decode.ragged_paged", self._grid_key, (model.tp,),
+            "int8" if c.kv_quant is not None else None, grid_schedule,
+        )
+        if getattr(sched, "kind", "ring") != "grid":
+            sched = GRID_DEFAULT      # stale ring entry: ignore
+        self.grid_schedule = sched
+        self._n_bufs = int(sched.n_bufs)
+        # tuned block_q is a FLOOR under the parking-zone cap: the
+        # packed array always carries block_q_cap parking tokens, so
+        # any block_q <= cap keeps garbage writes inside the zone
+        self._block_q_floor = int(sched.block_q)
         # LL MoE workspaces sized to the PACKED step width (None when
         # the model has no fused-transport EP layers)
         self.moe_state = (
@@ -569,7 +614,7 @@ class ServingEngine:
             self.params, state, jnp.asarray(tokens),
             jnp.asarray(token_rows), jnp.asarray(token_pos),
             jnp.asarray(q_starts), jnp.asarray(q_lens),
-            self.moe_state, block_q, self.use_pallas,
+            self.moe_state, block_q, self.use_pallas, self._n_bufs,
         )
         if self.moe_state is None:
             logits, self.state = out
@@ -593,6 +638,9 @@ class ServingEngine:
             self.step_count += 1
             return report
         block_q = auto_block_q(int(q_lens.max()), self._g)
+        # tuned floor (grid schedule): never past the parking-zone cap
+        block_q = min(self._block_q_cap,
+                      max(block_q, self._block_q_floor))
         from triton_distributed_tpu.runtime.health import PeerState
 
         peer = self.health_peer
@@ -660,6 +708,10 @@ class ServingEngine:
         self.stats.step_times.append(dt)
         self.stats.step_tokens.append(int(q_lens.sum()))
         self.stats.step_generated.append(gen_this_step)
+        self.stats.note_shape(
+            self._grid_key, dt * 1e3,
+            self.cfg.npages - self.pool.available,
+        )
         self.stats.prefill_tokens += prefill_this_step
         report.update(
             ms=round(dt * 1e3, 3), generated=gen_this_step,

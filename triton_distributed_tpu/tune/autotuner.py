@@ -501,3 +501,112 @@ def search_ring_schedule(
         "default_ms": default_ms, "rejected": rejected,
         "timed": timed, "candidates": len(legal) + len(rejected),
     }
+
+
+def search_grid_schedule(
+    family: str,
+    *,
+    shape,
+    mesh_shape,
+    wire: str | None = None,
+    n: int | None = None,
+    dryrun: bool = False,
+    top_k: int = 2,
+    time_fn=None,
+    force: bool = False,
+):
+    """Schedule-space search for one grid family (``GridSchedule`` IR).
+
+    Same oracle discipline as :func:`search_ring_schedule` — enumerate
+    the family's freedom set plus its known-illegal mutations, reject
+    through shmemlint + Mosaic preflight, price the clean survivors on
+    the family's traffic shape key, optionally time the top-k, persist
+    the winner under ``(family, shape, mesh, wire_dtype)``. The search
+    RAISES if the oracle rejected nothing: a gate that cannot reject is
+    not a gate, and a dead gate silently blesses every candidate.
+
+    ``shape`` is the grid family's traffic key, not a ring slab:
+    ragged ``(slots, t_pad, hkv, g, d, page)``, kv_ship
+    ``(layers, pages, hkv, d, page)``, gemm_rs ``(m, k, n_out)``.
+    A persisted winner short-circuits with ``cached=True`` at zero
+    search cost.
+    """
+    from triton_distributed_tpu.tune import schedule as sched_lib
+
+    if not sched_lib.is_grid_family(family):
+        raise ValueError(
+            f"{family!r} is not a grid family "
+            f"(grid families: {sched_lib.grid_families()})"
+        )
+    n = int(n if n is not None else int(np.prod(mesh_shape)))
+    shape = tuple(int(x) for x in shape)
+
+    def _price(s):
+        return sched_lib.price_schedule(
+            family, s, rows=shape[0], cols=shape[-1], n=n, wire=wire,
+            shape=shape,
+        )
+
+    if not force:
+        cached = sched_lib.load_schedule(
+            family, shape, tuple(int(x) for x in mesh_shape),
+            None if wire is None else str(wire),
+        )
+        if cached is not None and getattr(cached, "kind", "ring") == "grid":
+            return {
+                "family": family, "cached": True,
+                "winner": cached.to_dict(),
+                "winner_ms": _price(cached),
+                "default_ms": _price(sched_lib.GRID_DEFAULT),
+                "rejected": [], "timed": 0, "candidates": 0,
+            }
+
+    legal, rejected = [], []
+    for cand in sched_lib.enumerate_schedules(family, include_mutations=True):
+        findings = sched_lib.check_schedule(family, cand, n)
+        if findings:
+            rejected.append(
+                (cand.to_dict(), sorted({f.rule for f in findings}))
+            )
+        else:
+            legal.append(cand)
+    if not legal:
+        raise RuntimeError(
+            f"schedule search {family!r}: no lint-clean candidate "
+            f"(rejections: {[r for _, r in rejected]})"
+        )
+    if not rejected:
+        raise RuntimeError(
+            f"schedule search {family!r}: the oracle rejected nothing — "
+            "the legality gate is not wired"
+        )
+
+    priced = sorted(legal, key=_price)
+    timed = 0
+    winner = priced[0]
+    if time_fn is not None and not dryrun:
+        best_ms, best = float("inf"), None
+        for cand in priced[:max(1, int(top_k))]:
+            try:
+                ms = float(time_fn(cand))
+            except Exception:
+                traceback.print_exc()
+                continue
+            timed += 1
+            if ms < best_ms:
+                best_ms, best = ms, cand
+        if best is not None:
+            winner = best
+
+    default_ms = _price(sched_lib.GRID_DEFAULT)
+    winner_ms = _price(winner)
+    key = sched_lib.store_schedule(
+        family, shape, mesh_shape, wire, winner,
+        price_ms=winner_ms, default_ms=default_ms,
+    )
+    return {
+        "family": family, "cached": False, "key": key,
+        "winner": winner.to_dict(), "winner_ms": winner_ms,
+        "default_ms": default_ms, "rejected": rejected,
+        "timed": timed, "candidates": len(legal) + len(rejected),
+    }

@@ -24,8 +24,21 @@ makes the choice a VALUE:
   carry their rule IDs.
 * :func:`store_schedule` / :func:`load_schedule` — the flock'd winner
   store keyed by ``(family, shape, mesh, wire_dtype)``. Resolve paths
-  load with zero search cost; only the autotuner search mode
-  (``tune.autotuner.search_ring_schedule``) ever writes.
+  load with zero search cost; only the autotuner search modes
+  (``tune.autotuner.search_ring_schedule`` /
+  ``search_grid_schedule``) ever write.
+
+Alongside the ring IR lives :class:`GridSchedule` — the same
+schedule-as-data discipline for the GRID kernels that are not rings:
+the ragged paged-attention walk (``block_q`` ladder, page-walk
+double-buffer depth, GQA packing granularity), the kv_ship page
+transport (per-tick coalescing width, scale-rail placement) and the
+GEMM-RS int8-MXU producer epilogue (quantize-off-accumulator vs
+readback requantize, partial-tile demotion policy). The grid families
+share the enumerator, the oracle, the pricer dispatch and the store;
+:data:`GRID_DEFAULT` replays today's baked-in kernels byte-identically
+(test-pinned), and the serving engines resolve traffic-tuned grid
+winners through the very same :func:`resolve_schedule` hook.
 
 No devices are required anywhere here: the gate runs on an
 ``AbstractMesh`` exactly like ``analysis.lint``.
@@ -44,9 +57,15 @@ import numpy as np
 
 _F32 = np.dtype(np.float32)
 _I8 = np.dtype(np.int8)
+_I32 = np.dtype(np.int32)
 
-#: schema version of the persisted schedule store
-_STORE_VERSION = 1
+#: schema version of the persisted schedule store. v1 stored ring-only
+#: entries under a "v" header; v2 writes a "schema_version" header and
+#: tags every entry with its schedule ``kind`` ("ring" | "grid") so the
+#: loader can pick the right IR class. v1 stores are migrated on read
+#: (every pre-grid entry IS a ring entry); unknown versions are ignored
+#: cleanly rather than KeyError-ing on a schedule kind they predate.
+_STORE_VERSION = 2
 
 #: fields a schedule serializes (stable order for the store)
 _FIELDS = ("chunk_order", "direction", "split8", "depth", "scale_rail",
@@ -89,6 +108,11 @@ class RingSchedule:
         kernel twin).
     """
 
+    #: schedule-kind tag (a class attr, not a field — never serialized;
+    #: kernels duck-type on this so a schedule built when this module
+    #: runs as ``__main__`` still dispatches correctly)
+    kind = "ring"
+
     chunk_order: str = "ring"
     direction: str = "fwd"
     split8: int = 4
@@ -109,6 +133,83 @@ class RingSchedule:
 
 #: the canonical default — byte-identical to the pre-schedule rings
 DEFAULT = RingSchedule()
+
+
+#: fields a grid schedule serializes (stable order for the store)
+_GRID_FIELDS = ("block_q", "n_bufs", "pack_rows", "coalesce", "rail",
+                "epilogue", "demote")
+
+
+@dataclass(frozen=True)
+class GridSchedule:
+    """One executable grid-kernel schedule — the non-ring families'
+    schedule IR (ragged paged attention, kv_ship, the GEMM-RS int8-MXU
+    epilogue). One dataclass covers all three; each family's freedom
+    set only varies its own knobs and leaves the rest at the default.
+
+    ``block_q``
+        Ragged-attention query block rows. 0 means the runtime
+        ``auto_block_q`` ladder (today's behavior); an explicit value
+        pins the block (the engine applies it as a FLOOR, capped at
+        the chunk-derived parking-zone width). An over-wide pin makes
+        the out-DMA overrun the packed span's parking zone — only the
+        local delivery contract can see it (SL008, via the evaluator's
+        out-of-bounds events).
+    ``n_bufs``
+        Page-walk double-buffer depth: VMEM page landing slots the KV
+        fetch rotates through. 2 is today's double buffer; 3 hides one
+        more page fetch behind the flash inner loop.
+    ``pack_rows``
+        GQA packing granularity — the row alignment the engine packs
+        request spans to. Gate-geometry knob: widening it moves the
+        lint packing off the zero-slack layout, so its interaction
+        with ``block_q`` is exactly what the oracle must re-check.
+    ``coalesce``
+        kv_ship pages per tick descriptor: 1 is the classic per-page
+        dual-rail ship; wider ticks amortize descriptor issue but are
+        only legal when the landing table gives each tick a contiguous
+        slot run (``kv_ship.coalesced_landing_ok``).
+    ``rail``
+        kv_ship scale-plane placement: ``"paired"`` — own semaphores
+        (legal); ``"shared"`` — the payload's semaphores (torn-scale
+        hazard, SL009); ``"drop"`` — no scale rail at all (landed
+        pages stay raw quantized bytes, SL009).
+    ``epilogue``
+        GEMM-RS int8-MXU producer epilogue: ``"accumulator"`` folds
+        the wire quantization off the s32 accumulator (today's fused
+        epilogue); ``"readback"`` writes the dequantized partial tile
+        and re-quantizes it through the generic wire pipeline — an
+        extra VMEM pass the pricer charges per reduce hop.
+    ``demote``
+        Partial-tile policy when the int8-MXU layout does not divide
+        the local geometry: ``"auto"`` demotes to the eager int8 wire
+        (today's behavior), ``"strict"`` refuses to build instead.
+    """
+
+    #: schedule-kind tag (class attr — see :class:`RingSchedule`)
+    kind = "grid"
+
+    block_q: int = 0
+    n_bufs: int = 2
+    pack_rows: int = 8
+    coalesce: int = 1
+    rail: str = "paired"
+    epilogue: str = "accumulator"
+    demote: str = "auto"
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "GridSchedule":
+        return cls(**{k: d[k] for k in _GRID_FIELDS if k in d})
+
+    def is_default(self) -> bool:
+        return self == GRID_DEFAULT
+
+
+#: the canonical grid default — byte-identical to the baked-in kernels
+GRID_DEFAULT = GridSchedule()
 
 
 # ------------------------------------------------------------ freedom sets
@@ -161,37 +262,88 @@ _MUTATIONS: dict = {
     "grad_ring.stream_int8w": (dict(scale_rail="payload"),),
 }
 
+#: grid-family freedom sets — same proposer/oracle split as the rings.
+#: block_q=0 is the auto ladder; 8/16 pin the block. The (block_q=8,
+#: pack_rows=16) combo is a LEGITIMATE oracle rejection (a 16-row pack
+#: with an 8-row block leaves coverage holes — SL008): the freedom
+#: product is allowed to contain illegal corners; the gate prunes them.
+_GRID_FREEDOMS: dict = {
+    "flash_decode.ragged_paged": dict(
+        block_q=(0, 8, 16),
+        n_bufs=(2, 3),
+        pack_rows=(8, 16),
+    ),
+    "kv_ship.pages": dict(
+        coalesce=(1, 2),
+    ),
+    "gemm_rs.mx_epilogue": dict(
+        epilogue=("accumulator", "readback"),
+    ),
+}
+
+#: deliberately illegal grid mutations — the oracle's test diet
+_GRID_MUTATIONS: dict = {
+    # block wider than the parking zone: the out-DMA runs past the
+    # packed span's tail pad — OOB events → SL008
+    "flash_decode.ragged_paged": (dict(block_q=32),),
+    # a coalesced tick that ships no scale rail (raw-bytes install),
+    # and the scale rail signalling the payload's semaphores — SL009
+    "kv_ship.pages": (dict(coalesce=2, rail="drop"),
+                      dict(rail="shared")),
+    # the producer's wire scales on the payload semaphore — SL009
+    "gemm_rs.mx_epilogue": (dict(rail="shared"),),
+}
+
 
 def searchable_families() -> tuple:
-    return tuple(sorted(_FREEDOMS))
+    return tuple(sorted(set(_FREEDOMS) | set(_GRID_FREEDOMS)))
+
+
+def grid_families() -> tuple:
+    return tuple(sorted(_GRID_FREEDOMS))
+
+
+def is_grid_family(family: str) -> bool:
+    return family in _GRID_FREEDOMS
+
+
+def default_for(family: str):
+    """The family's canonical default schedule value."""
+    return GRID_DEFAULT if family in _GRID_FREEDOMS else DEFAULT
 
 
 def enumerate_schedules(family: str, *, include_mutations: bool = False):
     """All candidate schedules in ``family``'s freedom set (the default
     always first), optionally extended with the family's deliberately
-    illegal one-field mutations."""
-    free = _FREEDOMS[family]
+    illegal one-field mutations. Dispatches on the family kind: grid
+    families enumerate :class:`GridSchedule` values off
+    :data:`GRID_DEFAULT`, ring families :class:`RingSchedule` values."""
+    grid = family in _GRID_FREEDOMS
+    free = _GRID_FREEDOMS[family] if grid else _FREEDOMS[family]
+    base = GRID_DEFAULT if grid else DEFAULT
+    muts = _GRID_MUTATIONS if grid else _MUTATIONS
     keys = sorted(free)
     seen, out = set(), []
     for combo in itertools.product(*(free[k] for k in keys)):
-        s = replace(DEFAULT, **dict(zip(keys, combo)))
+        s = replace(base, **dict(zip(keys, combo)))
         if s not in seen:
             seen.add(s)
             out.append(s)
     out.sort(key=lambda s: not s.is_default())   # default first
     if include_mutations:
-        for m in _MUTATIONS[family]:
-            s = replace(DEFAULT, **m)
+        for m in muts[family]:
+            s = replace(base, **m)
             if s not in seen:
                 seen.add(s)
                 out.append(s)
     return out
 
 
-def mutate(schedule: RingSchedule, family: str):
+def mutate(schedule, family: str):
     """The family's illegal one-field mutations of ``schedule`` — what
     the search feeds the oracle to prove the gate is alive."""
-    return [replace(schedule, **m) for m in _MUTATIONS[family]]
+    muts = _GRID_MUTATIONS if family in _GRID_FREEDOMS else _MUTATIONS
+    return [replace(schedule, **m) for m in muts[family]]
 
 
 # ------------------------------------------------------------ legality gate
@@ -325,6 +477,106 @@ def _gate_grad_ring(schedule, n, mesh):
             DeliveryContract(kind="reduce", dst="out_hbm"), "grad_ring")
 
 
+def _gate_ragged_grid(schedule, n, mesh):
+    """The ragged paged-attention grid gate: build through the real
+    ``_build_ragged`` at the schedule-derived lint geometry (the packed
+    span tracks ``pack_rows``/``block_q`` so zero-slack coverage is
+    preserved for every LEGAL combo, and an over-wide block overruns
+    the parking zone — SL008 via the evaluator's OOB events). A LOCAL
+    family: the mesh only sets how many identical ranks replay."""
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.ragged_paged_attention import (
+        build_grid_lint_kernel,
+    )
+
+    del mesh
+    gm = build_grid_lint_kernel(
+        token=("schedule-gate", next(_TOKENS)), schedule=schedule,
+    )
+    pool = (gm["npages"], gm["hkv"], gm["page"], gm["d"])
+    shapes = [
+        ((gm["r"], gm["pps"]), _I32),                   # block table
+        ((gm["r"],), _I32),                             # kv_lens
+        ((gm["r"],), _I32),                             # q_lens
+        ((gm["r"],), _I32),                             # q_starts
+        ((gm["hkv"], gm["t"] * gm["g"], gm["d"]), _F32),  # packed q
+        (pool, _I8),                                    # k pool
+        (pool, _I8),                                    # v pool
+        ((gm["npages"], gm["hkv"], 1, gm["page"]), _F32),  # k scales
+        ((gm["npages"], gm["hkv"], 1, gm["page"]), _F32),  # v scales
+    ]
+    init = {
+        0: np.arange(gm["r"] * gm["pps"], dtype=np.int32).reshape(
+            gm["r"], gm["pps"]
+        ),
+        1: np.asarray(gm["kv_lens"], np.int32),
+        2: np.asarray(gm["q_lens"], np.int32),
+        3: np.asarray(gm["q_starts"], np.int32),
+    }
+    return ("ragged_paged_attention_q8", (lambda _n: shapes),
+            DeliveryContract(kind="local", dst=9), "ragged_paged", init)
+
+
+def _gate_kv_ship_grid(schedule, n, mesh):
+    """The kv_ship grid gate: the real page-ship builder with the
+    candidate's coalescing width and rail placement, against the
+    registry's pairwise permute contract — the landing table is the
+    coalesce-legal permutation (contiguous slot run per tick)."""
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.kv_ship import (
+        KV_SHIP_GEOM,
+        build_lint_kernel,
+        coalesced_landing_table,
+    )
+
+    g = KV_SHIP_GEOM
+    build_lint_kernel(
+        mesh, n, token=("schedule-gate", next(_TOKENS)), schedule=schedule,
+    )
+    rows = g["pages"] * g["rows"]
+    shapes = [
+        ((g["pages"],), _I32),               # landing page table (SMEM)
+        ((rows, g["cols"]), _I8),            # staged page payload
+        ((rows, 128), _F32),                 # per-row scale planes
+    ]
+    init = {0: np.asarray(
+        coalesced_landing_table(g["pages"], int(schedule.coalesce)),
+        np.int32,
+    )}
+    elems = g["pages"] * g["rows"] * g["cols"]
+    return ("kv_ship_pages", (lambda _n: shapes),
+            DeliveryContract(
+                kind="permute", dst="dst_q",
+                payload_per_src=lambda _n: elems,
+                src_only=lambda rank, nn: {(rank - nn // 2) % nn},
+            ), "kv_ship", init)
+
+
+def _gate_gemm_rs_mx(schedule, n, mesh):
+    """The GEMM-RS int8-MXU epilogue gate: the real fused builder on
+    the MXU wire with the candidate's epilogue placement threaded
+    through — accumulator-fold and readback-requantize both launch
+    under the same name, so one gate covers the whole freedom axis."""
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.gemm_rs import _build_fused
+    from triton_distributed_tpu.lang import wire as wirelib
+
+    import jax.numpy as jnp
+
+    _build_fused(
+        mesh, "x", (), (16 * n, 128 * n), (128 * n, 64),
+        jnp.dtype(jnp.float32), jnp.dtype(jnp.float32), 6,
+        ("schedule-gate", next(_TOKENS)), wire="int8-mxu",
+        schedule=schedule,
+    )
+    # per-rank quantized operands: a column-sharded → aq (16n, 128)
+    # with one scale row per 16-row chunk; b row-sharded → bq (128, 64)
+    shapes = [((16 * n, 128), _I8), ((n, wirelib.SCALE_LANES), _F32),
+              ((128, 64), _I8), ((1, 64), _F32)]
+    return ("gemm_rs_fused_int8mxw", (lambda _n: shapes),
+            DeliveryContract(kind="reduce", dst="out_hbm"), "gemm_rs")
+
+
 _GATES: dict = {
     "ag_gemm.fused": _gate_ag_gemm,
     "gemm_rs.fused": _gate_gemm_rs,
@@ -333,22 +585,32 @@ _GATES: dict = {
     "reduce_scatter.stream": _gate_rs_stream,
     "cp.ring_attention": _gate_cp_ring,
     "grad_ring.stream_int8w": _gate_grad_ring,
+    "flash_decode.ragged_paged": _gate_ragged_grid,
+    "kv_ship.pages": _gate_kv_ship_grid,
+    "gemm_rs.mx_epilogue": _gate_gemm_rs_mx,
 }
 
 
-def check_schedule(family: str, schedule: RingSchedule, n: int = 8,
+def check_schedule(family: str, schedule, n: int = 8,
                    *, mosaic: bool = True):
     """The oracle: build ``family`` with ``schedule`` over an abstract
     ``n``-rank mesh, replay through shmemlint against the family's
     delivery contract, and (when the protocol is clean) Mosaic-preflight
     the trace. Returns the finding list — empty means the candidate may
-    be timed/cached; otherwise ``findings[i].rule`` names why not."""
+    be timed/cached; otherwise ``findings[i].rule`` names why not.
+
+    Gates return ``(launch, in_shapes, contract, site)`` — grid gates
+    whose replay needs concrete scalar-prefetch values (landing tables,
+    block tables, lengths) append a 5th ``init`` element, forwarded to
+    the analyzer exactly like the registry families' ``init`` hook."""
     from triton_distributed_tpu.analysis import lint, mosaic_compat
     from triton_distributed_tpu.analysis.findings import has_errors
     from triton_distributed_tpu.lang.launch import captured_launch
 
     mesh = lint.lint_mesh(n)
-    launch, in_shapes, contract, site = _GATES[family](schedule, n, mesh)
+    gate = _GATES[family](schedule, n, mesh)
+    launch, in_shapes, contract, site = gate[:4]
+    init = gate[4] if len(gate) > 4 else None
     spec = captured_launch(launch)
     if spec is None:
         raise RuntimeError(
@@ -358,7 +620,7 @@ def check_schedule(family: str, schedule: RingSchedule, n: int = 8,
     name = f"{family}[{schedule.to_dict()}]"
     _, findings = lint.analyze_spec(
         spec, in_shapes(n), n, kernel_name=name, site=site,
-        contract=contract,
+        contract=contract, init=init,
     )
     if mosaic and not has_errors(findings):
         findings = findings + mosaic_compat.preflight_spec(
@@ -369,15 +631,84 @@ def check_schedule(family: str, schedule: RingSchedule, n: int = 8,
 
 # ------------------------------------------------------------ perf pricing
 
-def price_schedule(family: str, schedule: RingSchedule, *, rows: int,
+#: default pricing shapes per grid family, used when the caller has no
+#: observed traffic key (the CI smoke): ragged (r, t, hkv, g, d, page);
+#: kv_ship (pages, page, hkv, d, n_layers); gemm_rs (m, k, n_out)
+_GRID_SMOKE_SHAPES: dict = {
+    "flash_decode.ragged_paged": (8, 128, 2, 4, 128, 16),
+    "kv_ship.pages": (16, 16, 2, 128, 4),
+    "gemm_rs.mx_epilogue": (2048, 1024, 1024),
+}
+
+
+def price_grid_schedule(family: str, schedule: GridSchedule, *, shape,
+                        n: int = 8, wire: str | None = None,
+                        spec=None) -> float:
+    """Perf-model price (ms) of a grid schedule on a traffic shape key.
+
+    The terms mirror what each knob actually buys: deeper page-walk
+    double buffering divides the per-page descriptor-issue stall the
+    flash loop cannot hide (``n_bufs - 1`` fetches in flight); an
+    explicit ``block_q``/``pack_rows`` pays its tail-pad token traffic;
+    kv_ship coalescing divides the per-tick issue count; the readback
+    epilogue pays one extra requantize VMEM pass per reduce hop."""
+    from triton_distributed_tpu.tune import perf_model as pm
+
+    del wire
+    spec = spec or pm.detect_spec()
+    shape = tuple(int(x) for x in shape)
+    if family == "flash_decode.ragged_paged":
+        r, t, hkv, g, d, page = shape[:6]
+        kv = [t] * r
+        bytes_ms = pm.ragged_page_walk_ms(kv, page, hkv, d, spec=spec,
+                                          quant=True, issue_ms=0.0)
+        pages = r * max(-(-t // page), 1)
+        issue = pm.measured_page_issue_ms()
+        ms = bytes_ms + pages * issue / max(1, int(schedule.n_bufs) - 1)
+        # a pinned block or a coarser pack pays its tail pad: wasted q
+        # rows are read, attended and written back (3 touches, bf16)
+        waste = r * g * (max(0, int(schedule.block_q) - 8)
+                         + max(0, int(schedule.pack_rows) - 8))
+        ms += waste * d * 2 * 3 / (spec.hbm_gbps * 1e9) * 1e3
+        return ms
+    if family == "kv_ship.pages":
+        pages, page, hkv, d, layers = shape[:5]
+        ms = pm.kv_ship_ms(pages, page, hkv, d, layers, quant=True,
+                           spec=spec)
+        ticks = -(-pages // max(1, int(schedule.coalesce)))
+        ms += layers * 2 * ticks * pm.measured_page_issue_ms()
+        return ms
+    if family == "gemm_rs.mx_epilogue":
+        m, k, n_out = shape[:3]
+        m_local = max(m // n, 1)
+        ms = pm.estimate_s8_gemm_ms(m_local, max(k // n, 1), n_out, spec)
+        if schedule.epilogue == "readback":
+            # the partial tile leaves the accumulator dequantized and is
+            # re-quantized through the generic wire pipeline — one extra
+            # VMEM pass per reduce hop rides the critical path
+            ms += (n - 1) * pm.dequant_pass_ms(m_local, n_out, 2, spec)
+        return ms
+    raise KeyError(family)
+
+
+def price_schedule(family: str, schedule, *, rows: int,
                    cols: int, itemsize: int = 4, n: int = 8,
-                   wire: str | None = None, spec=None) -> float:
+                   wire: str | None = None, spec=None,
+                   shape=None) -> float:
     """Perf-model price (ms) of running ``family`` under ``schedule`` on
     an (rows, cols) per-rank ring slab: the hop-critical-path wire term
     plus the dequant-placement term. Legality is NOT checked here — the
-    search gates first, prices second."""
+    search gates first, prices second. Grid families dispatch to
+    :func:`price_grid_schedule` on their traffic shape key (``shape``;
+    the family's smoke shape when the caller has none)."""
     from triton_distributed_tpu.tune import perf_model as pm
 
+    if family in _GRID_FREEDOMS:
+        return price_grid_schedule(
+            family, schedule,
+            shape=shape if shape is not None else _GRID_SMOKE_SHAPES[family],
+            n=n, wire=wire, spec=spec,
+        )
     spec = spec or pm.detect_spec()
     hops = n - 1
     if family == "allgather.ring_bidir":
@@ -427,10 +758,19 @@ def _read_store(path: str) -> dict:
             data = json.load(f)
     except (OSError, ValueError):
         return {}
-    if not isinstance(data, dict) or data.get("v") != _STORE_VERSION:
+    if not isinstance(data, dict):
         return {}
+    version = data.get("schema_version", data.get("v"))
     entries = data.get("entries")
-    return entries if isinstance(entries, dict) else {}
+    if not isinstance(entries, dict):
+        return {}
+    if version == 1:
+        # pre-grid ring-only store: every entry is a ring schedule
+        return {k: dict(e, kind="ring") for k, e in entries.items()
+                if isinstance(e, dict)}
+    if version != _STORE_VERSION:
+        return {}
+    return entries
 
 
 def store_schedule(family: str, shape, mesh_shape, wire_dtype,
@@ -447,6 +787,7 @@ def store_schedule(family: str, shape, mesh_shape, wire_dtype,
         entries = _read_store(path)
         entries[key] = {
             "family": family,
+            "kind": getattr(schedule, "kind", "ring"),
             "schedule": schedule.to_dict(),
             "price_ms": price_ms,
             "default_ms": default_ms,
@@ -454,23 +795,36 @@ def store_schedule(family: str, shape, mesh_shape, wire_dtype,
         }
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"v": _STORE_VERSION, "entries": entries}, f,
+            json.dump({"schema_version": _STORE_VERSION,
+                       "entries": entries}, f,
                       indent=1, sort_keys=True)
         os.replace(tmp, path)
     load_schedule.cache_clear()
     return key
 
 
-def _load_entry(key: str) -> dict | None:
-    entry = _read_store(_store_path()).get(key)
+def schedule_from_entry(entry: dict):
+    """Rebuild a store entry's schedule value through its ``kind``
+    discriminator (ring by default — every v1 entry), or None when the
+    entry doesn't validate. bench --lint re-gates through this so grid
+    winners replay as :class:`GridSchedule`, not a ring shadow."""
     if not isinstance(entry, dict):
         return None
     sched = entry.get("schedule")
     if not isinstance(sched, dict):
         return None
+    cls = GridSchedule if entry.get("kind") == "grid" else RingSchedule
     try:
-        RingSchedule.from_dict(sched)
+        return cls.from_dict(sched)
     except TypeError:
+        return None
+
+
+def _load_entry(key: str) -> dict | None:
+    entry = _read_store(_store_path()).get(key)
+    if not isinstance(entry, dict):
+        return None
+    if schedule_from_entry(entry) is None:
         return None
     return entry
 
@@ -482,22 +836,22 @@ def stored_entries() -> dict:
 
 
 @functools.lru_cache(maxsize=256)
-def load_schedule(family: str, shape, mesh_shape,
-                  wire_dtype) -> RingSchedule | None:
+def load_schedule(family: str, shape, mesh_shape, wire_dtype):
     """The zero-search-cost resolve hook: the persisted winner for this
-    ``(family, shape, mesh, wire_dtype)``, or None. Cached per process —
-    the second build never touches the disk either."""
+    ``(family, shape, mesh, wire_dtype)`` (a :class:`RingSchedule` or
+    :class:`GridSchedule` per the entry's kind), or None. Cached per
+    process — the second build never touches the disk either."""
     entry = _load_entry(schedule_key(family, shape, mesh_shape, wire_dtype))
     if entry is None or entry.get("family") != family:
         return None
-    return RingSchedule.from_dict(entry["schedule"])
+    return schedule_from_entry(entry)
 
 
 def resolve_schedule(family: str, shape, mesh_shape, wire_dtype,
-                     explicit: RingSchedule | None = None):
+                     explicit=None):
     """What an op entry should run: the caller's explicit schedule if
     given, else the persisted searched winner, else None (the canonical
-    default paths, bit-for-bit today's rings)."""
+    default paths — bit-for-bit today's rings and grid kernels)."""
     if explicit is not None:
         return explicit
     try:
@@ -542,9 +896,9 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(
         prog="python -m triton_distributed_tpu.tune.schedule",
-        description="schedule-space smoke: enumerate ring schedules, "
-        "reject illegal mutations through shmemlint, pick the cheapest "
-        "legal candidate",
+        description="schedule-space smoke: enumerate ring/grid kernel "
+        "schedules, reject illegal mutations through shmemlint, pick "
+        "the cheapest legal candidate",
     )
     ap.add_argument("--family", default="ag_gemm.fused",
                     choices=sorted(_GATES))

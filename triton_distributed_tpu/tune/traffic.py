@@ -1,0 +1,95 @@
+"""Traffic-driven grid-schedule retuning (closes the serving loop).
+
+A serving run leaves an :class:`EngineStats` behind whose
+``shape_ledger`` maps each grid-schedule traffic key
+``(slots, t_pad, hkv, g, d, page)`` to the step time spent in it. This
+module turns that ledger into persisted schedule winners: rank the hot
+keys, run :func:`search_grid_schedule` for each (oracle-gated,
+perf-model priced), persist the winners in the flock'd store — and the
+NEXT engine build resolves them through
+``resolve_schedule("flash_decode.ragged_paged", key, ...)`` without
+paying any search cost on the serving path.
+
+The pass is deliberately OFF the hot path: run it synchronously after
+a serving run (:func:`retune_hot_shapes`) or fire-and-forget it on a
+background thread while the process drains
+(:func:`background_retune` — join the returned thread to collect the
+reports). ``dryrun=True`` (the default) skips hardware timing and
+keeps the whole pass perf-model-only, which is exactly what the bench
+and tests want.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+
+RAGGED_FAMILY = "flash_decode.ragged_paged"
+
+
+def retune_hot_shapes(stats, *, mesh_shape, wire=None, top: int = 4,
+                      dryrun: bool = True, force: bool = False,
+                      time_fn=None, family: str = RAGGED_FAMILY) -> list:
+    """Search + persist grid schedules for the ledger's hot shape keys.
+
+    ``stats``: an :class:`EngineStats` (anything with
+    ``hot_shape_keys(top)``); ``mesh_shape``: the TP mesh the engine
+    ran on (e.g. ``(model.tp,)``); ``wire``: the KV wire dtype key
+    (``"int8"`` under kv_quant, else None) — together these reproduce
+    the exact store key the next engine build resolves. Returns one
+    search report per hot key (``cached=True`` entries cost nothing).
+    A key whose search fails (an oracle bug is LOUD by design) is
+    reported as ``{"key": ..., "error": ...}`` rather than aborting
+    the remaining keys.
+    """
+    from triton_distributed_tpu.tune.autotuner import search_grid_schedule
+
+    reports = []
+    for key in stats.hot_shape_keys(top=top):
+        try:
+            rep = search_grid_schedule(
+                family, shape=key, mesh_shape=mesh_shape, wire=wire,
+                dryrun=dryrun, force=force, time_fn=time_fn,
+            )
+        except Exception as e:             # noqa: BLE001 — report, keep going
+            traceback.print_exc()
+            reports.append({"family": family, "key": tuple(key),
+                            "error": f"{type(e).__name__}: {e}"})
+            continue
+        reports.append(rep)
+    return reports
+
+
+def background_retune(stats, *, mesh_shape, wire=None, top: int = 4,
+                      dryrun: bool = True, force: bool = False,
+                      time_fn=None,
+                      family: str = RAGGED_FAMILY) -> threading.Thread:
+    """:func:`retune_hot_shapes` on a daemon thread. The thread object
+    carries the reports at ``thread.reports`` once joined — the store
+    write itself is flock'd, so a concurrent engine build reading the
+    store mid-pass sees either the old winner or the new one, never a
+    torn file."""
+
+    def run():
+        t.reports = retune_hot_shapes(
+            stats, mesh_shape=mesh_shape, wire=wire, top=top,
+            dryrun=dryrun, force=force, time_fn=time_fn, family=family,
+        )
+
+    t = threading.Thread(target=run, name="grid-retune", daemon=True)
+    t.reports = []
+    t.start()
+    return t
+
+
+def retune_engine(engine, *, top: int = 4, dryrun: bool = True,
+                  force: bool = False, time_fn=None) -> list:
+    """Convenience: retune from a live :class:`ServingEngine` — pulls
+    the mesh shape and wire key from the engine's model so the store
+    keys match what its next build will resolve."""
+    c = engine.model.config
+    return retune_hot_shapes(
+        engine.stats, mesh_shape=(engine.model.tp,),
+        wire="int8" if c.kv_quant is not None else None,
+        top=top, dryrun=dryrun, force=force, time_fn=time_fn,
+    )
