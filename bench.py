@@ -292,9 +292,11 @@ def _parse_args(argv=None):
         "replicas — serving_speculative — the draft-k speculative "
         "engine vs the plain engine, colocated AND disaggregated — or "
         "serving_elastic — autoscale grow from a reserve mesh, a "
-        "mid-trace drain with live KV-page migration; all compose "
-        "with --dryrun and --faults, e.g. the ISSUE-13 acceptance "
-        "line 'serving_elastic --dryrun --faults \"seed=1; "
+        "mid-trace drain with live KV-page migration — or "
+        "serving_multitenant — priority preemption, deadline routing "
+        "and brownout shedding under a 4x batch flood; all compose "
+        "with --dryrun and --faults, e.g. the ISSUE-16 acceptance "
+        "line 'serving_multitenant --dryrun --faults \"seed=1; "
         "ReplicaDeath(replica=1, step=8)\"' — or train_step — the "
         "dp×tp×cp train step on the int8 EF gradient ring vs the "
         "single-device reference and the exact psum twin, ISSUE-14)",
@@ -508,6 +510,7 @@ def main(argv=None) -> None:
             "serving_fleet": _bench_serving_fleet,
             "serving_speculative": _bench_serving_speculative,
             "serving_elastic": _bench_serving_elastic,
+            "serving_multitenant": _bench_serving_multitenant,
             "train_step": _bench_train_step,
         }
         bench_fn = scenarios.get(args.scenario)
@@ -2633,6 +2636,258 @@ def _bench_serving_elastic(mesh, n, on_tpu, spec, tiny=False):
             f"page={ecfg.page} npages={ecfg.npages} "
             f"requests={n_total} queue_cap=4 slo_ms=0.0 window=2 "
             f"temp=0.7 top_k=40 prefix_cache=on fleet_seed=1 "
+            + ("tiny-dryrun" if tiny or not on_tpu else "headline")
+        ),
+    }
+
+
+def _bench_serving_multitenant(mesh, n, on_tpu, spec, tiny=False):
+    """MULTI-TENANT fleet (ISSUE 16 tentpole acceptance): 3 replicas,
+    an interactive trickle under a 4x BATCH FLOOD plus a background
+    drip, per-tenant :class:`TenantConfig` (tight interactive SLO →
+    the router's deadline-slack term is live), the seeded
+    :class:`BrownoutController` armed and ``queue_cap`` admission
+    counting tier-visible depth. Four runs:
+
+    1. fault-free SINGLE-TENANT oracle over the identical trace — the
+       token-exactness reference (sampling is request-keyed, so
+       preemption/shed/retry may reorder WHEN a token appears, never
+       WHICH token);
+    2. flood-free interactive-only run under the SAME fault plan —
+       the p99 baseline the brownout + preemption must protect;
+    3. the headline multi-tenant run under the plan (the acceptance
+       line adds ``--faults "seed=1; ReplicaDeath(replica=1,
+       step=8)"``): interactive p99 no worse than (2), every shed on
+       background/batch with background shed strictly first,
+       preemptions > 0, zero pool-page leaks on live replicas, zero
+       lost requests;
+    4. a same-seed replay of (3) — the event log (placement,
+       preemption, shed, brownout transition, retune) must come back
+       byte-identical (the PR-13 replay contract extended to the
+       multi-tenant events)."""
+    import os as _os
+
+    import jax
+
+    from triton_distributed_tpu import config as _config
+    from triton_distributed_tpu.models import Transformer
+    from triton_distributed_tpu.runtime import faults as _rt_faults
+    from triton_distributed_tpu.runtime import watchdog as _rt_watchdog
+    from triton_distributed_tpu.runtime.topology import (
+        carve_replica_meshes,
+    )
+    from triton_distributed_tpu.serving import (
+        BrownoutConfig,
+        Request,
+        ServingEngine,
+        TenantConfig,
+    )
+    from triton_distributed_tpu.serving.fleet import (
+        RouterConfig,
+        ServingFleet,
+    )
+
+    devs = jax.devices()
+    n_replicas = 3
+    meshes = carve_replica_meshes(n_replicas, devs)
+    w = int(meshes[0].devices.size)
+    cfg, ecfg, _trace_kw, _s_cap = _serving_continuous_config(
+        w, on_tpu, tiny
+    )
+    from dataclasses import replace as _rep
+
+    if not on_tpu or tiny:
+        ecfg = _rep(ecfg, slots=4, token_budget=48, chunk=16, page=8,
+                    npages=64)
+    ecfg = _rep(ecfg, prefix_cache=True, temperature=0.7, top_k=40,
+                seed=11)
+    # SLOs scale with the perf model's step cost: interpreter-tiny
+    # models step in ~microseconds of MODEL time, headline in ms
+    slo_iact = 0.05 if (tiny or not on_tpu) else 50.0
+    slo_brownout = 0.004 if (tiny or not on_tpu) else 4.0
+    tenants = {
+        "iact": TenantConfig(priority="interactive", slo_ms=slo_iact),
+        "bat": TenantConfig(priority="batch"),
+        "bg": TenantConfig(priority="background"),
+    }
+
+    models = []
+    for m in meshes:
+        model = Transformer(cfg, m, tp_axis="x")
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s),
+            model.init(jax.random.PRNGKey(7)), model.shardings(),
+        )
+        params = model.quantize_moe_weights(params)
+        params = model.quantize_dense_weights(params)
+        models.append((model, params))
+
+    import numpy as _np
+
+    n_iact, n_bat, n_bg = 6, 24, 6       # the 4x batch flood
+
+    def fresh_trace(only_interactive=False):
+        out, rid = [], 0
+
+        def mk(rid, arrival, tenant, plen):
+            rng = _np.random.default_rng(5000 + rid)
+            prompt = rng.integers(
+                0, cfg.vocab, (plen,)).astype(_np.int32)
+            r = Request(rid=rid, prompt=prompt, max_new=5,
+                        arrival=arrival)
+            r.tenant = tenant
+            return r
+
+        for i in range(n_iact):
+            out.append(mk(rid, i * 3.0, "iact", 20)); rid += 1
+        for i in range(n_bat):
+            r = mk(rid, 1.0 + i * 0.2, "bat", 24); rid += 1
+            if not only_interactive:
+                out.append(r)
+        for i in range(n_bg):
+            r = mk(rid, i * 1.5, "bg", 16); rid += 1
+            if not only_interactive:
+                out.append(r)
+        return out
+
+    def build_fleet(multitenant=True):
+        engines = [ServingEngine(model, params, ecfg)
+                   for model, params in models]
+        if not multitenant:
+            return ServingFleet(engines, seed=1,
+                                router=RouterConfig(),
+                                meshes=list(meshes))
+        return ServingFleet(
+            engines, seed=1,
+            router=RouterConfig(queue_cap=3),
+            meshes=list(meshes),
+            tenants=tenants,
+            brownout=BrownoutConfig(slo_ms=slo_brownout, window=2,
+                                    cooldown=3),
+        )
+
+    def drive(fleet, trace, max_ticks=2000):
+        fleet.submit_trace(trace)
+        prev = _config.fleet_seed()
+        _config.set_fleet_seed(fleet.seed)
+        try:
+            for _ in range(max_ticks):
+                if fleet.idle:
+                    break
+                fleet.tick()
+        finally:
+            _config.set_fleet_seed(prev)
+        return fleet.stats
+
+    wd_trips = []
+
+    def _guarded(run_fn):
+        if _rt_faults.active_plan() is None:
+            return run_fn()
+        deadline = float(_os.environ.get("TDTPU_BENCH_WATCHDOG",
+                                         "10.0"))
+        box = {}
+        try:
+            with _rt_watchdog.collective_watchdog(deadline=deadline):
+                box["out"] = run_fn()
+        except _rt_watchdog.WatchdogTimeout as e:
+            wd_trips.append(str(e).splitlines()[0])
+        finally:
+            _rt_watchdog.clear_trip()
+        return box.get("out")
+
+    # ---- (1) fault-free single-tenant oracle (run twice — the first
+    # pays every jit compile for the replica models)
+    plan = _rt_faults.active_plan()
+    _rt_faults.set_fault_plan(None)
+    try:
+        for _warm in (False, True):
+            oracle = build_fleet(multitenant=False)
+            drive(oracle, fresh_trace())
+    finally:
+        _rt_faults.set_fault_plan(plan)
+    ref_tokens = oracle.token_streams()
+    assert oracle.stats.lost_requests == 0, oracle.stats
+
+    # ---- (2) flood-free interactive-only baseline, SAME fault plan:
+    # the p99 the flood must not degrade
+    base = build_fleet()
+    base_stats = _guarded(
+        lambda: drive(base, fresh_trace(only_interactive=True)))
+    assert base_stats is not None, wd_trips
+    p99_free = base.per_tenant()["iact"]["p99_ttft_ticks"]
+
+    # ---- (3) the headline multi-tenant flood under the plan
+    fleet = build_fleet()
+    stats = _guarded(lambda: drive(fleet, fresh_trace()))
+    assert stats is not None, wd_trips
+
+    # ---- (4) same-seed replay: byte-identical event log
+    fleet2 = build_fleet()
+    stats2 = _guarded(lambda: drive(fleet2, fresh_trace()))
+    assert stats2 is not None, wd_trips
+    events_deterministic = list(stats.events) == list(stats2.events)
+
+    per_tenant = fleet.per_tenant()
+    p99_flood = per_tenant["iact"]["p99_ttft_ticks"]
+    tokens = fleet.token_streams()
+    mismatches = sum(
+        1 for rid, t in ref_tokens.items() if tokens.get(rid) != t
+    )
+    shed_tiers = [e[3].split("tier=")[1].split()[0]
+                  for e in stats.events if e[0] == "shed"]
+    bg_shed_first = ("batch" not in shed_tiers
+                     or "background" in
+                     shed_tiers[:shed_tiers.index("batch")])
+    leaked = sum(role.pool.held_pages
+                 for r in fleet._alive() for role in r._roles)
+
+    # the acceptance pins — loud here, and ci/fast.sh re-derives them
+    # from the JSON so the smoke exits nonzero on any regression
+    assert stats.lost_requests == 0, stats
+    assert mismatches == 0, (
+        f"{mismatches} admitted streams diverged from the fault-free "
+        "single-tenant oracle")
+    assert set(shed_tiers) <= {"background", "batch"}, shed_tiers
+    assert bg_shed_first, shed_tiers
+    assert fleet.preemptions > 0, "flood never forced a preemption"
+    assert leaked == 0, f"{leaked} pool pages leaked on live replicas"
+    assert p99_flood <= p99_free, (
+        f"interactive p99 degraded under flood: "
+        f"{p99_flood} > {p99_free}")
+
+    return {
+        "metric": "serving_multitenant",
+        "value": round(fleet.goodput_tok_per_s, 1),
+        "unit": "tok/s fleet goodput (modeled wall)",
+        "ticks": fleet.ticks,
+        "completed": stats.completed,
+        "lost_requests": stats.lost_requests,
+        "token_mismatches_vs_single_tenant_oracle": mismatches,
+        "interactive_p99_ttft_ticks_flood": p99_flood,
+        "interactive_p99_ttft_ticks_flood_free": p99_free,
+        "preemptions": fleet.preemptions,
+        "tenant_preemptions": fleet.tenant_preemptions(),
+        "sheds_by_tier": dict(stats.sheds),
+        "background_shed_before_batch": bg_shed_first,
+        "brownout_transitions": [
+            e[3] for e in stats.events if e[0] == "brownout"],
+        "pool_pages_leaked": leaked,
+        "deaths": stats.deaths,
+        "failover_requeued": stats.failover_requeued,
+        "admission_rejections": stats.admission_rejections,
+        "per_tenant": per_tenant,
+        "routed": {str(k): v for k, v in sorted(stats.routed.items())},
+        "event_log": [list(e) for e in stats.events[:24]],
+        "event_log_deterministic": events_deterministic,
+        "watchdog_trips": wd_trips,
+        "config": (
+            f"replicas={n_replicas}x{w} slots={ecfg.slots} "
+            f"budget={ecfg.token_budget} chunk={ecfg.chunk} "
+            f"page={ecfg.page} npages={ecfg.npages} "
+            f"trace={n_iact}iact+{n_bat}bat+{n_bg}bg queue_cap=3 "
+            f"slo_iact={slo_iact} brownout_slo={slo_brownout} "
+            f"window=2 cooldown=3 temp=0.7 top_k=40 fleet_seed=1 "
             + ("tiny-dryrun" if tiny or not on_tpu else "headline")
         ),
     }

@@ -17,7 +17,10 @@
 # token-exactness, probation re-promotion) and the fleet router suite
 # (tests/test_fleet.py: scoring/affinity/spill, ReplicaDeath failover,
 # probe re-entry, chaos-site heartbeats, elastic grow/drain and the
-# live KV-page-migration chaos soak) and the training suite
+# live KV-page-migration chaos soak), the multi-tenant suite
+# (tests/test_multitenant.py: deadline routing, priority preemption,
+# tier-priced retries, fair share, brownout shedding, replay
+# determinism) and the training suite
 # (tests/test_train.py: EF gradient-ring numerics + determinism, the
 # dp×tp×cp train step vs the dense reference, backward wire duals,
 # grad-ring chaos degradation/probation) — everything that answers
@@ -352,4 +355,127 @@ print(f"train smoke: 5 steps dp2×tp2×cp2 wire=int8, "
       f"wire bytes ratio {rep['ratio']:.2f}x, "
       f"{len(TRAIN_ENGINE_FAMILIES)} families lint-clean with "
       f"declared fallbacks")
+EOF
+
+# Multi-tenant smoke (ISSUE 16 acceptance): a 2-replica fleet under a
+# batch flood + an interactive trickle + a mid-flood ReplicaDeath,
+# with the brownout controller armed and tier-priced admission —
+# exits nonzero unless interactive p99 TTFT is no worse than the
+# no-flood baseline (same death), every shed landed on
+# background/batch only, and lost_requests == 0.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2").strip()
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from triton_distributed_tpu import config
+from triton_distributed_tpu.models import Transformer, TransformerConfig
+from triton_distributed_tpu.runtime import faults
+from triton_distributed_tpu.serving import (
+    BrownoutConfig, EngineConfig, Request, RouterConfig, ServingEngine,
+    ServingFleet, TenantConfig,
+)
+
+cfg = TransformerConfig(
+    vocab=128, n_layers=2, hidden=64, ffn=128, n_heads=4, n_kv_heads=2,
+    head_dim=16, dtype=jnp.float32, param_dtype=jnp.float32,
+    kv_quant="int8")
+ecfg = EngineConfig(slots=4, token_budget=48, chunk=16, page=8,
+                    npages=32, prefix_cache=True, temperature=0.7,
+                    top_k=40, seed=11)
+tenants = {
+    "iact": TenantConfig(priority="interactive", slo_ms=0.05),
+    "bat": TenantConfig(priority="batch"),
+    "bg": TenantConfig(priority="background"),
+}
+devs = jax.devices()
+models = []
+params = None
+for k in range(2):
+    mesh = Mesh(np.asarray(devs[k:k + 1]), ("tp",))
+    model = Transformer(cfg, mesh, "tp", ())
+    if params is None:
+        params = model.init(jax.random.PRNGKey(0))
+    p = jax.tree.map(lambda x, s: jax.device_put(x, s), params,
+                     model.shardings())
+    models.append((model, p))
+
+
+def build():
+    return ServingFleet(
+        [ServingEngine(m, p, ecfg, use_pallas=False)
+         for m, p in models],
+        seed=1, router=RouterConfig(queue_cap=3), tenants=tenants,
+        brownout=BrownoutConfig(slo_ms=0.004, window=2, cooldown=3))
+
+
+def trace(flood=True):
+    rng = np.random.default_rng(5)
+    out = []
+
+    def mk(rid, arrival, tenant, plen):
+        r = Request(rid=rid,
+                    prompt=rng.integers(0, 128, (plen,)).astype(
+                        np.int32),
+                    max_new=5, arrival=arrival)
+        r.tenant = tenant
+        return r
+
+    for i in range(4):
+        out.append(mk(i, i * 3.0, "iact", 20))
+    if flood:
+        for i in range(24):
+            out.append(mk(10 + i, 1.0 + i * 0.2, "bat", 24))
+        for i in range(6):
+            out.append(mk(50 + i, i * 1.5, "bg", 16))
+    return out
+
+
+def run(fleet, t):
+    plan = faults.parse_plan("seed=1; ReplicaDeath(replica=1, step=8)")
+    prev = config.fleet_seed()
+    config.set_fleet_seed(fleet.seed)
+    try:
+        with faults.fault_plan(plan):
+            fleet.submit_trace(t)
+            for _ in range(800):
+                if fleet.idle:
+                    break
+                fleet.tick()
+    finally:
+        config.set_fleet_seed(prev)
+    return fleet.stats
+
+base = build()
+run(base, trace(flood=False))
+assert base.stats.lost_requests == 0, base.stats
+p99_free = base.per_tenant()["iact"]["p99_ttft_ticks"]
+
+fleet = build()
+stats = run(fleet, trace(flood=True))
+p99_flood = fleet.per_tenant()["iact"]["p99_ttft_ticks"]
+assert stats.lost_requests == 0, (
+    f"multi-tenant smoke lost {stats.lost_requests} requests: {stats}")
+assert (1, 8) in stats.deaths, stats.deaths
+assert set(stats.sheds) <= {"background", "batch"}, stats.sheds
+assert sum(stats.sheds.values()) >= 1, "flood never tripped brownout"
+assert p99_flood <= p99_free, (
+    f"multi-tenant smoke: interactive p99 degraded under the flood "
+    f"({p99_flood} > {p99_free})")
+leaked = sum(role.pool.held_pages
+             for r in fleet._alive() for role in r._roles)
+assert leaked == 0, f"multi-tenant smoke leaked {leaked} pool pages"
+print(f"multi-tenant smoke: {stats.completed}/{stats.submitted} "
+      f"completed, 0 lost across ReplicaDeath(replica=1, step=8), "
+      f"interactive p99 {p99_flood} <= {p99_free} no-flood, "
+      f"sheds={dict(stats.sheds)}, "
+      f"preemptions={fleet.preemptions}")
 EOF

@@ -72,11 +72,14 @@ logger = logging.getLogger(__name__)
 #: ring KV-rotation + Ulysses a2a) and the wire-quantized gradient
 #: rings (``grad_ring``: the EF reduce/gather duals and the trainer's
 #: dp all-reduce) — the last collectives that could wedge silently.
+#: ``preempt`` gates the multi-tenant priority-preemption body (a
+#: chaos Stall there must not leak the victim's pages or wedge the
+#: admitting tier).
 SITES = (
     "allgather", "reduce_scatter", "all_to_all", "ag_gemm", "gemm_rs",
     "moe_dispatch", "flash_decode",
     "ragged_paged", "serving_step", "kv_ship", "router_dispatch",
-    "kv_migrate", "cp_ring", "grad_ring",
+    "kv_migrate", "preempt", "cp_ring", "grad_ring",
 )
 
 

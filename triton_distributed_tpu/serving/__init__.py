@@ -13,18 +13,25 @@ See docs/SERVING.md for the lifecycle and knob catalog.
 """
 
 from triton_distributed_tpu.serving.engine import (  # noqa: F401
+    TIERS,
     DisaggregatedEngine,
     DisaggStats,
     EngineConfig,
     EngineStats,
     Request,
     ServingEngine,
+    TenantConfig,
+    effective_rank,
     poisson_trace,
+    tier_rank,
 )
 from triton_distributed_tpu.serving.fleet import (  # noqa: F401
+    BROWNOUT_LEVELS,
     FLEET_ENGINE_FAMILIES,
     MIGRATION_ENGINE_FAMILIES,
     AutoscalerConfig,
+    BrownoutConfig,
+    BrownoutController,
     FleetAutoscaler,
     FleetRouter,
     FleetStats,

@@ -46,6 +46,75 @@ from dataclasses import dataclass, field
 import numpy as np
 
 
+#: Priority classes, best-first: admission, eviction-victim selection
+#: and the fleet's brownout shedding all order by the index in this
+#: tuple (``tier_rank``) — interactive outranks batch outranks
+#: background everywhere a scheduling decision is made.
+TIERS = ("interactive", "batch", "background")
+
+TIER_RANK = {name: i for i, name in enumerate(TIERS)}
+
+
+def tier_rank(priority: str | None) -> int:
+    """Numeric rank of a priority class (lower = more important).
+    Unknown/unset priorities rank as interactive — the single-tenant
+    default must behave exactly like the pre-tenancy engine."""
+    return TIER_RANK.get(priority, 0)
+
+
+@dataclass(frozen=True)
+class TenantConfig:
+    """Per-tenant serving contract (docs/SERVING.md § Multi-tenant).
+
+    ``priority`` — the tenant's tier (``TIERS``), the default for its
+    requests' ``Request.priority``. ``slo_ms`` — per-request modeled
+    completion SLO; the fleet router's deadline slack term is
+    ``slo_ms − modeled completion`` (inf = no deadline, the slack term
+    vanishes). ``token_budget`` — cap on the packed tokens the
+    tenant's RESIDENT rows may claim per engine step (None =
+    unbounded). ``page_share`` — fraction of each engine's page pool
+    the tenant's residents may hold; both shares are enforced at
+    admission (a request over its tenant's share defers WITHOUT
+    head-of-line blocking other tenants)."""
+
+    priority: str = "interactive"
+    slo_ms: float = float("inf")
+    token_budget: int | None = None
+    page_share: float = 1.0
+
+    def __post_init__(self):
+        if self.priority not in TIERS:
+            raise ValueError(
+                f"unknown priority {self.priority!r} (want one of "
+                f"{TIERS})")
+        if not 0.0 < self.page_share <= 1.0:
+            raise ValueError(
+                f"page_share must be in (0, 1], got {self.page_share}")
+        if self.token_budget is not None and self.token_budget < 8:
+            raise ValueError(
+                f"token_budget must be >= 8 (one packed row), got "
+                f"{self.token_budget}")
+
+
+#: The tenant every unconfigured request belongs to: interactive tier,
+#: no deadline, full shares — byte-identical scheduling to the
+#: pre-tenancy engine.
+DEFAULT_TENANT = TenantConfig()
+
+
+def effective_rank(req, now: float, aging_ticks: int) -> int:
+    """The rank admission actually orders by: the request's tier rank
+    minus one bump per ``aging_ticks`` ticks waited since arrival —
+    the anti-starvation aging that lets a background request outrank a
+    sustained interactive flood once it has waited long enough.
+    Deterministic (pure function of the tick clock), floor 0."""
+    rank = tier_rank(getattr(req, "priority", None))
+    if rank == 0 or aging_ticks <= 0:
+        return rank
+    waited = max(float(now) - float(req.arrival), 0.0)
+    return max(0, rank - int(waited // aging_ticks))
+
+
 @dataclass
 class Request:
     """One serving request. ``arrival`` is in engine-step units (the
@@ -55,6 +124,12 @@ class Request:
     prompt: np.ndarray                 # (L,) int32 token ids
     max_new: int = 8
     arrival: float = 0.0
+    # multi-tenancy: the tenant key (looked up in the engine/fleet
+    # tenants map) and the priority class. ``priority=None`` defers to
+    # the tenant's configured tier; both defaults reproduce the
+    # single-tenant engine exactly.
+    tenant: str = "default"
+    priority: str | None = None
 
     # runtime (engine-owned)
     generated: list = field(default_factory=list)
@@ -125,6 +200,10 @@ class EngineStats:
     evictions: int = 0
     deferrals: int = 0
     prefix_hits: int = 0               # pages reattached from the cache
+    # --- multi-tenancy (zero on single-tenant engines) ---
+    preemptions: int = 0               # evictions forced by a higher tier
+    tenant_preemptions: dict = field(default_factory=dict)  # tenant -> n
+    fair_share_deferrals: dict = field(default_factory=dict)  # tenant -> n
     # CURRENTLY on the XLA twin (no longer a one-way latch: probation
     # re-promotion clears it — see HealthLedger)
     degraded: bool = False
@@ -277,7 +356,8 @@ class ServingEngine:
                  moe_state="auto", use_pallas: bool = True,
                  on_complete=None, health=None,
                  health_peer: str = "site:serving_step",
-                 grid_schedule=None):
+                 grid_schedule=None, tenants=None,
+                 aging_ticks: int = 64):
         import jax.numpy as jnp
 
         from triton_distributed_tpu.runtime.health import HealthLedger
@@ -313,6 +393,17 @@ class ServingEngine:
         self.waiting: deque = deque()      # arrived, not admitted
         self.stats = EngineStats()
         self.step_count = 0
+        # --- multi-tenancy (all defaults reproduce the single-tenant
+        # engine exactly: one implicit tenant at full shares, rank 0,
+        # so preemption never finds a strictly-lower victim) ---
+        self.tenants: dict = dict(tenants or {})
+        self.aging_ticks = int(aging_ticks)
+        # tiers the fleet brownout controller is currently squeezing:
+        # their rows chunk at half budget and draft at k=1
+        self.throttled_tiers: frozenset = frozenset()
+        # hook: called (by_req, victim) when admission preempts a
+        # lower-tier resident — the fleet wires its event log here
+        self.on_preempt = None
         g = model.config.n_heads // model.config.n_kv_heads
         self._g = g
         from triton_distributed_tpu.kernels.ragged_paged_attention import (
@@ -377,6 +468,44 @@ class ServingEngine:
         return (not self.pending and not self.waiting
                 and all(r is None for r in self.slot_req))
 
+    # ------------------------------------------------------------ tenancy
+
+    def _tenant(self, req) -> TenantConfig:
+        return self.tenants.get(
+            getattr(req, "tenant", "default"), DEFAULT_TENANT)
+
+    def _rank(self, req) -> int:
+        """Static tier rank: the request's own priority, else its
+        tenant's configured tier."""
+        pr = getattr(req, "priority", None)
+        if pr is None:
+            pr = self._tenant(req).priority
+        return tier_rank(pr)
+
+    def _eff_rank(self, req) -> int:
+        """Admission-order rank WITH anti-starvation aging."""
+        pr = getattr(req, "priority", None)
+        if pr is None:
+            pr = self._tenant(req).priority
+        rank = tier_rank(pr)
+        if rank == 0 or self.aging_ticks <= 0:
+            return rank
+        waited = max(float(self.step_count) - float(req.arrival), 0.0)
+        return max(0, rank - int(waited // self.aging_ticks))
+
+    def _chunk_for(self, req) -> int:
+        """Per-request prefill chunk: the configured budget, halved
+        (floor 1) while the request's tier is under a brownout
+        squeeze."""
+        c = self.cfg.chunk
+        if self.throttled_tiers:
+            pr = getattr(req, "priority", None)
+            if pr is None:
+                pr = self._tenant(req).priority
+            if pr in self.throttled_tiers:
+                c = max(1, c // 2)
+        return c
+
     # ----------------------------------------------------------- allocator
 
     def _pages_held(self, cursor: int) -> int:
@@ -402,20 +531,23 @@ class ServingEngine:
         self.slot_req[slot] = None
 
     def _evict_one(self, batched: set) -> bool:
-        """Evict the latest-arrived active request not already in this
-        step's batch (LIFO preemption); its pages return to the free
-        list and the request re-queues AT THE FRONT with cursor 0 — the
-        recompute prefix (prompt + generated) resumes it exactly.
-        Parked requests (pages pinned by an in-flight KV ship) and
-        already-completed holders are never victims."""
+        """Evict the lowest-tier, latest-arrived active request not
+        already in this step's batch (priority-aware LIFO preemption —
+        with one tenant every rank ties and this is exactly the
+        pre-tenancy LIFO); its pages return to the free list and the
+        request re-queues AT THE FRONT with cursor 0 — the recompute
+        prefix (prompt + generated) resumes it exactly. Parked requests
+        (pages pinned by an in-flight KV ship) and already-completed
+        holders are never victims."""
         victims = [
-            (req.arrival, s) for s, req in enumerate(self.slot_req)
+            (self._rank(req), req.arrival, s)
+            for s, req in enumerate(self.slot_req)
             if req is not None and s not in batched
             and not req.parked and not req.done
         ]
         if not victims:
             return False
-        _, s = max(victims)
+        _, _, s = max(victims)
         req = self.slot_req[s]
         req.cursor = 0
         req.evictions += 1
@@ -425,13 +557,63 @@ class ServingEngine:
         self.stats.evictions += 1
         return True
 
+    def _preempt_for(self, by_req) -> bool:
+        """Priority preemption: a higher-tier admission found no free
+        slot (or no page headroom), so the LOWEST-tier resident row
+        strictly below ``by_req``'s effective rank is evicted through
+        the recompute-eviction discipline — token-exact and
+        cursor-resumable, so preemption is free correctness-wise. The
+        victim re-queues into ``waiting``, where the priority sort
+        re-orders it at its tenant's tier. False = no strictly-lower
+        victim exists (single-tenant engines always land here).
+        Victims are ranked by EFFECTIVE rank too: anti-starvation
+        aging protects residency as well as admission order — a
+        background row that waited out its aging bumps can no longer
+        be preempted by the interactive flood that starved it. Runs
+        under the ``preempt`` chaos site so a fault-plan Stall can
+        wedge it visibly."""
+        rank = self._eff_rank(by_req)
+        victims = [
+            (self._eff_rank(req), req.arrival, s)
+            for s, req in enumerate(self.slot_req)
+            if req is not None and not req.parked and not req.done
+            and self._eff_rank(req) > rank
+        ]
+        if not victims:
+            return False
+        from triton_distributed_tpu.lang.launch import maybe_instrument
+
+        _, _, s = max(victims)
+
+        def body():
+            victim = self.slot_req[s]
+            victim.cursor = 0
+            victim.evictions += 1
+            victim.slot = None
+            self._free_slot(s)
+            self.waiting.append(victim)
+            self.stats.evictions += 1
+            self.stats.preemptions += 1
+            t = getattr(victim, "tenant", "default")
+            self.stats.tenant_preemptions[t] = (
+                self.stats.tenant_preemptions.get(t, 0) + 1)
+            if self.on_preempt is not None:
+                self.on_preempt(by_req, victim)
+            return True
+
+        return maybe_instrument(
+            body, axis=None, site="preempt",
+            collective_id=("preempt", self.step_count), n=1,
+            step=self.step_count,
+        )()
+
     # ---------------------------------------------------------------- step
 
     def _row_take_bound(self, req) -> int:
         """Upper bound on the tokens this request's next row packs —
         the admission/reservation headroom term. The speculative engine
         widens it by its draft budget."""
-        return min(self.cfg.chunk, len(req.seq) - req.cursor)
+        return min(self._chunk_for(req), len(req.seq) - req.cursor)
 
     def _committed_pages(self) -> int:
         """Pages the already-admitted slots will claim for their NEXT
@@ -448,18 +630,69 @@ class ServingEngine:
             )
         return tot
 
+    def _fair_share_ok(self, req, first: int) -> bool:
+        """Per-tenant fair-share admission gate: would admitting
+        ``req`` push its tenant past its configured ``page_share`` of
+        the pool, or past its ``token_budget`` of packed tokens per
+        step (summed over the tenant's resident rows)? Tenant-local —
+        a violation defers THIS request without head-of-line blocking
+        other tenants."""
+        tc = self._tenant(req)
+        if tc.page_share >= 1.0 and tc.token_budget is None:
+            return True
+        tenant = getattr(req, "tenant", "default")
+        resident = [
+            r for r in self.slot_req
+            if r is not None and not r.done
+            and getattr(r, "tenant", "default") == tenant
+        ]
+        if tc.page_share < 1.0:
+            cap = int(tc.page_share * self.cfg.npages)
+            held = sum(self._pages_held(r.cursor) for r in resident)
+            if held + self._pages_held(first) > cap:
+                return False
+        if tc.token_budget is not None:
+            packed = sum(self._row_take_bound(r) for r in resident
+                         if not r.parked)
+            if packed + first > tc.token_budget:
+                return False
+        return True
+
     def _admit(self) -> None:
         while self.pending and self.pending[0].arrival <= self.step_count:
             self.waiting.append(self.pending.popleft())
+        if not self.waiting:
+            return
+        # priority admission: effective tier rank (tenant tier minus
+        # the aging bump), then FIFO. With one tenant every rank is 0
+        # and this is a stable no-op — the pre-tenancy FIFO exactly.
+        self.waiting = deque(sorted(
+            self.waiting,
+            key=lambda r: (self._eff_rank(r), r.arrival, r.rid)))
+        deferred: list = []
         while self.waiting:
+            req = self.waiting[0]
             free = [s for s, r in enumerate(self.slot_req) if r is None]
             if not free:
-                return
-            req = self.waiting[0]
-            first = min(self.cfg.chunk, len(req.seq))
+                if not self._preempt_for(req):
+                    break                  # no slot, no lower-tier victim
+                free = [s for s, r in enumerate(self.slot_req)
+                        if r is None]
+            first = min(self._chunk_for(req), len(req.seq))
             if (self._pages_held(first)
                     > self.pool.available - self._committed_pages()):
-                return                     # pool exhausted — hold the queue
+                # pool exhausted: a higher tier may still claim pages
+                # by preempting the lowest-tier resident
+                if self._preempt_for(req):
+                    continue
+                break                      # hold the queue
+            if not self._fair_share_ok(req, first):
+                self.waiting.popleft()
+                deferred.append(req)
+                t = getattr(req, "tenant", "default")
+                self.stats.fair_share_deferrals[t] = (
+                    self.stats.fair_share_deferrals.get(t, 0) + 1)
+                continue
             self.waiting.popleft()
             s = free[0]
             req.slot = s
@@ -474,6 +707,8 @@ class ServingEngine:
                 )
             if self.pool.prefix_cache and req.cursor == 0:
                 self._attach_prefix(req, s)
+        for req in deferred:               # over-share: retry next step
+            self.waiting.append(req)
 
     # ------------------------------------------------------ prefix cache
 
@@ -527,7 +762,7 @@ class ServingEngine:
         the next ``min(chunk, remaining)`` sequence tokens. The
         speculative engine appends provisional draft tokens to steady
         decode rows (its override records which tail is draft)."""
-        take = min(self.cfg.chunk, len(req.seq) - req.cursor)
+        take = min(self._chunk_for(req), len(req.seq) - req.cursor)
         return np.asarray(req.seq[req.cursor:req.cursor + take],
                           np.int32)
 

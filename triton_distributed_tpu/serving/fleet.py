@@ -52,6 +52,20 @@ re-enters rotation only through the PR 10 probation-probe path: clean
 idle ticks earn PROBATION, seeded probes earn traffic, enough clean
 probes earn HEALTHY — never a blind re-add. All replicas dead is a
 loud refusal, not a hang.
+
+Multi-tenancy (docs/SERVING.md § Multi-tenant serving): requests carry
+a ``tenant`` + priority tier (interactive / batch / background, from
+:class:`~triton_distributed_tpu.serving.engine.TenantConfig`), and the
+fleet enforces them end to end — a deadline **slack** term in the
+router score (``slack = slo_ms − modeled completion``; negative slack
+outranks prefix affinity), tier-priced retry-after (a tier-r retry
+waits only on the queue at rank ≤ r), engine-level **priority
+preemption** through the recompute-eviction discipline, and a
+:class:`BrownoutController` that sheds overload in strict
+reverse-priority order (background rejected first, batch spec/chunk
+budgets squeezed next, interactive last) with hysteretic recovery.
+Every shed/preempt/brownout transition lands in ``stats.events`` —
+the same replay-determinism contract as scale/drain/migrate.
 """
 
 from __future__ import annotations
@@ -197,11 +211,18 @@ class Replica:
         return sum(perf_model.replica_step_ms(r) for r in self._roles
                    if not r.idle)
 
-    def queue_depth(self) -> int:
+    def queue_depth(self, *, rank=None, rank_of=None) -> int:
         """Requests queued at the admission role (not yet in slots) —
-        the quantity the router's ``queue_cap`` bounds."""
+        the quantity the router's ``queue_cap`` bounds. With ``rank``
+        (and the fleet's ``rank_of``), only entries at rank <= rank
+        count: priority admission sorts a tier-r arrival ahead of
+        everything below it, so lower-tier backlog is not depth a
+        tier-r client ever stands behind."""
         role = self.admit_role
-        return len(role.waiting) + len(role.pending)
+        queued = list(role.waiting) + list(role.pending)
+        if rank is None or rank_of is None:
+            return len(queued)
+        return sum(1 for q in queued if rank_of(q) <= rank)
 
     def can_accept(self, req) -> bool:
         """Would the admission role admit ``req`` NOW (free slot + page
@@ -222,13 +243,15 @@ class RouterConfig:
 
     w_prefix: float = 1.0       # weight of the prefix-overlap term
     w_load: float = 1.0         # weight of the fleet-mean-relative load
+    w_slack: float = 1.0        # weight of the deadline-deficit term
     policy: str = "scored"      # "scored" | "round_robin" (baseline)
     affinity: bool = True       # session stickiness
     # admission control: when EVERY routable replica already has this
-    # many requests queued (waiting + pending on its admission role),
-    # the fleet REJECTS the arrival with a priced retry-after instead
-    # of letting `waiting` grow without bound. None = unbounded (the
-    # pre-cap behavior).
+    # many requests queued (waiting + pending on its admission role,
+    # counted at the arrival's own tier — lower-tier backlog is
+    # invisible to a higher-tier arrival), the fleet REJECTS the
+    # arrival with a priced retry-after instead of letting `waiting`
+    # grow without bound. None = unbounded (the pre-cap behavior).
     queue_cap: int | None = None
 
 
@@ -242,6 +265,9 @@ class FleetRouter:
         self.cfg = cfg or RouterConfig()
         self._rr = 0
         self.affinity: dict = {}           # session -> replica index
+        # tenant -> TenantConfig, assigned by the owning ServingFleet;
+        # empty = single-tenant (no deadline term, pre-tier behavior)
+        self.tenants: dict = {}
 
     def health_factor(self, state) -> float | None:
         """None = not routable. PROBATION returns None here — probe
@@ -255,19 +281,46 @@ class FleetRouter:
             return 0.5
         return None                        # PROBATION / UNHEALTHY
 
+    def slack_ms(self, replica: Replica, req) -> float | None:
+        """Deadline slack of placing ``req`` at ``replica``:
+        ``slo_ms − modeled completion``, where modeled completion is
+        the queue already ahead (``replica.load_ms()``) plus the
+        request's own remaining work (:func:`~triton_distributed_tpu.
+        tune.perf_model.request_service_ms`). None when the request's
+        tenant has no finite SLO — no deadline term at all."""
+        import math
+
+        tc = self.tenants.get(getattr(req, "tenant", None))
+        if tc is None or not math.isfinite(tc.slo_ms):
+            return None
+        from triton_distributed_tpu.tune import perf_model
+
+        return (tc.slo_ms - replica.load_ms()
+                - perf_model.request_service_ms(replica.admit_role, req))
+
     def score(self, replica: Replica, req, state,
-              mean_load: float = 0.0) -> float | None:
+              mean_load: float = 0.0,
+              slack: float | None = None) -> float | None:
         """The admission score. The load term enters RELATIVE to
         ``mean_load`` (the fleet mean, computed by :meth:`route`) so
         ``w_load`` is scale-free — the same knob balances microsecond
-        CPU-sim steps and millisecond TPU steps."""
+        CPU-sim steps and millisecond TPU steps. A NEGATIVE deadline
+        ``slack`` divides the score by the (mean-normalized) deficit:
+        the tighter a placement misses the tenant SLO, the harder it
+        is penalized, so tight-deadline requests drift to the replica
+        that still makes the deadline even when another holds their
+        prefix."""
         hf = self.health_factor(state)
         if hf is None:
             return None
         c = self.cfg
         rel = replica.load_ms() / mean_load if mean_load > 0 else 0.0
-        return ((1.0 + c.w_prefix * replica.overlap_pages(req)) * hf
+        base = ((1.0 + c.w_prefix * replica.overlap_pages(req)) * hf
                 / (1.0 + c.w_load * rel))
+        if slack is not None and slack < 0:
+            deficit = -slack / mean_load if mean_load > 0 else -slack
+            base /= (1.0 + c.w_slack * deficit)
+        return base
 
     def route(self, req, replicas: list, ledger) -> tuple:
         """Pick the replica for ``req`` among routable ``replicas``.
@@ -286,7 +339,9 @@ class FleetRouter:
             self._rr += 1
             return r, False
         mean = sum(r.load_ms() for r in routable) / len(routable)
-        scored = [(r, self.score(r, req, states[r.index], mean))
+        slacks = {r.index: self.slack_ms(r, req) for r in routable}
+        scored = [(r, self.score(r, req, states[r.index], mean,
+                                 slack=slacks[r.index]))
                   for r in routable]
         # seeded tie-break: equal scores place identically under the
         # same fleet seed regardless of construction order
@@ -301,8 +356,18 @@ class FleetRouter:
                 and sess in self.affinity:
             home = next((rs for rs in scored
                          if rs[0].index == self.affinity[sess]), None)
+            hs = slacks.get(home[0].index) if home is not None else None
             if home is None:
                 spilled = True       # home dead/condemned: re-home
+            elif hs is not None and hs < 0 \
+                    and not home[0].can_accept(req) \
+                    and best_with_room is not None \
+                    and (slacks.get(best_with_room[0].index) or 0.0) > hs:
+                # deadline outranks prefix affinity: queueing at the
+                # full home is MODELED to miss the tenant SLO while
+                # another replica with room still makes (or misses it
+                # by less) — re-home now, pages can follow the spill
+                spilled = True
             elif home[0].can_accept(req) or best_with_room is None \
                     or home[1] >= best_with_room[1]:
                 # queue at the home even when it is full, as long as
@@ -392,6 +457,127 @@ class FleetAutoscaler:
         return True
 
 
+# ------------------------------------------------------------ brownout
+
+#: Escalation ladder, strict reverse-priority order. Each level keeps
+#: everything the previous one shed: ``shed_background`` bounces
+#: background arrivals with a priced retry-after; ``squeeze_batch``
+#: additionally throttles the batch tier's spec/chunk budgets on every
+#: engine (``throttled_tiers``); ``shed_batch`` bounces batch arrivals
+#: too. Interactive is NEVER shed — its protection is the whole point.
+BROWNOUT_LEVELS = ("normal", "shed_background", "squeeze_batch",
+                   "shed_batch")
+
+
+@dataclass(frozen=True)
+class BrownoutConfig:
+    """Overload-controller knobs (docs/SERVING.md § Multi-tenant
+    serving). Flap-damped like :class:`AutoscalerConfig`: ``window``
+    consecutive pressured ticks escalate one level, ``cooldown``
+    consecutive clean ticks de-escalate one level — hysteresis, so a
+    border-line load doesn't oscillate the fleet between shedding and
+    re-admitting every tick."""
+
+    slo_ms: float                  # fleet-wide modeled-wait ceiling
+    window: int = 3                # pressured ticks per escalation
+    cooldown: int = 5              # clean ticks per de-escalation
+
+
+class BrownoutController:
+    """Fleet-level graceful degradation. Watches the same priced
+    pressure signal as the autoscaler PLUS per-tier modeled slack (an
+    arrived request whose tenant SLO is missed even at the lightest
+    routable replica is pressure, whatever the absolute load), and
+    sheds in strict reverse-priority order — see
+    :data:`BROWNOUT_LEVELS`. Pure bookkeeping over deterministic
+    inputs, seeded like every fleet component: same seed and trace ⇒
+    identical shed ticks and transitions."""
+
+    def __init__(self, cfg: BrownoutConfig, seed: int = 0):
+        self.cfg = cfg
+        self.seed = seed
+        self.level = 0                 # index into BROWNOUT_LEVELS
+        self.pressured = 0             # consecutive pressured ticks
+        self.clean = 0                 # consecutive clean ticks
+        self.history: list = []        # (tick, projected_ms, backlog)
+
+    def pressure(self, fleet) -> bool:
+        """Is THIS tick pressured? Backlog counts only ARRIVED fleet
+        queue entries — a shed request parked at a future retry tick
+        is the controller's own output, not input pressure (counting
+        it would latch the brownout on forever)."""
+        routable = [
+            r for r in fleet._route_candidates()
+            if fleet.router.health_factor(
+                fleet.health.state(r.peer)) is not None
+        ]
+        if not routable:
+            return False
+        arrived = [q for q in fleet.queue if q.arrival <= fleet.ticks]
+        backlog = (len(arrived)
+                   + sum(r.queue_depth() for r in routable))
+        projected = min(r.load_ms() for r in routable)
+        self.history.append((fleet.ticks, projected, backlog))
+        if backlog == 0:
+            return False
+        if projected > self.cfg.slo_ms:
+            return True
+        # per-tier slack: even a light fleet is pressured when some
+        # arrived tenant's deadline is already un-meetable everywhere
+        for q in arrived:
+            slacks = [s for s in (fleet.router.slack_ms(r, q)
+                                  for r in routable) if s is not None]
+            if slacks and max(slacks) < 0:
+                return True
+        return False
+
+    def observe(self, fleet) -> None:
+        """One observation per fleet tick: escalate after ``window``
+        pressured ticks, de-escalate after ``cooldown`` clean ticks,
+        log every transition into the replay-determinism event
+        stream."""
+        if self.pressure(fleet):
+            self.pressured += 1
+            self.clean = 0
+            if self.pressured >= max(1, self.cfg.window) \
+                    and self.level < len(BROWNOUT_LEVELS) - 1:
+                old = BROWNOUT_LEVELS[self.level]
+                self.level += 1
+                self.pressured = 0
+                fleet._log_event(
+                    "brownout", -1,
+                    f"{old}->{BROWNOUT_LEVELS[self.level]}")
+        else:
+            self.clean += 1
+            self.pressured = 0
+            if self.clean >= max(1, self.cfg.cooldown) \
+                    and self.level > 0:
+                old = BROWNOUT_LEVELS[self.level]
+                self.level -= 1
+                self.clean = 0
+                fleet._log_event(
+                    "brownout", -1,
+                    f"{old}->{BROWNOUT_LEVELS[self.level]}")
+
+    def sheds(self, rank: int) -> bool:
+        """Does the CURRENT level shed an arrival of this tier rank?
+        Strict reverse priority: background (rank 2) from
+        ``shed_background`` up, batch (rank 1) only at ``shed_batch``,
+        interactive (rank 0) never."""
+        if rank >= 2:
+            return self.level >= 1
+        if rank == 1:
+            return self.level >= 3
+        return False
+
+    @property
+    def squeezed(self) -> frozenset:
+        """Tiers whose spec/chunk budgets every engine throttles at
+        the current level (``ServingEngine.throttled_tiers``)."""
+        return (frozenset({"batch"}) if self.level >= 2
+                else frozenset())
+
+
 # --------------------------------------------------------------- stats
 
 @dataclass
@@ -424,6 +610,12 @@ class FleetStats:
     retired_prefix_hits: int = 0
     retired_evictions: int = 0
     retired_generated: int = 0
+    retired_preemptions: int = 0
+    retired_tenant_preemptions: dict = field(default_factory=dict)
+    # --- multi-tenant brownout / maintenance ---
+    sheds: dict = field(default_factory=dict)         # tier -> count
+    tenant_sheds: dict = field(default_factory=dict)  # tenant -> count
+    retunes: list = field(default_factory=list)  # (tick, replica, n)
     records: dict = field(default_factory=dict)
     # rid -> {arrival, first_token_tick, completion_tick, n, tokens}
     # --- elastic fleet (grow / drain / migrate) ---
@@ -460,15 +652,21 @@ class FleetStats:
     def lost_requests(self) -> int:
         return self.submitted - self.completed
 
-    def _ttfts(self) -> list:
+    def _recs(self, tenant: str | None = None) -> list:
+        if tenant is None:
+            return list(self.records.values())
+        return [r for r in self.records.values()
+                if getattr(r["req"], "tenant", "default") == tenant]
+
+    def _ttfts(self, tenant: str | None = None) -> list:
         return [r["first_token_tick"] - r["arrival"]
-                for r in self.records.values()
+                for r in self._recs(tenant)
                 if r["first_token_tick"] is not None]
 
-    def _tpots(self) -> list:
+    def _tpots(self, tenant: str | None = None) -> list:
         return [(r["completion_tick"] - r["first_token_tick"])
                 / max(r["n"] - 1, 1)
-                for r in self.records.values()
+                for r in self._recs(tenant)
                 if r["completion_tick"] is not None]
 
     @property
@@ -484,6 +682,39 @@ class FleetStats:
 
         ts = self._tpots()
         return float(np.percentile(np.asarray(ts), 99)) if ts else 0.0
+
+    def per_tenant(self, preemptions: dict | None = None) -> dict:
+        """tenant -> goodput/latency/robustness view: submitted,
+        completed, generated tokens, p99 TTFT/TPOT in fleet ticks,
+        sheds, and (when the fleet passes its merged map) preemptions
+        — the per-tenant observability surface the multi-tenant bench
+        and CI smoke assert on."""
+        import numpy as np
+
+        out: dict = {}
+        for rec in self.records.values():
+            t = getattr(rec["req"], "tenant", "default")
+            d = out.setdefault(t, {
+                "submitted": 0, "completed": 0, "generated": 0,
+                "p99_ttft_ticks": 0.0, "p99_tpot_ticks": 0.0,
+                "sheds": 0, "preemptions": 0,
+            })
+            d["submitted"] += 1
+            if rec["completion_tick"] is not None:
+                d["completed"] += 1
+                d["generated"] += rec["n"]
+        for t, d in out.items():
+            ts = self._ttfts(t)
+            if ts:
+                d["p99_ttft_ticks"] = float(
+                    np.percentile(np.asarray(ts), 99))
+            tp = self._tpots(t)
+            if tp:
+                d["p99_tpot_ticks"] = float(
+                    np.percentile(np.asarray(tp), 99))
+            d["sheds"] = self.tenant_sheds.get(t, 0)
+            d["preemptions"] = (preemptions or {}).get(t, 0)
+        return out
 
 
 # --------------------------------------------------------------- fleet
@@ -507,12 +738,21 @@ class ServingFleet:
     ``perf_spec`` — optional TpuSpec override for the migration pricing
     (tests flip the migrate-vs-reprefill verdict by shrinking
     ``dcn_gbps``).
+    ``tenants`` — ``{tenant: TenantConfig}``; enables the deadline
+    slack term, tier-priced retry-after, per-tenant fair share, and
+    priority preemption (the map is pushed into every engine).
+    ``brownout`` — a :class:`BrownoutConfig`; None disables
+    load-shedding (the pre-brownout behavior).
+    ``retune_every`` — run the grid-schedule ``background_retune`` in
+    the fleet's own maintenance window every N ticks (low-pressure
+    ticks only; suppressed during brownout). None disables.
     """
 
     def __init__(self, engines, *, seed: int = 0,
                  router: RouterConfig | None = None, health=None,
                  meshes=None, reserve=None, autoscaler=None,
-                 perf_spec=None):
+                 perf_spec=None, tenants=None, brownout=None,
+                 retune_every: int | None = None):
         from triton_distributed_tpu.runtime.health import HealthLedger
 
         if not engines:
@@ -541,6 +781,43 @@ class ServingFleet:
         self.autoscaler = (FleetAutoscaler(autoscaler, seed=seed)
                            if autoscaler is not None else None)
         self.perf_spec = perf_spec
+        self.tenants = dict(tenants or {})
+        self.router.tenants = self.tenants
+        self.brownout = (BrownoutController(brownout, seed=seed)
+                         if brownout is not None else None)
+        self.retune_every = retune_every
+        for r in self.replicas:
+            self._wire_tenancy(r)
+
+    def _wire_tenancy(self, replica: Replica) -> None:
+        """Push the fleet tenant map into the replica's engines and
+        hook engine preemptions into the replay-determinism event
+        stream — called for every replica that enters the fleet
+        (construction, grow, revive)."""
+        for role in replica._roles:
+            if self.tenants:
+                role.tenants = self.tenants
+
+            def on_preempt(by, victim, _idx=replica.index, _role=role):
+                from triton_distributed_tpu.serving.engine import TIERS
+
+                self._log_event(
+                    "preempt", _idx,
+                    f"rid={victim.rid} tier="
+                    f"{TIERS[_role._rank(victim)]} by={by.rid}")
+
+            role.on_preempt = on_preempt
+
+    def _rank_of(self, req) -> int:
+        """Fleet-side tier rank of a request — per-request priority
+        first, then its tenant's tier, interactive (0) by default."""
+        from triton_distributed_tpu.serving.engine import (
+            DEFAULT_TENANT, tier_rank,
+        )
+
+        tc = self.tenants.get(getattr(req, "tenant", "default"),
+                              DEFAULT_TENANT)
+        return tier_rank(getattr(req, "priority", None) or tc.priority)
 
     # ---------------------------------------------------------- intake
 
@@ -609,6 +886,8 @@ class ServingFleet:
         n = 0
         while self.queue and self.queue[0].arrival <= self.ticks:
             req = self.queue.popleft()
+            if self._shed_brownout(req):
+                continue
             if self._reject_overload(req):
                 continue
             target = self._route_probe(req)
@@ -640,42 +919,112 @@ class ServingFleet:
         routable replica's queue is at cap, the arrival is REJECTED
         with a priced retry-after instead of deepening some replica's
         ``waiting`` without bound. The retry-after is the perf model's
-        estimate of when the LIGHTEST queue will have drained —
-        :func:`~triton_distributed_tpu.tune.perf_model.replica_load_ms`
-        of the least-loaded routable replica, converted to fleet ticks
-        by its modeled step time — so a client backs off proportionally
-        to real congestion, not by a blind constant. The rejected
-        request re-enters the fleet queue at the retry tick (the
-        harness's stand-in for the client honoring Retry-After), so a
-        flooded trace finishes with zero LOST requests — later, not
-        never."""
-        import math
-
+        estimate of when the LIGHTEST ROUTABLE queue will have drained
+        at the request's own tier (:meth:`_priced_retry`) — so a
+        client backs off proportionally to the congestion its tier
+        actually sees, not by a blind constant. The rejected request
+        re-enters the fleet queue at the retry tick (the harness's
+        stand-in for the client honoring Retry-After), so a flooded
+        trace finishes with zero LOST requests — later, not never."""
         cap = self.router.cfg.queue_cap
         if cap is None:
             return False
-        routable = [
+        routable = self._routable()
+        if not routable:
+            return False       # route() raises the every-replica-dead error
+        # depth at the arrival's OWN tier: a batch flood queued below
+        # an interactive arrival is not depth it stands behind (the
+        # same tier-visibility the priced retry uses) — single-tenant
+        # fleets see the full queue, the pre-tier cap exactly
+        rank = self._rank_of(req)
+        if min(r.queue_depth(rank=rank, rank_of=self._rank_of)
+               for r in routable) < cap:
+            return False
+        retry_ms, retry_ticks = self._priced_retry(req, routable)
+        self.stats.admission_rejections += 1
+        self._requeue_priced(req, retry_ms, retry_ticks)
+        return True
+
+    def _routable(self) -> list:
+        """Route candidates the ledger actually admits traffic to —
+        PROBATION and UNHEALTHY excluded. Every retry-after price MUST
+        come off this set: a PROBATION replica's empty queue is not a
+        wait any client can actually buy (it only takes seeded
+        probes), so pricing off it would hand out retry-afters the
+        fleet cannot honor (pinned by test)."""
+        return [
             r for r in self._route_candidates()
             if self.router.health_factor(self.health.state(r.peer))
             is not None
         ]
-        if not routable:
-            return False       # route() raises the every-replica-dead error
-        if min(r.queue_depth() for r in routable) < cap:
-            return False
+
+    def _priced_retry(self, req, routable) -> tuple:
+        """``(retry_ms, retry_ticks)`` for a bounced arrival: the
+        modeled drain of the lightest ROUTABLE replica's queue AT THE
+        REQUEST'S OWN TIER. Priority admission sorts tier-r retries
+        ahead of every lower tier, so a tier-r client waits only on
+        the queued work at rank ≤ r — per-tenant retry-after prices by
+        the tenant's own tier, not the fleet mean. Single-tenant
+        fleets price identically to the pre-tier behavior (every
+        request is rank 0, the filter passes the whole queue)."""
+        import math
+
+        from triton_distributed_tpu.tune import perf_model
+
         light = min(routable, key=lambda r: (r.queue_depth(),
                                              r.load_ms(), r.index))
-        retry_ms = light.load_ms()
+        rank = self._rank_of(req)
+        role = light.admit_role
+        ahead = sum(1 for q in list(role.waiting) + list(role.pending)
+                    if self._rank_of(q) <= rank)
+        retry_ms = perf_model.tiered_replica_load_ms(role, ahead)
+        for other in light._roles:
+            if other is not role:
+                retry_ms += perf_model.replica_load_ms(other)
         step_ms = light.step_model_ms()
         retry_ticks = (max(1, math.ceil(retry_ms / step_ms))
                        if step_ms > 0 else 1)
+        return retry_ms, retry_ticks
+
+    def _requeue_priced(self, req, retry_ms: float,
+                        retry_ticks: int) -> None:
         req.arrival = self.ticks + retry_ticks
         req.admission_retries = getattr(req, "admission_retries", 0) + 1
-        self.stats.admission_rejections += 1
         self.stats.retry_after_ms.append(retry_ms)
         # re-enter in arrival order (stable sort keeps FIFO among ties)
         self.queue.append(req)
         self.queue = deque(sorted(self.queue, key=lambda r: r.arrival))
+
+    def _shed_brownout(self, req) -> bool:
+        """Brownout load-shedding: while the overload controller sits
+        at a level that sheds this arrival's tier, bounce it with the
+        same tier-priced retry-after as admission control — strict
+        reverse-priority order (background first, batch only at the
+        deepest level, interactive never) and zero lost requests (the
+        retry re-enters the fleet queue and lands once the controller
+        recovers)."""
+        if self.brownout is None or self.brownout.level == 0:
+            return False
+        rank = self._rank_of(req)
+        if not self.brownout.sheds(rank):
+            return False
+        routable = self._routable()
+        if not routable:
+            return False
+        from triton_distributed_tpu.serving.engine import TIERS
+
+        retry_ms, retry_ticks = self._priced_retry(req, routable)
+        tier = TIERS[min(rank, len(TIERS) - 1)]
+        self.stats.sheds[tier] = self.stats.sheds.get(tier, 0) + 1
+        t = getattr(req, "tenant", "default")
+        self.stats.tenant_sheds[t] = (
+            self.stats.tenant_sheds.get(t, 0) + 1)
+        self._log_event(
+            "shed", -1,
+            f"rid={req.rid} tier={tier} "
+            f"level={BROWNOUT_LEVELS[self.brownout.level]} "
+            f"retry@{self.ticks + retry_ticks}")
+        self._requeue_priced(req, retry_ms, retry_ticks)
         return True
 
     def _route_probe(self, req):
@@ -705,6 +1054,7 @@ class ServingFleet:
 
         self._check_replica_deaths()
         self._maybe_grow()
+        self._observe_brownout()
         routed = self._dispatch()
         self._advance_drains()
         stepped = 0
@@ -737,10 +1087,70 @@ class ServingFleet:
             if r.index in self._probing:
                 del self._probing[r.index]
                 self.health.probe_result(r.peer, True, step=self.ticks)
+        self._maybe_retune()
         self._update_records()
         self.ticks += 1
         return {"tick": self.ticks, "routed": routed,
                 "stepped": stepped, "queued": len(self.queue)}
+
+    def _observe_brownout(self) -> None:
+        """One brownout observation per tick, then project the current
+        squeeze set onto every live engine — ``throttled_tiers`` is
+        what ``_chunk_for`` and the speculative ``_plan_row`` read to
+        halve the batch tier's chunk and cap its draft budget."""
+        if self.brownout is None:
+            return
+        self.brownout.observe(self)
+        squeezed = self.brownout.squeezed
+        for r in self._alive():
+            for role in r._roles:
+                role.throttled_tiers = squeezed
+
+    def _maybe_retune(self) -> None:
+        """Grid-schedule retuning inside the fleet's own MAINTENANCE
+        WINDOW (PR-15 follow-on): every ``retune_every`` ticks, IF the
+        tick is low-pressure — no arrived backlog, every routable
+        queue empty, brownout at normal (an overloaded fleet has no
+        business burning host time on schedule search). Retunes the
+        hottest shape ledger among the routable replicas via
+        ``background_retune`` (dryrun: perf-model priced, store
+        persisted) and joins the thread inside the window — the next
+        engine build resolves the winners for free."""
+        if not self.retune_every or self.ticks == 0 \
+                or self.ticks % self.retune_every:
+            return
+        if self.brownout is not None and self.brownout.level > 0:
+            return
+        if any(q.arrival <= self.ticks for q in self.queue):
+            return
+        routable = self._routable()
+        if not routable or any(r.queue_depth() > 0 for r in routable):
+            return
+
+        def heat(replica):
+            return sum(float(ent[1]) for role in replica._roles
+                       for ent in role.stats.shape_ledger.values())
+
+        target = max(routable, key=lambda r: (
+            heat(r), -_u(self.seed, "retune", self.ticks, r.index)))
+        role = target.admit_role
+        if not role.stats.shape_ledger:
+            return
+        from triton_distributed_tpu.tune.traffic import (
+            background_retune,
+        )
+
+        mc = role.model.config
+        t = background_retune(
+            role.stats, mesh_shape=(role.model.tp,),
+            wire="int8" if getattr(mc, "kv_quant", None) is not None
+            else None,
+            dryrun=True)
+        t.join()
+        self.stats.retunes.append(
+            (self.ticks, target.index, len(t.reports)))
+        self._log_event("retune", target.index,
+                        f"reports={len(t.reports)}")
 
     def _update_records(self) -> None:
         # the Request objects are shared with the engines (engines
@@ -833,6 +1243,10 @@ class ServingFleet:
             self.stats.retired_prefix_hits += role.stats.prefix_hits
             self.stats.retired_evictions += role.stats.evictions
             self.stats.retired_generated += role.stats.generated_tokens
+            self.stats.retired_preemptions += role.stats.preemptions
+            for t, n in role.stats.tenant_preemptions.items():
+                self.stats.retired_tenant_preemptions[t] = (
+                    self.stats.retired_tenant_preemptions.get(t, 0) + n)
 
     def revive(self, k: int, engine=None) -> None:
         """Bring replica ``k`` back with a FRESH engine (its old device
@@ -843,6 +1257,7 @@ class ServingFleet:
             raise ValueError(f"replica {k} is not dead")
         if engine is not None:
             self.replicas[k].engine = engine
+        self._wire_tenancy(self.replicas[k])
         self._dead.discard(k)
 
     # ---------------------------------------------------------- elastic
@@ -874,6 +1289,7 @@ class ServingFleet:
         idx = len(self.replicas)
         replica = Replica(idx, engine, mesh)
         self.replicas.append(replica)
+        self._wire_tenancy(replica)
         self.health.record(
             "autoscale_spawn", replica.peer, step=self.ticks,
             detail=f"replica {idx} spawned from the reserve pool",
@@ -1204,6 +1620,29 @@ class ServingFleet:
             role.stats.evictions
             for r in self.replicas for role in r._roles
             if r.index not in self._dead)
+
+    @property
+    def preemptions(self) -> int:
+        return self.stats.retired_preemptions + sum(
+            role.stats.preemptions
+            for r in self.replicas for role in r._roles
+            if r.index not in self._dead)
+
+    def tenant_preemptions(self) -> dict:
+        """tenant -> preemption count, live engines + retired."""
+        out = dict(self.stats.retired_tenant_preemptions)
+        for r in self.replicas:
+            if r.index in self._dead:
+                continue
+            for role in r._roles:
+                for t, n in role.stats.tenant_preemptions.items():
+                    out[t] = out.get(t, 0) + n
+        return out
+
+    def per_tenant(self) -> dict:
+        """:meth:`FleetStats.per_tenant` with the fleet's merged
+        preemption map filled in — the one-call observability view."""
+        return self.stats.per_tenant(self.tenant_preemptions())
 
     @property
     def generated_tokens(self) -> int:

@@ -23,11 +23,12 @@ The pieces:
     model weights, no device work) and strong on motif-heavy traffic.
 
 ``DraftModelDrafter``
-    A shared-weights draft model: the target model's own embedding and
-    lm_head composed into a greedy bigram table
-    (``argmax(embed @ lm_head)`` per source token). Host-side, built
-    lazily once, deterministic. Stands in for a genuinely smaller
-    checkpoint without shipping one.
+    A shared-weights TRUNCATED-DEPTH draft model: the target's own
+    embedding, first ``depth`` decoder blocks, final norm and lm_head
+    (parameter views — no second checkpoint) run as a real greedy
+    autoregressive forward. Acceptance now measures how much of the
+    target the early layers already determine, which is what makes
+    acceptance rates and the adaptive-k budget meaningful.
 
 ``SpeculativeEngine``
     A :class:`~triton_distributed_tpu.serving.engine.ServingEngine`
@@ -126,53 +127,79 @@ class NGramDrafter(Drafter):
 
 
 class DraftModelDrafter(Drafter):
-    """Shared-weights draft model: the target's embedding composed with
-    its lm_head as a greedy bigram predictor.
+    """A genuinely smaller shared-weights draft model: the target's own
+    embedding, its FIRST ``depth`` decoder blocks, final norm and
+    lm_head — all parameter VIEWS into the target checkpoint (shared
+    embeddings, truncated depth; no second checkpoint shipped) — run as
+    a real autoregressive forward. Drafting k tokens is k greedy steps
+    of that truncated model, so acceptance tracks how much of the
+    target's computation the early layers already determine (the
+    adaptive-k budget then has a real signal to walk), instead of the
+    fixed bigram table this class used to be.
 
-    ``table[v] = argmax(embed[v] @ lm_head)`` is materialized host-side
-    once (O(vocab² · hidden) on tiny serving models; lazily, so
-    building the engine costs nothing) and drafting k tokens walks the
-    table from the frontier token. Quantized lm_heads
-    (``{"q", "scale"}``) are dequantized through the same
-    per-out-channel convention the device uses."""
+    Sequences are right-padded to a ``BUCKET``-aligned length so the
+    jitted forward compiles once per bucket, not per length; causal
+    attention keeps the padding out of every position that is read.
+    Deterministic pure function of ``req.seq`` — the drafter contract
+    token-exactness rests on."""
 
     name = "draft_model"
 
-    def __init__(self, model, params):
-        self._model = model
-        self._params = params
-        self._table: np.ndarray | None = None
+    BUCKET = 16
 
-    def _bigram_table(self) -> np.ndarray:
-        if self._table is None:
-            embed = np.asarray(self._params["embed"], np.float32)
-            w = self._params["lm_head"]
-            if isinstance(w, dict):
-                w = (np.asarray(w["q"], np.float32)
-                     * np.asarray(w["scale"], np.float32)[None, :])
-            else:
-                w = np.asarray(w, np.float32)
-            self._table = np.argmax(embed @ w, axis=-1).astype(np.int32)
-        return self._table
+    def __init__(self, model, params, depth: int | None = None):
+        n = len(params["blocks"])
+        if depth is None:
+            depth = max(1, n // 2)
+        if not 1 <= depth <= n:
+            raise ValueError(
+                f"draft depth must be in [1, {n}], got {depth}")
+        self.depth = int(depth)
+        self._model = model
+        # views, not copies: the draft checkpoint IS the target's
+        self._params = {
+            "embed": params["embed"],
+            "norm_f": params["norm_f"],
+            "lm_head": params["lm_head"],
+            "blocks": list(params["blocks"][:depth]),
+        }
+        self._fwd = None
+
+    def _forward(self):
+        if self._fwd is None:
+            import jax
+
+            self._fwd = jax.jit(self._model.forward)
+        return self._fwd
+
+    def _next_token(self, seq: list) -> int:
+        ln = len(seq)
+        pad = -(-ln // self.BUCKET) * self.BUCKET
+        toks = np.zeros((1, pad), np.int32)
+        toks[0, :ln] = seq
+        logits = np.asarray(self._forward()(self._params, toks))
+        return int(np.argmax(logits[ln - 1]))
 
     def draft(self, req, k: int) -> np.ndarray:
-        table = self._bigram_table()
-        out, tok = [], int(req.seq[-1])
+        seq = [int(t) for t in req.seq]
+        out = []
         for _ in range(k):
-            tok = int(table[tok])
+            tok = self._next_token(seq)
             out.append(tok)
+            seq.append(tok)
         return np.asarray(out, np.int32)
 
 
 def make_drafter(kind: str, model=None, params=None, **kw) -> Drafter:
     """Build a drafter by name (``"ngram"`` / ``"draft_model"``) —
-    the bench/CI entry point."""
+    the bench/CI entry point. ``draft_model`` accepts ``depth`` (the
+    truncated layer count; default half the target's)."""
     if kind == "ngram":
         return NGramDrafter(**kw)
     if kind == "draft_model":
         if model is None or params is None:
             raise ValueError("draft_model drafter needs model + params")
-        return DraftModelDrafter(model, params)
+        return DraftModelDrafter(model, params, **kw)
     raise ValueError(f"unknown drafter kind: {kind!r}")
 
 
@@ -241,6 +268,15 @@ class SpeculativeEngine(ServingEngine):
             st = self.stats
             st.adaptive_k_rows[budget] = (
                 st.adaptive_k_rows.get(budget, 0) + 1)
+        if self.throttled_tiers:
+            # brownout squeeze: a throttled tier drafts at most one
+            # token — speculation's rollback work is the first compute
+            # the fleet reclaims from batch traffic under overload
+            pr = getattr(req, "priority", None)
+            if pr is None:
+                pr = self._tenant(req).priority
+            if pr in self.throttled_tiers:
+                budget = min(budget, 1)
         nd = min(budget,
                  self.state.capacity - (req.cursor + 1),
                  req.max_new - len(req.generated) - 1)

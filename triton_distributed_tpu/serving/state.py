@@ -134,6 +134,15 @@ class PagePool:
         """Pages an allocation may claim: free + reclaimable-cached."""
         return len(self.free) + len(self._reclaim)
 
+    @property
+    def held_pages(self) -> int:
+        """Pages some block-table row still references (refs >= 1).
+        On an IDLE engine this must be 0 — anything else is a leak
+        (the preemption/eviction invariant the multi-tenant chaos
+        matrix pins: ``pool.held_pages == 0`` once every stream has
+        completed, whatever was preempted mid-draft on the way)."""
+        return int((self.refs >= 1).sum())
+
     def alloc(self) -> int | None:
         """Claim one page (refcount 1), reclaiming the LRU cached page
         when the free list is dry. None when genuinely exhausted."""

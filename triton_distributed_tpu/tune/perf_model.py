@@ -376,6 +376,33 @@ def replica_step_ms(engine, *, spec: TpuSpec | None = None) -> float:
     )
 
 
+def _spec_accept_factor(engine) -> float:
+    """Tokens a speculative engine's verify step EMITS per step run —
+    the measured engine rate once verify rows exist, the geometric
+    prior before, 1.0 on plain engines. Divides the step clock
+    wherever per-token throughput is being priced."""
+    k = int(getattr(engine, "spec_k", 0))
+    if not k:
+        return 1.0
+    st = getattr(engine, "stats", None)
+    if st is not None and getattr(st, "spec_rows", 0) > 0:
+        return max(st.accepted_tokens_per_step, 1.0)
+    return expected_accepted_per_step(k, DEFAULT_SPEC_ACCEPTANCE)
+
+
+def tiered_replica_load_ms(engine, queued_ahead: int, *,
+                           spec: TpuSpec | None = None) -> float:
+    """:func:`replica_load_ms` with an EXPLICIT queued-ahead count —
+    the admission wait a PRIORITIZED arrival pays. Tier-r work
+    re-enters admission ahead of every lower tier (the multi-tenant
+    priority sort), so a tier-r arrival waits only on the queued
+    requests at rank <= r; the caller passes that tier-filtered depth
+    and the fleet's per-tenant retry-after prices by the tenant's own
+    tier instead of the fleet-blind full queue."""
+    step = replica_step_ms(engine, spec=spec) / _spec_accept_factor(engine)
+    return step * (1.0 + max(int(queued_ahead), 0))
+
+
 def replica_load_ms(engine, *, spec: TpuSpec | None = None) -> float:
     """Queue-depth load estimate for one fleet replica: the analytic
     :func:`replica_step_ms` scaled by how many admissions are already
@@ -387,17 +414,33 @@ def replica_load_ms(engine, *, spec: TpuSpec | None = None) -> float:
     the router under-routes exactly the replicas speculation sped
     up."""
     queued = len(engine.waiting) + len(engine.pending)
+    return tiered_replica_load_ms(engine, queued, spec=spec)
+
+
+def request_service_ms(engine, req, *,
+                       spec: TpuSpec | None = None) -> float:
+    """Modeled time to serve ``req`` ITSELF at this engine's current
+    occupancy clock: remaining prefill chunks plus remaining decode
+    steps (speculation divides the decode part by accepted-tokens-per-
+    step), each billed one :func:`replica_step_ms`. The own-work term
+    of the router's deadline slack."""
     step = replica_step_ms(engine, spec=spec)
-    k = int(getattr(engine, "spec_k", 0))
-    if k:
-        st = getattr(engine, "stats", None)
-        if st is not None and getattr(st, "spec_rows", 0) > 0:
-            accepted = max(st.accepted_tokens_per_step, 1.0)
-        else:
-            accepted = expected_accepted_per_step(
-                k, DEFAULT_SPEC_ACCEPTANCE)
-        step /= accepted
-    return step * (1.0 + queued)
+    remaining = max(len(req.seq) - req.cursor, 0)
+    chunks = -(-remaining // max(int(engine.cfg.chunk), 1))
+    decode = max(int(req.max_new) - len(req.generated), 0)
+    return (chunks + decode / _spec_accept_factor(engine)) * step
+
+
+def request_slack_ms(engine, req, slo_ms: float, *,
+                     spec: TpuSpec | None = None) -> float:
+    """Deadline slack of routing ``req`` to ``engine``:
+    ``slo_ms − modeled completion``, where modeled completion is the
+    queue already ahead (:func:`replica_load_ms`) plus the request's
+    own remaining work (:func:`request_service_ms`). Negative slack
+    means this placement is MODELED to miss the tenant's SLO — the
+    fleet router lets that outrank prefix affinity."""
+    return (float(slo_ms) - replica_load_ms(engine, spec=spec)
+            - request_service_ms(engine, req, spec=spec))
 
 
 # ------------------------------------------------ hop critical-path term
