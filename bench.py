@@ -255,6 +255,16 @@ def _parse_args(argv=None):
         "timed run",
     )
     ap.add_argument(
+        "--infer-contracts", action="store_true",
+        help="with --lint: additionally derive each family's delivery "
+        "contract from its XLA twin (rank-tagged execution + replay "
+        "provenance) and diff it against the declared one — SL012 on "
+        "drift, SL013 on a family registered without a declaration "
+        "(SL008 runs on the inferred contract there). Needs enough "
+        "host devices to execute the twins; falls back to the static "
+        "class table otherwise",
+    )
+    ap.add_argument(
         "--dryrun", action="store_true",
         help="hardware-free engine exercise: run ONLY the "
         "serving_continuous bench at interpreter-tiny shapes (whatever "
@@ -304,11 +314,13 @@ def _parse_args(argv=None):
     return ap.parse_args(argv)
 
 
-def _run_lint() -> None:
+def _run_lint(infer_contracts: bool = False) -> None:
     """bench --lint: static protocol + dataflow + Mosaic-compat passes
     over the benched kernel set (exit 2 on errors — unchanged
     contract; the dataflow rules ride inside lint_all, the pre-flight
-    is its own sweep)."""
+    is its own sweep). ``infer_contracts`` additionally diffs every
+    declared delivery contract against the twin-inferred one (SL012 /
+    SL013 ride inside the findings stream like any other rule)."""
     from triton_distributed_tpu.analysis import lint as shmemlint
     from triton_distributed_tpu.analysis import mosaic_compat
     from triton_distributed_tpu.analysis.findings import (
@@ -316,7 +328,16 @@ def _run_lint() -> None:
         rule_counts,
     )
 
-    findings = shmemlint.lint_all(n=8)
+    findings = shmemlint.lint_all(n=8, infer_contracts=infer_contracts)
+    if infer_contracts:
+        print(
+            json.dumps({"lint_contract_inference": {
+                "mesh": 8,
+                "drift": sum(f.rule == "SL012" for f in findings),
+                "undeclared": sum(f.rule == "SL013" for f in findings),
+            }}),
+            file=sys.stderr, flush=True,
+        )
     mc, report = mosaic_compat.preflight_all(n=8)
     findings += mc
     for f in findings:
@@ -492,7 +513,7 @@ def _run_lint() -> None:
 def main(argv=None) -> None:
     args = _parse_args(argv)
     if args.lint:
-        _run_lint()
+        _run_lint(infer_contracts=args.infer_contracts)
     if args.faults:
         from triton_distributed_tpu.runtime import faults as _rt_faults
 

@@ -479,3 +479,36 @@ print(f"multi-tenant smoke: {stats.completed}/{stats.submitted} "
       f"sheds={dict(stats.sheds)}, "
       f"preemptions={fleet.preemptions}")
 EOF
+
+# Contract-inference smoke (ISSUE 17 acceptance): derive the delivery
+# contract of one family per twin class from the XLA twin + replay
+# provenance at mesh 4 and diff it against the declaration — a drifted
+# declaration (SL012) or a silently missing one (SL013) fails CI in
+# seconds. The full-registry sweep at mesh 4 AND 8 lives in the pytest
+# suite; this step keeps the fast path to one family per class:
+# gather (ring AG), reduce (ring RS), permute (dense a2a), local
+# (ragged paged attention).
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+
+from triton_distributed_tpu.analysis import contract_infer
+from triton_distributed_tpu.kernels.registry import families
+
+fams = families()
+drifted = []
+for name in ("allgather.ring_1d", "reduce_scatter.ring",
+             "all_to_all.dense", "flash_decode.ragged_paged"):
+    res = contract_infer.infer_family(fams[name], 4)
+    assert res.profile.executed, (
+        f"{name}: twin not executed ({res.profile.detail})")
+    if res.findings:
+        drifted.append((name, [f.format() for f in res.findings]))
+assert not drifted, f"contract inference drift: {drifted}"
+print("contract inference: ring AG / ring RS / dense a2a / ragged "
+      "local all agree with their declared contracts at mesh 4")
+EOF

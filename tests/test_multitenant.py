@@ -371,6 +371,41 @@ class TestPreemption:
         assert all(r.done for r in bgs + [hi])
         _assert_no_leaks(eng)
 
+    def test_equal_rank_victim_is_fewest_committed_pages(
+            self, fleet_models):
+        """ISSUE-17 fleet satellite (ROADMAP #2 follow-on): preemption-
+        aware victim COST. At equal effective rank the resident with
+        the FEWEST committed pages is evicted — eviction is recompute-
+        priced, so the cheapest re-prefill goes first. The short-prompt
+        row sits in slot 0 on purpose: the pre-cost tie-break (latest
+        arrival, then highest slot) would have picked a long row and
+        thrown away 3x the materialized KV."""
+        eng = _engine(fleet_models, tenants=dict(TEN))
+        short = _req(0, 0.0, "bg", plen=8, max_new=8)
+        longs = [_req(i, 0.0, "bg", plen=24, max_new=8)
+                 for i in range(1, 4)]
+        for r in [short] + longs:
+            eng.submit(r)
+        for _ in range(3):
+            eng.step()
+        assert all(r.slot is not None for r in [short] + longs)
+        pages = {r.rid: int((eng.table[r.slot] >= 0).sum())
+                 for r in [short] + longs}
+        assert pages[0] == min(pages.values())
+        assert pages[0] < min(pages[r.rid] for r in longs)
+        hi = _req(10, 2.0, "iact", max_new=4)
+        eng.submit(hi)
+        eng.step()
+        assert eng.stats.preemptions == 1
+        assert short.slot is None and short.cursor == 0
+        assert all(r.slot is not None for r in longs)
+        for _ in range(300):
+            if eng.idle:
+                break
+            eng.step()
+        assert all(r.done for r in [short, hi] + longs)
+        _assert_no_leaks(eng)
+
     def test_preemption_token_exact(self, fleet_models):
         """Preempted streams are byte-identical to an unpreempted
         single-tenant run: sampling is keyed (seed, rid, n_generated),

@@ -27,6 +27,12 @@ Layout:
   contracts (SL008), wire-rail consistency (SL009), stale-scale reads
   (SL010) — a schedule can be semaphore-clean and still deliver wrong
   bytes; this pass is what catches that.
+* :mod:`contract_infer` — contract inference: run each family's XLA
+  twin (``degrades_to``) on rank-tagged inputs and realize the concrete
+  delivery contract from the replay's provenance arrays; declared
+  contracts become assertions (SL012 on drift, SL013 on a missing
+  declaration — inference supplies the contract so SL008 never goes
+  blind).
 * :mod:`mosaic_compat` — the seconds-fast Mosaic pre-flight (MC001–
   MC003): each family's kernel jaxpr, built for hardware, scanned for
   constructs this toolchain's Mosaic backend rejects.
@@ -58,6 +64,8 @@ __all__ = [
     "lint_family",
     "lint_mesh",
     "preflight_all",
+    "infer_family",
+    "verify_declared_contracts",
 ]
 
 
@@ -77,4 +85,8 @@ def __getattr__(name):
         from triton_distributed_tpu.analysis import dataflow
 
         return dataflow.DeliveryContract
+    if name in ("infer_family", "verify_declared_contracts"):
+        from triton_distributed_tpu.analysis import contract_infer
+
+        return getattr(contract_infer, name)
     raise AttributeError(name)

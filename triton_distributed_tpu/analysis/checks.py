@@ -463,7 +463,8 @@ def _check_vmem(rec) -> list:
     )]
 
 
-def check_family(rec: ev.Recorder, contract=None) -> list:
+def check_family(rec: ev.Recorder, contract=None,
+                 fallback_contract=None) -> list:
     """All per-family passes over one recorded kernel family.
 
     ``contract`` (a :class:`~triton_distributed_tpu.analysis.dataflow.
@@ -472,9 +473,17 @@ def check_family(rec: ev.Recorder, contract=None) -> list:
     against the contract, SL009/SL010 wire-rail consistency. The wire
     passes run whenever the traces carry a quantized rail, contract or
     not — a protocol can be semaphore-clean and still deliver the wrong
-    bytes, which is exactly what these passes exist to catch."""
+    bytes, which is exactly what these passes exist to catch.
+
+    ``fallback_contract`` is used only when ``contract`` is None: an
+    obligation *inferred* from the family's XLA twin
+    (:mod:`.contract_infer`) so SL008 never goes blind on a family
+    registered without a declaration — the gap itself is surfaced as
+    SL013 by the inference pass."""
     from triton_distributed_tpu.analysis import dataflow
 
+    if contract is None:
+        contract = fallback_contract
     sim = simulate(rec)
     findings = _check_barriers(rec) + _check_vmem(rec)
     if sim.completed:

@@ -705,15 +705,29 @@ def _check_oob(rec) -> list:
 
 # ------------------------------------------------------------------- entry
 
+def replay_provenance(rec, sim):
+    """Seed per-rank provenance and replay one completed schedule.
+
+    Returns ``(state, puts, findings)`` — the terminal provenance
+    ``_State`` (contrib/wire/scale/hop arrays per (rank, root)), the
+    put count, and the mid-replay SL009/SL010 wire findings. This is
+    the shared substrate of :func:`check_dataflow` and the contract
+    inference in :mod:`.contract_infer` (which realizes a
+    DeliveryContract *from* the terminal state instead of checking one
+    against it)."""
+    state = _State(rec)
+    state.seed_inputs()
+    puts, findings = _replay(rec, sim, state)
+    return state, puts, findings
+
+
 def check_dataflow(rec, sim, contract: DeliveryContract | None) -> list:
     """The SL008/SL009/SL010 data-correctness passes plus the SL011
     hop-critical-path check over one completed replay."""
     if rec.n > MAX_RANKS:
         return []
     findings = _check_oob(rec)
-    state = _State(rec)
-    state.seed_inputs()
-    _puts, more = _replay(rec, sim, state)
+    state, _puts, more = replay_provenance(rec, sim)
     findings += more
     findings += _check_rail_pairing(rec)
     if contract is not None:

@@ -106,6 +106,25 @@ RULES = {
         "counters are fed to tune.perf_model.hop_critical_path_ms to "
         "project the wall-clock regression before any hardware run",
     ),
+    "SL012": (
+        "contract-drift",
+        Severity.ERROR,
+        "the hand-declared DeliveryContract disagrees with the one "
+        "inferred from the family's XLA twin + replay provenance: wrong "
+        "kind class (gather/permute vs reduce vs local), a dst root "
+        "that never exhibits the twin's delivery pattern, "
+        "over/under-declared payload_per_src, missing or stray source "
+        "ranks, or full/own-absent drift — the declaration would make "
+        "SL008 check the wrong obligation",
+    ),
+    "SL013": (
+        "undeclared-contract",
+        Severity.WARNING,
+        "a registered family carries no declared DeliveryContract; "
+        "contract inference derived one from the XLA twin so the SL008 "
+        "completeness pass still runs, but the gap should be closed by "
+        "declaring the contract in kernels/registry.py",
+    ),
     "MC001": (
         "mosaic-f8-cast",
         Severity.ERROR,

@@ -1061,3 +1061,77 @@ def grad_ring_unpaired_scale(axis="x"):
         lambda _n: [((8 * _n, 2048), _F32)],
         DeliveryContract(kind="reduce", dst="out_hbm"),
     )
+
+
+def contract_declares_gather_actually_reduces(axis="x"):
+    """A seeded SL012 true-positive for contract inference: the REAL
+    reduce-scatter ring kernel registered with a hand-written contract
+    that declares ``kind='gather'``. Every semaphore balances and the
+    kernel genuinely delivers — but it FOLDS (every output element sums
+    a contribution from all ranks) while the declaration promises
+    single-sourced chunks, so plain SL008 would check the wrong shape
+    and judge a correct reduction 'incomplete' (or a broken gather
+    complete). Only the twin diff (``jax.lax.psum_scatter`` delivers
+    class 'fold', the declared kind is class 'single') can name the
+    declaration itself as the bug. Returns (spec, in_shapes, declared
+    contract, degrades_to path)."""
+    from dataclasses import replace
+
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.analysis.lint import lint_mesh
+    from triton_distributed_tpu.kernels.reduce_scatter import (
+        _build_reduce_scatter,
+    )
+    from triton_distributed_tpu.lang.launch import captured_launch
+
+    n = 8
+    _build_reduce_scatter(
+        lint_mesh(n, axis), axis, (8 * n, 128), jnp.dtype(jnp.float32),
+        False, 55, _schedule_token(),
+    )
+    spec = captured_launch("rs_ring")
+    return (
+        replace(spec, name="fixture_contract_gather_actually_reduces"),
+        lambda _n: [((8 * _n, 128), _F32)],
+        DeliveryContract(kind="gather", dst="out_ref"),
+        "jax.lax.psum_scatter",
+    )
+
+
+def contract_overdeclared_payload(axis="x"):
+    """A seeded SL012 true-positive for contract inference: the REAL
+    1-D all-gather ring with a declared ``payload_per_src`` of TWICE
+    what the twin (and the kernel) actually deliver per source. The
+    kind and dst are right, so the drift is purely quantitative — a
+    declaration like this would make SL008 flag every correct run as
+    half-delivered (and, declared the other way, bless a half-delivered
+    one). The inference pass measures the modal per-source landing off
+    the replay's provenance nibbles and names the over-declaration.
+    Returns (spec, in_shapes, declared contract, degrades_to path)."""
+    from dataclasses import replace
+
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.analysis.lint import lint_mesh
+    from triton_distributed_tpu.kernels.allgather import _build_all_gather
+    from triton_distributed_tpu.lang.launch import captured_launch
+    from triton_distributed_tpu.runtime import AllGatherMethod
+
+    n = 8
+    _build_all_gather(
+        lint_mesh(n, axis), axis, AllGatherMethod.RING_1D, (8 * n, 128),
+        jnp.dtype(jnp.float32), 56, _schedule_token(),
+    )
+    spec = captured_launch("ag_ring_1d")
+    return (
+        replace(spec, name="fixture_contract_overdeclared_payload"),
+        lambda _n: [((8, 128), _F32)],
+        DeliveryContract(
+            kind="gather", dst="out_ref",
+            payload_per_src=lambda _n: 2 * 8 * 128,
+        ),
+        "jax.lax.all_gather",
+    )

@@ -59,10 +59,16 @@ def analyze_spec(spec, in_shapes, n, *, kernel_name, site=None, init=None,
     return rec, checks.check_family(rec, contract=contract)
 
 
-def analyze_family(fam, n: int = 8, mesh=None):
+def analyze_family(fam, n: int = 8, mesh=None, *, infer_contracts=False):
     """Build one registry family over an abstract mesh, read back the
     captured LaunchSpec, and analyze it (the family's declared delivery
-    contract drives the SL008 pass). Returns (recorder, findings)."""
+    contract drives the SL008 pass). Returns (recorder, findings).
+
+    ``infer_contracts=True`` additionally derives the family's delivery
+    obligation from its XLA twin (:mod:`.contract_infer`): declared
+    contracts are diffed against the inferred one (SL012 on drift), and
+    a family with ``contract=None`` gets the inferred contract as the
+    SL008 fallback plus an SL013 surfacing the gap."""
     from triton_distributed_tpu.lang.launch import captured_launch
 
     mesh = mesh if mesh is not None else lint_mesh(n, fam.axis)
@@ -73,13 +79,23 @@ def analyze_family(fam, n: int = 8, mesh=None):
             f"family {fam.name!r}: builder did not construct a "
             f"shmem_call named {fam.launch_name!r}"
         )
-    return analyze_spec(
+    rec = abstract.run_symbolic(
         spec, fam.in_shapes(n), n,
-        kernel_name=fam.name, site=fam.site,
-        init=fam.init(n) if fam.init else None,
         axis=fam.axis, mesh_axes=fam.mesh_axes,
-        contract=fam.contract,
+        init=fam.init(n) if fam.init else None,
+        kernel_name=fam.name, site=fam.site,
     )
+    fallback, inferred = None, []
+    if infer_contracts and fam.degrades_to:
+        from triton_distributed_tpu.analysis import contract_infer
+
+        result = contract_infer.infer_spec(
+            rec, degrades_to=fam.degrades_to, declared=fam.contract)
+        inferred = result.findings
+        fallback = result.contract
+    findings = checks.check_family(
+        rec, contract=fam.contract, fallback_contract=fallback)
+    return rec, findings + inferred
 
 
 def _apply_allow(findings, allow):
@@ -90,12 +106,14 @@ def _apply_allow(findings, allow):
     return findings
 
 
-def lint_family(name: str, n: int = 8, mesh=None, allow=None):
+def lint_family(name: str, n: int = 8, mesh=None, allow=None,
+                infer_contracts=False):
     """Lint one registry family by name; returns the findings."""
     from triton_distributed_tpu.kernels.registry import families
 
     fam = families()[name]
-    _, findings = analyze_family(fam, n, mesh)
+    _, findings = analyze_family(fam, n, mesh,
+                                 infer_contracts=infer_contracts)
     return _apply_allow(findings, allow)
 
 
@@ -126,7 +144,8 @@ def _cross_family_checks(recorders) -> list:
     return findings
 
 
-def lint_all(n: int = 8, mesh=None, kernels=None, allow=None):
+def lint_all(n: int = 8, mesh=None, kernels=None, allow=None,
+             infer_contracts=False):
     """Lint every registered kernel family (optionally filtered by the
     ``kernels`` substring list) plus the cross-family hygiene checks.
     Returns the combined findings list."""
@@ -142,7 +161,8 @@ def lint_all(n: int = 8, mesh=None, kernels=None, allow=None):
             raise ValueError(f"no registered kernel matches {kernels}")
     findings, recorders = [], []
     for name in sorted(fams):
-        rec, f = analyze_family(fams[name], n, mesh)
+        rec, f = analyze_family(fams[name], n, mesh,
+                                infer_contracts=infer_contracts)
         recorders.append(rec)
         findings += f
     findings += _cross_family_checks(recorders)
@@ -174,6 +194,12 @@ def main(argv=None) -> int:
                     help="one JSON object per line on stdout: a "
                     "schema_version header, each finding, and a "
                     "rule_counts summary")
+    ap.add_argument("--infer-contracts", action="store_true",
+                    help="derive each family's delivery contract from "
+                    "its XLA twin and diff it against the declared one "
+                    "(SL012 on drift, SL013 on a missing declaration; "
+                    "SL008 runs on the inferred contract when none is "
+                    "declared)")
     ap.add_argument("--mosaic", action="store_true",
                     help="also run the Mosaic-compat pre-flight (rules "
                     "MC001-MC004: trace each family's kernel jaxpr and "
@@ -193,7 +219,8 @@ def main(argv=None) -> int:
             print(f"{name:24s} site={fam.site} launch={fam.launch_name}")
         return 0
 
-    findings = lint_all(n=args.mesh, kernels=args.kernel, allow=args.allow)
+    findings = lint_all(n=args.mesh, kernels=args.kernel, allow=args.allow,
+                        infer_contracts=args.infer_contracts)
     if args.mosaic:
         from triton_distributed_tpu.analysis import mosaic_compat
 

@@ -877,10 +877,48 @@ def families() -> dict:
     ]
     from dataclasses import replace as _replace
 
-    return {
+    out = {
         f.name: (
             f if f.degrades_to
             else _replace(f, degrades_to=DEGRADATION_TARGETS.get(f.name))
         )
         for f in fams
     }
+    _strict_verify_contracts()
+    return out
+
+
+#: one-shot flag for the TDTPU_LINT_STRICT registration gate: None =
+#: not yet run, True = verified clean. A failure leaves it None so a
+#: fixed environment can re-verify.
+_STRICT_VERIFIED = None
+
+
+def _strict_verify_contracts():
+    """Under ``TDTPU_LINT_STRICT=1``, re-verify every hand-declared
+    delivery contract against the one inferred from its XLA twin at
+    registration time (mesh 4, memoized — one pass per process). Any
+    SL012 drift raises: a declaration that would make SL008 check the
+    wrong obligation must not register."""
+    import os
+
+    global _STRICT_VERIFIED
+    if _STRICT_VERIFIED or os.environ.get("TDTPU_LINT_STRICT") != "1":
+        return
+    # mark before running: verification itself calls families()
+    _STRICT_VERIFIED = True
+    try:
+        from triton_distributed_tpu.analysis import contract_infer
+        from triton_distributed_tpu.analysis.findings import Severity
+
+        findings = contract_infer.verify_declared_contracts(n=4)
+        errs = [f for f in findings if f.severity >= Severity.ERROR]
+        if errs:
+            raise RuntimeError(
+                "TDTPU_LINT_STRICT: declared delivery contracts drift "
+                "from the twin-inferred obligations:\n"
+                + "\n".join(f.format() for f in errs)
+            )
+    except BaseException:
+        _STRICT_VERIFIED = None
+        raise

@@ -569,12 +569,16 @@ class ServingEngine:
         Victims are ranked by EFFECTIVE rank too: anti-starvation
         aging protects residency as well as admission order — a
         background row that waited out its aging bumps can no longer
-        be preempted by the interactive flood that starved it. Runs
-        under the ``preempt`` chaos site so a fault-plan Stall can
-        wedge it visibly."""
+        be preempted by the interactive flood that starved it. At
+        EQUAL effective rank the victim with the fewest committed
+        pages goes first: eviction is recompute-priced, so the cheapest
+        re-prefill (least KV already materialized) is the one to throw
+        away. Runs under the ``preempt`` chaos site so a fault-plan
+        Stall can wedge it visibly."""
         rank = self._eff_rank(by_req)
         victims = [
-            (self._eff_rank(req), req.arrival, s)
+            (self._eff_rank(req), -int((self.table[s] >= 0).sum()),
+             req.arrival, s)
             for s, req in enumerate(self.slot_req)
             if req is not None and not req.parked and not req.done
             and self._eff_rank(req) > rank
@@ -583,7 +587,7 @@ class ServingEngine:
             return False
         from triton_distributed_tpu.lang.launch import maybe_instrument
 
-        _, _, s = max(victims)
+        _, _, _, s = max(victims)
 
         def body():
             victim = self.slot_req[s]
