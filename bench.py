@@ -289,6 +289,15 @@ def _parse_args(argv=None):
         "explainable (ISSUE-12 satellite / ISSUE-6 follow-on)",
     )
     ap.add_argument(
+        "--tree", action="store_true",
+        help="serving_speculative: the tree-speculation paired row — "
+        "spec_tree verify trees (TreeDrafter sibling branches) vs "
+        "linear draft-k on a branchy SAMPLED motif trace (token "
+        "mismatches must be 0 and accepted/step strictly above "
+        "linear), plus the in-batch shared-prefix dedup row "
+        "(deduped pages > 0, token-exact; ISSUE-18)",
+    )
+    ap.add_argument(
         "--spec-k", type=int, default=None, metavar="K",
         help="serving_fleet: run SPECULATIVE replicas (draft-k K, "
         "ngram drafter) against a non-speculative fleet on the "
@@ -546,6 +555,8 @@ def main(argv=None) -> None:
         kw = {}
         if args.scenario == "serving_fleet" and args.spec_k:
             kw["spec_k"] = args.spec_k
+        if args.scenario == "serving_speculative" and args.tree:
+            kw["tree"] = True
         out = bench_fn(
             mesh, len(devs), on_tpu, detect_spec(),
             tiny=args.dryrun or not on_tpu, **kw,
@@ -1962,7 +1973,164 @@ def _bench_serving_disaggregated(mesh, n, on_tpu, spec, tiny=False):
     }
 
 
-def _bench_serving_speculative(mesh, n, on_tpu, spec, tiny=False):
+def _bench_serving_speculative_tree(mesh, n, on_tpu, spec, tiny=False):
+    """The --tree paired row (ISSUE-18 acceptance): tree speculation
+    (spec_tree verify trees under the kernel's TREE topology, the
+    TreeDrafter's trunk + sibling branches) against linear draft-k on
+    a BRANCHY SAMPLED motif trace — small top_k temperature sampling
+    makes the prompt self-history genuinely ambiguous, the regime
+    where sibling rescue branches accept tokens the single linear
+    draft loses. Both engines must reproduce the plain engine's
+    streams byte-identically; the tree row must land strictly more
+    accepted tokens per verify step. Rides the pinned small recipe
+    (the acceptance comparison is about scheduling, not FLOPs) so the
+    row is deterministic on CPU and TPU alike. Also emits the
+    in-batch shared-prefix dedup paired row: requests sharing a long
+    prompt prefix served with ``prefix_share`` fold their duplicate
+    frozen prefix pages onto one canonical page (deduped pages > 0,
+    token-exact, goodput no worse)."""
+    import jax
+    from dataclasses import replace as _sp_rep
+
+    from triton_distributed_tpu.models import Transformer, TransformerConfig
+    from triton_distributed_tpu.serving import (
+        EngineConfig,
+        NGramDrafter,
+        Request,
+        ServingEngine,
+        SpeculativeEngine,
+        TreeDrafter,
+        poisson_trace,
+    )
+    from triton_distributed_tpu.tune.perf_model import (
+        DEFAULT_SPEC_ACCEPTANCE,
+        expected_accepted_per_step,
+        expected_accepted_per_step_tree,
+    )
+
+    cfg = TransformerConfig(
+        vocab=128, n_layers=2, hidden=64, ffn=128, n_heads=4,
+        n_kv_heads=2, head_dim=16, dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    mesh1 = Mesh(np.asarray(jax.devices()[:1]), ("x",))
+    model = Transformer(cfg, mesh1, tp_axis="x")
+    params = model.init(jax.random.PRNGKey(0))
+    ecfg = EngineConfig(slots=4, token_budget=48, chunk=16, page=8,
+                        npages=40, temperature=1.0, top_k=4, seed=5)
+    spec_tree, spec_k = 8, 4
+
+    def branchy_trace():
+        base = poisson_trace(13, 6, 0.5, 8, 30, 16, 24, 128)
+        rng = np.random.default_rng(13 + 1000)
+        for r in base:
+            ln = len(r.prompt)
+            motif = rng.integers(0, 128, (5,)).astype(np.int32)
+            r.prompt = np.tile(motif, -(-ln // 5))[:ln]
+        return base
+
+    t_ref = branchy_trace()
+    stats_ref = ServingEngine(model, params, ecfg).run(
+        t_ref, max_steps=800)
+    t_tree = branchy_trace()
+    stats_tree = SpeculativeEngine(
+        model, params, ecfg, spec_tree=spec_tree,
+        drafter=TreeDrafter(branches=3, branch_len=2),
+    ).run(t_tree, max_steps=800)
+    t_lin = branchy_trace()
+    stats_lin = SpeculativeEngine(
+        model, params, ecfg, spec_k=spec_k, drafter=NGramDrafter(),
+    ).run(t_lin, max_steps=800)
+    assert (stats_ref.completed == stats_tree.completed
+            == stats_lin.completed == len(t_ref))
+    mism_tree = sum(
+        a.generated != b.generated for a, b in zip(t_ref, t_tree))
+    mism_lin = sum(
+        a.generated != b.generated for a, b in zip(t_ref, t_lin))
+    tree_acc = stats_tree.accepted_tokens_per_step
+    lin_acc = stats_lin.accepted_tokens_per_step
+
+    # ---- shared-prefix dedup paired row: one long common prefix
+    def shared_trace():
+        rng = np.random.default_rng(21)
+        prefix = rng.integers(0, 128, (24,)).astype(np.int32)
+        return [
+            Request(rid=i,
+                    prompt=np.concatenate(
+                        [prefix,
+                         rng.integers(0, 128, (4,)).astype(np.int32)]),
+                    max_new=6, arrival=0.1 * i)
+            for i in range(6)
+        ]
+
+    dcfg = _sp_rep(ecfg, slots=3, npages=64)
+    for _warm in (False, True):            # warm run pays the compiles
+        t_base = shared_trace()
+        stats_base = ServingEngine(model, params, dcfg).run(
+            t_base, max_steps=800)
+    for _warm in (False, True):
+        t_dd = shared_trace()
+        stats_dd = ServingEngine(
+            model, params,
+            _sp_rep(dcfg, prefix_cache=True, prefix_share=True),
+        ).run(t_dd, max_steps=800)
+    assert stats_base.completed == stats_dd.completed == len(t_base)
+    mism_dd = sum(
+        a.generated != b.generated for a, b in zip(t_base, t_dd))
+
+    return {
+        "metric": "serving_speculative_tree",
+        "value": round(tree_acc, 3),
+        "unit": "accepted tok/verify-step",
+        "accepted_tokens_per_step": round(tree_acc, 3),
+        "linear_accepted_tokens_per_step": round(lin_acc, 3),
+        "tree_beats_linear": bool(tree_acc > lin_acc),
+        "token_mismatches_vs_nonspeculative": mism_tree,
+        "linear_token_mismatches_vs_nonspeculative": mism_lin,
+        "spec_rows": stats_tree.spec_rows,
+        "draft_tokens": stats_tree.draft_tokens,
+        "rolled_back_tokens": stats_tree.rolled_back_tokens,
+        "steps": len(stats_tree.step_times),
+        "steps_linear": len(stats_lin.step_times),
+        "steps_nonspeculative": len(stats_ref.step_times),
+        "model_accepted_per_step_linear_prior": round(
+            expected_accepted_per_step(spec_k, DEFAULT_SPEC_ACCEPTANCE),
+            3),
+        "model_accepted_per_step_tree_prior": round(
+            expected_accepted_per_step_tree(
+                spec_tree, DEFAULT_SPEC_ACCEPTANCE, branches=3), 3),
+        # the shared-prefix dedup row
+        "shared_prefix_rows": stats_dd.shared_prefix_rows,
+        "deduped_pages": stats_dd.deduped_pages,
+        "dedup_token_mismatches": mism_dd,
+        # scheduler-level goodput (generated tokens per STEP): the
+        # deterministic "no worse" pin — dedup changes page aliasing,
+        # never the step count or the streams. Wall-clock goodput rides
+        # alongside; at interpreter-tiny shapes it sees the host-side
+        # table rewrite but not the KV reads dedup saves, so it is
+        # reported, not gated on.
+        "dedup_goodput_ratio": round(
+            (stats_dd.generated_tokens / len(stats_dd.step_times))
+            / (stats_base.generated_tokens / len(stats_base.step_times)),
+            3),
+        "dedup_wallclock_goodput_ratio": round(
+            stats_dd.goodput_tok_per_s / stats_base.goodput_tok_per_s, 3
+        ) if stats_base.goodput_tok_per_s else None,
+        "config": (
+            f"spec_tree={spec_tree} TreeDrafter(branches=3, "
+            f"branch_len=2) vs spec_k={spec_k} ngram, top_k=4 "
+            f"temperature=1.0 branchy motif trace; dedup: 6 requests "
+            f"sharing a 24-token prefix, page=8 "
+            + ("tiny-dryrun" if tiny or not on_tpu else "headline")
+        ),
+    }
+
+
+def _bench_serving_speculative(mesh, n, on_tpu, spec, tiny=False,
+                               tree=False):
+    if tree:
+        return _bench_serving_speculative_tree(mesh, n, on_tpu, spec,
+                                               tiny=tiny)
     """SPECULATIVE decoding (ISSUE 12 tentpole acceptance): the PR-6
     Poisson trace with MOTIF-HEAVY prompts (repeated 5-token motifs —
     the traffic shape prompt-lookup speculation exists for) served

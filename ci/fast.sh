@@ -201,6 +201,107 @@ print(f"speculative smoke: 0 mismatches across {stats.completed} "
       f"rolled_back={stats.rolled_back_tokens})")
 EOF
 
+# Tree-speculation smoke (ISSUE 18 acceptance): a BRANCHY sampled motif
+# trace (small top_k makes the self-history ambiguous — the regime
+# sibling rescue branches exist for) through spec_tree=8 (TreeDrafter)
+# vs linear spec_k=4 vs the plain engine — exits nonzero unless the
+# tree streams are byte-identical (token_mismatches == 0) AND the tree
+# row lands at least the linear baseline's accepted tokens per verify
+# step (strictly more, on this pinned recipe). Then the in-batch
+# shared-prefix dedup smoke: requests sharing one long prompt prefix
+# under cfg.prefix_share must fold duplicate prefix pages
+# (deduped_pages > 0) while staying token-exact with no pool leak.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from triton_distributed_tpu.models import Transformer, TransformerConfig
+from triton_distributed_tpu.serving import (
+    EngineConfig, NGramDrafter, Request, ServingEngine,
+    SpeculativeEngine, TreeDrafter, poisson_trace,
+)
+from dataclasses import replace
+
+cfg = TransformerConfig(
+    vocab=128, n_layers=2, hidden=64, ffn=128, n_heads=4, n_kv_heads=2,
+    head_dim=16, dtype=jnp.float32, param_dtype=jnp.float32)
+ecfg = EngineConfig(slots=4, token_budget=48, chunk=16, page=8,
+                    npages=40, temperature=1.0, top_k=4, seed=5)
+mesh = Mesh(np.asarray(jax.devices()[:1]), ("tp",))
+model = Transformer(cfg, mesh, "tp", ())
+params = model.init(jax.random.PRNGKey(0))
+
+def mk_trace():
+    base = poisson_trace(seed=13, n_requests=6, mean_interarrival=0.5,
+                         len_lo=8, len_hi=30, max_new_lo=16,
+                         max_new_hi=24, vocab=128)
+    rng = np.random.default_rng(1013)
+    for r in base:
+        ln = len(r.prompt)
+        motif = rng.integers(0, 128, (5,)).astype(np.int32)
+        r.prompt = np.tile(motif, -(-ln // 5))[:ln]
+    return base
+
+t_ref = mk_trace()
+ServingEngine(model, params, ecfg, use_pallas=False).run(
+    t_ref, max_steps=800)
+t_tree = mk_trace()
+eng = SpeculativeEngine(
+    model, params, ecfg, spec_tree=8,
+    drafter=TreeDrafter(branches=3, branch_len=2), use_pallas=False)
+tree = eng.run(t_tree, max_steps=800)
+t_lin = mk_trace()
+lin = SpeculativeEngine(
+    model, params, ecfg, spec_k=4, drafter=NGramDrafter(),
+    use_pallas=False).run(t_lin, max_steps=800)
+mismatches = sum(
+    a.generated != b.generated for a, b in zip(t_ref, t_tree))
+assert mismatches == 0, (
+    f"tree smoke: {mismatches} token-stream mismatches vs the "
+    f"non-speculative engine")
+t_acc = tree.accepted_tokens_per_step
+l_acc = lin.accepted_tokens_per_step
+assert t_acc >= l_acc, (
+    f"tree smoke: tree accepted/step {t_acc:.3f} below the linear "
+    f"draft-k baseline {l_acc:.3f}")
+assert eng.pool.available == ecfg.npages, "tree smoke: pool leak"
+print(f"tree smoke: 0 mismatches across {tree.completed} requests, "
+      f"tree accepted/step={t_acc:.3f} vs linear {l_acc:.3f} "
+      f"(rolled_back={tree.rolled_back_tokens})")
+
+rng = np.random.default_rng(21)
+prefix = rng.integers(0, 128, (24,)).astype(np.int32)
+def shared_trace():
+    r2 = np.random.default_rng(22)
+    return [Request(rid=i,
+                    prompt=np.concatenate(
+                        [prefix,
+                         r2.integers(0, 128, (4,)).astype(np.int32)]),
+                    max_new=6, arrival=0.1 * i)
+            for i in range(6)]
+
+dcfg = replace(ecfg, slots=3, npages=64)
+t_base = shared_trace()
+ServingEngine(model, params, dcfg, use_pallas=False).run(
+    t_base, max_steps=800)
+t_dd = shared_trace()
+deng = ServingEngine(
+    model, params, replace(dcfg, prefix_cache=True, prefix_share=True),
+    use_pallas=False)
+dd = deng.run(t_dd, max_steps=800)
+mism = sum(a.generated != b.generated for a, b in zip(t_base, t_dd))
+assert mism == 0, f"dedup smoke: {mism} token-stream mismatches"
+assert dd.deduped_pages > 0, (
+    f"dedup smoke: no pages deduped "
+    f"(shared_prefix_rows={dd.shared_prefix_rows})")
+assert deng.pool.available == dcfg.npages, "dedup smoke: pool leak"
+print(f"dedup smoke: 0 mismatches across {dd.completed} requests, "
+      f"deduped_pages={dd.deduped_pages} "
+      f"shared_prefix_rows={dd.shared_prefix_rows}")
+EOF
+
 # Elastic fleet smoke (ISSUE 13 acceptance): a 1-replica fleet with one
 # reserve engine scales UP under queue pressure (the grown replica must
 # earn admission through the probation-probe path), then replica 0 is

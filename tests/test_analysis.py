@@ -424,6 +424,18 @@ class TestMosaicCompat:
         )
         assert _rules(f) == ["MC003"]
 
+    def test_dynamic_gather_fixture_flagged(self):
+        """MC006: jnp.take over a TRACED index vector — the anc[par]
+        index chase the ragged kernel's static ancestor-bitmask unroll
+        exists to avoid — is denied; the registry preflight above
+        proves the real kernels never produce it."""
+        spec, in_shapes = fixtures.dynamic_gather()
+        f = mosaic_compat.preflight_spec(
+            spec, in_shapes(4), 4, kernel_name="fx_dg", site="fixture"
+        )
+        assert _rules(f) == ["MC006"]
+        assert "traced indices" in f[0].message
+
     def test_fp8_wire_family_flags_mc001_when_forced(self, monkeypatch):
         """The KNOWN f8-cast construct, on a real registry family: with
         the toolchain override asserting in-kernel f8 support, the fp8
@@ -511,7 +523,7 @@ class TestEventModel:
         assert set(RULES) == {
             "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007",
             "SL008", "SL009", "SL010", "SL011", "SL012", "SL013",
-            "MC001", "MC002", "MC003", "MC004", "MC005",
+            "MC001", "MC002", "MC003", "MC004", "MC005", "MC006",
         }
 
     def test_ring_trace_targets_right_neighbor(self):
@@ -766,10 +778,39 @@ class TestRaggedFamily:
         writes = [
             e.dst_region for e in rec.traces[0]
             if isinstance(e, events.PutEvent) and e.local
-            and e.dst_region.ref == "ref9"
+            and e.dst_region.ref == "ref10"
         ]
         starts = sorted(r.lo[1] for r in writes)
         assert starts == [0, 8]            # one out-DMA per packed row
+
+    def test_tree_sibling_fixture_is_sl008(self):
+        """Seeded masked-coverage true-positive: a TREE row whose
+        ancestry bitmask smuggles a SIBLING-branch bit (anc not closed
+        under the parent pointers) — balanced semaphores, full byte
+        coverage; only the contract's topology facet can reject it."""
+        spec, in_shapes, contract, init = fixtures.ragged_tree_sibling()
+        _, findings = analyze_spec(
+            spec, in_shapes(4), 4, kernel_name="ragged_tree_sibling",
+            site="fixture", contract=contract, init=init,
+        )
+        sib = [f for f in findings if f.rule == "SL008"]
+        assert sib, [f.format() for f in findings]
+        assert all("sibling" in f.message for f in sib)
+        assert all(f.severity == Severity.ERROR for f in sib)
+
+    def test_topo_meta_inferred_both_meshes(self):
+        """The masked-coverage facet is INFERRED, not just declared:
+        contract inference detects the topology operand from the
+        scalar-prefetch profile at mesh 4 AND 8, agrees with the
+        declared facet (no SL012), and carries the width."""
+        from triton_distributed_tpu.analysis import contract_infer
+
+        for n in (4, 8):
+            res = contract_infer.infer_family(
+                families()["flash_decode.ragged_paged"], n)
+            assert res.findings == [], [f.format() for f in res.findings]
+            assert res.contract.topo == {
+                "ref": 4, "kv_lens": 1, "q_lens": 2, "width": 8}
 
     def test_ragged_hole_fixture_is_sl008(self):
         spec, in_shapes, contract = fixtures.ragged_hole()

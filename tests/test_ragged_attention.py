@@ -19,9 +19,12 @@ import pytest
 from triton_distributed_tpu.kernels.flash_decode import quantize_kv
 from triton_distributed_tpu.kernels.ragged_paged_attention import (
     auto_block_q,
+    causal_topologies,
     pack_gqa_rows,
     ragged_paged_attention,
     ragged_paged_attention_xla,
+    topo_width,
+    tree_topology_row,
     unpack_gqa_rows,
 )
 
@@ -180,6 +183,161 @@ class TestRaggedKernel:
             ragged_paged_attention(
                 pack_gqa_rows(q, HKV), *pools, kv_lens, q_lens, q_starts,
                 table, group=G, block_q=3,
+            )
+
+    @pytest.mark.parametrize("quant", [False, True])
+    def test_all_causal_topologies_byte_identical(self, quant):
+        """Acceptance: an all-CAUSAL topology operand changes NOTHING —
+        valid spans byte-identical to the topology-less launch (the
+        identity-operand contract; garbage spans excluded, per the
+        packing contract)."""
+        rng = np.random.default_rng(6)
+        pools, scales = _pools(rng, quant)
+        q, kv_lens, q_lens, q_starts, table = _mixed_batch(rng)
+        qp = pack_gqa_rows(q, HKV)
+        base, base_lse = ragged_paged_attention(
+            qp, *pools, kv_lens, q_lens, q_starts, table, group=G,
+            block_q=8, **scales,
+        )
+        topo = jnp.asarray(causal_topologies(3, topo_width(8)))
+        got, got_lse = ragged_paged_attention(
+            qp, *pools, kv_lens, q_lens, q_starts, table, group=G,
+            block_q=8, topologies=topo, **scales,
+        )
+        for r in range(3):
+            s = int(q_starts[r]) * G
+            w = int(q_lens[r]) * G
+            np.testing.assert_array_equal(
+                np.asarray(base)[:, s:s + w], np.asarray(got)[:, s:s + w]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(base_lse)[:, s:s + w],
+                np.asarray(got_lse)[:, s:s + w],
+            )
+
+    def _tree_batch(self, rng):
+        """Row 0: a tree verify row — frontier + 5 draft nodes with a
+        sibling fork (node 1 and node 2 both children of node 0).
+        Row 1: a plain decode row (CAUSAL)."""
+        parents = [-1, 0, 0, 2, 3]
+        kv_lens = jnp.asarray([14, 21], jnp.int32)
+        q_lens = jnp.asarray([6, 1], jnp.int32)
+        q_starts = jnp.asarray([0, 8], jnp.int32)
+        table = jnp.asarray(
+            rng.permutation(NPAGES)[: 2 * PPS].reshape(2, PPS), jnp.int32
+        )
+        t = 16
+        q = jnp.asarray(
+            rng.standard_normal((t, HKV * G, D)), jnp.float32
+        )
+        w = topo_width(8)
+        topo = causal_topologies(2, w)
+        topo[0] = tree_topology_row(parents, w)
+        return q, kv_lens, q_lens, q_starts, table, jnp.asarray(topo)
+
+    @pytest.mark.parametrize("quant", [False, True])
+    def test_tree_row_matches_xla_twin(self, quant):
+        """Tentpole numerics: a TREE verify row (sibling fork) under the
+        ancestor-bitmask mask agrees with the XLA twin given the same
+        topology operand."""
+        rng = np.random.default_rng(7)
+        pools, scales = _pools(rng, quant)
+        q, kv_lens, q_lens, q_starts, table, topo = self._tree_batch(rng)
+        qp = pack_gqa_rows(q, HKV)
+        out, lse = ragged_paged_attention(
+            qp, *pools, kv_lens, q_lens, q_starts, table, group=G,
+            block_q=8, topologies=topo, **scales,
+        )
+        ref, rlse = ragged_paged_attention_xla(
+            qp, *pools, kv_lens, q_lens, q_starts, table, group=G,
+            topologies=topo, **scales,
+        )
+        tol = 2e-2 if quant else 1e-5
+        for r in range(2):
+            s = int(q_starts[r]) * G
+            w = int(q_lens[r]) * G
+            np.testing.assert_allclose(
+                np.asarray(out)[:, s:s + w], np.asarray(ref)[:, s:s + w],
+                atol=tol, rtol=tol,
+            )
+            np.testing.assert_allclose(
+                np.asarray(lse)[:, s:s + w],
+                np.asarray(rlse)[:, s:s + w], atol=tol, rtol=tol,
+            )
+
+    def test_twin_tree_mask_matches_manual_dense(self):
+        """The twin's TREE semantics, pinned independently: each q
+        position attends the full committed prefix plus exactly the
+        speculative positions its ancestor bitmask names — node 3 (a
+        child of node 2) must NOT see sibling node 1's position."""
+        rng = np.random.default_rng(8)
+        (kc, vc), _ = _pools(rng, False)
+        q, kv_lens, q_lens, q_starts, table, topo = self._tree_batch(rng)
+        qp = pack_gqa_rows(q, HKV)
+        out, _ = ragged_paged_attention_xla(
+            qp, kc, vc, kv_lens, q_lens, q_starts, table, group=G,
+            topologies=topo,
+        )
+        got = unpack_gqa_rows(out, HKV * G)
+        L, nq = int(kv_lens[0]), int(q_lens[0])
+        base = L - nq                        # committed prefix tokens
+        anc = np.asarray(topo)[0, 2:2 + topo_width(8)]
+        kcat = kc[table[0]].transpose(1, 0, 2, 3).reshape(HKV, -1, D)[:, :L]
+        vcat = vc[table[0]].transpose(1, 0, 2, 3).reshape(HKV, -1, D)[:, :L]
+        for t in range(nq):
+            vis = np.zeros((L,), bool)
+            vis[:base] = True
+            for j in range(nq):
+                if (int(anc[t]) >> j) & 1:
+                    vis[base + j] = True
+            if t >= 3:                       # deep chain excludes node 1
+                assert not vis[base + 2]
+            qt = np.asarray(q)[t].reshape(HKV, G, D)
+            s = np.einsum(
+                "hgd,hsd->hgs", qt, np.asarray(kcat)
+            ) / math.sqrt(D)
+            s = np.where(vis[None, None, :], s, -1e30)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            ref = np.einsum(
+                "hgs,hsd->hgd", p, np.asarray(vcat)
+            ).reshape(HKV * G, D)
+            np.testing.assert_allclose(
+                np.asarray(got)[t], ref, atol=1e-5, rtol=1e-5
+            )
+
+    def test_qlen_zero_rows_skipped_under_topology(self):
+        """Satellite: with the topology operand present the kernel
+        takes the cross-row q-prefetch hop over q_len == 0 rows —
+        active rows' valid spans must match the batch without the
+        inactive row byte-for-byte."""
+        rng = np.random.default_rng(9)
+        pools, scales = _pools(rng, True)
+        q, kv_lens, q_lens, q_starts, table = _mixed_batch(rng)
+        qp = pack_gqa_rows(q, HKV)
+        w = topo_width(8)
+        a_out, _ = ragged_paged_attention(
+            qp, *pools, kv_lens, q_lens, q_starts, table, group=G,
+            block_q=8, topologies=jnp.asarray(causal_topologies(3, w)),
+            **scales,
+        )
+        # inactive row INSIDE the batch (skip hop must cross it)
+        kv4 = jnp.asarray([13, 0, 21, 8], jnp.int32)
+        ql4 = jnp.asarray([1, 0, 5, 8], jnp.int32)
+        qs4 = jnp.asarray([0, 24, 8, 16], jnp.int32)
+        tb4 = jnp.concatenate(
+            [table[:1], jnp.zeros((1, PPS), jnp.int32), table[1:]]
+        )
+        b_out, _ = ragged_paged_attention(
+            qp, *pools, kv4, ql4, qs4, tb4, group=G, block_q=8,
+            topologies=jnp.asarray(causal_topologies(4, w)), **scales,
+        )
+        for r in range(3):
+            s = int(q_starts[r]) * G
+            w_ = int(q_lens[r]) * G
+            np.testing.assert_array_equal(
+                np.asarray(a_out)[:, s:s + w_],
+                np.asarray(b_out)[:, s:s + w_],
             )
 
     def test_inactive_rows_leave_valid_spans_intact(self):

@@ -406,6 +406,7 @@ def _build_ragged_grid(sched):
         LINT_GEOM,
         build_grid_lint_kernel,
         build_lint_kernel,
+        causal_topologies,
     )
 
     if sched is None:
@@ -415,12 +416,16 @@ def _build_ragged_grid(sched):
                  q_starts=(0, 8))
     else:
         g = build_grid_lint_kernel(token=_tok(), schedule=sched)
+    topo = g.get("topo")
+    if topo is None:
+        topo = causal_topologies(g["r"], g["topo_w"])
     pool = (g["npages"], g["hkv"], g["page"], g["d"])
     shapes = [
         ((g["r"], g["pps"]), np.dtype(np.int32)),
         ((g["r"],), np.dtype(np.int32)),
         ((g["r"],), np.dtype(np.int32)),
         ((g["r"],), np.dtype(np.int32)),
+        ((g["r"], 2 + 2 * g["topo_w"]), np.dtype(np.int32)),
         ((g["hkv"], g["t"] * g["g"], g["d"]), _F32),
         (pool, _I8), (pool, _I8),
         ((g["npages"], g["hkv"], 1, g["page"]), _F32),
@@ -432,6 +437,7 @@ def _build_ragged_grid(sched):
         1: np.asarray(g["kv_lens"], np.int32),
         2: np.asarray(g["q_lens"], np.int32),
         3: np.asarray(g["q_starts"], np.int32),
+        4: np.asarray(topo, np.int32),
     }
     return "ragged_paged_attention_q8", shapes, "ragged_paged", init
 

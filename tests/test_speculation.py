@@ -32,6 +32,7 @@ from triton_distributed_tpu.serving import (
     Request,
     ServingEngine,
     SpeculativeEngine,
+    TreeDrafter,
     make_drafter,
     poisson_trace,
 )
@@ -346,6 +347,166 @@ class TestRejectionSamplingIdentity:
         with pytest.raises(ValueError, match="spec_k"):
             SpeculativeEngine(model, params, EngineConfig(**ECFG),
                               spec_k=0)
+
+
+class TestTreeSpeculation:
+    """spec_tree: a branchy draft tree packed into ONE verify row under
+    the kernel's TREE topology — same request-keyed accept identity,
+    sibling rescue paths linear draft-k cannot express."""
+
+    def _streams(self, model, params, trace_fn, ecfg, **spec_kw):
+        t_ref = trace_fn()
+        ServingEngine(model, params, EngineConfig(**ecfg)).run(
+            t_ref, max_steps=800)
+        t_spec = trace_fn()
+        eng = SpeculativeEngine(model, params, EngineConfig(**ecfg),
+                                **spec_kw)
+        stats = eng.run(t_spec, max_steps=800)
+        assert stats.completed == len(t_ref)
+        return t_ref, t_spec, stats, eng
+
+    def test_token_exact_greedy(self, model_params):
+        model, params = model_params
+        t_ref, t_spec, stats, eng = self._streams(
+            model, params,
+            lambda: _motif_trace(7, 6, 0.5, 8, 30, 8, 16),
+            ECFG, spec_tree=8, drafter=TreeDrafter(),
+        )
+        assert stats.spec_rows > 0
+        assert stats.accepted_draft_tokens > 0
+        for a, b in zip(t_ref, t_spec):
+            assert a.generated == b.generated, a.rid
+        # drained: no slot held, every page back in the pool
+        assert all(r is None for r in eng.slot_req)
+        assert eng.pool.available == eng.cfg.npages
+
+    def test_token_exact_sampled_and_beats_linear(self, model_params):
+        """The acceptance claim, pinned: on branchy sampled traffic
+        (small top_k makes the self-history genuinely ambiguous) the
+        tree's sibling branches rescue steps the linear draft loses —
+        accepted tokens per verify row strictly above linear draft-k,
+        streams byte-identical to the plain engine throughout."""
+        model, params = model_params
+        ecfg = dict(ECFG, temperature=1.0, top_k=4, seed=5)
+        trace_fn = lambda: _motif_trace(13, 6, 0.5, 8, 30, 16, 24)
+        t_ref, t_tree, tree, _ = self._streams(
+            model, params, trace_fn, ecfg,
+            spec_tree=8, drafter=TreeDrafter(branches=3, branch_len=2),
+        )
+        for a, b in zip(t_ref, t_tree):
+            assert a.generated == b.generated, a.rid
+        t_lin = trace_fn()
+        lin = SpeculativeEngine(
+            model, params, EngineConfig(**ecfg), spec_k=4,
+            drafter=NGramDrafter(),
+        ).run(t_lin, max_steps=800)
+        for a, b in zip(t_ref, t_lin):
+            assert a.generated == b.generated, a.rid
+        tree_rate = tree.accepted_draft_tokens / max(tree.spec_rows, 1)
+        lin_rate = lin.accepted_draft_tokens / max(lin.spec_rows, 1)
+        assert tree_rate > lin_rate, (tree_rate, lin_rate)
+
+    def test_token_exact_under_eviction(self, model_params):
+        model, params = model_params
+        t_ref, t_spec, stats, _ = self._streams(
+            model, params,
+            lambda: _motif_trace(9, 8, 0.4, 8, 30, 8, 16),
+            dict(ECFG, npages=14), spec_tree=6, drafter=TreeDrafter(),
+        )
+        assert stats.evictions > 0, "config failed to force an eviction"
+        for a, b in zip(t_ref, t_spec):
+            assert a.generated == b.generated, a.rid
+
+    def test_validation_and_factory(self, model_params):
+        model, params = model_params
+        with pytest.raises(ValueError, match="chunk"):
+            SpeculativeEngine(
+                model, params,
+                EngineConfig(slots=2, token_budget=32, chunk=4, page=8,
+                             npages=16),
+                spec_tree=8,
+            )
+        with pytest.raises(ValueError, match="draft_tree"):
+            SpeculativeEngine(model, params, EngineConfig(**ECFG),
+                              spec_tree=4, drafter=NGramDrafter())
+        assert isinstance(make_drafter("tree", branches=3), TreeDrafter)
+
+    def test_tree_traffic_key_is_distinct(self, model_params):
+        """Satellite: the grid-schedule traffic key carries the
+        speculation signature — tree, linear, and plain engines must
+        ledger under different keys for the retuner."""
+        model, params = model_params
+        plain = ServingEngine(model, params, EngineConfig(**ECFG))
+        lin = SpeculativeEngine(model, params, EngineConfig(**ECFG),
+                                spec_k=4)
+        tree = SpeculativeEngine(model, params, EngineConfig(**ECFG),
+                                 spec_tree=8, drafter=TreeDrafter())
+        keys = {e._grid_key[-2:] for e in (plain, lin, tree)}
+        assert keys == {(0, 0), (4, 0), (4, 8)}
+        assert len({e._grid_key for e in (plain, lin, tree)}) == 3
+
+    def test_trunk_is_linear_draft(self, model_params):
+        """TreeDrafter's trunk IS the NGram linear draft — the tree can
+        only add rescue branches, never lose the linear path."""
+        model, params = model_params
+        req = _req([1, 2, 3, 9, 1, 2, 3])
+        lin = NGramDrafter().draft(req, 3)
+        toks, parents = TreeDrafter().draft_tree(req, 6)
+        trunk = []
+        cur = -1
+        for i, p in enumerate(parents):
+            if p == cur:
+                trunk.append(int(toks[i]))
+                cur = i
+        np.testing.assert_array_equal(trunk[:len(lin)], lin)
+        assert all(p < i for i, p in enumerate(parents))
+
+
+class TestSharedPrefix:
+    """cfg.prefix_share: in-batch shared-prefix dedup — duplicate
+    prefix pages folded onto one canonical page (PagePool refcounts),
+    rows marked SHARED_PREFIX in the topology operand."""
+
+    def _shared_trace(self, n=6, vocab=128):
+        """Requests sharing a long common prompt prefix — every batch
+        carries duplicate frozen prefix pages until dedup folds them."""
+        rng = np.random.default_rng(21)
+        prefix = rng.integers(0, vocab, (24,)).astype(np.int32)
+        reqs = []
+        for i in range(n):
+            tail = rng.integers(0, vocab, (4,)).astype(np.int32)
+            reqs.append(Request(
+                rid=i, prompt=np.concatenate([prefix, tail]),
+                max_new=6, arrival=0.1 * i,
+            ))
+        return reqs
+
+    def test_dedup_token_exact_and_counted(self, model_params):
+        model, params = model_params
+        ecfg = dict(ECFG, slots=3, npages=64)
+        t_ref = self._shared_trace()
+        ServingEngine(model, params, EngineConfig(**ecfg)).run(
+            t_ref, max_steps=800)
+        t_dd = self._shared_trace()
+        eng = ServingEngine(
+            model, params,
+            EngineConfig(**ecfg, prefix_cache=True, prefix_share=True),
+        )
+        stats = eng.run(t_dd, max_steps=800)
+        assert stats.completed == len(t_ref)
+        assert stats.shared_prefix_rows > 0
+        assert stats.deduped_pages > 0
+        for a, b in zip(t_ref, t_dd):
+            assert a.generated == b.generated, a.rid
+        # no leak: drained engine returns every page
+        assert all(r is None for r in eng.slot_req)
+        assert eng.pool.available == eng.cfg.npages
+
+    def test_prefix_share_requires_prefix_cache(self, model_params):
+        model, params = model_params
+        with pytest.raises(ValueError, match="prefix_cache"):
+            ServingEngine(model, params,
+                          EngineConfig(**ECFG, prefix_share=True))
 
 
 class TestSpeculativeDisaggregated:

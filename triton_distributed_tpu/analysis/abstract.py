@@ -489,6 +489,10 @@ def build_refs(launch, in_shapes, rec: ev.Recorder, init=None):
         data = init.get(name, init.get(i))
         data = (np.zeros(shape, dtype) if data is None
                 else np.array(data, dtype).reshape(shape))
+        if kind == "ref" and i < n_in:
+            # value-level contract facets (the ragged topology check)
+            # read input OPERANDS at replay time
+            rec.input_values.setdefault(i, np.array(data, copy=True))
         refs.append(AbsRef(name, data, space, rec))
         if space in ("vmem", "smem"):
             vmem += data.nbytes

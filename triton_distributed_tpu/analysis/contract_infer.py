@@ -355,12 +355,20 @@ def _h_grad_ring(twin, n):
 def _h_ragged_local(twin, n):
     # a per-rank function: no mesh/axis operand at all. Execute at the
     # registry's lint geometry on one device so path rot still fails
-    # loudly, then assert finiteness
+    # loudly, then assert finiteness — INCLUDING the per-row topology
+    # operand with a full TREE row, so a twin that dropped or broke
+    # the masked path fails the profile instead of silently agreeing
     from triton_distributed_tpu.kernels.ragged_paged_attention import (
         LINT_GEOM as g,
+        causal_topologies,
+        tree_topology_row,
     )
 
     pool = np.ones((g["npages"], g["hkv"], g["page"], g["d"]), np.float32)
+    topo = causal_topologies(g["r"], g["topo_w"])
+    # row 1: frontier + 7 nodes, two branches off the frontier — every
+    # packed position occupied, so the tree row stays finite
+    topo[1] = tree_topology_row([-1, 0, 0, 2, 3, 4, 5], g["topo_w"])
     out = twin(
         np.ones((g["hkv"], g["t"] * g["g"], g["d"]), np.float32),
         pool, pool,
@@ -368,7 +376,7 @@ def _h_ragged_local(twin, n):
         np.asarray([0, 8], np.int32),
         np.arange(g["r"] * g["pps"], dtype=np.int32)
         .reshape(g["r"], g["pps"]),
-        group=g["g"],
+        group=g["g"], topologies=topo,
     )
     out, _lse = out                        # (attention out, per-row LSE)
     if not np.isfinite(np.asarray(out)).all():
@@ -688,6 +696,36 @@ def _diff_single(rec, declared, per_rank, dst, profile, q):
     return findings
 
 
+def _infer_topo_meta(rec) -> dict | None:
+    """Detect a per-row attention-topology operand from the replay's
+    input signature. The ragged family's scalar-prefetch block is a
+    leading run of int32 inputs — table ``(R, pps)``, then the three
+    per-row vectors ``kv_lens``/``q_lens``/``q_starts`` of length R.
+    When a FIFTH leading int32 input follows with shape
+    ``(R, 2 + 2W)``, it is the topology descriptor: the inferred LOCAL
+    contract carries the masked-coverage facet so an UNDECLARED family
+    still gets its descriptors value-checked."""
+    metas = sorted(
+        (m for m in rec.ref_meta.values() if m.is_input),
+        key=lambda m: m.index,
+    )
+    if len(metas) < 5:
+        return None
+    lead = metas[:5]
+    if not all(m.dtype == np.dtype(np.int32) for m in lead):
+        return None
+    if len(lead[1].shape) != 1:
+        return None
+    rows = lead[1].shape[0]
+    tshape = lead[4].shape
+    if len(tshape) != 2 or tshape[0] != rows:
+        return None
+    w = (tshape[1] - 2) // 2
+    if w < 1 or tshape[1] != 2 + 2 * w:
+        return None
+    return {"ref": 4, "kv_lens": 1, "q_lens": 2, "width": int(w)}
+
+
 def infer_from_replay(rec, sim, state, *, degrades_to,
                       declared=None) -> InferenceResult:
     """The core diff: profile the twin, realize the contract from the
@@ -730,16 +768,39 @@ def infer_from_replay(rec, sim, state, *, degrades_to,
         quantities = {}
     elif profile.cls == LOCAL:
         full = all(o["empty"] == 0 for o in per_rank)
-        contract = DeliveryContract(kind="local", dst=dst, full=full)
-        quantities = {"full": full}
-        if declared is not None and _KIND_CLASS.get(declared.kind) == LOCAL \
-                and declared.full != full:
-            findings.append(Finding(
-                "SL012", kernel,
-                f"declared full={declared.full} but the replay shows "
-                f"full={full} own-write coverage of {dst}{tabled}",
-                site=site,
-            ))
+        topo_meta = _infer_topo_meta(rec)
+        contract = DeliveryContract(kind="local", dst=dst, full=full,
+                                    topo=topo_meta)
+        quantities = {"full": full, "topo": topo_meta}
+        if declared is not None and _KIND_CLASS.get(declared.kind) == LOCAL:
+            if declared.full != full:
+                findings.append(Finding(
+                    "SL012", kernel,
+                    f"declared full={declared.full} but the replay shows "
+                    f"full={full} own-write coverage of {dst}{tabled}",
+                    site=site,
+                ))
+            dt = getattr(declared, "topo", None)
+            if (dt is None) != (topo_meta is None):
+                have = "a" if topo_meta else "no"
+                want = "one" if dt else "none"
+                findings.append(Finding(
+                    "SL012", kernel,
+                    f"the replay's input signature shows {have} per-row "
+                    f"attention-topology operand but the declared "
+                    f"contract carries {want} — the masked-coverage "
+                    f"facet would check the wrong operand set{tabled}",
+                    site=site,
+                ))
+            elif dt is not None and topo_meta is not None and \
+                    int(dt.get("width", -1)) != topo_meta["width"]:
+                findings.append(Finding(
+                    "SL012", kernel,
+                    f"declared topology width {dt.get('width')} drifted "
+                    f"from the replay's descriptor width "
+                    f"{topo_meta['width']}{tabled}",
+                    site=site,
+                ))
     else:
         contract, quantities = _infer_single(rec, per_rank, dst, profile)
         if declared is not None \

@@ -82,6 +82,15 @@ class DeliveryContract:
     transports (kv_ship: each decode rank receives exactly its partner
     prefill rank's pages) declare their topology with this; None keeps
     the all-sources default of the all-to-all/gather families.
+    ``topo``: masked-coverage facet for LOCAL kernels that carry a
+    per-row attention-topology operand (the ragged paged family):
+    ``{"ref": <input index of the (R, 2+2W) descriptor>, "kv_lens": i,
+    "q_lens": i, "width": W}``. The replay then VALUE-checks each
+    descriptor row — kind in {CAUSAL, TREE, SHARED_PREFIX}, a TREE
+    row's ancestry bitmasks closed under the packed parent pointers
+    (``anc[t] == anc[parent[t]] | 1<<t`` — a row violating closure
+    lets a node attend a sibling branch), a SHARED_PREFIX split inside
+    the row's prefix span — and flags violations as SL008.
     """
 
     kind: str
@@ -90,6 +99,7 @@ class DeliveryContract:
     full: bool = True
     own_absent_ok: bool = False
     src_only: object = None
+    topo: object = None
 
 
 # ------------------------------------------------------------- replay state
@@ -500,10 +510,101 @@ def _bbox(mask) -> str:
     return "[" + ",".join(f"{a}:{b}" for a, b in zip(lo, hi)) + "]"
 
 
+def _check_topology(rec, contract: DeliveryContract) -> list:
+    """Masked-coverage facet of the LOCAL contract: value-check the
+    per-row attention-topology descriptor operand. The provenance
+    arrays prove every out element was the rank's own write; THIS
+    check proves the mask those writes were computed under is
+    well-formed — a TREE row whose ancestry bitmasks are not closed
+    under its parent pointers lets a draft node attend a SIBLING
+    branch (contaminating the path-conditioned logits the verify walk
+    samples from), which coverage alone can never see."""
+    findings: list = []
+    kernel, site = rec.info.kernel, rec.info.site
+    t = contract.topo
+    vals = getattr(rec, "input_values", {})
+    topo = vals.get(t["ref"])
+    if topo is None:
+        return [Finding(
+            "SL008", kernel,
+            f"contract declares a topology operand at input {t['ref']} "
+            "but the replay captured no value for it",
+            site=site,
+        )]
+    topo = np.asarray(topo)
+    w = (topo.shape[-1] - 2) // 2
+    if w != int(t.get("width", w)):
+        findings.append(Finding(
+            "SL008", kernel,
+            f"topology operand width {w} drifted from the contract's "
+            f"declared width {t['width']}",
+            site=site,
+        ))
+    kv_lens = vals.get(t.get("kv_lens"))
+    q_lens = vals.get(t.get("q_lens"))
+    for r in range(topo.shape[0]):
+        kind = int(topo[r, 0])
+        aux = int(topo[r, 1])
+        if kind not in (0, 1, 2):            # CAUSAL / TREE / SHARED_PREFIX
+            findings.append(Finding(
+                "SL008", kernel,
+                f"row {r}'s topology kind {kind} is not a known "
+                "descriptor (CAUSAL=0, TREE=1, SHARED_PREFIX=2)",
+                site=site,
+            ))
+            continue
+        if kind == 1:                        # TREE: ancestry closure
+            anc = topo[r, 2:2 + w].astype(np.int64)
+            par = topo[r, 2 + w:2 + 2 * w]
+            if not 1 <= aux <= w:
+                findings.append(Finding(
+                    "SL008", kernel,
+                    f"TREE row {r} packs {aux} positions, outside the "
+                    f"descriptor width {w}",
+                    site=site,
+                ))
+                continue
+            if anc[0] & 1 == 0:
+                findings.append(Finding(
+                    "SL008", kernel,
+                    f"TREE row {r}'s frontier (q position 0) is not its "
+                    "own ancestor — anc[0] must carry bit 0",
+                    site=site,
+                ))
+            for q in range(1, aux):
+                pt = int(par[q])
+                want = (anc[pt] | (np.int64(1) << q)) if 0 <= pt < q \
+                    else None
+                if want is None or int(anc[q]) != int(want):
+                    findings.append(Finding(
+                        "SL008", kernel,
+                        f"TREE row {r}'s ancestry is not closed under "
+                        f"its parent pointers at q position {q} "
+                        f"(anc={int(anc[q]):#x}, parent={pt}) — the "
+                        "node's visible set is not exactly its "
+                        "root-to-node path, so it can attend a sibling "
+                        "branch",
+                        site=site,
+                    ))
+        elif kind == 2:                      # SHARED_PREFIX: split bound
+            if kv_lens is not None and q_lens is not None:
+                prefix = int(kv_lens[r]) - int(q_lens[r])
+                if not 0 <= aux <= prefix:
+                    findings.append(Finding(
+                        "SL008", kernel,
+                        f"SHARED_PREFIX row {r}'s split {aux} falls "
+                        f"outside the row's prefix span [0, {prefix}]",
+                        site=site,
+                    ))
+    return findings
+
+
 def _check_contract(rec, state: _State, contract: DeliveryContract) -> list:
     findings: list = []
     kernel, site = rec.info.kernel, rec.info.site
     n = rec.n
+    if contract.kind == "local" and contract.topo:
+        findings.extend(_check_topology(rec, contract))
     dst = _resolve_dst(rec, contract.dst)
     meta = rec.ref_meta[dst]
     dst_elems = int(np.prod(meta.shape))

@@ -222,6 +222,11 @@ class Recorder:
         #: root ref name -> RefMeta, in kernel-signature order (filled by
         #: abstract.build_refs; identical across ranks by SPMD symmetry)
         self.ref_meta: dict = {}
+        #: input ref index -> initial ndarray (filled by
+        #: abstract.build_refs). Value-level contract facets — e.g. the
+        #: ragged family's attention-topology descriptor — read the
+        #: OPERANDS, not just the traces, so the replay keeps them.
+        self.input_values: dict = {}
 
     def emit(self, ev: Event) -> Event:
         assert self.me is not None, "recorder has no current rank"

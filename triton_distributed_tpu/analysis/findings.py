@@ -167,6 +167,15 @@ RULES = {
         "survives (the ragged kernel's head-major GQA-rows packing) "
         "or reshape on the XLA side",
     ),
+    "MC006": (
+        "mosaic-dynamic-gather",
+        Severity.ERROR,
+        "an in-kernel gather with TRACED (runtime) indices; this "
+        "Mosaic backend has no dynamic vector-indexed gather lowering "
+        "— unroll over the index set with static masks (the ragged "
+        "kernel's per-position ancestor-bitmask unroll) or gather on "
+        "the XLA side",
+    ),
 }
 
 

@@ -729,13 +729,14 @@ def grid_ragged_overwide_block(axis="x"):
     real = captured_launch("ragged_paged_attention_q8")
 
     def kernel(*refs):
-        table, kv_lens, q_lens, q_starts = refs[:4]
+        table, kv_lens, q_lens, q_starts, topo = refs[:5]
         table[...] = np.arange(
             g["r"] * g["pps"], dtype=np.int32
         ).reshape(g["r"], g["pps"])
         kv_lens[...] = np.asarray(g["kv_lens"], np.int32)
         q_lens[...] = np.asarray(g["q_lens"], np.int32)
         q_starts[...] = np.asarray(g["q_starts"], np.int32)
+        topo[...] = np.asarray(g["topo"], np.int32)
         real.kernel(*refs)
 
     def in_shapes(n):
@@ -746,6 +747,7 @@ def grid_ragged_overwide_block(axis="x"):
             ((g["r"],), np.dtype(np.int32)),
             ((g["r"],), np.dtype(np.int32)),
             ((g["r"],), np.dtype(np.int32)),
+            ((g["r"], 2 + 2 * g["topo_w"]), np.dtype(np.int32)),
             ((g["hkv"], g["t"] * g["g"], g["d"]), _F32),
             (pool, np.dtype(np.int8)),
             (pool, np.dtype(np.int8)),
@@ -757,7 +759,7 @@ def grid_ragged_overwide_block(axis="x"):
         replace(real, kernel=kernel,
                 name="fixture_grid_ragged_overwide_block"),
         in_shapes,
-        DeliveryContract(kind="local", dst=9),
+        DeliveryContract(kind="local", dst=10),
     )
 
 
@@ -971,6 +973,7 @@ def ragged_hole(axis="x"):
             ((g["r"],), np.dtype(np.int32)),
             ((g["r"],), np.dtype(np.int32)),
             ((g["r"],), np.dtype(np.int32)),
+            ((g["r"], 2 + 2 * g["topo_w"]), np.dtype(np.int32)),
             ((g["hkv"], g["t"] * g["g"], g["d"]), _F32),
             (pool, np.dtype(np.int8)),
             (pool, np.dtype(np.int8)),
@@ -981,7 +984,81 @@ def ragged_hole(axis="x"):
     return (
         replace(real, kernel=kernel, name="fixture_ragged_hole"),
         in_shapes,
-        DeliveryContract(kind="local", dst=9),
+        DeliveryContract(kind="local", dst=10),
+    )
+
+
+def ragged_tree_sibling(axis="x"):
+    """The REAL ragged kernel fed a MALFORMED tree descriptor: row 1's
+    node at q position 2 carries an ancestry bitmask that includes its
+    SIBLING branch (bit 1) — the bitmasks are not closed under the
+    packed parent pointers, so that node's scores admit keys from a
+    path it does not descend from and the verify walk samples from a
+    contaminated distribution. Coverage is perfect (every out element
+    is the rank's own write), so only the contract's masked-coverage
+    facet can reject it. SL008 (kind='local', value-level)."""
+    from dataclasses import replace
+
+    from triton_distributed_tpu.analysis.dataflow import DeliveryContract
+    from triton_distributed_tpu.kernels.ragged_paged_attention import (
+        LINT_GEOM,
+        TOPO_TREE,
+        build_lint_kernel,
+        causal_topologies,
+    )
+    from triton_distributed_tpu.lang.launch import captured_launch
+
+    g = LINT_GEOM
+    w = g["topo_w"]
+    build_lint_kernel(token=("fixture_ragged_tree_sibling",))
+    real = captured_launch("ragged_paged_attention_q8")
+
+    topo = causal_topologies(g["r"], w)
+    # row 1: frontier + 7 nodes filling the packed span; q1 and q2 are
+    # SIBLING branches off the frontier, q3..q7 chain off q2. A
+    # well-formed q2 mask is {0, 2}; this one smuggles in bit 1 (its
+    # sibling q1) — and every descendant inherits the leak, but the
+    # closure breaks exactly at q2, the graft point.
+    topo[1, 0] = TOPO_TREE
+    topo[1, 1] = 8
+    anc = [1, 3, 7, 15, 31, 63, 127, 255]   # anc[2] holds bit 1: BUG
+    par = [-1, 0, 0, 2, 3, 4, 5, 6]
+    topo[1, 2:2 + 8] = anc
+    topo[1, 2 + w:2 + w + 8] = par
+
+    def in_shapes(n):
+        del n
+        pool = (g["npages"], g["hkv"], g["page"], g["d"])
+        return [
+            ((g["r"], g["pps"]), np.dtype(np.int32)),
+            ((g["r"],), np.dtype(np.int32)),
+            ((g["r"],), np.dtype(np.int32)),
+            ((g["r"],), np.dtype(np.int32)),
+            ((g["r"], 2 + 2 * w), np.dtype(np.int32)),
+            ((g["hkv"], g["t"] * g["g"], g["d"]), _F32),
+            (pool, np.dtype(np.int8)),
+            (pool, np.dtype(np.int8)),
+            ((g["npages"], g["hkv"], 1, g["page"]), _F32),
+            ((g["npages"], g["hkv"], 1, g["page"]), _F32),
+        ]
+
+    init = {
+        0: np.arange(g["r"] * g["pps"], dtype=np.int32).reshape(
+            g["r"], g["pps"]),
+        1: np.asarray([12, 8], np.int32),
+        2: np.asarray([8, 8], np.int32),
+        3: np.asarray([0, 8], np.int32),
+        4: topo,
+    }
+    return (
+        replace(real, kernel=real.kernel,
+                name="fixture_ragged_tree_sibling"),
+        in_shapes,
+        DeliveryContract(
+            kind="local", dst=10,
+            topo={"ref": 4, "kv_lens": 1, "q_lens": 2, "width": w},
+        ),
+        init,
     )
 
 
@@ -1001,6 +1078,26 @@ def lane_reshape(axis="x"):
         _spec(kernel, "fixture_lane_reshape",
               out_shapes=[((16, 128), _F32)]),
         lambda n: [((8, 256), _F32)],
+    )
+
+
+def dynamic_gather(axis="x"):
+    """An in-kernel gather with TRACED indices — the ``anc[par]``
+    index chase a naive tree-topology mask build would produce
+    (``jnp.take`` over a runtime int vector). This Mosaic has no
+    dynamic vector-indexed gather lowering; the ragged kernel's
+    static ancestor-bitmask unroll exists to avoid it. MC006."""
+
+    def kernel(idx_ref, x_ref, out_ref):
+        import jax.numpy as jnp
+
+        idx = idx_ref[...]                     # (8,) traced int32
+        out_ref[...] = jnp.take(x_ref[...], idx, axis=0)   # BUG
+
+    return (
+        _spec(kernel, "fixture_dynamic_gather",
+              out_shapes=[((8, 128), _F32)]),
+        lambda n: [((8,), np.dtype(np.int32)), ((8, 128), _F32)],
     )
 
 

@@ -29,7 +29,11 @@ for the constructs this toolchain's Mosaic backend is KNOWN to reject:
   (no f8 MXU form here, see MC001). A family whose builder refuses
   cleanly under ``lang.wire.require_mxu`` (TDTPU_WIRE_INT8_MXU=0) is a
   pass — the contract fires before Mosaic ever would, mirroring the
-  MC001 fp8 handling.
+  MC001 fp8 handling;
+* **MC006** — a gather with traced (runtime) indices: no dynamic
+  vector-indexed gather lowering here — the reason the ragged
+  kernel's tree-topology mask is a STATIC per-position
+  ancestor-bitmask unroll rather than an ``anc[par]`` index chase.
 
 A family whose builder REFUSES cleanly under the hardware contract
 (``require_inkernel`` raising for a pinned fp8 wire) is a pass: the
@@ -114,7 +118,7 @@ def _kernel_jaxprs(jaxpr):
 
 
 def scan_kernel_jaxpr(kjaxpr, kernel_name, site=None) -> list:
-    """MC001–MC004 over one kernel jaxpr."""
+    """MC001–MC006 over one kernel jaxpr."""
     findings = []
     seen = set()
 
@@ -200,6 +204,25 @@ def scan_kernel_jaxpr(kjaxpr, kernel_name, site=None) -> list:
                         "int32 and fold the scales on the accumulator "
                         "in the epilogue (the lang.wire int8-mxu "
                         "contract)")
+        elif name == "gather" and len(eqn.invars) >= 2:
+            # MC006: a gather whose index operand is a TRACED value
+            # (a Var, not a Literal constant) — dynamic vector-indexed
+            # gathers have no lowering on this Mosaic backend. The
+            # construct a naive topology-mask build produces
+            # (anc[par[q]] with runtime par): the ragged kernel's
+            # static per-position ancestor-bitmask unroll exists to
+            # avoid it. Constant-index gathers fold at trace time and
+            # pass.
+            idx = eqn.invars[1]
+            if not hasattr(idx, "val"):        # jax.core.Literal has .val
+                ishape = getattr(idx.aval, "shape", ())
+                add("MC006",
+                    f"in-kernel gather with traced indices (index "
+                    f"shape {tuple(ishape)}): this Mosaic has no "
+                    "dynamic vector-indexed gather lowering — unroll "
+                    "over the index set with static masks (the ragged "
+                    "kernel's ancestor-bitmask unroll) or gather on "
+                    "the XLA side")
     return findings
 
 

@@ -69,6 +69,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -77,6 +78,88 @@ from triton_distributed_tpu.lang.launch import shmem_call
 from triton_distributed_tpu.utils.testing import chaos_delay
 
 NEG_INF = -1.0e30
+
+# ------------------------------------------------------- attention topology
+#
+# Per-row mask descriptor over the q×kv tile grid — the 5th scalar-
+# prefetch operand (``topologies``). Row layout, ``W`` = descriptor
+# width (ancestor-bitmask positions):
+#
+#   [kind, aux, anc[0..W-1], parent[0..W-1]]        (2 + 2·W) int32
+#
+# * ``kind``: TOPO_CAUSAL (today's causal-frontier mask — the default,
+#   byte-identical outputs), TOPO_TREE (tree-speculation verify row:
+#   ``anc[t]`` is the packed ancestor bitmask of q position ``t`` over
+#   the row's speculative region ``[kv_len - q_len, kv_len)``, bit 0 =
+#   the frontier token, self bit included — sibling branches never
+#   attend each other), or TOPO_SHARED_PREFIX (positions below
+#   ``aux = split`` tokens are a prefix page run ALIASED across rows'
+#   block tables; the mask itself stays causal — the aliasing is a
+#   table-level fact the engine's PagePool refcounts make safe).
+# * ``aux``: TREE → occupied q positions (1 + draft nodes);
+#   SHARED_PREFIX → the shared-prefix split in tokens.
+# * ``parent[t]``: q position of t's tree parent (-1 for the frontier)
+#   — NOT read by the kernel (the anc bitmask is self-contained); it is
+#   the analysis cross-check the masked-coverage SL008 facet validates
+#   ``anc[t] == anc[parent[t]] | (1 << t)`` against, so a descriptor
+#   that lets a TREE row attend a sibling branch cannot hide.
+#
+# The bitmask is int32, so a tree verify row carries at most
+# TOPO_MAX_NODES q positions (bits 0..30 — bit 31 would overflow the
+# signed lane).
+
+TOPO_CAUSAL = 0
+TOPO_TREE = 1
+TOPO_SHARED_PREFIX = 2
+TOPO_MAX_NODES = 31
+
+
+def topo_width(block_q: int) -> int:
+    """Descriptor width for a ``block_q`` launch: one ancestor-bitmask
+    slot per q position, capped at the int32 bitmask bound."""
+    return min(int(block_q), TOPO_MAX_NODES)
+
+
+def causal_topologies(r: int, width: int):
+    """(R, 2+2W) all-CAUSAL descriptor block — the identity operand."""
+    return np.zeros((r, 2 + 2 * width), np.int32)
+
+
+def tree_topology_row(parents, width: int):
+    """One TREE descriptor row from per-node parent indices.
+
+    ``parents[i]`` is the parent DRAFT NODE of draft node ``i`` (-1 =
+    the frontier token). q position 0 is the frontier; node ``i`` sits
+    at q position ``i + 1``."""
+    n = len(parents)
+    if n + 1 > width:
+        raise ValueError(
+            f"tree of {n} nodes needs width >= {n + 1}, got {width}")
+    row = np.zeros((2 + 2 * width,), np.int32)
+    row[0] = TOPO_TREE
+    row[1] = n + 1
+    anc = np.zeros((width,), np.int64)
+    par = np.full((width,), -1, np.int64)
+    anc[0] = 1
+    for i, p in enumerate(parents):
+        t = i + 1
+        pt = int(p) + 1
+        if not 0 <= pt < t:
+            raise ValueError(
+                f"node {i}: parent {p} must be an earlier node or -1")
+        anc[t] = anc[pt] | (np.int64(1) << t)
+        par[t] = pt
+    row[2:2 + width] = anc.astype(np.int32)
+    row[2 + width:2 + 2 * width] = par.astype(np.int32)
+    return row
+
+
+def shared_prefix_topology_row(split: int, width: int):
+    """One SHARED_PREFIX descriptor row (``split`` in tokens)."""
+    row = np.zeros((2 + 2 * width,), np.int32)
+    row[0] = TOPO_SHARED_PREFIX
+    row[1] = int(split)
+    return row
 
 
 def _n_valid_pages(kv_len, page):
@@ -104,7 +187,8 @@ def unpack_gqa_rows(o, hq):
 
 
 def _ragged_kernel(
-    scale, soft_cap, page, n_bufs, hkv, g, d, block_q, quant, *refs,
+    scale, soft_cap, page, n_bufs, hkv, g, d, block_q, quant, topo_w,
+    *refs,
 ):
     """Grid (R,): one request row per step; all local KV heads unrolled.
 
@@ -115,25 +199,50 @@ def _ragged_kernel(
     an online softmax whose state spans the row's ``block_q·G`` query
     rows per head. Slot rotation and the row-ahead prefetch ride an
     SMEM carry — SEQUENTIAL grid execution required (pinned via
-    dimension_semantics)."""
+    dimension_semantics).
+
+    ``topo_w`` (static): 0 keeps the pre-topology kernel bit-for-bit
+    (four scalar operands, every row causal, every row active); > 0
+    adds the 5th scalar-prefetch topology operand of that descriptor
+    width, the TREE ancestor-bitmask mask, and the ``q_len == 0`` row
+    skip — inactive rows are hopped over by the cross-row q-prefetch
+    (the prefetch targets the NEXT ACTIVE row, not ``r + 1``) and
+    leave carries, buffers, and their stale out spans untouched."""
     if quant:
-        (table_ref, kv_lens_ref, q_lens_ref, q_starts_ref,
-         q_hbm, k_hbm, v_hbm, ks_hbm, vs_hbm,
-         out_hbm, lse_hbm,
-         qbuf, kbuf, vbuf, ksbuf, vsbuf, obuf, lbuf,
-         sem_q, sem_k, sem_v, sem_ks, sem_vs, sem_o,
-         slot_ref, m_ref, l_ref, acc_ref) = refs
+        if topo_w:
+            (table_ref, kv_lens_ref, q_lens_ref, q_starts_ref, topo_ref,
+             q_hbm, k_hbm, v_hbm, ks_hbm, vs_hbm,
+             out_hbm, lse_hbm,
+             qbuf, kbuf, vbuf, ksbuf, vsbuf, obuf, lbuf,
+             sem_q, sem_k, sem_v, sem_ks, sem_vs, sem_o,
+             slot_ref, m_ref, l_ref, acc_ref) = refs
+        else:
+            (table_ref, kv_lens_ref, q_lens_ref, q_starts_ref,
+             q_hbm, k_hbm, v_hbm, ks_hbm, vs_hbm,
+             out_hbm, lse_hbm,
+             qbuf, kbuf, vbuf, ksbuf, vsbuf, obuf, lbuf,
+             sem_q, sem_k, sem_v, sem_ks, sem_vs, sem_o,
+             slot_ref, m_ref, l_ref, acc_ref) = refs
     else:
-        (table_ref, kv_lens_ref, q_lens_ref, q_starts_ref,
-         q_hbm, k_hbm, v_hbm,
-         out_hbm, lse_hbm,
-         qbuf, kbuf, vbuf, obuf, lbuf,
-         sem_q, sem_k, sem_v, sem_o,
-         slot_ref, m_ref, l_ref, acc_ref) = refs
+        if topo_w:
+            (table_ref, kv_lens_ref, q_lens_ref, q_starts_ref, topo_ref,
+             q_hbm, k_hbm, v_hbm,
+             out_hbm, lse_hbm,
+             qbuf, kbuf, vbuf, obuf, lbuf,
+             sem_q, sem_k, sem_v, sem_o,
+             slot_ref, m_ref, l_ref, acc_ref) = refs
+        else:
+            (table_ref, kv_lens_ref, q_lens_ref, q_starts_ref,
+             q_hbm, k_hbm, v_hbm,
+             out_hbm, lse_hbm,
+             qbuf, kbuf, vbuf, obuf, lbuf,
+             sem_q, sem_k, sem_v, sem_o,
+             slot_ref, m_ref, l_ref, acc_ref) = refs
     r = pl.program_id(0)
     nr = pl.num_programs(0)
     npages = k_hbm.shape[0]
     pps = table_ref.shape[1]
+    nrows = table_ref.shape[0]
     rows = block_q * g
 
     kv_len = kv_lens_ref[r]
@@ -176,153 +285,240 @@ def _ragged_kernel(
             sem_q.at[qslot],
         )
 
-    @pl.when(r == 0)
-    def _warmup():
-        slot_ref[0] = 0                       # KV slot rotation carry
-        slot_ref[1] = 0                       # q double-buffer parity
-        qdma(0, 0).start()
-        for cp in dma(0, 0, 0):
-            cp.start()
+    if topo_w:
+        # ---- q_len == 0 skip: the cross-row prefetch hop protocol ----
+        # next_active(a): smallest active row index >= a (static unroll
+        # over the R-sized scalar operand; nrows when none). The warmup
+        # and the end-of-row prefetch both target the next ACTIVE row,
+        # and an inactive row's entire body is skipped — its carries
+        # pass through untouched, so the rotation the last active row
+        # handed on still matches the buffers in flight.
+        def next_active(after):
+            na = jnp.int32(nrows)
+            for rr in range(nrows - 1, -1, -1):
+                na = jnp.where(
+                    jnp.logical_and(rr >= after, q_lens_ref[rr] > 0),
+                    jnp.int32(rr), na,
+                )
+            return na
 
-    s0 = slot_ref[0]
-    qslot = slot_ref[1]
-    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-    l_ref[:] = jnp.zeros_like(l_ref)
-    acc_ref[:] = jnp.zeros_like(acc_ref)
-    qdma(r, qslot).wait()                     # warmed by the previous row
+        first_active = next_active(0)
+        nxt_active = next_active(r + 1)
+        nxt_clamped = jnp.minimum(nxt_active, nrows - 1)
 
-    # per-query-row causal limit: token t = row // g sits at global
-    # position kv_len - q_len + t and may attend positions < limit =
-    # that + 1. Rows past q_len (block padding) get limit > kv_len —
-    # they attend whatever the pool holds and produce garbage the
-    # packing contract discards (see module docstring).
-    base = kv_len - q_len
-    row_tok = jax.lax.div(
-        jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0), g
-    )
-    limit = base + row_tok + 1                # (rows, 1)
+        @pl.when(r == 0)
+        def _warmup():
+            slot_ref[0] = 0                   # KV slot rotation carry
+            slot_ref[1] = 0                   # q double-buffer parity
 
-    def body(j, _):
-        slot = jax.lax.rem(s0 + j, n_bufs)
-        nxt = jax.lax.rem(s0 + j + 1, n_bufs)
-
-        @pl.when(j + 1 < nb)
-        def _prefetch_in_row():
-            for cp in dma(r, j + 1, nxt):
+            @pl.when(first_active < nr)
+            def _start_first():
+                fa = jnp.minimum(first_active, nrows - 1)
+                qdma(fa, 0).start()
+                for cp in dma(fa, 0, 0):
+                    cp.start()
+    else:
+        @pl.when(r == 0)
+        def _warmup():
+            slot_ref[0] = 0                   # KV slot rotation carry
+            slot_ref[1] = 0                   # q double-buffer parity
+            qdma(0, 0).start()
+            for cp in dma(0, 0, 0):
                 cp.start()
 
-        @pl.when(jnp.logical_and(j + 1 == nb, r + 1 < nr))
-        def _prefetch_next_row():
-            qdma(r + 1, 1 - qslot).start()
-            for cp in dma(r + 1, 0, nxt):
-                cp.start()
+    def row_body():
+        s0 = slot_ref[0]
+        qslot = slot_ref[1]
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        qdma(r, qslot).wait()                 # warmed by the previous row
 
-        # chaos hook: widens the slot-rotation window between the
-        # prefetch issues and this page's wait (the race-prone carry)
-        chaos_delay(site="ragged_paged", step=None, me=None, n=None)
-        for cp in dma(r, j, slot):
-            cp.wait()
-
-        # only pages crossing the causal frontier (or the length tail)
-        # pay the mask chain; interior pages take the plain path
-        is_frontier = (j + 1) * page > base + 1
-
-        def heads(masked):
-            if masked:
-                pos = j * page + jax.lax.broadcasted_iota(
-                    jnp.int32, (1, page), 1
-                )
-                valid = pos < limit           # (rows, page)
-            for h in range(hkv):              # static unroll
-                q = qbuf[qslot, h]            # (rows, d)
-                k = kbuf[slot, h]
-                v = vbuf[slot, h]
-                if quant:
-                    k = k.astype(jnp.bfloat16)
-                    v = v.astype(jnp.bfloat16)
-                s = jax.lax.dot_general(
-                    q, k, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                ) * scale                     # (rows, page) f32
-                if quant:
-                    s = s * ksbuf[slot, h]    # (1, page) — exact fold
-                if soft_cap > 0.0:
-                    s = soft_cap * jnp.tanh(s / soft_cap)
-                if masked:
-                    s = jnp.where(valid, s, NEG_INF)
-                lo, hi = h * rows, (h + 1) * rows
-                m = m_ref[lo:hi]
-                m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-                alpha = jnp.exp(m - m_new)
-                p = jnp.exp(s - m_new)
-                if masked:
-                    # an all-masked row degenerates exp(s - m) to 1
-                    p = jnp.where(valid, p, 0.0)
-                l_ref[lo:hi] = alpha * l_ref[lo:hi] + jnp.sum(
-                    p, axis=1, keepdims=True
-                )
-                if quant:
-                    pv = (p * vsbuf[slot, h]).astype(v.dtype)
-                else:
-                    pv = p.astype(v.dtype)
-                acc_ref[lo:hi] = alpha * acc_ref[lo:hi] + jnp.dot(
-                    pv, v, preferred_element_type=jnp.float32
-                )
-                m_ref[lo:hi] = m_new
-
-        @pl.when(is_frontier)
-        def _masked():
-            heads(True)
-
-        @pl.when(jnp.logical_not(is_frontier))
-        def _plain():
-            heads(False)
-
-        return 0
-
-    jax.lax.fori_loop(0, nb, body, 0)
-    slot_ref[0] = jax.lax.rem(s0 + nb, n_bufs)   # hand the rotation on
-    slot_ref[1] = jnp.where(r + 1 < nr, 1 - qslot, qslot)
-
-    for h in range(hkv):
-        lo, hi = h * rows, (h + 1) * rows
-        l = l_ref[lo:hi]
-        safe_l = jnp.where(l > 0.0, l, 1.0)
-        obuf[h] = (acc_ref[lo:hi] / safe_l).astype(obuf.dtype)
-        lbuf[h] = jnp.where(
-            l > 0.0, m_ref[lo:hi] + jnp.log(safe_l), jnp.full_like(l, NEG_INF)
+        # per-query-row causal limit: token t = row // g sits at global
+        # position kv_len - q_len + t and may attend positions < limit =
+        # that + 1. Rows past q_len (block padding) get limit > kv_len —
+        # they attend whatever the pool holds and produce garbage the
+        # packing contract discards (see module docstring).
+        base = kv_len - q_len
+        row_tok = jax.lax.div(
+            jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0), g
         )
-    start = q_starts_ref[r] * g
-    o_cp = pltpu.make_async_copy(
-        obuf, out_hbm.at[:, pl.ds(start, rows)], sem_o.at[0]
-    )
-    l_cp = pltpu.make_async_copy(
-        lbuf, lse_hbm.at[:, pl.ds(start, rows)], sem_o.at[1]
-    )
-    o_cp.start()
-    l_cp.start()
-    # wait BEFORE the grid advances: overlapping rows' out regions
-    # self-heal by write order, which async completions would break
-    o_cp.wait()
-    l_cp.wait()
+        limit = base + row_tok + 1            # (rows, 1)
+
+        if topo_w:
+            # the row's descriptor, materialized as per-query-row
+            # columns via STATIC selects over the descriptor width —
+            # the vector-indexed gather Mosaic rejects (MC006) is
+            # exactly what this unroll avoids.
+            kind = topo_ref[r, 0]
+            anc_col = jnp.zeros((rows, 1), jnp.int32)
+            for t in range(min(topo_w, block_q)):
+                anc_col = jnp.where(
+                    row_tok == t, topo_ref[r, 2 + t], anc_col
+                )
+
+        def body(j, _):
+            slot = jax.lax.rem(s0 + j, n_bufs)
+            nxt = jax.lax.rem(s0 + j + 1, n_bufs)
+
+            @pl.when(j + 1 < nb)
+            def _prefetch_in_row():
+                for cp in dma(r, j + 1, nxt):
+                    cp.start()
+
+            if topo_w:
+                @pl.when(jnp.logical_and(j + 1 == nb, nxt_active < nr))
+                def _prefetch_next_row():
+                    qdma(nxt_clamped, 1 - qslot).start()
+                    for cp in dma(nxt_clamped, 0, nxt):
+                        cp.start()
+            else:
+                @pl.when(jnp.logical_and(j + 1 == nb, r + 1 < nr))
+                def _prefetch_next_row():
+                    qdma(r + 1, 1 - qslot).start()
+                    for cp in dma(r + 1, 0, nxt):
+                        cp.start()
+
+            # chaos hook: widens the slot-rotation window between the
+            # prefetch issues and this page's wait (the race-prone carry)
+            chaos_delay(site="ragged_paged", step=None, me=None, n=None)
+            for cp in dma(r, j, slot):
+                cp.wait()
+
+            # only pages crossing the causal frontier (or the length
+            # tail) pay the mask chain; interior pages take the plain
+            # path. TREE rows: the speculative region [base, kv_len) is
+            # entirely frontier pages, so interior pages stay fast.
+            is_frontier = (j + 1) * page > base + 1
+
+            def heads(masked):
+                if masked:
+                    pos = j * page + jax.lax.broadcasted_iota(
+                        jnp.int32, (1, page), 1
+                    )
+                    valid = pos < limit       # (rows, page)
+                    if topo_w:
+                        # TREE: position base+t is visible to query row
+                        # t' iff bit t of anc[t'] is set; everything
+                        # below base stays causal-visible, everything
+                        # past kv_len masked. SHARED_PREFIX masks as
+                        # causal (the aliasing is table-level).
+                        rel = pos - base      # (rows, page)
+                        bit = jax.lax.shift_right_logical(
+                            anc_col, jnp.clip(rel, 0, 31)
+                        ) & 1
+                        tree_valid = jnp.logical_and(
+                            pos < kv_len,
+                            jnp.logical_or(rel < 0, bit > 0),
+                        )
+                        valid = jnp.where(
+                            kind == TOPO_TREE, tree_valid, valid
+                        )
+                for h in range(hkv):          # static unroll
+                    q = qbuf[qslot, h]        # (rows, d)
+                    k = kbuf[slot, h]
+                    v = vbuf[slot, h]
+                    if quant:
+                        k = k.astype(jnp.bfloat16)
+                        v = v.astype(jnp.bfloat16)
+                    s = jax.lax.dot_general(
+                        q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    ) * scale                 # (rows, page) f32
+                    if quant:
+                        s = s * ksbuf[slot, h]   # (1, page) — exact fold
+                    if soft_cap > 0.0:
+                        s = soft_cap * jnp.tanh(s / soft_cap)
+                    if masked:
+                        s = jnp.where(valid, s, NEG_INF)
+                    lo, hi = h * rows, (h + 1) * rows
+                    m = m_ref[lo:hi]
+                    m_new = jnp.maximum(
+                        m, jnp.max(s, axis=1, keepdims=True)
+                    )
+                    alpha = jnp.exp(m - m_new)
+                    p = jnp.exp(s - m_new)
+                    if masked:
+                        # an all-masked row degenerates exp(s - m) to 1
+                        p = jnp.where(valid, p, 0.0)
+                    l_ref[lo:hi] = alpha * l_ref[lo:hi] + jnp.sum(
+                        p, axis=1, keepdims=True
+                    )
+                    if quant:
+                        pv = (p * vsbuf[slot, h]).astype(v.dtype)
+                    else:
+                        pv = p.astype(v.dtype)
+                    acc_ref[lo:hi] = alpha * acc_ref[lo:hi] + jnp.dot(
+                        pv, v, preferred_element_type=jnp.float32
+                    )
+                    m_ref[lo:hi] = m_new
+
+            @pl.when(is_frontier)
+            def _masked():
+                heads(True)
+
+            @pl.when(jnp.logical_not(is_frontier))
+            def _plain():
+                heads(False)
+
+            return 0
+
+        jax.lax.fori_loop(0, nb, body, 0)
+        slot_ref[0] = jax.lax.rem(s0 + nb, n_bufs)  # hand the rotation on
+        if topo_w:
+            slot_ref[1] = jnp.where(nxt_active < nr, 1 - qslot, qslot)
+        else:
+            slot_ref[1] = jnp.where(r + 1 < nr, 1 - qslot, qslot)
+
+        for h in range(hkv):
+            lo, hi = h * rows, (h + 1) * rows
+            l = l_ref[lo:hi]
+            safe_l = jnp.where(l > 0.0, l, 1.0)
+            obuf[h] = (acc_ref[lo:hi] / safe_l).astype(obuf.dtype)
+            lbuf[h] = jnp.where(
+                l > 0.0, m_ref[lo:hi] + jnp.log(safe_l),
+                jnp.full_like(l, NEG_INF)
+            )
+        start = q_starts_ref[r] * g
+        o_cp = pltpu.make_async_copy(
+            obuf, out_hbm.at[:, pl.ds(start, rows)], sem_o.at[0]
+        )
+        l_cp = pltpu.make_async_copy(
+            lbuf, lse_hbm.at[:, pl.ds(start, rows)], sem_o.at[1]
+        )
+        o_cp.start()
+        l_cp.start()
+        # wait BEFORE the grid advances: overlapping rows' out regions
+        # self-heal by write order, which async completions would break
+        o_cp.wait()
+        l_cp.wait()
+
+    if topo_w:
+        @pl.when(q_len > 0)
+        def _active_row():
+            row_body()
+    else:
+        row_body()
 
 
 @functools.lru_cache(maxsize=64)
 def _build_ragged(
     r, pps, npages, t_tokens, hkv, g, d, page, block_q, q_dtype,
-    quant, scale, soft_cap, n_bufs, interpret, token=(),
+    quant, scale, soft_cap, n_bufs, interpret, token=(), topo_w=0,
 ):
     """Construct the ragged-paged-attention pallas_call (lru-cached on
     the full static geometry; ``token`` busts the cache for lint/
     preflight builds). Returns the call taking
-    ``(table, kv_lens, q_lens, q_starts, q, k_pool, v_pool
-    [, k_scale, v_scale])``."""
+    ``(table, kv_lens, q_lens, q_starts[, topologies], q, k_pool,
+    v_pool [, k_scale, v_scale])`` — the topology operand present iff
+    ``topo_w > 0`` (its descriptor width; 0 = the pre-topology
+    launch, bit-for-bit)."""
     del token
     q_dtype = jnp.dtype(q_dtype)
     rows = block_q * g
     kernel = functools.partial(
         _ragged_kernel, scale, soft_cap, page, n_bufs, hkv, g, d,
-        block_q, quant,
+        block_q, quant, topo_w,
     )
     pool_dt = jnp.dtype(jnp.int8) if quant else q_dtype
     in_specs = [
@@ -359,7 +555,8 @@ def _build_ragged(
     ]
     sems += [pltpu.SemaphoreType.DMA((2,))]   # sem_o (out, lse)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,                # table, kv_lens, q_lens, starts
+        # table, kv_lens, q_lens, starts [+ per-row topology]
+        num_scalar_prefetch=5 if topo_w else 4,
         grid=(r,),
         in_specs=in_specs,
         out_specs=[
@@ -421,9 +618,9 @@ def auto_block_q(max_q_len: int, g: int) -> int:
 )
 def ragged_paged_attention(
     q, k_pool, v_pool, kv_lens, q_lens, q_starts, block_table, *,
-    group: int, k_scale=None, v_scale=None, scale: float | None = None,
-    soft_cap: float = 0.0, block_q: int = 8, n_bufs: int = 2,
-    interpret=None,
+    group: int, topologies=None, k_scale=None, v_scale=None,
+    scale: float | None = None, soft_cap: float = 0.0, block_q: int = 8,
+    n_bufs: int = 2, interpret=None,
 ):
     """Mixed prefill-chunk/decode attention over a shared page pool.
 
@@ -436,6 +633,13 @@ def ragged_paged_attention(
     with ``q_starts[r] + block_q <= T`` slack for every row);
     block_table: (R, pages_per_seq) int32 pool page ids. ``block_q``:
     static bound on max(q_lens) (see :func:`auto_block_q`).
+
+    ``topologies``: optional (R, 2+2W) int32 per-row attention-topology
+    descriptors (see the module-level layout notes) — None keeps the
+    pre-topology launch bit-for-bit. When given, TREE rows mask by
+    ancestor bitmask, SHARED_PREFIX rows read aliased prefix pages
+    through their (deduplicated) block tables, and ``q_len == 0`` rows
+    are skipped by the cross-row prefetch hop.
 
     Returns (out (Hkv, T·G, D) in q.dtype, lse (Hkv, T·G) f32). Rows
     of dim 1 outside the per-row valid spans hold garbage (the packing
@@ -457,16 +661,29 @@ def ragged_paged_attention(
             "sublane-aligned (multiple of 8) — pick block_q via "
             "auto_block_q"
         )
+    topo_w = 0
+    if topologies is not None:
+        tr, tw = topologies.shape
+        topo_w = (tw - 2) // 2
+        if tr != r or tw != 2 + 2 * topo_w or not (
+            1 <= topo_w <= TOPO_MAX_NODES
+        ):
+            raise ValueError(
+                f"ragged_paged_attention: topologies shape {(tr, tw)} "
+                f"must be (R={r}, 2+2·W) with 1 <= W <= {TOPO_MAX_NODES}"
+            )
     call = _build_ragged(
         r, pps, npages, t_tokens, hkv, g, d, page, block_q,
         jnp.dtype(q.dtype).name, quant, float(scale), float(soft_cap),
-        n_bufs, interpret,
+        n_bufs, interpret, (), topo_w,
     )
     args = [
         block_table.astype(jnp.int32), kv_lens.astype(jnp.int32),
         q_lens.astype(jnp.int32), q_starts.astype(jnp.int32),
-        q, k_pool, v_pool,
     ]
+    if topo_w:
+        args.append(topologies.astype(jnp.int32))
+    args += [q, k_pool, v_pool]
     if quant:
         args += [
             k_scale.astype(jnp.float32).reshape(npages, hkv, 1, page),
@@ -478,12 +695,15 @@ def ragged_paged_attention(
 
 def ragged_paged_attention_xla(
     q, k_pool, v_pool, kv_lens, q_lens, q_starts, block_table, *,
-    group: int, k_scale=None, v_scale=None, scale=None, soft_cap=0.0,
+    group: int, topologies=None, k_scale=None, v_scale=None, scale=None,
+    soft_cap=0.0,
 ):
     """Dense-XLA twin (correctness reference + degradation target):
     gather each row's pages into a contiguous cache and run the masked
-    dense attention with the same causal-frontier semantics. Same
-    signature/garbage-rows contract as :func:`ragged_paged_attention`.
+    dense attention with the same causal-frontier semantics — including
+    the per-row topology operand (TREE ancestor-bitmask masks; CAUSAL
+    and SHARED_PREFIX rows mask causally). Same signature/garbage-rows
+    contract as :func:`ragged_paged_attention`.
     """
     hkv, tg, d = q.shape
     g = group
@@ -526,7 +746,24 @@ def ragged_paged_attention_xla(
     s = jnp.einsum("htgd,thsd->htgs", qg, kt) * scale
     if soft_cap > 0.0:
         s = soft_cap * jnp.tanh(s / soft_cap)
-    mask = jnp.arange(s_cap)[None, None, None, :] < limit[None, :, None, None]
+    pos_s = jnp.arange(s_cap)
+    ok = pos_s[None, :] < limit[:, None]       # (T, S) causal
+    if topologies is not None:
+        topologies = jnp.asarray(topologies, jnp.int32)
+        w = (topologies.shape[1] - 2) // 2
+        kind_t = topologies[row_c, 0]          # (T,)
+        anc_t = topologies[row_c, 2 + jnp.clip(t_in_row, 0, w - 1)]
+        base_t = kv_lens[row_c] - q_lens[row_c]
+        rel = pos_s[None, :] - base_t[:, None]             # (T, S)
+        bit = jnp.right_shift(anc_t[:, None], jnp.clip(rel, 0, 31)) & 1
+        tree_ok = (pos_s[None, :] < kv_lens[row_c][:, None]) & (
+            (rel < 0) | (bit > 0)
+        )
+        ok = jnp.where(
+            ((kind_t == TOPO_TREE) & (row_of >= 0))[:, None],
+            tree_ok, ok,
+        )
+    mask = ok[None, :, None, :]
     s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.where(mask, jnp.exp(s - m), 0.0)
@@ -551,7 +788,7 @@ def ragged_paged_attention_xla(
 # require FULL coverage of the out buffer by locally computed writes.
 
 LINT_GEOM = dict(r=2, pps=2, npages=4, t=16, hkv=2, g=1, d=128, page=8,
-                 block_q=8)
+                 block_q=8, topo_w=8)
 
 #: parking-zone slack the GRID lint geometry reserves past each row's
 #: packed span — the widest block_q a legal candidate may write into it.
@@ -577,10 +814,25 @@ def grid_lint_geom(schedule=None) -> dict:
     t = pack + min(bq, GRID_BLOCK_CAP)
     kv0 = pack + 4                        # row 0 crosses a page boundary
     pps = -(-kv0 // page)
+    topo_w = topo_width(max(bq, 8))
+    topo = causal_topologies(2, topo_w)
+    tree_pack = 0 if schedule is None else int(
+        getattr(schedule, "tree_pack", 0)
+    )
+    if tree_pack > 0:
+        # exercise the TREE mask path at the gate: row 1 carries a
+        # branchy verify tree (trunk chain + one sibling branch off the
+        # frontier) of min(tree_pack, pack) nodes
+        nd = max(min(tree_pack, pack) - 1, 1)
+        parents = [-1] + list(range(nd - 1))
+        if nd >= 3:
+            parents[2] = -1               # sibling branch off the root
+        topo[1] = tree_topology_row(parents[:nd], topo_w)
     return dict(
         r=2, pps=pps, npages=2 * pps, t=t, hkv=2, g=g, d=128, page=page,
         block_q=bq, n_bufs=2 if schedule is None else int(schedule.n_bufs),
         kv_lens=(kv0, pack), q_lens=(pack, pack), q_starts=(0, pack),
+        topo_w=topo_w, topo=topo,
     )
 
 
@@ -595,6 +847,7 @@ def build_grid_lint_kernel(token=(), schedule=None, quant=True):
         gm["r"], gm["pps"], gm["npages"], gm["t"], gm["hkv"], gm["g"],
         gm["d"], gm["page"], gm["block_q"], "float32", quant,
         1.0 / math.sqrt(gm["d"]), 0.0, gm["n_bufs"], False, token,
+        gm["topo_w"],
     )
     return gm
 
@@ -608,5 +861,5 @@ def build_lint_kernel(token=(), quant=True):
     return _build_ragged(
         gm["r"], gm["pps"], gm["npages"], gm["t"], gm["hkv"], gm["g"],
         gm["d"], gm["page"], gm["block_q"], "float32", quant,
-        1.0 / math.sqrt(gm["d"]), 0.0, 2, False, token,
+        1.0 / math.sqrt(gm["d"]), 0.0, 2, False, token, gm["topo_w"],
     )

@@ -535,6 +535,7 @@ def _ragged_in_shapes(n):
         ((g["r"],), _I32),                            # kv_lens
         ((g["r"],), _I32),                            # q_lens
         ((g["r"],), _I32),                            # q_starts
+        ((g["r"], 2 + 2 * g["topo_w"]), _I32),        # topologies
         ((g["hkv"], g["t"] * g["g"], g["d"]), _F32),  # packed q
         (pool, _I8),                                  # k pool
         (pool, _I8),                                  # v pool
@@ -559,6 +560,7 @@ def _ragged_init(n):
         1: np.asarray([12, 8], np.int32),             # kv_lens
         2: np.asarray([8, 8], np.int32),              # q_lens
         3: np.asarray([0, 8], np.int32),              # q_starts
+        4: np.zeros((g["r"], 2 + 2 * g["topo_w"]), np.int32),  # CAUSAL
     }
 
 
@@ -807,7 +809,10 @@ def families() -> dict:
             _ragged_paged,
             _ragged_in_shapes,
             init=_ragged_init,
-            contract=DeliveryContract(kind="local", dst=9),
+            contract=DeliveryContract(
+                kind="local", dst=10,
+                topo={"ref": 4, "kv_lens": 1, "q_lens": 2, "width": 8},
+            ),
         ),
         KernelFamily(
             # the disaggregated-serving page ship: a PAIRWISE permute —

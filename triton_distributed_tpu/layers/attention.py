@@ -195,11 +195,14 @@ class RaggedPagedAttention:
     use_pallas: bool = True
 
     def __call__(self, qp, k_pool, v_pool, kv_lens, q_lens, q_starts,
-                 block_table, *, block_q: int = 8, n_bufs: int = 2):
+                 block_table, *, topologies=None, block_q: int = 8,
+                 n_bufs: int = 2):
         """qp: (Hkv, T·G, D) packed rows sharded P(axis) on dim 0;
         k_pool/v_pool: (npages, Hkv, page, D) arrays or int8
-        ``{"q","scale"}`` dicts, sharded P(None, axis); metadata
-        replicated. Returns (Hkv, T·G, D) sharded like qp."""
+        ``{"q","scale"}`` dicts, sharded P(None, axis); metadata —
+        including the optional (R, 2+2W) per-row attention-topology
+        descriptors — replicated. Returns (Hkv, T·G, D) sharded like
+        qp."""
         from jax.sharding import PartitionSpec as P
 
         from triton_distributed_tpu.kernels.ragged_paged_attention import (
@@ -210,11 +213,17 @@ class RaggedPagedAttention:
         quant = isinstance(k_pool, dict)
         g, block = self.group, block_q
         use_pallas = self.use_pallas
+        has_topo = topologies is not None
 
-        def local(qp, table, kv_lens, q_lens, q_starts, *pools):
+        def local(qp, table, kv_lens, q_lens, q_starts, *rest):
+            if has_topo:
+                topo, *pools = rest
+            else:
+                topo, pools = None, rest
             fn = (ragged_paged_attention if use_pallas
                   else ragged_paged_attention_xla)
-            kw = dict(group=g, scale=self.scale, soft_cap=self.soft_cap)
+            kw = dict(group=g, scale=self.scale, soft_cap=self.soft_cap,
+                      topologies=topo)
             if use_pallas:
                 kw["block_q"] = block
                 kw["n_bufs"] = n_bufs
@@ -232,16 +241,18 @@ class RaggedPagedAttention:
             (k_pool["q"], k_pool["scale"], v_pool["q"], v_pool["scale"])
             if quant else (k_pool, v_pool)
         )
+        meta = (P(),) if has_topo else ()
         sharded = jax.shard_map(
             local,
             mesh=self.mesh,
-            in_specs=(P(self.axis), P(), P(), P(), P())
+            in_specs=(P(self.axis), P(), P(), P(), P()) + meta
             + tuple(P(None, self.axis) for _ in pools),
             out_specs=P(self.axis),
             check_vma=False,
         )
+        extra = (topologies,) if has_topo else ()
         return sharded(qp, block_table, kv_lens, q_lens, q_starts,
-                       *pools)
+                       *extra, *pools)
 
 
 def append_kv(k_cache, v_cache, kv_lens, k_new, v_new, kv_layout="bhsd",

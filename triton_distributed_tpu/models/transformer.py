@@ -1441,7 +1441,7 @@ class Transformer:
         )
 
     def _ragged_attn(self, qp, k_pool, v_pool, state, q_lens, q_starts,
-                     block_q, use_pallas, n_bufs=2):
+                     block_q, use_pallas, n_bufs=2, topologies=None):
         """One layer's ragged paged attention over the (updated) pools
         via the head-sharded serving layer. qp: (Hkv, T·G, D) packed
         GQA rows (already holding this step's tokens in the pools —
@@ -1455,11 +1455,12 @@ class Transformer:
         )
         return layer(
             qp, k_pool, v_pool, state.kv_lens, q_lens, q_starts,
-            state.block_table, block_q=block_q, n_bufs=n_bufs,
+            state.block_table, topologies=topologies, block_q=block_q,
+            n_bufs=n_bufs,
         )
 
     def serving_step(self, params, state, tokens, token_rows, token_pos,
-                     q_starts, q_lens, moe_state=None, *,
+                     q_starts, q_lens, topologies=None, moe_state=None, *,
                      block_q: int = 8, use_pallas: bool = True,
                      n_bufs: int = 2, all_logits: bool = False):
         """One CONTINUOUS-BATCHING step: a ragged mixed batch of prefill
@@ -1477,6 +1478,12 @@ class Transformer:
         next-token distribution for rows that finished a chunk at their
         prompt end, garbage for q_lens == 0 slots), plus ``moe_state'``
         threaded as in :meth:`decode_step` when given.
+
+        ``topologies``: optional (slots, 2+2W) int32 per-row attention-
+        topology descriptors (kernels/ragged_paged_attention.py layout)
+        shared by every layer's attention — TREE verify rows, shared-
+        prefix aliasing, and the ``q_lens == 0`` kernel-side row skip
+        all ride this operand; None keeps the pre-topology launch.
 
         Every new K/V token is scattered into the page pools FIRST and
         attention reads the updated pools (append-then-attend): a
@@ -1557,7 +1564,7 @@ class Transformer:
             )
             o = self._ragged_attn(
                 qp, kp, vp, state.replace(layers=()), q_lens, q_starts,
-                block_q, use_pallas, n_bufs,
+                block_q, use_pallas, n_bufs, topologies,
             )
             o = unpack_gqa_rows(o, c.n_heads).reshape(t, c.q_dim)
             x = x + self._dmm(o.astype(c.dtype), blk["wo"])
@@ -1614,14 +1621,15 @@ class Transformer:
         # donate the ServingState (pool append aliases in place — the
         # same discipline as the decode jits) and the LL MoE workspaces
         @functools.partial(
-            jax.jit, static_argnums=(8, 9, 10), donate_argnums=(1, 7)
+            jax.jit, static_argnums=(9, 10, 11), donate_argnums=(1, 8)
         )
         def step(params, state, tokens, token_rows, token_pos, q_starts,
-                 q_lens, moe_state, block_q, use_pallas, n_bufs=2):
+                 q_lens, topologies, moe_state, block_q, use_pallas,
+                 n_bufs=2):
             return self.serving_step(
                 params, state, tokens, token_rows, token_pos, q_starts,
-                q_lens, moe_state, block_q=block_q, use_pallas=use_pallas,
-                n_bufs=n_bufs,
+                q_lens, topologies, moe_state, block_q=block_q,
+                use_pallas=use_pallas, n_bufs=n_bufs,
             )
 
         return step
@@ -1634,14 +1642,15 @@ class Transformer:
         # verify row's distribution after each draft token. Same
         # donation discipline as `_serving_jit`.
         @functools.partial(
-            jax.jit, static_argnums=(8, 9, 10), donate_argnums=(1, 7)
+            jax.jit, static_argnums=(9, 10, 11), donate_argnums=(1, 8)
         )
         def step(params, state, tokens, token_rows, token_pos, q_starts,
-                 q_lens, moe_state, block_q, use_pallas, n_bufs=2):
+                 q_lens, topologies, moe_state, block_q, use_pallas,
+                 n_bufs=2):
             return self.serving_step(
                 params, state, tokens, token_rows, token_pos, q_starts,
-                q_lens, moe_state, block_q=block_q, use_pallas=use_pallas,
-                n_bufs=n_bufs, all_logits=True,
+                q_lens, topologies, moe_state, block_q=block_q,
+                use_pallas=use_pallas, n_bufs=n_bufs, all_logits=True,
             )
 
         return step

@@ -7,7 +7,8 @@ the page pool, chunked prefill interleaved into decode batches), with
 and cache-aware :class:`FleetRouter`. :class:`SpeculativeEngine` adds
 draft-k speculative decoding as a ragged-batch scenario (verify pass =
 one ``q_len=k+1`` row, token-exact accept via the request-keyed
-sampler).
+sampler); ``spec_tree`` + :class:`TreeDrafter` pack a branchy draft
+TREE into that row under the kernel's per-row topology operand.
 
 See docs/SERVING.md for the lifecycle and knob catalog.
 """
@@ -45,6 +46,7 @@ from triton_distributed_tpu.serving.spec import (  # noqa: F401
     Drafter,
     NGramDrafter,
     SpeculativeEngine,
+    TreeDrafter,
     make_drafter,
 )
 from triton_distributed_tpu.serving.state import (  # noqa: F401

@@ -187,6 +187,15 @@ class EngineConfig:
     # evicted requests reattach resident pages instead of recomputing
     # the prefix.
     prefix_cache: bool = False
+    # prefix_share: in-batch shared-prefix dedup (requires
+    # prefix_cache). Batch assembly folds every batched row's frozen
+    # pages onto the prefix cache's canonical page for the same chain
+    # hash — one physical page run walked by many rows' block tables —
+    # and marks the rows SHARED_PREFIX in the kernel's attention-
+    # topology operand. Cuts page-walk DMA traffic and pool pressure on
+    # motif traffic; token streams are unchanged (frozen pages with
+    # equal chain hashes hold byte-identical KV by construction).
+    prefix_share: bool = False
 
 
 @dataclass
@@ -200,6 +209,9 @@ class EngineStats:
     evictions: int = 0
     deferrals: int = 0
     prefix_hits: int = 0               # pages reattached from the cache
+    # --- in-batch shared-prefix dedup (EngineConfig.prefix_share) ---
+    shared_prefix_rows: int = 0        # batched rows marked SHARED_PREFIX
+    deduped_pages: int = 0             # duplicate pages folded onto canon
     # --- multi-tenancy (zero on single-tenant engines) ---
     preemptions: int = 0               # evictions forced by a higher tier
     tenant_preemptions: dict = field(default_factory=dict)  # tenant -> n
@@ -426,8 +438,11 @@ class ServingEngine:
         )
 
         c = model.config
+        # traffic key: geometry + the speculation coordinates (draft-k,
+        # spec_tree) so tune.traffic re-searches hot SPECULATIVE shapes
+        # separately from plain decode at the same geometry
         self._grid_key = (cfg.slots, self._t_pad, c.n_kv_heads, g,
-                          c.head_dim, cfg.page)
+                          c.head_dim, cfg.page) + self._spec_key()
         sched = resolve_schedule(
             "flash_decode.ragged_paged", self._grid_key, (model.tp,),
             "int8" if c.kv_quant is not None else None, grid_schedule,
@@ -453,6 +468,18 @@ class ServingEngine:
                 f"chunk={cfg.chunk} exceeds token_budget="
                 f"{cfg.token_budget}"
             )
+        if cfg.prefix_share and not cfg.prefix_cache:
+            raise ValueError(
+                "prefix_share requires prefix_cache (the chain-hash "
+                "registry IS the dedup index)"
+            )
+
+    def _spec_key(self) -> tuple:
+        """Speculation coordinates appended to the grid-schedule traffic
+        key: (draft-k, spec_tree width). (0, 0) on plain engines; the
+        speculative engine reports its draft budget so hot speculative
+        shapes tune separately."""
+        return (0, 0)
 
     # ------------------------------------------------------------ requests
 
@@ -770,7 +797,59 @@ class ServingEngine:
         return np.asarray(req.seq[req.cursor:req.cursor + take],
                           np.int32)
 
+    def _row_topology(self, s: int, req, take: int):
+        """Per-row attention-topology descriptor (one
+        ``(2+2W,)`` int32 row, kernels/ragged_paged_attention.py
+        layout) for this step's batch, or None for CAUSAL — the
+        default. The speculative engine returns TREE descriptors for
+        packed verify trees; batch assembly may still overwrite CAUSAL
+        rows with SHARED_PREFIX after the dedup pass."""
+        return None
+
+    def _dedup_shared_prefixes(self, batched, topo, width: int) -> None:
+        """In-batch shared-prefix dedup (``cfg.prefix_share``): fold
+        each batched row's FROZEN pages (fully below its cursor —
+        nothing writes them again) onto the prefix cache's canonical
+        page for the same chain hash, releasing the duplicate. Rows
+        whose leading pages end up multiply-referenced are marked
+        SHARED_PREFIX with ``aux = split`` tokens; the kernel masks
+        them causally (aliasing is a table-level fact) but the page
+        walk now hits one physical run shared across the batch."""
+        from triton_distributed_tpu.kernels.ragged_paged_attention import (
+            TOPO_CAUSAL,
+            shared_prefix_topology_row,
+        )
+
+        page = self.cfg.page
+        for s in sorted(batched):
+            req = self.slot_req[s]
+            frozen = min(req.cursor // page, self.state.pages_per_seq)
+            if frozen <= 0:
+                continue
+            run = 0
+            for p, h in enumerate(self._page_hashes(req, frozen)):
+                pg = int(self.table[s, p])
+                canon = self.pool.lookup(h)
+                if canon is not None and canon != pg:
+                    self.pool.release(pg)
+                    self.pool.retain(canon)
+                    self.table[s, p] = canon
+                    self.stats.deduped_pages += 1
+                    pg = canon
+                if run == p and self.pool.refs[pg] >= 2:
+                    run = p + 1
+            if run > 0 and topo[s, 0] == TOPO_CAUSAL:
+                topo[s] = shared_prefix_topology_row(
+                    min(run * page, int(req.cursor)), width
+                )
+                self.stats.shared_prefix_rows += 1
+
     def _assemble(self):
+        from triton_distributed_tpu.kernels.ragged_paged_attention import (
+            causal_topologies,
+            topo_width,
+        )
+
         cfg = self.cfg
         R, T = cfg.slots, self._t_pad
         tokens = np.zeros((T,), np.int32)
@@ -781,6 +860,8 @@ class ServingEngine:
         q_starts = np.full((R,), cfg.token_budget, np.int32)
         q_lens = np.zeros((R,), np.int32)
         kv_dev = np.zeros((R,), np.int32)
+        topo_w = topo_width(self._block_q_cap)
+        topo = causal_topologies(R, topo_w)
         next_start = 0
         batched: set = set()
         takes: dict = {}
@@ -816,11 +897,16 @@ class ServingEngine:
                 next_start += _ceil8(take)
                 batched.add(s)
                 takes[s] = take
+                desc = self._row_topology(s, req, take)
+                if desc is not None:
+                    topo[s] = desc
                 continue
             # page allocation failed even after eviction: defer the row
             self.stats.deferrals += 1
+        if cfg.prefix_share and batched:
+            self._dedup_shared_prefixes(batched, topo, topo_w)
         return (tokens, token_rows, token_pos, q_starts, q_lens, kv_dev,
-                batched, takes)
+                topo, batched, takes)
 
     def _step_jit(self):
         """The jitted device step this engine launches. The speculative
@@ -830,7 +916,8 @@ class ServingEngine:
 
     def _run_device(self, arrays, block_q):
         jnp = self._jnp
-        tokens, token_rows, token_pos, q_starts, q_lens, kv_dev = arrays
+        (tokens, token_rows, token_pos, q_starts, q_lens, kv_dev,
+         topo) = arrays
         state = self.state.replace(
             block_table=jnp.asarray(self.table),
             kv_lens=jnp.asarray(kv_dev),
@@ -853,6 +940,7 @@ class ServingEngine:
             self.params, state, jnp.asarray(tokens),
             jnp.asarray(token_rows), jnp.asarray(token_pos),
             jnp.asarray(q_starts), jnp.asarray(q_lens),
+            jnp.asarray(topo),
             self.moe_state, block_q, self.use_pallas, self._n_bufs,
         )
         if self.moe_state is None:
@@ -870,7 +958,7 @@ class ServingEngine:
 
         self._admit()
         (tokens, token_rows, token_pos, q_starts, q_lens, kv_dev,
-         batched, takes) = self._assemble()
+         topo, batched, takes) = self._assemble()
         report = {"step": self.step_count, "batched": len(batched),
                   "tokens": int(q_lens.sum())}
         if not batched:
@@ -896,7 +984,8 @@ class ServingEngine:
         if probing:
             self.use_pallas = True
         t0 = time.perf_counter()
-        arrays = (tokens, token_rows, token_pos, q_starts, q_lens, kv_dev)
+        arrays = (tokens, token_rows, token_pos, q_starts, q_lens, kv_dev,
+                  topo)
         try:
             logits = self._run_device(arrays, block_q)
         except Exception:

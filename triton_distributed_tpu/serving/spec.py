@@ -126,6 +126,97 @@ class NGramDrafter(Drafter):
         return np.zeros((0,), np.int32)
 
 
+class TreeDrafter(NGramDrafter):
+    """Tree drafting over the request's OWN token history — the
+    Medusa-style multi-path proposal the ragged kernel's TREE attention
+    topology verifies in ONE row.
+
+    The TRUNK is exactly :class:`NGramDrafter`'s proposal (the most
+    recent matching continuation), packed first as a parent chain — so
+    a tree row can never accept fewer trunk tokens than the linear
+    drafter would have. DIVERGENT continuations from OLDER occurrences
+    of the same suffix n-gram then graft sibling branches at their
+    divergence points: where the history continues the motif more than
+    one way, the tree hedges instead of committing, and the verify walk
+    follows whichever child the keyed sample actually draws (the
+    "sibling rescue" that beats linear draft-k on branchy traffic).
+
+    ``draft_tree(req, budget)`` returns ``(tokens, parents)`` int32
+    arrays of equal length ``<= budget``: ``tokens[i]`` is draft node
+    ``i``'s token, ``parents[i]`` its parent NODE index (< i; -1 = the
+    frontier). Trunk-first packing (node ``i`` of the trunk has parent
+    ``i - 1``) is part of the contract — the engine rewinds the cursor
+    to the accepted IN-PLACE prefix, and only trunk nodes sit at their
+    true sequence offsets in the pool. Deterministic pure function of
+    ``req.seq``, like every drafter."""
+
+    name = "tree"
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
+                 branches: int = 2, branch_len: int = 2):
+        super().__init__(max_ngram, min_ngram)
+        if branches < 0 or branch_len < 1:
+            raise ValueError((branches, branch_len))
+        self.branches = branches
+        self.branch_len = branch_len
+
+    def _continuations(self, seq: list, k: int) -> list:
+        """Continuations of the longest matched suffix n-gram, most
+        recent occurrence first (the same scan order as
+        :meth:`NGramDrafter.draft`, collecting every occurrence)."""
+        for n in range(self.max_ngram, self.min_ngram - 1, -1):
+            if len(seq) <= n:
+                continue
+            tail = seq[-n:]
+            conts = []
+            for i in range(len(seq) - n - 1, -1, -1):
+                if seq[i:i + n] == tail:
+                    cont = seq[i + n:i + n + k]
+                    if cont:
+                        conts.append(cont)
+            if conts:
+                return conts
+        return []
+
+    def draft_tree(self, req, budget: int):
+        empty = (np.zeros((0,), np.int32), np.zeros((0,), np.int32))
+        if budget <= 0:
+            return empty
+        seq = [int(t) for t in req.seq]
+        conts = self._continuations(seq, budget)
+        if not conts:
+            return empty
+        trunk = conts[0][:budget]
+        tokens = list(trunk)
+        parents = [-1] + list(range(len(trunk) - 1))
+        grafted = 0
+        for cont in conts[1:]:
+            if grafted >= self.branches or len(tokens) >= budget:
+                break
+            dv = next(
+                (d for d in range(min(len(cont), len(trunk)))
+                 if cont[d] != trunk[d]),
+                None,
+            )
+            if dv is None:
+                continue               # same path — nothing to hedge
+            if any(parents[t] == dv - 1 and tokens[t] == cont[dv]
+                   for t in range(len(tokens))):
+                continue               # this sibling already exists
+            par = dv - 1               # divergence hangs off trunk[dv-1]
+            added = False
+            for tok in cont[dv:dv + self.branch_len]:
+                if len(tokens) >= budget:
+                    break
+                tokens.append(tok)
+                parents.append(par)
+                par = len(tokens) - 1
+                added = True
+            grafted += int(added)
+        return (np.asarray(tokens, np.int32),
+                np.asarray(parents, np.int32))
+
+
 class DraftModelDrafter(Drafter):
     """A genuinely smaller shared-weights draft model: the target's own
     embedding, its FIRST ``depth`` decoder blocks, final norm and
@@ -191,11 +282,14 @@ class DraftModelDrafter(Drafter):
 
 
 def make_drafter(kind: str, model=None, params=None, **kw) -> Drafter:
-    """Build a drafter by name (``"ngram"`` / ``"draft_model"``) —
-    the bench/CI entry point. ``draft_model`` accepts ``depth`` (the
-    truncated layer count; default half the target's)."""
+    """Build a drafter by name (``"ngram"`` / ``"tree"`` /
+    ``"draft_model"``) — the bench/CI entry point. ``draft_model``
+    accepts ``depth`` (the truncated layer count; default half the
+    target's); ``tree`` accepts ``branches``/``branch_len``."""
     if kind == "ngram":
         return NGramDrafter(**kw)
+    if kind == "tree":
+        return TreeDrafter(**kw)
     if kind == "draft_model":
         if model is None or params is None:
             raise ValueError("draft_model drafter needs model + params")
@@ -215,11 +309,21 @@ class SpeculativeEngine(ServingEngine):
     changes what a steady decode row PACKS (``1 + k`` tokens instead of
     1) and how its logits are consumed (the verify/accept loop in
     :meth:`_advance_row`). With ``spec_k <= 7`` the widened row costs
-    no extra packed budget: ``_ceil8(k+1) == _ceil8(1)``."""
+    no extra packed budget: ``_ceil8(k+1) == _ceil8(1)``.
+
+    ``spec_tree > 0`` switches steady decode rows to TREE verification:
+    the drafter's ``draft_tree`` packs up to ``spec_tree`` nodes of a
+    draft TREE into one verify row, the row carries a TREE attention-
+    topology descriptor (kernels/ragged_paged_attention.py) so sibling
+    branches never attend each other, and the accept walk descends the
+    tree by the same request-keyed draws — each emitted token is the
+    keyed sample at its PATH-conditioned distribution, so streams stay
+    byte-identical to the plain engine while branchy traffic accepts
+    more tokens per step than any single linear path could."""
 
     def __init__(self, model, params, cfg, *, drafter: Drafter | None = None,
-                 spec_k: int = 4, adaptive_k: bool = False, **kw):
-        super().__init__(model, params, cfg, **kw)
+                 spec_k: int = 4, spec_tree: int = 0,
+                 adaptive_k: bool = False, **kw):
         if spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {spec_k}")
         if spec_k + 1 > cfg.chunk:
@@ -228,8 +332,29 @@ class SpeculativeEngine(ServingEngine):
             # prefill chunk would invalidate both
             raise ValueError(
                 f"spec_k={spec_k} verify row exceeds chunk={cfg.chunk}")
+        if spec_tree:
+            from triton_distributed_tpu.kernels.ragged_paged_attention \
+                import TOPO_MAX_NODES
+
+            if spec_tree + 1 > cfg.chunk:
+                raise ValueError(
+                    f"spec_tree={spec_tree} verify row exceeds "
+                    f"chunk={cfg.chunk}")
+            if spec_tree + 1 > TOPO_MAX_NODES:
+                raise ValueError(
+                    f"spec_tree={spec_tree} exceeds the topology "
+                    f"descriptor's {TOPO_MAX_NODES - 1}-node bound")
         self.spec_k = int(spec_k)
-        self.drafter = drafter if drafter is not None else NGramDrafter()
+        self.spec_tree = int(spec_tree)
+        # set before super().__init__: the traffic key (_spec_key) is
+        # derived during the base constructor
+        super().__init__(model, params, cfg, **kw)
+        if drafter is None:
+            drafter = TreeDrafter() if spec_tree else NGramDrafter()
+        if spec_tree and not hasattr(drafter, "draft_tree"):
+            raise ValueError(
+                "spec_tree needs a drafter with draft_tree (TreeDrafter)")
+        self.drafter = drafter
         # adaptive per-request draft budget: consume the observe()
         # feedback to walk each request's k inside [1, spec_k] — AIMD
         # over the verify outcomes (grow +1 on a clean sweep, shrink to
@@ -244,6 +369,14 @@ class SpeculativeEngine(ServingEngine):
         # assembly: a deferred row's entry must not leak into a later
         # step where the slot packs something else)
         self._step_drafts: dict = {}
+        # slot -> (tokens, parents) of this step's draft TREE (tree
+        # mode only; cleared alongside _step_drafts)
+        self._step_trees: dict = {}
+
+    def _spec_key(self) -> tuple:
+        # extends the engine's traffic-tuning key: a schedule searched
+        # for draft-k=4 rows is the wrong answer for tree-packed rows
+        return (self.spec_k, self.spec_tree)
 
     # ------------------------------------------------------- planning
 
@@ -252,13 +385,15 @@ class SpeculativeEngine(ServingEngine):
         if len(req.seq) - req.cursor == 1:
             # steady decode row: may widen by the draft budget —
             # admission headroom must assume the widest case
-            take = min(1 + self.spec_k,
+            take = min(1 + max(self.spec_k, self.spec_tree),
                        self.state.capacity - req.cursor)
         return take
 
     def _plan_row(self, req) -> np.ndarray:
         if len(req.seq) - req.cursor != 1:
             return super()._plan_row(req)     # prefill/chunk row
+        if self.spec_tree:
+            return self._plan_tree_row(req)
         # steady decode row: widen to [frontier, d_1 .. d_nd]. Drafting
         # past the request's remaining emission target is pure rollback
         # work, so nd is also capped by (max_new - generated - 1).
@@ -287,8 +422,51 @@ class SpeculativeEngine(ServingEngine):
         return np.concatenate(
             [np.asarray(req.seq[req.cursor:], np.int32), drafts])
 
+    def _plan_tree_row(self, req) -> np.ndarray:
+        """Steady decode row, tree mode: pack [frontier, node_1 ..
+        node_nd] where the nodes are a draft TREE in index order
+        (``parents[t] < t``, ``-1`` = the frontier). The row's TREE
+        topology descriptor (emitted by :meth:`_row_topology`) masks
+        each node to attend only its root-to-node ancestry, so
+        ``logits[base + t]`` is the PATH-conditioned next-token
+        distribution — sibling branches never contaminate each other."""
+        budget = self.spec_tree
+        if self.throttled_tiers:
+            pr = getattr(req, "priority", None)
+            if pr is None:
+                pr = self._tenant(req).priority
+            if pr in self.throttled_tiers:
+                budget = 1            # brownout: shed speculation first
+        nd = min(budget,
+                 self.state.capacity - (req.cursor + 1),
+                 req.max_new - len(req.generated) - 1)
+        if nd > 0:
+            tokens, parents = self.drafter.draft_tree(req, nd)
+            # parents[t] < t, so truncating the tail keeps a valid tree
+            tokens = np.asarray(tokens, np.int32)[:nd]
+            parents = np.asarray(parents, np.int32)[: len(tokens)]
+        else:
+            tokens = np.zeros((0,), np.int32)
+            parents = np.zeros((0,), np.int32)
+        self._step_trees[req.slot] = (tokens, parents)
+        self._step_drafts[req.slot] = tokens
+        return np.concatenate(
+            [np.asarray(req.seq[req.cursor:], np.int32), tokens])
+
+    def _row_topology(self, s: int, req, take: int):
+        tree = self._step_trees.get(s)
+        if tree is None or len(tree[0]) == 0:
+            return None               # plain row stays CAUSAL
+        from triton_distributed_tpu.kernels.ragged_paged_attention \
+            import topo_width, tree_topology_row
+
+        _, parents = tree
+        return tree_topology_row(
+            [int(p) for p in parents], topo_width(self._block_q_cap))
+
     def _assemble(self):
         self._step_drafts = {}
+        self._step_trees = {}
         return super()._assemble()
 
     # ------------------------------------------------------- verify
@@ -303,6 +481,9 @@ class SpeculativeEngine(ServingEngine):
                      q_starts, q_lens) -> tuple:
         drafts = self._step_drafts.get(s)
         base = int(q_starts[s])
+        tree = self._step_trees.get(s)
+        if tree is not None and len(tree[0]) > 0:
+            return self._advance_tree_row(s, req, take, logits, base, tree)
         if drafts is None or len(drafts) == 0:
             # plain chunk/decode row — base bookkeeping, but the
             # frontier distribution lives at the row's LAST packed
@@ -361,6 +542,73 @@ class SpeculativeEngine(ServingEngine):
         self.drafter.observe(req, accepted, nd - accepted)
         if self.adaptive_k:
             self._observe_k(req, accepted, nd - accepted, nd)
+        self._maybe_complete(req, s)
+        return emitted, 0
+
+    def _advance_tree_row(self, s: int, req, take: int, logits,
+                          base: int, tree) -> tuple:
+        """Tree verify: walk the draft tree from the frontier, at each
+        node drawing the request-keyed sample from that node's
+        PATH-conditioned logits (the TREE mask guarantees position
+        ``t+1`` attended exactly prefix + node ``t``'s ancestry).
+        Accepting means descending to the child whose draft token
+        matches the draw; the walk ends on a mismatch (the draw IS the
+        correction) or at a leaf (the draw is the bonus token). Every
+        draw keys on (seed, rid, generated-so-far) exactly as the plain
+        engine's sequential draws would, so the stream is
+        byte-identical to non-speculative decode.
+
+        Only the leading IN-PLACE segment of the accepted path — nodes
+        whose q position equals their linear packed position, i.e. the
+        trunk — advances the cursor: off-trunk accepted tokens were
+        written to the wrong pool offsets, so they are emitted into the
+        stream now but re-packed (and their KV rewritten in place) as a
+        chunk row next step."""
+        tokens, parents = tree
+        nd = len(tokens)
+        assert take == nd + 1, (take, nd)
+        old_cursor = req.cursor
+        emitted = 0
+        path = []                 # q positions of accepted nodes, root->leaf
+        cur = 0                   # current q position (0 = frontier)
+        while True:
+            tok = self._sample(logits[base + cur], req)
+            req.generated.append(tok)
+            emitted += 1
+            if len(req.generated) >= req.max_new:
+                break             # stream length must match exactly
+            nxt = -1
+            for t in range(nd):   # child of cur whose draft matches the draw
+                if int(parents[t]) + 1 == cur and int(tokens[t]) == tok:
+                    nxt = t + 1
+                    break
+            if nxt < 0:
+                break             # correction draw, or bonus past a leaf
+            path.append(nxt)
+            cur = nxt
+        in_place = 0
+        for i, qp in enumerate(path):
+            if qp == i + 1:       # trunk: packed position == path position
+                in_place += 1
+            else:
+                break
+        req.cursor = old_cursor + 1 + in_place
+        keep = self._pages_held(req.cursor)
+        got = self._pages_held(old_cursor + take)
+        for pg in range(keep, got):
+            if self.table[s, pg] >= 0:
+                self.pool.release(int(self.table[s, pg]))
+                self.table[s, pg] = -1
+        if self.pool.prefix_cache:
+            self._register_frozen(req, s, old_cursor)
+        st = self.stats
+        st.spec_rows += 1
+        st.draft_tokens += nd
+        accepted = len(path)
+        st.accepted_draft_tokens += accepted
+        st.spec_tokens_out += emitted
+        st.rolled_back_tokens += nd - in_place
+        self.drafter.observe(req, accepted, nd - accepted)
         self._maybe_complete(req, s)
         return emitted, 0
 

@@ -325,20 +325,48 @@ def expected_accepted_per_step(spec_k: int, acceptance_rate: float) -> float:
     return (1.0 - p ** (spec_k + 1)) / (1.0 - p)
 
 
+def expected_accepted_per_step_tree(spec_tree: int,
+                                    acceptance_rate: float,
+                                    branches: int = 2) -> float:
+    """Expected tokens emitted by one TREE verify row of ``spec_tree``
+    nodes hedged ``branches`` ways per level. Where the linear row must
+    match ONE proposed token per level, a tree level escapes with any
+    of its ``b`` siblings: ``q = 1 - (1-p)^b`` per level, and the node
+    budget buys ``spec_tree // b`` levels —
+    ``1 + q + q² + … + q^levels``. ``branches=1`` degenerates to
+    :func:`expected_accepted_per_step` exactly; wider hedging trades
+    depth for per-level escape probability, which wins when the
+    traffic's continuations are genuinely ambiguous (branchy motifs)
+    and loses on incompressible or single-path streams — the term the
+    tune layer prices ``GridSchedule.tree_pack`` against."""
+    p = min(max(float(acceptance_rate), 0.0), 1.0)
+    b = max(int(branches), 1)
+    levels = max(int(spec_tree) // b, 0)
+    q = 1.0 - (1.0 - p) ** b
+    if q >= 1.0:
+        return float(levels + 1)
+    return (1.0 - q ** (levels + 1)) / (1.0 - q)
+
+
 def spec_step_ms(kv_lens, *, spec_k: int, page: int, hkv: int, g: int,
                  d: int, hidden: int, n_layers: int = 1,
+                 spec_tree: int = 0,
                  spec: TpuSpec | None = None, quant: bool = True,
                  issue_ms: float | None = None) -> float:
     """Analytic cost of one speculative VERIFY step: the plain ragged
     step with every decode row widened to ``q_len = 1 + spec_k`` (the
-    frontier token plus k provisional drafts). The page walk reads the
-    k extra appended pages' worth of KV; the token traffic term scales
-    with the widened pack. Divide by
-    :func:`expected_accepted_per_step` for the per-emitted-token
-    clock."""
-    wide = [int(l) + spec_k for l in kv_lens]
+    frontier token plus k provisional drafts; ``spec_tree > 0`` widens
+    to the tree pack instead — a tree row costs exactly what a linear
+    row of the same node count costs, since the ancestor-bitmask mask
+    changes which scores survive, not which pages are walked). The
+    page walk reads the extra appended pages' worth of KV; the token
+    traffic term scales with the widened pack. Divide by
+    :func:`expected_accepted_per_step` (or the ``_tree`` variant) for
+    the per-emitted-token clock."""
+    k = max(int(spec_k), int(spec_tree))
+    wide = [int(l) + k for l in kv_lens]
     return ragged_serving_step_ms(
-        wide, [1 + spec_k] * len(kv_lens), page=page, hkv=hkv, g=g,
+        wide, [1 + k] * len(kv_lens), page=page, hkv=hkv, g=g,
         d=d, hidden=hidden, n_layers=n_layers, spec=spec, quant=quant,
         issue_ms=issue_ms,
     )
@@ -357,8 +385,10 @@ def replica_step_ms(engine, *, spec: TpuSpec | None = None) -> float:
     spec = spec or detect_spec()
     mc = engine.model.config
     # a speculative engine's decode rows are ``1 + spec_k`` wide (the
-    # verify pack) — price the step it actually launches
-    k = int(getattr(engine, "spec_k", 0))
+    # verify pack; tree mode packs its node budget instead) — price
+    # the step it actually launches
+    k = max(int(getattr(engine, "spec_k", 0)),
+            int(getattr(engine, "spec_tree", 0)))
     active = [r for r in engine.slot_req if r is not None]
     kv_lens = [max(r.cursor, 1) + (k if r.cursor >= len(r.prompt) else 0)
                for r in active] or [1]

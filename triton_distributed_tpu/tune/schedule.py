@@ -19,7 +19,7 @@ makes the choice a VALUE:
 * :func:`check_schedule` — the legality gate: every candidate is built
   through the real kernel builder over an abstract mesh, abstractly
   replayed through shmemlint (SL001–SL011 against the family's declared
-  ``DeliveryContract``) and Mosaic-preflighted (MC001–MC005). A
+  ``DeliveryContract``) and Mosaic-preflighted (MC001–MC006). A
   candidate may be timed or cached ONLY with zero findings; rejections
   carry their rule IDs.
 * :func:`store_schedule` / :func:`load_schedule` — the flock'd winner
@@ -137,7 +137,7 @@ DEFAULT = RingSchedule()
 
 #: fields a grid schedule serializes (stable order for the store)
 _GRID_FIELDS = ("block_q", "n_bufs", "pack_rows", "coalesce", "rail",
-                "epilogue", "demote")
+                "epilogue", "demote", "tree_pack", "prefix_run_len")
 
 
 @dataclass(frozen=True)
@@ -184,6 +184,17 @@ class GridSchedule:
         Partial-tile policy when the int8-MXU layout does not divide
         the local geometry: ``"auto"`` demotes to the eager int8 wire
         (today's behavior), ``"strict"`` refuses to build instead.
+    ``tree_pack``
+        Ragged-attention tree-verify packing: 0 gates the all-CAUSAL
+        row mix; > 0 makes the gate geometry carry a branchy TREE
+        topology row of that many packed positions, so the oracle
+        re-checks the schedule against the ancestor-bitmask mask path
+        the speculative engine's tree rows actually execute.
+    ``prefix_run_len``
+        SHARED_PREFIX run length (pages) the engine's batch dedup is
+        expected to alias — a pricing term (deduped page reads), not a
+        kernel-build knob: the kernel masks SHARED_PREFIX rows as
+        causal either way.
     """
 
     #: schedule-kind tag (class attr — see :class:`RingSchedule`)
@@ -196,6 +207,8 @@ class GridSchedule:
     rail: str = "paired"
     epilogue: str = "accumulator"
     demote: str = "auto"
+    tree_pack: int = 0
+    prefix_run_len: int = 0
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -272,6 +285,7 @@ _GRID_FREEDOMS: dict = {
         block_q=(0, 8, 16),
         n_bufs=(2, 3),
         pack_rows=(8, 16),
+        tree_pack=(0, 8),
     ),
     "kv_ship.pages": dict(
         coalesce=(1, 2),
@@ -499,6 +513,7 @@ def _gate_ragged_grid(schedule, n, mesh):
         ((gm["r"],), _I32),                             # kv_lens
         ((gm["r"],), _I32),                             # q_lens
         ((gm["r"],), _I32),                             # q_starts
+        ((gm["r"], 2 + 2 * gm["topo_w"]), _I32),        # topologies
         ((gm["hkv"], gm["t"] * gm["g"], gm["d"]), _F32),  # packed q
         (pool, _I8),                                    # k pool
         (pool, _I8),                                    # v pool
@@ -512,9 +527,14 @@ def _gate_ragged_grid(schedule, n, mesh):
         1: np.asarray(gm["kv_lens"], np.int32),
         2: np.asarray(gm["q_lens"], np.int32),
         3: np.asarray(gm["q_starts"], np.int32),
+        4: np.asarray(gm["topo"], np.int32),
     }
     return ("ragged_paged_attention_q8", (lambda _n: shapes),
-            DeliveryContract(kind="local", dst=9), "ragged_paged", init)
+            DeliveryContract(
+                kind="local", dst=10,
+                topo={"ref": 4, "kv_lens": 1, "q_lens": 2,
+                      "width": gm["topo_w"]},
+            ), "ragged_paged", init)
 
 
 def _gate_kv_ship_grid(schedule, n, mesh):
@@ -670,7 +690,20 @@ def price_grid_schedule(family: str, schedule: GridSchedule, *, shape,
         waste = r * g * (max(0, int(schedule.block_q) - 8)
                          + max(0, int(schedule.pack_rows) - 8))
         ms += waste * d * 2 * 3 / (spec.hbm_gbps * 1e9) * 1e3
-        return ms
+        # tree-packed verify rows widen the q block the row occupies
+        # (1 + tree_pack positions attend the row's whole prefix) —
+        # extra q/out traffic, paid back upstream by accepted tokens
+        tp = int(getattr(schedule, "tree_pack", 0))
+        if tp:
+            ms += r * g * tp * d * 2 * 3 / (spec.hbm_gbps * 1e9) * 1e3
+        # a shared-prefix run aliases its page reads across the batch:
+        # (r - 1) rows skip prefix_run_len pages of KV traffic
+        run = int(getattr(schedule, "prefix_run_len", 0))
+        if run and r > 1:
+            per_page = page * hkv * d * (1 + 1)     # int8 K + V bytes
+            ms -= min(run, max(-(-t // page), 1)) * (r - 1) * per_page \
+                / (spec.hbm_gbps * 1e9) * 1e3
+        return max(ms, 0.0)
     if family == "kv_ship.pages":
         pages, page, hkv, d, layers = shape[:5]
         ms = pm.kv_ship_ms(pages, page, hkv, d, layers, quant=True,
