@@ -490,6 +490,28 @@ def _run_lint(infer_contracts: bool = False) -> None:
             file=sys.stderr, flush=True,
         )
 
+    # serving-protocol gate (ISSUE 19): servlint's bounded model check
+    # of the host-side serving/fleet protocol — page conservation,
+    # transactional ships, request safety (SV001–SV007) — over the
+    # production ProtocolOps seam. The same exit-2 contract: a protocol
+    # counterexample refuses the timing run.
+    from triton_distributed_tpu.analysis import servlint
+
+    sv_findings, sv_stats = servlint.lint_serving(max_states=3000)
+    findings += sv_findings
+    for f in sv_findings:
+        print(json.dumps({"lint": f.to_json()}), file=sys.stderr,
+              flush=True)
+    print(
+        json.dumps({"metric": "servlint",
+                    "states": sv_stats["states"],
+                    "transitions": sv_stats["transitions"],
+                    "complete": sv_stats["complete"],
+                    "errors": sum(f.severity >= Severity.ERROR
+                                  for f in sv_findings)}),
+        file=sys.stderr, flush=True,
+    )
+
     errs = (sum(f.severity >= Severity.ERROR for f in findings)
             + len(gaps) + len(fleet_gaps) + len(spec_gaps)
             + len(migration_gaps) + len(train_gaps))

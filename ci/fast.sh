@@ -613,3 +613,37 @@ assert not drifted, f"contract inference drift: {drifted}"
 print("contract inference: ring AG / ring RS / dense a2a / ragged "
       "local all agree with their declared contracts at mesh 4")
 EOF
+
+# Serving-protocol model-check smoke (ISSUE 19 acceptance): servlint's
+# bounded exhaustive exploration over the production ProtocolOps seam
+# must visit >= 1000 states with ZERO findings in <= 5 s, and every
+# seeded mutated-ops fixture (SV001..SV007) must be caught — exit 2 —
+# by exactly its rule.
+JAX_PLATFORMS=cpu python - <<'EOF2'
+import time
+
+from triton_distributed_tpu.analysis import servlint
+
+t0 = time.perf_counter()
+findings, stats = servlint.lint_serving(max_states=2000)
+dt = time.perf_counter() - t0
+assert findings == [], (
+    f"servlint smoke: production ops produced findings: "
+    f"{[f.format() for f in findings]}")
+assert stats["states"] >= 1000, (
+    f"servlint smoke: only {stats['states']} states explored (< 1000)")
+assert dt <= 5.0, (
+    f"servlint smoke: exploration took {dt:.1f}s (> 5s budget)")
+print(f"servlint smoke: {stats['states']} states / "
+      f"{stats['transitions']} transitions clean in {dt:.2f}s")
+EOF2
+for rule in SV001 SV002 SV003 SV004 SV005 SV006 SV007; do
+  rc=0
+  JAX_PLATFORMS=cpu python -m triton_distributed_tpu.analysis.lint \
+    --serving-fixture "$rule" >/dev/null 2>&1 || rc=$?
+  if [ "$rc" -ne 2 ]; then
+    echo "servlint smoke: fixture $rule exited $rc (want 2)" >&2
+    exit 1
+  fi
+done
+echo "servlint smoke: all 7 seeded fixtures caught (exit 2 each)"

@@ -436,6 +436,19 @@ class TestMosaicCompat:
         assert _rules(f) == ["MC006"]
         assert "traced indices" in f[0].message
 
+    def test_sublane_dynamic_slice_fixture_flagged(self):
+        """MC007 (the nightly-slow-run signature promoted to a static
+        rule): lax.dynamic_slice with a TRACED start index on the
+        sublane (second-minor) dim — this Mosaic only folds constant
+        sublane offsets, so the 8-minute AOT refusal becomes a
+        2-second lint finding."""
+        spec, in_shapes = fixtures.sublane_dynamic_slice()
+        f = mosaic_compat.preflight_spec(
+            spec, in_shapes(4), 4, kernel_name="fx_sds", site="fixture"
+        )
+        assert _rules(f) == ["MC007"]
+        assert "sublane" in f[0].message
+
     def test_fp8_wire_family_flags_mc001_when_forced(self, monkeypatch):
         """The KNOWN f8-cast construct, on a real registry family: with
         the toolchain override asserting in-kernel f8 support, the fp8
@@ -524,6 +537,9 @@ class TestEventModel:
             "SL001", "SL002", "SL003", "SL004", "SL005", "SL006", "SL007",
             "SL008", "SL009", "SL010", "SL011", "SL012", "SL013",
             "MC001", "MC002", "MC003", "MC004", "MC005", "MC006",
+            "MC007",
+            "SV001", "SV002", "SV003", "SV004", "SV005", "SV006",
+            "SV007",
         }
 
     def test_ring_trace_targets_right_neighbor(self):
@@ -1122,14 +1138,14 @@ class TestLintDocs:
         repo = pathlib.Path(__file__).resolve().parents[1]
         analysis_dir = (repo / "triton_distributed_tpu" / "analysis")
         emitted = set(RULES)
-        pat = re.compile(r'["\'](SL\d{3}|MC\d{3})["\']')
+        pat = re.compile(r'["\'](SL\d{3}|MC\d{3}|SV\d{3})["\']')
         for py in analysis_dir.glob("*.py"):
             emitted |= set(pat.findall(py.read_text()))
         doc = (repo / "docs" / "LINT.md").read_text()
         documented = {
             m.group(1)
-            for m in re.finditer(r"^\|\s*(SL\d{3}|MC\d{3})\s*\|", doc,
-                                 re.MULTILINE)
+            for m in re.finditer(r"^\|\s*(SL\d{3}|MC\d{3}|SV\d{3})\s*\|",
+                                 doc, re.MULTILINE)
         }
         undocumented = emitted - documented
         assert not undocumented, (

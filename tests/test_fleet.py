@@ -50,6 +50,7 @@ from triton_distributed_tpu.runtime.faults import (
 from triton_distributed_tpu.runtime.health import HealthLedger, PeerState
 from triton_distributed_tpu.runtime.watchdog import WatchdogTimeout
 from triton_distributed_tpu.serving import (
+    DisaggregatedEngine,
     EngineConfig,
     Request,
     ServingEngine,
@@ -773,3 +774,184 @@ class TestChaosSoak:
         assert not fleet._draining
         assert st.failover_requeued >= 1
         assert fleet.token_streams() == ref.token_streams()
+
+
+# ----------------------------------------- ship-window chaos (ISSUE-19)
+
+class TestShipReservationWindowChaos:
+    """The servlint-discovered interleaving as a concrete chaos case:
+    ``ReplicaDeath`` landing BETWEEN ``reserve_shipped`` and
+    ``commit_shipped`` on a disaggregated replica — the destination
+    slot+pages are reserved, the payload is in flight, and the replica
+    dies before the commit fence. The reservation must roll back with
+    the replica (its pool died with the slice) and every mid-ship
+    request must re-route onto the survivor: 0 lost requests, 0 leaked
+    pages."""
+
+    def _trace(self, n=4, max_new=6):
+        return [_req(i, 0.0, session="s", plen=20, max_new=max_new)
+                for i in range(n)]
+
+    def _fleet_with_disagg(self, fleet_models, ship_delay_steps=3):
+        (m0, p0), (m1, p1) = fleet_models
+        colo = ServingEngine(m0, p0, EngineConfig(**ECFG),
+                             use_pallas=False)
+        # same model for both roles: transport="xla" needs no hybrid
+        # mesh, and the window under test is the host-side reservation
+        disagg = DisaggregatedEngine(
+            m1, p1, m1, p1, EngineConfig(**ECFG), transport="xla",
+            ship_delay_steps=ship_delay_steps, use_pallas=False)
+        fleet = ServingFleet([colo, disagg], seed=1,
+                             router=RouterConfig(policy="scored"))
+        fleet.router.affinity["s"] = 1
+        return fleet
+
+    def test_death_in_reservation_window(self, fleet_models):
+        ref = self._fleet_with_disagg(fleet_models)
+        ref.run(self._trace())
+        assert ref.stats.lost_requests == 0
+        ref_streams = ref.token_streams()
+
+        fleet = self._fleet_with_disagg(fleet_models)
+        fleet.submit_trace(self._trace())
+        eng = fleet.replicas[1].engine
+        armed = None
+        for t in range(400):
+            if fleet.idle:
+                break
+            if armed is None and eng._inflight:
+                # reserve_shipped ran (decode slot+pages reserved,
+                # req parked) and nothing has committed yet: arm the
+                # death so the NEXT tick's death check — which runs
+                # before any step could commit — kills the replica
+                # inside the reservation window
+                assert eng.stats.ships == 0
+                armed = [r.req.rid for r in eng._inflight]
+                faults.set_fault_plan(FaultPlan(
+                    seed=1,
+                    faults=(ReplicaDeath(replica=1, step=fleet.ticks),)))
+            fleet.tick()
+        assert armed, "no ship ever entered the reservation window"
+        st = fleet.stats
+        assert st.lost_requests == 0
+        assert st.completed == 4
+        assert [k for k, _ in st.deaths] == [1]
+        assert st.failover_requeued >= len(armed)
+        # the mid-ship payload never landed: the commit was rolled
+        # back with the replica, not half-applied
+        assert eng.stats.ships == 0
+        # 0 leaked pages on the survivor: at idle every page is either
+        # on the free list or parked in the reclaimable prefix cache,
+        # and no refcount is live
+        for role in fleet.replicas[0]._roles:
+            assert int((np.asarray(role.pool.refs) > 0).sum()) == 0
+            assert role.pool.available == role.pool.npages
+        # placement changed (survivor finished the mid-ship rows),
+        # bytes did not
+        assert fleet.token_streams() == ref_streams
+
+
+# ------------------------------------- drain-cancel on death (ISSUE-19)
+
+class TestDrainCancelOnDeath:
+    """servlint SV007 counterexample, regression-pinned: replica 0
+    draining, replica 1 (the only other routable replica) dies — the
+    backlog would wait forever on a fleet whose sole survivor admits no
+    routed work. ``_kill`` now cancels the surviving drains (capacity
+    loss outranks the drain intent)."""
+
+    def test_death_of_last_routable_cancels_drain(self, fleet_models):
+        from triton_distributed_tpu.tune.perf_model import TpuSpec
+
+        # price the migration wire absurdly slow so the drain cannot
+        # complete instantly — rows finish in place, holding the drain
+        # open across the death tick
+        slow = TpuSpec(name="slow-dcn", bf16_tflops=200.0,
+                       hbm_gbps=800.0, ici_gbps=50.0, ici_links=4,
+                       dcn_gbps=1e-12)
+
+        def _trace():
+            out = [_req(i, 0.0, session="a", plen=20, max_new=8)
+                   for i in range(2)]
+            out += [_req(10 + i, 0.0, session="b", plen=20, max_new=8)
+                    for i in range(2)]
+            out += [_req(20 + i, 4.0, plen=10, max_new=3)
+                    for i in range(3)]
+            return out
+
+        ref = _fleet(fleet_models, "scored")
+        ref.perf_spec = slow
+        ref.router.affinity["a"] = 0
+        ref.router.affinity["b"] = 1
+        ref.run(_trace())
+        assert ref.stats.lost_requests == 0
+
+        fleet = _fleet(fleet_models, "scored")
+        fleet.perf_spec = slow
+        fleet.router.affinity["a"] = 0
+        fleet.router.affinity["b"] = 1
+        fleet.submit_trace(_trace())
+        plan = FaultPlan(seed=1,
+                         faults=(ReplicaDeath(replica=1, step=5),))
+        with faults.fault_plan(plan):
+            for t in range(400):
+                if t == 3:
+                    fleet.drain(0)
+                if fleet.idle:
+                    break
+                fleet.tick()
+        st = fleet.stats
+        assert st.lost_requests == 0
+        assert st.completed == 7
+        assert st.deaths == [(1, 5)]
+        # the drain was CANCELED, not completed: replica 0 is back in
+        # rotation serving the backlog, never retired
+        cancels = [e for e in st.events if e[0] == "drain_cancel"]
+        assert cancels and cancels[0][1] == 0
+        assert "death@1" in cancels[0][3]
+        assert st.drains == []
+        assert not fleet._draining
+        assert 0 not in fleet._retired
+        assert fleet.rotation() == (0,)
+        # the backlog drained through the de-drained survivor with the
+        # streams still byte-identical to the fault-free run
+        assert fleet.token_streams() == ref.token_streams()
+
+
+# --------------------------------- ProtocolOps seam pin (ISSUE-19)
+
+class TestProtocolSeamTraceEquality:
+    """The ProtocolOps refactor is behavior-preserving: this golden
+    fleet trace (events + token streams) was captured BEFORE the
+    serving verbs moved behind the seam. Same seed ⇒ byte-identical
+    ``FleetStats.events`` and streams after it."""
+
+    GOLDEN_EVENTS = [
+        ("drain_start", 0, 6, "requeued=0"),
+        ("migrate", 0, 6, "rid=5 pages=2 -> replica 1"),
+        ("migrate", 0, 6, "rid=3 pages=3 -> replica 1"),
+        ("migrate", 0, 6, "rid=4 pages=3 -> replica 1"),
+        ("drain_done", 0, 6, "started@6"),
+    ]
+    GOLDEN_STREAMS = [
+        (0, (19, 60, 73, 107)), (1, (54, 81, 32, 53)),
+        (2, (123, 84, 51, 95)), (3, (121, 80, 80, 77)),
+        (4, (20, 62, 113, 84)), (5, (19, 46, 26, 48)),
+        (6, (31, 44, 73, 0)), (7, (70, 5, 51, 35)),
+    ]
+
+    def test_golden_fleet_trace_unchanged(self, fleet_models):
+        fleet = _fleet(fleet_models, "scored", seed=3)
+        fleet.submit_trace([_req(i, float(i), plen=20, max_new=4)
+                            for i in range(8)])
+        for t in range(60):
+            if t == 6:
+                fleet.drain(0)
+            if fleet.idle:
+                break
+            fleet.tick()
+        assert fleet.stats.lost_requests == 0
+        assert list(fleet.stats.events) == self.GOLDEN_EVENTS
+        streams = sorted((r, tuple(v))
+                         for r, v in fleet.token_streams().items())
+        assert streams == self.GOLDEN_STREAMS

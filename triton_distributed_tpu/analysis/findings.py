@@ -13,7 +13,7 @@ from dataclasses import dataclass, field
 #: version of the machine-readable finding schema (``--json`` output and
 #: :meth:`Finding.to_json`). Bump when a field is added/renamed so
 #: downstream consumers (CI dashboards, bench parsers) can dispatch.
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 
 class Severity(enum.IntEnum):
@@ -175,6 +175,72 @@ RULES = {
         "— unroll over the index set with static masks (the ragged "
         "kernel's per-position ancestor-bitmask unroll) or gather on "
         "the XLA side",
+    ),
+    "MC007": (
+        "mosaic-sublane-dynamic-slice",
+        Severity.ERROR,
+        "an in-kernel dynamic_slice with a TRACED start index on the "
+        "sublane (second-minor) dimension of a >=2-D vector; this "
+        "Mosaic backend can only fold dynamic sublane offsets that are "
+        "compile-time constants — slice the sublane dim with a static "
+        "offset (unroll over the candidate offsets with masks) or hoist "
+        "the slice to the XLA side",
+    ),
+    "SV001": (
+        "serving-page-leak",
+        Severity.ERROR,
+        "a reachable serving state holds a page that no slot table, "
+        "ship reservation, or prefix-cache entry references and that is "
+        "not on the pool free list — the pool permanently shrinks and "
+        "admission eventually wedges",
+    ),
+    "SV002": (
+        "serving-double-free",
+        Severity.ERROR,
+        "a protocol transition releases a page more times than it was "
+        "retained (negative refcount) or allocates a page whose "
+        "refcount is still live — two rows now share KV that one of "
+        "them will overwrite",
+    ),
+    "SV003": (
+        "serving-freed-while-shipped",
+        Severity.ERROR,
+        "a page pinned by an in-flight KV ship or live migration was "
+        "freed (eviction/preemption of a parked row, or source release "
+        "before the transport resolved) — the transfer lands into (or "
+        "reads from) reallocated pages",
+    ),
+    "SV004": (
+        "serving-request-conservation",
+        Severity.ERROR,
+        "a request was lost or duplicated across "
+        "failover/drain/preemption: the multiset of live requests "
+        "(queued + resident + parked + shipped + completed) no longer "
+        "matches the admitted set",
+    ),
+    "SV005": (
+        "serving-cursor-regression",
+        Severity.ERROR,
+        "a resident request's cursor moved backwards past a committed "
+        "prefix without the recompute-eviction discipline (cursor reset "
+        "to 0 off-slot) — the stream-exactness precondition breaks and "
+        "re-emitted tokens diverge",
+    ),
+    "SV006": (
+        "serving-nontransactional-ship",
+        Severity.ERROR,
+        "a KV ship/migration violated the transactional discipline: "
+        "the destination commit became observable before the source "
+        "released its pinned pages, or a transport-exhausted ship "
+        "leaked its destination reservation instead of rolling back",
+    ),
+    "SV007": (
+        "serving-unroutable-livelock",
+        Severity.ERROR,
+        "a reachable state with a nonempty backlog from which no "
+        "sequence of transitions ever admits a request (no replica can "
+        "free the pages/slots it would need) — the fleet livelocks "
+        "with work queued",
     ),
 }
 

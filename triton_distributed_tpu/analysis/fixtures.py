@@ -1101,6 +1101,27 @@ def dynamic_gather(axis="x"):
     )
 
 
+def sublane_dynamic_slice(axis="x"):
+    """An in-kernel ``dynamic_slice`` whose SUBLANE (second-minor)
+    start index is a traced runtime value — the jaxpr signature the
+    nightly slow run surfaced (a KV-window slice ``x[start:start+8]``
+    with a per-step ``start``). This Mosaic only folds constant
+    sublane offsets; traced LANE offsets are fine. MC007."""
+
+    def kernel(idx_ref, x_ref, out_ref):
+        import jax.lax as lax
+
+        i = idx_ref[0]                         # traced scalar int32
+        out_ref[...] = lax.dynamic_slice(
+            x_ref[...], (i, 0), (8, 128))      # BUG: traced sublane start
+
+    return (
+        _spec(kernel, "fixture_sublane_dynamic_slice",
+              out_shapes=[((8, 128), _F32)]),
+        lambda n: [((1,), np.dtype(np.int32)), ((16, 128), _F32)],
+    )
+
+
 def cp_ring_skipped_block(axis="x"):
     """The context-parallel KV rotation ring one BLOCK short: the
     schedule mutation ``chunk_order='skip_last'`` threaded through the

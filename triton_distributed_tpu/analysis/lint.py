@@ -171,6 +171,49 @@ def lint_all(n: int = 8, mesh=None, kernels=None, allow=None,
 
 # ---------------------------------------------------------------------- CLI
 
+def _main_serving(args, json, sys) -> int:
+    """The ``--serving`` mode: servlint's bounded model check of the
+    serving/fleet protocol (SV001–SV007). Exits 2 on any error finding
+    — the bench/CI abort convention — 0 when the exploration is
+    clean."""
+    from triton_distributed_tpu.analysis import servlint
+    from triton_distributed_tpu.analysis.findings import (
+        SCHEMA_VERSION,
+        rule_counts,
+    )
+
+    findings, stats = servlint.lint_serving(
+        fixture=args.serving_fixture, max_states=args.serving_states)
+    _apply_allow(findings, args.allow)
+    errs = sum(f.severity >= Severity.ERROR for f in findings)
+    warns = sum(f.severity == Severity.WARNING for f in findings)
+    if args.json:
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION, "mode": "serving",
+            "fixture": args.serving_fixture,
+            "states": stats["states"],
+            "transitions": stats["transitions"],
+            "complete": stats["complete"],
+        }))
+        for f in findings:
+            print(json.dumps(f.to_json()))
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "rule_counts": rule_counts(findings),
+            "errors": errs, "warnings": warns,
+        }))
+    else:
+        for f in findings:
+            print(f.format())
+        kind = "exhaustive" if stats["complete"] else "state-capped"
+        print(
+            f"servlint: {stats['states']} states, "
+            f"{stats['transitions']} transitions ({kind}): "
+            f"{errs} error(s), {warns} warning(s)",
+            file=sys.stderr)
+    return 2 if has_errors(findings) else 0
+
+
 def main(argv=None) -> int:
     import argparse
     import json
@@ -205,12 +248,29 @@ def main(argv=None) -> int:
                     "MC001-MC004: trace each family's kernel jaxpr and "
                     "scan for constructs this toolchain's Mosaic "
                     "rejects)")
+    ap.add_argument("--serving", action="store_true",
+                    help="model-check the serving/fleet protocol "
+                    "instead of the kernel families (rules SV001-SV007: "
+                    "bounded exhaustive interleaving over a 2-replica "
+                    "abstract fleet driven by the production ProtocolOps "
+                    "seam); exits 2 on any error finding")
+    ap.add_argument("--serving-fixture", default=None, metavar="RULE",
+                    help="run the --serving exploration against the "
+                    "seeded mutated-ops fixture for RULE (e.g. SV003) "
+                    "instead of the production ops")
+    ap.add_argument("--serving-states", type=int, default=6000,
+                    metavar="N",
+                    help="distinct-state cap for the --serving "
+                    "exploration (default 6000)")
     ap.add_argument("--list", action="store_true",
                     help="list registered kernel families and exit")
     args = ap.parse_args(argv)
 
     if args.mesh < 2:
         ap.error("--mesh must be >= 2 (a 1-rank mesh has no protocol)")
+
+    if args.serving or args.serving_fixture:
+        return _main_serving(args, json, sys)
 
     from triton_distributed_tpu.kernels.registry import families
 

@@ -223,6 +223,32 @@ def scan_kernel_jaxpr(kjaxpr, kernel_name, site=None) -> list:
                     "over the index set with static masks (the ragged "
                     "kernel's ancestor-bitmask unroll) or gather on "
                     "the XLA side")
+        elif name == "dynamic_slice" and len(eqn.invars) >= 2:
+            # MC007: a dynamic_slice whose start index on the SUBLANE
+            # (second-minor) dimension is a TRACED value while the
+            # slice is proper on that dimension — this Mosaic can only
+            # fold dynamic sublane offsets that are compile-time
+            # constants (traced LANE offsets and full-size sublane
+            # "slices" at a traced zero both lower fine). Promoted
+            # from the nightly slow run's jaxpr signature so the
+            # 8-minute finding is a 2-second one.
+            op = eqn.invars[0]
+            oshape = getattr(op.aval, "shape", ())
+            sizes = tuple(eqn.params.get("slice_sizes", ()))
+            if (len(oshape) >= 2
+                    and len(eqn.invars) == 1 + len(oshape)
+                    and len(sizes) == len(oshape)
+                    and sizes[-2] != oshape[-2]):
+                sub = eqn.invars[1 + len(oshape) - 2]
+                if not hasattr(sub, "val"):   # Literal has .val
+                    add("MC007",
+                        f"in-kernel dynamic_slice of {tuple(oshape)} "
+                        f"with a traced start index on the sublane "
+                        f"(second-minor) dimension (slice_sizes="
+                        f"{sizes}): this Mosaic only folds constant "
+                        "sublane offsets — unroll over the candidate "
+                        "offsets with static masks or hoist the slice "
+                        "to the XLA side")
     return findings
 
 

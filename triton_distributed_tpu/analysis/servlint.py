@@ -1,0 +1,850 @@
+"""servlint: small-scope model checking of the serving/fleet protocol.
+
+shmemlint verifies the DEVICE protocol (semaphores, delivery
+contracts); this module verifies the HOST protocol one layer up — page
+refcounts, transactional reserve/land/commit KV ships, drain/migrate/
+failover, preemption and speculative rollback. The invariants it
+checks (no lost request, no leaked or double-freed page, no page freed
+mid-ship) were previously pinned only by example traces; the same
+"semaphore-clean != data-correct" lesson applies, and TLA+-style
+bounded exhaustive interleaving over a tiny fleet finds the races
+chaos seeds can only sample.
+
+The checker does NOT re-implement the protocol. Every transition runs
+*the production code's own transition functions* through the
+:class:`~triton_distributed_tpu.serving.protocol.ProtocolOps` seam —
+the exact ``admit``/``evict_one``/``preempt_for``/``ensure_pages``/
+``advance_cursor``/``rollback_draft``/``reserve_shipped``/
+``ship_commit``/``ship_abort``/``failover_requeue``/``drain_requeue``
+objects the engines delegate to — driven over an abstract 2-replica
+fleet small enough to explore exhaustively:
+
+    2 replicas x <= 3 requests x <= 8 pages (4 per replica pool),
+    BFS over all interleavings of {route, admit, step, spec-rollback,
+    evict, preempt, launch_ship, commit_ship, transport-fail,
+    ReplicaDeath, drain} with state-hash memoization.
+
+BFS makes the first counterexample *minimal*: the finding's printed
+repro interleaving is a shortest path to the violation.
+
+Rules (stable IDs, catalogued in analysis/findings.py and
+docs/LINT.md):
+
+* **SV001** page leak — a page neither referenced by any block table
+  nor on the free/reclaim lists (or refcounted with no referent).
+* **SV002** double-free / negative refcount — the PagePool asserts
+  (``release`` of a freed page, ``alloc`` of a live one) or a block
+  table referencing a freed page.
+* **SV003** page freed while a ship/migration holds it — an in-flight
+  ship record whose pinned source or reserved destination pages lost
+  their refcount or table entry.
+* **SV004** request lost or duplicated — conservation of the request
+  multiset across failover/drain/preemption (an in-flight ship
+  legitimately appears at both endpoints; anything else is a bug).
+* **SV005** cursor regression — a request resident in the same slot
+  whose cursor moved backwards across a transition (production only
+  rewinds via off-slot requeue at cursor 0, or speculative rollback
+  to at least the pre-row cursor + 1).
+* **SV006** non-transactional ship — dst commit observable before the
+  source released its pinned pages, or a transport-exhausted ship
+  leaving its destination reservation occupied.
+* **SV007** unroutable livelock — backlog nonempty, no resident work
+  anywhere, and routing + admission on every routable replica admits
+  nothing (nothing can ever change).
+
+Model boundary: no revive/grow (a death is final), a single engine
+role per replica, token values are synthetic (scheduling never reads
+them), and device work (gather/land) is stubbed — every checked
+invariant is pure host bookkeeping, which is exactly what makes the
+exploration affordable.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from triton_distributed_tpu.analysis.findings import Finding
+from triton_distributed_tpu.serving.engine import (
+    EngineConfig,
+    EngineStats,
+    Request,
+    ServingEngine,
+)
+from triton_distributed_tpu.serving.protocol import ProtocolOps
+from triton_distributed_tpu.serving.state import PagePool
+
+#: the abstract fleet's per-replica geometry (2 replicas => 8 pages)
+_CFG = EngineConfig(slots=2, token_budget=8, chunk=4, page=4, npages=4)
+_PAGES_PER_SEQ = 4
+
+
+class _StateStub:
+    """The two fields of the device ServingState the host verbs read."""
+
+    pages_per_seq = _PAGES_PER_SEQ
+    capacity = _PAGES_PER_SEQ * _CFG.page
+
+
+class _HostShell(ServingEngine):
+    """A ServingEngine reduced to its HOST half: the exact fields and
+    helper methods the ProtocolOps verbs touch, none of the device
+    state (model, params, jits, pools-on-device). The verbs therefore
+    run bit-identically to production — same admission sort, same
+    eviction ranking, same refcount discipline — at model-checking
+    speed."""
+
+    def __init__(self, ops: ProtocolOps):
+        # deliberately does NOT call ServingEngine.__init__ (no model)
+        self.cfg = _CFG
+        self.ops = ops
+        self.state = _StateStub()
+        self.table = np.full((_CFG.slots, _PAGES_PER_SEQ), -1, np.int32)
+        self.pool = PagePool(_CFG.npages, _CFG.page,
+                             prefix_cache=_CFG.prefix_cache)
+        self.slot_req = [None] * _CFG.slots
+        self.pending: deque = deque()
+        self.waiting: deque = deque()
+        self.stats = EngineStats()
+        self.step_count = 0
+        self.tenants = {}
+        self.aging_ticks = 0
+        self.throttled_tiers = frozenset()
+        self.on_complete = None
+        self.on_preempt = None
+
+    # device work is out of model: the payload is its page-id list
+    def gather_pages(self, pids):
+        return tuple(pids), None
+
+    def land_pages(self, pids, q_payload, s_payload):
+        return None
+
+    def clone(self, reqs: dict) -> "_HostShell":
+        c = _HostShell.__new__(_HostShell)
+        c.cfg = self.cfg
+        c.ops = self.ops
+        c.state = self.state
+        c.table = self.table.copy()
+        pool = PagePool.__new__(PagePool)
+        pool.npages = self.pool.npages
+        pool.page = self.pool.page
+        pool.prefix_cache = self.pool.prefix_cache
+        pool.refs = self.pool.refs.copy()
+        pool.free = list(self.pool.free)
+        pool._by_hash = dict(self.pool._by_hash)
+        pool._hash_of = dict(self.pool._hash_of)
+        pool._reclaim = OrderedDict(self.pool._reclaim)
+        c.pool = pool
+        c.slot_req = [None if r is None else reqs[r.rid]
+                      for r in self.slot_req]
+        c.pending = deque(reqs[r.rid] for r in self.pending)
+        c.waiting = deque(reqs[r.rid] for r in self.waiting)
+        c.stats = EngineStats()
+        c.step_count = self.step_count
+        c.tenants = self.tenants
+        c.aging_ticks = self.aging_ticks
+        c.throttled_tiers = self.throttled_tiers
+        c.on_complete = None
+        c.on_preempt = None
+        return c
+
+
+class _Ship:
+    """One in-flight KV ship/migration: the reservation-to-commit
+    window the transactional discipline protects. ``src_pids`` are the
+    source's pinned pages, ``dpids`` the destination's reserved landing
+    pages — SV003 demands both stay held until the record resolves."""
+
+    __slots__ = ("rid", "src", "pslot", "dst", "dslot", "dpids",
+                 "src_pids")
+
+    def __init__(self, rid, src, pslot, dst, dslot, dpids, src_pids):
+        self.rid = rid
+        self.src = src
+        self.pslot = pslot
+        self.dst = dst
+        self.dslot = dslot
+        self.dpids = tuple(dpids)
+        self.src_pids = tuple(src_pids)
+
+    def key(self):
+        return (self.rid, self.src, self.pslot, self.dst, self.dslot,
+                self.dpids, self.src_pids)
+
+
+def _universe():
+    """The <=3-request workload: mixed tiers (so admission exercises
+    preempt_for), page-crossing prompts (so eviction/rollback move real
+    pages), single-token completions (bounded lifecycle)."""
+    return [
+        Request(rid=0, prompt=np.arange(6, dtype=np.int32),
+                max_new=2, arrival=0.0),
+        Request(rid=1, prompt=np.arange(6, dtype=np.int32) + 1,
+                max_new=1, arrival=0.0, priority="batch"),
+        Request(rid=2, prompt=np.arange(10, dtype=np.int32) + 2,
+                max_new=1, arrival=0.0, priority="background"),
+    ]
+
+
+class _World:
+    """One explored fleet state: 2 host shells, the fleet queue, the
+    in-flight ship records, the dead/draining sets, and the transition
+    trace that reached it (the minimal repro when a rule fires)."""
+
+    def __init__(self, ops: ProtocolOps):
+        self.ops = ops
+        self.engines = [_HostShell(ops), _HostShell(ops)]
+        self.requests = {r.rid: r for r in _universe()}
+        self.queue: deque = deque(self.requests.values())
+        self.ships: list = []
+        self.dead: set = set()
+        self.draining: set = set()
+        self.trace: tuple = ()
+
+    def clone(self) -> "_World":
+        w = _World.__new__(_World)
+        w.ops = self.ops
+        reqs = {}
+        for rid, r in self.requests.items():
+            c = Request(rid=r.rid, prompt=r.prompt, max_new=r.max_new,
+                        arrival=r.arrival, tenant=r.tenant,
+                        priority=r.priority)
+            c.generated = list(r.generated)
+            c.cursor = r.cursor
+            c.slot = r.slot
+            c.evictions = r.evictions
+            c.done = r.done
+            c.parked = r.parked
+            reqs[rid] = c
+        w.requests = reqs
+        w.engines = [e.clone(reqs) for e in self.engines]
+        w.queue = deque(reqs[r.rid] for r in self.queue)
+        w.ships = [_Ship(*s.key()) for s in self.ships]
+        w.dead = set(self.dead)
+        w.draining = set(self.draining)
+        w.trace = self.trace
+        return w
+
+    def alive(self):
+        return [k for k in range(len(self.engines))
+                if k not in self.dead]
+
+    def routable(self):
+        return [k for k in self.alive() if k not in self.draining]
+
+    def key(self):
+        """Canonical hashable state (counters/stats excluded — they
+        grow without bound and never feed a scheduling decision)."""
+        reqs = tuple(
+            (rid, r.cursor, len(r.generated), r.parked, r.done)
+            for rid, r in sorted(self.requests.items()))
+        engs = []
+        for k, e in enumerate(self.engines):
+            if k in self.dead:
+                engs.append("dead")
+                continue
+            engs.append((
+                k in self.draining,
+                tuple(None if r is None else r.rid
+                      for r in e.slot_req),
+                tuple(int(p) for p in e.table.flat),
+                tuple(e.pool.free),
+                tuple(int(x) for x in e.pool.refs),
+                tuple(sorted(int(p) for p in e.pool._reclaim)),
+                tuple(sorted(e.pool._hash_of.items())),
+                tuple(r.rid for r in e.waiting),
+                tuple(r.rid for r in e.pending),
+            ))
+        return (reqs, tuple(engs),
+                tuple(r.rid for r in self.queue),
+                tuple(sorted(s.key() for s in self.ships)))
+
+
+# ------------------------------------------------------------ transitions
+
+
+def _resident_rows(world, k):
+    eng = world.engines[k]
+    return [(s, r) for s, r in enumerate(eng.slot_req)
+            if r is not None and not r.parked and not r.done]
+
+
+def _tok(req) -> int:
+    """Synthetic deterministic token — scheduling never reads values,
+    only lengths, so any pure function of (rid, position) works."""
+    return (req.rid * 31 + len(req.generated)) % 97
+
+
+def _enabled(world):
+    """Labels of every transition enabled in ``world``. A label is
+    (kind, args...); :func:`_apply` executes it through the seam."""
+    out = []
+    for k in world.routable():
+        if world.queue:
+            out.append(("route", k))
+    for k in world.alive():
+        # a draining replica admits no ROUTED work but its engine still
+        # runs local admission over what it already holds
+        eng = world.engines[k]
+        if eng.waiting or eng.pending:
+            out.append(("admit", k))
+        if eng.waiting:
+            head = eng.waiting[0]
+            if any(r is not None and not r.parked and not r.done
+                   and eng._eff_rank(r) > eng._eff_rank(head)
+                   for r in eng.slot_req):
+                out.append(("preempt", k))
+    for k in world.alive():
+        rows = _resident_rows(world, k)
+        if rows:
+            out.append(("evict", k))
+        for s, r in rows:
+            out.append(("step", k, s))
+            if r.cursor > 0 and len(r.seq) - r.cursor >= 2:
+                out.append(("spec", k, s))
+            if r.cursor > 0 and not any(
+                    sh.rid == r.rid for sh in world.ships):
+                for j in world.alive():
+                    if j != k:
+                        out.append(("ship", k, s, j))
+    for i, sh in enumerate(world.ships):
+        out.append(("commit", i))
+        out.append(("xfail", i))
+    if len(world.alive()) == 2:
+        for k in world.alive():
+            out.append(("kill", k))
+        for k in world.routable():
+            if len(world.routable()) == 2:
+                out.append(("drain", k))
+    return out
+
+
+def _label(world, t) -> str:
+    kind = t[0]
+    if kind in ("route", "admit", "preempt", "evict", "kill", "drain"):
+        return f"{kind}@{t[1]}"
+    if kind in ("step", "spec"):
+        r = world.engines[t[1]].slot_req[t[2]]
+        return f"{kind}(r{r.rid}@{t[1]})"
+    if kind == "ship":
+        r = world.engines[t[1]].slot_req[t[2]]
+        return f"ship(r{r.rid}:{t[1]}->{t[3]})"
+    sh = world.ships[t[1]]
+    return f"{kind}(r{sh.rid})"
+
+
+def _apply(world, t) -> None:
+    """Execute one transition on ``world`` IN PLACE, through the
+    production seam verbs."""
+    kind = t[0]
+    ops = world.ops
+    if kind == "route":
+        world.engines[t[1]].waiting.append(world.queue.popleft())
+    elif kind == "admit":
+        ops.admit(world.engines[t[1]])
+    elif kind == "preempt":
+        eng = world.engines[t[1]]
+        if eng.waiting:
+            ops.preempt_for(eng, eng.waiting[0])
+    elif kind == "evict":
+        ops.evict_one(world.engines[t[1]], set())
+    elif kind == "step":
+        _, k, s = t
+        eng = world.engines[k]
+        req = eng.slot_req[s]
+        take = min(eng._chunk_for(req), len(req.seq) - req.cursor)
+        held = eng._pages_held(req.cursor)
+        need = eng._pages_held(req.cursor + take)
+        if not ops.ensure_pages(eng, s, held, need, {s}):
+            return                     # deferred (evictions may have run)
+        ops.advance_cursor(eng, s, req, take)
+        if req.cursor == len(req.seq):
+            req.generated.append(_tok(req))
+            ops.complete(eng, req, s)
+    elif kind == "spec":
+        # one all-rejected verify row: the frontier draw emits, every
+        # draft rolls back — the production rollback_draft discipline
+        _, k, s = t
+        eng = world.engines[k]
+        req = eng.slot_req[s]
+        take = min(eng._chunk_for(req), len(req.seq) - req.cursor)
+        held = eng._pages_held(req.cursor)
+        need = eng._pages_held(req.cursor + take)
+        if not ops.ensure_pages(eng, s, held, need, {s}):
+            return
+        old_cursor = req.cursor
+        req.generated.append(_tok(req))
+        ops.rollback_draft(eng, s, req, old_cursor, take, 0)
+        ops.complete(eng, req, s)
+    elif kind == "ship":
+        _, k, s, j = t
+        eng, dst = world.engines[k], world.engines[j]
+        req = eng.slot_req[s]
+        npg = eng._pages_held(req.cursor)
+        req.parked = True              # source pins its pages
+        got = ops.reserve_shipped(dst, req)
+        if got is None:
+            req.parked = False         # no reservation: unwind the pin
+            req.slot = s
+            return
+        dslot, dpids = got
+        src_pids = [int(p) for p in eng.table[s, :npg]]
+        world.ships.append(_Ship(req.rid, k, s, j, dslot, dpids,
+                                 src_pids))
+    elif kind == "commit":
+        sh = world.ships.pop(t[1])
+        ops.ship_commit(world.engines[sh.src], sh.pslot,
+                        world.engines[sh.dst],
+                        world.requests[sh.rid])
+    elif kind == "xfail":
+        sh = world.ships.pop(t[1])
+        ops.ship_abort(world.engines[sh.dst], sh.dslot,
+                       world.requests[sh.rid], sh.pslot)
+        world._last_xfail = sh         # checked by _check_state (SV006)
+    elif kind == "kill":
+        _kill(world, t[1])
+    elif kind == "drain":
+        k = t[1]
+        world.draining.add(k)
+        ops.drain_requeue(world.engines[k], world.queue)
+    else:                              # pragma: no cover
+        raise ValueError(kind)
+
+
+def _kill(world, k: int) -> None:
+    """ReplicaDeath, mirroring ServingFleet._kill + the
+    DisaggregatedEngine._fail_over ship discipline: resolve in-flight
+    ships first (dst death unparks the row in place at the source; src
+    death force-commits at the destination), then the seam's
+    failover_requeue drains everything the dead replica held, then the
+    survivors' drains are cancelled if the death left no routable
+    replica (the SV007 counterexample fix)."""
+    ops = world.ops
+    for sh in [s for s in world.ships if s.src == k or s.dst == k]:
+        world.ships.remove(sh)
+        req = world.requests[sh.rid]
+        if sh.dst == k:
+            # destination died: the source keeps the row, unparked in
+            # place (the _fail_over decode-death path); the dead
+            # reservation vanishes with the destination's pool
+            world.engines[k].slot_req[sh.dslot] = None
+            req.slot = sh.pslot
+            req.parked = False
+        else:
+            # source died: force-commit at the destination without a
+            # source release (the pages died with the pool)
+            world.engines[k].slot_req[sh.pslot] = None
+            ops.commit_shipped(world.engines[sh.dst], req)
+    world.dead.add(k)
+    world.draining.discard(k)
+    eng = world.engines[k]
+    held, seen = [], set()
+    for r in (list(eng.slot_req) + list(eng.waiting)
+              + list(eng.pending)):
+        if r is not None and not r.done and id(r) not in seen:
+            seen.add(id(r))
+            held.append(r)
+    ops.failover_requeue(held, world.queue, None)
+    eng.slot_req = [None] * eng.cfg.slots
+    eng.table[:] = -1
+    eng.waiting.clear()
+    eng.pending.clear()
+    if not world.routable() and world.draining:
+        # a drain that can no longer hand off must cancel, or the
+        # backlog is unroutable forever (ServingFleet._kill does the
+        # same since this checker first flagged it)
+        world.draining.clear()
+
+
+# ------------------------------------------------------------------ checks
+
+
+def _repro(world, label=None) -> str:
+    steps = world.trace + ((label,) if label else ())
+    return " -> ".join(steps) if steps else "<initial state>"
+
+
+def _finding(rule, msg, world, label=None) -> Finding:
+    return Finding(
+        rule=rule, kernel="serving-protocol", site="servlint",
+        message=f"{msg}; repro: {_repro(world, label)}")
+
+
+def _check_pages(world) -> Finding | None:
+    """SV001/SV002 static halves: every page of every alive pool is
+    exactly one of free / reclaimable-cached / table-referenced."""
+    for k in world.alive():
+        eng = world.engines[k]
+        pool = eng.pool
+        intable = {}
+        for p in eng.table.flat:
+            if p >= 0:
+                intable[int(p)] = intable.get(int(p), 0) + 1
+        free = set(pool.free)
+        for pg in range(pool.npages):
+            r = int(pool.refs[pg])
+            if r == 0 and intable.get(pg):
+                return _finding(
+                    "SV002",
+                    f"replica {k} block table references freed page "
+                    f"{pg} (refcount 0)", world)
+            if r == 0 and pg not in free and pg not in pool._reclaim:
+                return _finding(
+                    "SV001",
+                    f"replica {k} page {pg} is unreachable: refcount "
+                    f"0 but on neither the free list nor the reclaim "
+                    f"cache", world)
+            if r > 0 and not intable.get(pg):
+                return _finding(
+                    "SV001",
+                    f"replica {k} page {pg} leaked: refcount {r} but "
+                    f"no block-table row references it", world)
+            if pg in free and r != 0:
+                return _finding(
+                    "SV002",
+                    f"replica {k} page {pg} is on the free list with "
+                    f"refcount {r}", world)
+    return None
+
+
+def _check_ships(world) -> Finding | None:
+    """SV003: an in-flight ship's pinned source pages and reserved
+    destination pages must stay held until the record resolves."""
+    for sh in world.ships:
+        src, dst = world.engines[sh.src], world.engines[sh.dst]
+        for pg in sh.src_pids:
+            if int(src.pool.refs[pg]) < 1:
+                return _finding(
+                    "SV003",
+                    f"source page {pg} of in-flight ship of r{sh.rid} "
+                    f"({sh.src}->{sh.dst}) was freed mid-flight",
+                    world)
+        for pg in sh.dpids:
+            if int(dst.pool.refs[pg]) < 1:
+                return _finding(
+                    "SV003",
+                    f"destination landing page {pg} reserved for "
+                    f"r{sh.rid} ({sh.src}->{sh.dst}) was freed before "
+                    f"the transfer resolved", world)
+    return None
+
+
+def _check_requests(world) -> Finding | None:
+    """SV004: conservation of the request multiset."""
+    shipping = {sh.rid for sh in world.ships}
+    for rid, req in sorted(world.requests.items()):
+        n = sum(1 for r in world.queue if r.rid == rid)
+        for k in world.alive():
+            eng = world.engines[k]
+            n += sum(1 for r in eng.waiting if r.rid == rid)
+            n += sum(1 for r in eng.pending if r.rid == rid)
+            n += sum(1 for r in eng.slot_req
+                     if r is not None and r.rid == rid)
+        want = 0 if req.done else (2 if rid in shipping else 1)
+        if n != want:
+            what = "lost" if n < want else "duplicated"
+            return _finding(
+                "SV004",
+                f"request r{rid} {what}: found {n} live copies, "
+                f"expected {want} (done={req.done}, "
+                f"shipping={rid in shipping})", world)
+    return None
+
+
+def _check_xfail(world) -> Finding | None:
+    """SV006 (leak half): after a transport-exhausted ship, the
+    destination reservation must be fully rolled back."""
+    sh = getattr(world, "_last_xfail", None)
+    if sh is None or sh.dst in world.dead:
+        return None
+    dst = world.engines[sh.dst]
+    holder = dst.slot_req[sh.dslot]
+    if holder is not None and holder.rid == sh.rid:
+        return _finding(
+            "SV006",
+            f"transport-exhausted ship of r{sh.rid} leaked its "
+            f"destination reservation: slot {sh.dslot} on replica "
+            f"{sh.dst} is still occupied", world)
+    for pg in sh.dpids:
+        if int(dst.pool.refs[pg]) > 0 and not (dst.table == pg).any():
+            return _finding(
+                "SV006",
+                f"transport-exhausted ship of r{sh.rid} leaked "
+                f"reserved landing page {pg} on replica {sh.dst}",
+                world)
+    return None
+
+
+def _check_cursor(pre, world, label) -> Finding | None:
+    """SV005: a request resident in the same slot across a transition
+    must not move its cursor backwards (legal rewinds go off-slot at
+    cursor 0, or through rollback_draft which lands at >= old+1)."""
+    for rid, old in pre.items():
+        k, s, cursor = old
+        if k in world.dead:
+            continue
+        req = world.engines[k].slot_req[s]
+        if req is None or req.rid != rid:
+            continue
+        if req.cursor < cursor and not (req.cursor == 0
+                                        and req.slot is None):
+            return _finding(
+                "SV005",
+                f"request r{rid} cursor regressed {cursor} -> "
+                f"{req.cursor} while resident in slot {s} of replica "
+                f"{k} — committed-prefix tokens would re-emit", world,
+                label)
+    return None
+
+
+def _check_livelock(world) -> Finding | None:
+    """SV007: backlog nonempty, nothing resident, no ship in flight,
+    and routing + admitting the whole backlog on every routable
+    replica admits nothing — no transition can ever make progress."""
+    if world.ships:
+        return None
+    for k in world.alive():
+        if any(r is not None for r in world.engines[k].slot_req):
+            return None
+    backlog = len(world.queue) + sum(
+        len(world.engines[k].waiting) + len(world.engines[k].pending)
+        for k in world.alive())
+    if backlog == 0:
+        return None
+    probe = world.clone()
+    routable = probe.routable()
+    for k in probe.alive():
+        eng = probe.engines[k]
+        if k in routable:
+            while probe.queue:
+                eng.waiting.append(probe.queue.popleft())
+        try:
+            probe.ops.admit(eng)
+        except Exception:
+            pass
+        if any(r is not None for r in eng.slot_req):
+            return None
+    return _finding(
+        "SV007",
+        f"unroutable livelock: {backlog} request(s) backlogged, no "
+        f"replica resident work, and admission on every routable "
+        f"replica admits nothing", world)
+
+
+def _check_state(pre_cursors, world, label) -> Finding | None:
+    for check in (_check_pages, _check_ships, _check_requests,
+                  _check_xfail):
+        f = check(world)
+        if f is not None:
+            return f
+    f = _check_cursor(pre_cursors, world, label)
+    if f is not None:
+        return f
+    return _check_livelock(world)
+
+
+def _cursors(world) -> dict:
+    out = {}
+    for k in world.alive():
+        for s, r in enumerate(world.engines[k].slot_req):
+            if r is not None:
+                out[r.rid] = (k, s, r.cursor)
+    return out
+
+
+# ---------------------------------------------------------------- explorer
+
+
+def explore(ops: ProtocolOps | None = None, *,
+            max_states: int = 20_000) -> tuple:
+    """Exhaustive bounded BFS over the abstract fleet driven by
+    ``ops`` (production :class:`ProtocolOps` by default). Stops at the
+    FIRST finding (BFS order makes its repro interleaving minimal) or
+    when the reachable graph — capped at ``max_states`` — is
+    exhausted. Returns ``(findings, stats)`` where stats carries
+    ``states`` (distinct states visited), ``transitions`` (edges
+    executed) and ``complete`` (True when the full reachable graph fit
+    under the cap)."""
+    ops = ops if ops is not None else ProtocolOps()
+    root = _World(ops)
+    f = _check_state({}, root, None)
+    if f is not None:
+        return [f], {"states": 1, "transitions": 0, "complete": True}
+    seen = {root.key()}
+    frontier = deque([root])
+    states, edges, truncated = 1, 0, False
+    while frontier:
+        world = frontier.popleft()
+        pre = _cursors(world)
+        for t in _enabled(world):
+            label = _label(world, t)
+            succ = world.clone()
+            edges += 1
+            try:
+                _apply(succ, t)
+            except AssertionError as exc:
+                rule = "SV006" if t[0] in ("ship", "commit",
+                                           "xfail") else "SV002"
+                why = ("ship handshake violated the pool/parking "
+                       "discipline" if rule == "SV006"
+                       else "PagePool refcount assertion tripped "
+                            "(double free / alloc of a live page)")
+                return ([_finding(rule, f"{why}: {exc}", world,
+                                  label)],
+                        {"states": states, "transitions": edges,
+                         "complete": False})
+            succ.trace = world.trace + (label,)
+            key = succ.key()
+            if key in seen:
+                continue
+            if states >= max_states:
+                truncated = True
+                continue
+            seen.add(key)
+            states += 1
+            f = _check_state(pre, succ, label)
+            if f is not None:
+                return [f], {"states": states, "transitions": edges,
+                             "complete": False}
+            frontier.append(succ)
+    return [], {"states": states, "transitions": edges,
+                "complete": not truncated}
+
+
+# ---------------------------------------------------------------- fixtures
+
+# One deliberately-broken ProtocolOps per rule — each mutation is built
+# THROUGH the production seam (a subclass overriding exactly one verb),
+# so the checker proves it would catch that bug in the real engines.
+
+
+class _LeakOnFree(ProtocolOps):
+    """SV001: free_slot drops the table without releasing refcounts."""
+
+    seeds_rule = "SV001"
+
+    def free_slot(self, eng, slot):
+        eng.table[slot] = -1           # BUG: pages stay refcounted
+        eng.slot_req[slot] = None
+
+
+class _DoubleFree(ProtocolOps):
+    """SV002: free_slot releases every page twice."""
+
+    seeds_rule = "SV002"
+
+    def free_slot(self, eng, slot):
+        for pg in eng.table[slot]:
+            if pg >= 0:
+                eng.pool.release(int(pg))
+                eng.pool.release(int(pg))   # BUG
+        eng.table[slot] = -1
+        eng.slot_req[slot] = None
+
+
+class _EvictParked(ProtocolOps):
+    """SV003: evict_one ignores the parked (pages-pinned) guard."""
+
+    seeds_rule = "SV003"
+
+    def evict_one(self, eng, batched):
+        victims = [
+            (eng._rank(req), req.arrival, s)
+            for s, req in enumerate(eng.slot_req)
+            if req is not None and s not in batched
+            and not req.done           # BUG: parked rows are victims
+        ]
+        if not victims:
+            return False
+        _, _, s = max(victims)
+        req = eng.slot_req[s]
+        req.cursor = 0
+        req.evictions += 1
+        req.slot = None
+        self.free_slot(eng, s)
+        eng.waiting.appendleft(req)
+        eng.stats.evictions += 1
+        return True
+
+
+class _DropOnKill(ProtocolOps):
+    """SV004: failover_requeue silently drops the newest request."""
+
+    seeds_rule = "SV004"
+
+    def failover_requeue(self, held, queue, stats=None):
+        drained = sorted(held, key=lambda r: r.arrival)
+        return super().failover_requeue(drained[:-1], queue,
+                                        stats)   # BUG
+
+
+class _DeepRollback(ProtocolOps):
+    """SV005: speculative rollback rewinds past the committed
+    frontier token."""
+
+    seeds_rule = "SV005"
+
+    def rollback_draft(self, eng, s, req, old_cursor, take, accepted):
+        req.cursor = max(0, old_cursor - 1)      # BUG: not old+1+acc
+        keep = eng._pages_held(req.cursor)
+        got = eng._pages_held(old_cursor + take)
+        for pg in range(keep, got):
+            if eng.table[s, pg] >= 0:
+                eng.pool.release(int(eng.table[s, pg]))
+                eng.table[s, pg] = -1
+        if eng.pool.prefix_cache:
+            eng._register_frozen(req, s, old_cursor)
+
+
+class _EagerCommit(ProtocolOps):
+    """SV006: destination commit observable before source release."""
+
+    seeds_rule = "SV006"
+
+    def ship_commit(self, src_eng, pslot, dst_eng, req):
+        self.commit_shipped(dst_eng, req)        # BUG: dst first
+        self.release_parked(src_eng, pslot)
+
+
+class _NeverAdmit(ProtocolOps):
+    """SV007: admission sorts the queue and admits nothing."""
+
+    seeds_rule = "SV007"
+
+    def admit(self, eng):
+        while eng.pending and eng.pending[0].arrival <= eng.step_count:
+            eng.waiting.append(eng.pending.popleft())
+        if not eng.waiting:
+            return
+        eng.waiting = deque(sorted(                # BUG: sort-only
+            eng.waiting,
+            key=lambda r: (eng._eff_rank(r), r.arrival, r.rid)))
+
+
+#: rule id -> mutated-ops factory (the seeded true positives)
+FIXTURES = {
+    "SV001": _LeakOnFree,
+    "SV002": _DoubleFree,
+    "SV003": _EvictParked,
+    "SV004": _DropOnKill,
+    "SV005": _DeepRollback,
+    "SV006": _EagerCommit,
+    "SV007": _NeverAdmit,
+}
+
+
+def lint_serving(ops: ProtocolOps | None = None, *,
+                 fixture: str | None = None,
+                 max_states: int = 20_000) -> tuple:
+    """Model-check the serving protocol. ``fixture`` selects a seeded
+    mutated-ops true positive from :data:`FIXTURES` instead of the
+    production ops. Returns ``(findings, stats)``."""
+    if fixture is not None:
+        if fixture not in FIXTURES:
+            raise ValueError(
+                f"unknown servlint fixture {fixture!r} (want one of "
+                f"{sorted(FIXTURES)})")
+        ops = FIXTURES[fixture]()
+    return explore(ops, max_states=max_states)

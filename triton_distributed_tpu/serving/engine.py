@@ -369,16 +369,21 @@ class ServingEngine:
                  on_complete=None, health=None,
                  health_peer: str = "site:serving_step",
                  grid_schedule=None, tenants=None,
-                 aging_ticks: int = 64):
+                 aging_ticks: int = 64, ops=None):
         import jax.numpy as jnp
 
         from triton_distributed_tpu.runtime.health import HealthLedger
+        from triton_distributed_tpu.serving.protocol import ProtocolOps
         from triton_distributed_tpu.serving.state import PagePool
 
         self.model = model
         self.params = params
         self.cfg = cfg
         self.use_pallas = use_pallas
+        # the protocol seam: every scheduling/pool transition runs
+        # through these verbs (serving/protocol.py) — the same objects
+        # analysis/servlint.py model-checks
+        self.ops = ops if ops is not None else ProtocolOps()
         # every failure signal lands here; probation re-promotes the
         # fused path. A shared ledger (DisaggregatedEngine) makes one
         # role's kernel failure visible to the other.
@@ -540,103 +545,24 @@ class ServingEngine:
 
     def _alloc(self, slot: int, held: int, need: int) -> bool:
         """Grow slot's table from ``held`` to ``need`` pages; all-or-
-        nothing (no partial growth to unwind)."""
-        if need - held > self.pool.available:
-            return False
-        for pg in range(held, need):
-            self.table[slot, pg] = self.pool.alloc()
-        return True
+        nothing — :meth:`ProtocolOps.alloc`."""
+        return self.ops.alloc(self, slot, held, need)
 
     def _free_slot(self, slot: int) -> None:
-        """Release the slot's page references — shared-prefix pages only
-        truly free when their LAST holder lets go (the refcount
-        discipline); privately-held pages return to the free list."""
-        for pg in self.table[slot]:
-            if pg >= 0:
-                self.pool.release(int(pg))
-        self.table[slot] = -1
-        self.slot_req[slot] = None
+        """Release the slot's page references (the refcount
+        discipline) — :meth:`ProtocolOps.free_slot`."""
+        self.ops.free_slot(self, slot)
 
     def _evict_one(self, batched: set) -> bool:
-        """Evict the lowest-tier, latest-arrived active request not
-        already in this step's batch (priority-aware LIFO preemption —
-        with one tenant every rank ties and this is exactly the
-        pre-tenancy LIFO); its pages return to the free list and the
-        request re-queues AT THE FRONT with cursor 0 — the recompute
-        prefix (prompt + generated) resumes it exactly. Parked requests
-        (pages pinned by an in-flight KV ship) and already-completed
-        holders are never victims."""
-        victims = [
-            (self._rank(req), req.arrival, s)
-            for s, req in enumerate(self.slot_req)
-            if req is not None and s not in batched
-            and not req.parked and not req.done
-        ]
-        if not victims:
-            return False
-        _, _, s = max(victims)
-        req = self.slot_req[s]
-        req.cursor = 0
-        req.evictions += 1
-        req.slot = None
-        self._free_slot(s)
-        self.waiting.appendleft(req)
-        self.stats.evictions += 1
-        return True
+        """Priority-aware LIFO eviction through the recompute
+        discipline — :meth:`ProtocolOps.evict_one`."""
+        return self.ops.evict_one(self, batched)
 
     def _preempt_for(self, by_req) -> bool:
-        """Priority preemption: a higher-tier admission found no free
-        slot (or no page headroom), so the LOWEST-tier resident row
-        strictly below ``by_req``'s effective rank is evicted through
-        the recompute-eviction discipline — token-exact and
-        cursor-resumable, so preemption is free correctness-wise. The
-        victim re-queues into ``waiting``, where the priority sort
-        re-orders it at its tenant's tier. False = no strictly-lower
-        victim exists (single-tenant engines always land here).
-        Victims are ranked by EFFECTIVE rank too: anti-starvation
-        aging protects residency as well as admission order — a
-        background row that waited out its aging bumps can no longer
-        be preempted by the interactive flood that starved it. At
-        EQUAL effective rank the victim with the fewest committed
-        pages goes first: eviction is recompute-priced, so the cheapest
-        re-prefill (least KV already materialized) is the one to throw
-        away. Runs under the ``preempt`` chaos site so a fault-plan
-        Stall can wedge it visibly."""
-        rank = self._eff_rank(by_req)
-        victims = [
-            (self._eff_rank(req), -int((self.table[s] >= 0).sum()),
-             req.arrival, s)
-            for s, req in enumerate(self.slot_req)
-            if req is not None and not req.parked and not req.done
-            and self._eff_rank(req) > rank
-        ]
-        if not victims:
-            return False
-        from triton_distributed_tpu.lang.launch import maybe_instrument
-
-        _, _, _, s = max(victims)
-
-        def body():
-            victim = self.slot_req[s]
-            victim.cursor = 0
-            victim.evictions += 1
-            victim.slot = None
-            self._free_slot(s)
-            self.waiting.append(victim)
-            self.stats.evictions += 1
-            self.stats.preemptions += 1
-            t = getattr(victim, "tenant", "default")
-            self.stats.tenant_preemptions[t] = (
-                self.stats.tenant_preemptions.get(t, 0) + 1)
-            if self.on_preempt is not None:
-                self.on_preempt(by_req, victim)
-            return True
-
-        return maybe_instrument(
-            body, axis=None, site="preempt",
-            collective_id=("preempt", self.step_count), n=1,
-            step=self.step_count,
-        )()
+        """Priority preemption of the lowest-tier resident strictly
+        below ``by_req``'s effective rank —
+        :meth:`ProtocolOps.preempt_for`."""
+        return self.ops.preempt_for(self, by_req)
 
     # ---------------------------------------------------------------- step
 
@@ -690,56 +616,10 @@ class ServingEngine:
         return True
 
     def _admit(self) -> None:
-        while self.pending and self.pending[0].arrival <= self.step_count:
-            self.waiting.append(self.pending.popleft())
-        if not self.waiting:
-            return
-        # priority admission: effective tier rank (tenant tier minus
-        # the aging bump), then FIFO. With one tenant every rank is 0
-        # and this is a stable no-op — the pre-tenancy FIFO exactly.
-        self.waiting = deque(sorted(
-            self.waiting,
-            key=lambda r: (self._eff_rank(r), r.arrival, r.rid)))
-        deferred: list = []
-        while self.waiting:
-            req = self.waiting[0]
-            free = [s for s, r in enumerate(self.slot_req) if r is None]
-            if not free:
-                if not self._preempt_for(req):
-                    break                  # no slot, no lower-tier victim
-                free = [s for s, r in enumerate(self.slot_req)
-                        if r is None]
-            first = min(self._chunk_for(req), len(req.seq))
-            if (self._pages_held(first)
-                    > self.pool.available - self._committed_pages()):
-                # pool exhausted: a higher tier may still claim pages
-                # by preempting the lowest-tier resident
-                if self._preempt_for(req):
-                    continue
-                break                      # hold the queue
-            if not self._fair_share_ok(req, first):
-                self.waiting.popleft()
-                deferred.append(req)
-                t = getattr(req, "tenant", "default")
-                self.stats.fair_share_deferrals[t] = (
-                    self.stats.fair_share_deferrals.get(t, 0) + 1)
-                continue
-            self.waiting.popleft()
-            s = free[0]
-            req.slot = s
-            self.slot_req[s] = req
-            if len(req.seq) > self.state.capacity:
-                # cannot ever fit — fail it loudly rather than wedging
-                req.done = True
-                self._free_slot(s)
-                raise ValueError(
-                    f"request {req.rid}: sequence {len(req.seq)} exceeds "
-                    f"slot capacity {self.state.capacity}"
-                )
-            if self.pool.prefix_cache and req.cursor == 0:
-                self._attach_prefix(req, s)
-        for req in deferred:               # over-share: retry next step
-            self.waiting.append(req)
+        """Priority admission (effective tier rank, then FIFO; with one
+        tenant every rank is 0 and this is the pre-tenancy FIFO
+        exactly) — :meth:`ProtocolOps.admit`."""
+        self.ops.admit(self)
 
     # ------------------------------------------------------ prefix cache
 
@@ -880,10 +760,7 @@ class ServingEngine:
                 continue                   # token budget spent
             held = self._pages_held(req.cursor)
             need = self._pages_held(req.cursor + take)
-            while not self._alloc(s, held, need):
-                if not self._evict_one(batched | {s}):
-                    break
-            else:
+            if self.ops.ensure_pages(self, s, held, need, batched):
                 # allocation succeeded
                 span = slice(next_start, next_start + take)
                 tokens[span] = row
@@ -1058,10 +935,7 @@ class ServingEngine:
         packed tokens that were prefill (not generation) work. The
         speculative engine overrides this with the verify/accept loop
         (multi-token emission + rejected-draft rollback)."""
-        old_cursor = req.cursor
-        req.cursor += take
-        if self.pool.prefix_cache:
-            self._register_frozen(req, s, old_cursor)
+        self.ops.advance_cursor(self, s, req, take)
         if req.cursor == len(req.seq):
             # the row's last packed token was its sequence frontier:
             # the logits row is the next-token distribution
@@ -1072,18 +946,9 @@ class ServingEngine:
         return 0, take
 
     def _maybe_complete(self, req, s: int) -> None:
-        """Completion check after a row emitted into ``req.generated``;
-        frees (or parks, via ``on_complete``) the slot when the request
-        reaches its target."""
-        target = 1 if self.cfg.prefill_only else req.max_new
-        if len(req.generated) >= target:
-            req.completion_step = self.step_count
-            self.stats.completed += 1
-            self.stats.generated_tokens += len(req.generated)
-            if not self.cfg.prefill_only:
-                req.done = True
-            if self.on_complete is None or self.on_complete(req, s):
-                self._free_slot(s)
+        """Completion check after a row emitted into ``req.generated``
+        — :meth:`ProtocolOps.complete`."""
+        self.ops.complete(self, req, s)
 
     def _sample(self, row_logits, req) -> int:
         """Next token for one completed row. Greedy argmax at
@@ -1131,43 +996,23 @@ class ServingEngine:
 
     def reserve_shipped(self, req) -> tuple | None:
         """Claim a slot + landing pages for a request whose first
-        ``req.cursor`` tokens of KV will arrive by transfer. Returns
-        (slot, page_ids) or None (no slot / pool pressure — the caller
-        retries, leaving the source pages pinned)."""
-        free = [s for s, r in enumerate(self.slot_req) if r is None]
-        if not free:
-            return None
-        if len(req.seq) > self.state.capacity:
-            raise ValueError(
-                f"request {req.rid}: sequence {len(req.seq)} exceeds "
-                f"slot capacity {self.state.capacity}"
-            )
-        need = self._pages_held(req.cursor)
-        if need > self.pool.available - self._committed_pages():
-            return None
-        s = free[0]
-        pids = []
-        for p in range(need):
-            pg = self.pool.alloc()
-            self.table[s, p] = pg
-            pids.append(int(pg))
-        req.slot = s
-        req.parked = True
-        self.slot_req[s] = req
-        return s, pids
+        ``req.cursor`` tokens of KV will arrive by transfer —
+        :meth:`ProtocolOps.reserve_shipped`. Returns (slot, page_ids)
+        or None (no slot / pool pressure — the caller retries, leaving
+        the source pages pinned)."""
+        return self.ops.reserve_shipped(self, req)
 
     def commit_shipped(self, req) -> None:
         """The transfer into this request's reserved pages has landed:
-        the row becomes schedulable (and evictable) like any other."""
-        req.parked = False
+        the row becomes schedulable (and evictable) like any other —
+        :meth:`ProtocolOps.commit_shipped`."""
+        self.ops.commit_shipped(self, req)
 
     def release_parked(self, slot: int) -> None:
         """Free a parked slot (source-side handoff after its pages have
-        shipped, or an abandoned reservation)."""
-        req = self.slot_req[slot]
-        assert req is not None and req.parked, (slot, req)
-        req.parked = False
-        self._free_slot(slot)
+        shipped, or an abandoned reservation) —
+        :meth:`ProtocolOps.release_parked`."""
+        self.ops.release_parked(self, slot)
 
     # The wire-form page plumbing below is shared by every pool→pool
     # transfer this engine is an endpoint of: the disaggregated
@@ -1682,9 +1527,13 @@ class DisaggregatedEngine:
             for r in rs:
                 # handoff order matters: the source frees its pinned
                 # pages first, THEN the row becomes schedulable
+                # (ProtocolOps.ship_commit — the transactional verb
+                # servlint model-checks)
                 if release_source:
-                    self.prefill.release_parked(r.pslot)
-                self.decode.commit_shipped(r.req)
+                    self.decode.ops.ship_commit(
+                        self.prefill, r.pslot, self.decode, r.req)
+                else:
+                    self.decode.commit_shipped(r.req)
                 self._warm_prefix_cache(r)
                 self._inflight.remove(r)
                 self.stats.ships += 1

@@ -752,8 +752,9 @@ class ServingFleet:
                  router: RouterConfig | None = None, health=None,
                  meshes=None, reserve=None, autoscaler=None,
                  perf_spec=None, tenants=None, brownout=None,
-                 retune_every: int | None = None):
+                 retune_every: int | None = None, ops=None):
         from triton_distributed_tpu.runtime.health import HealthLedger
+        from triton_distributed_tpu.serving.protocol import ProtocolOps
 
         if not engines:
             raise ValueError("a fleet needs at least one replica")
@@ -766,6 +767,9 @@ class ServingFleet:
         self.replicas = [Replica(i, e, m)
                          for i, (e, m) in enumerate(zip(engines, meshes))]
         self.seed = seed
+        # fleet-level protocol verbs live behind the same seam the
+        # engines use, so servlint can drive (or mutate) them too
+        self.ops = ops if ops is not None else ProtocolOps()
         self.health = health if health is not None else HealthLedger(
             seed=seed)
         self.router = FleetRouter(seed, router)
@@ -1221,22 +1225,25 @@ class ServingFleet:
         # prompt+generated resumes the exact cursor) and re-routes onto
         # the survivors this same tick — zero lost requests, and the
         # request-keyed sampler keeps the streams byte-identical
-        drained = sorted(replica.held(), key=lambda r: r.arrival)
-        for req in drained:
-            self.stats.failover_re_prefill_tokens += req.cursor
-            if req.cursor > 0:
-                req.evictions += 1
-            req.cursor = 0
-            req.slot = None
-            req.parked = False
+        drained = self.ops.failover_requeue(
+            replica.held(), self.queue, self.stats)
         self.stats.failover_requeued += len(drained)
-        for req in reversed(drained):
-            self.queue.appendleft(req)
         replica.neutralize()
         # the dead replica's sessions must re-home on their next request
         for sess, idx in list(self.router.affinity.items()):
             if idx == k:
                 del self.router.affinity[sess]
+        # SV007 (servlint counterexample): if this death left ONLY
+        # draining survivors, the fleet is permanently unroutable — the
+        # backlog (including the rows just requeued above) waits on
+        # replicas that admit no routed work, and drain completion
+        # itself can wedge when the drain's migration target was the
+        # replica that just died. Cancel the surviving drains: capacity
+        # loss outranks the drain intent.
+        if not self._route_candidates():
+            for j in sorted(self._draining):
+                self._draining.pop(j)
+                self._log_event("drain_cancel", j, f"death@{k}")
 
     def _retire_engine(self, replica: Replica) -> None:
         for role in replica._roles:
@@ -1329,14 +1336,7 @@ class ServingFleet:
         replica = self.replicas[k]
         requeued = 0
         for role in replica._roles:
-            moved = [r for r in list(role.waiting) + list(role.pending)
-                     if not r.done]
-            role.waiting.clear()
-            role.pending.clear()
-            for req in moved:
-                req.slot = None
-                self.queue.append(req)
-            requeued += len(moved)
+            requeued += len(self.ops.drain_requeue(role, self.queue))
         if requeued:
             self.queue = deque(sorted(self.queue,
                                       key=lambda r: r.arrival))
@@ -1471,29 +1471,17 @@ class ServingFleet:
             dst_role = dst.admit_role
             if dst_role.cfg.page != role.cfg.page:
                 continue               # pages ship verbatim
-            got = dst_role.reserve_shipped(req)
-            if got is None:
+            out = self.ops.migrate_live_core(
+                req, role, dst_role, pslot, npg,
+                lambda p, _d=dst_role: self._migrate_transport(p, _d))
+            if out is None:
                 continue               # no slot/pages there; try next
-            dslot, dpids = got
-            src_pids = [int(p) for p in role.table[pslot, :npg]]
-            payload = role.gather_pages(src_pids)
-            shipped = self._migrate_transport(payload, dst_role)
-            if shipped is None:
-                # roll the reservation back; the row stays at src and
-                # can still finish in place (or requeue on a kill)
-                dst_role.release_parked(dslot)
-                req.slot = pslot
-                req.parked = False
+            if out is False:
                 self.stats.migration_failures += 1
                 self._log_event("migrate_failed", src.index,
                                 f"rid={req.rid} dst={dst.index}")
                 return False
-            dst_role.land_pages(dpids, *shipped)
-            # handoff order matters (the _commit_ships discipline): the
-            # source frees its pinned pages, THEN the row becomes
-            # schedulable at the destination
-            role.release_parked(pslot)
-            dst_role.commit_shipped(req)
+            dslot, dpids = out
             self._warm_migrated_prefix(req, dst_role, dpids)
             sess = getattr(req, "session", None)
             if sess is not None:

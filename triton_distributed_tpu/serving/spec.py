@@ -488,10 +488,7 @@ class SpeculativeEngine(ServingEngine):
             # plain chunk/decode row — base bookkeeping, but the
             # frontier distribution lives at the row's LAST packed
             # index (logits here are per-token, not per-slot)
-            old_cursor = req.cursor
-            req.cursor += take
-            if self.pool.prefix_cache:
-                self._register_frozen(req, s, old_cursor)
+            self.ops.advance_cursor(self, s, req, take)
             if req.cursor == len(req.seq):
                 tok = self._sample(logits[base + take - 1], req)
                 req.generated.append(tok)
@@ -522,17 +519,7 @@ class SpeculativeEngine(ServingEngine):
         # the rejected tail claimed at assembly. Garbage KV above the
         # cursor is never attended (kv_lens is recomputed from host
         # cursors) and the next append overwrites it in place.
-        req.cursor = old_cursor + 1 + accepted
-        keep = self._pages_held(req.cursor)
-        got = self._pages_held(old_cursor + take)
-        for pg in range(keep, got):
-            if self.table[s, pg] >= 0:
-                self.pool.release(int(self.table[s, pg]))
-                self.table[s, pg] = -1
-        if self.pool.prefix_cache:
-            # register AFTER the rewind — only pages below the FINAL
-            # cursor are frozen (pure functions of the chained prefix)
-            self._register_frozen(req, s, old_cursor)
+        self.ops.rollback_draft(self, s, req, old_cursor, take, accepted)
         st = self.stats
         st.spec_rows += 1
         st.draft_tokens += nd
@@ -592,15 +579,7 @@ class SpeculativeEngine(ServingEngine):
                 in_place += 1
             else:
                 break
-        req.cursor = old_cursor + 1 + in_place
-        keep = self._pages_held(req.cursor)
-        got = self._pages_held(old_cursor + take)
-        for pg in range(keep, got):
-            if self.table[s, pg] >= 0:
-                self.pool.release(int(self.table[s, pg]))
-                self.table[s, pg] = -1
-        if self.pool.prefix_cache:
-            self._register_frozen(req, s, old_cursor)
+        self.ops.rollback_draft(self, s, req, old_cursor, take, in_place)
         st = self.stats
         st.spec_rows += 1
         st.draft_tokens += nd
