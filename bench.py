@@ -313,7 +313,12 @@ def _parse_args(argv=None):
         "serving_elastic — autoscale grow from a reserve mesh, a "
         "mid-trace drain with live KV-page migration — or "
         "serving_multitenant — priority preemption, deadline routing "
-        "and brownout shedding under a 4x batch flood; all compose "
+        "and brownout shedding under a 4x batch flood — or "
+        "serving_longcontext — context-parallel decode over the "
+        "cp-sharded page pool: a request k× one pool shard served "
+        "token-exact vs the single-slice oracle, the short-request "
+        "goodput tax, and the priced long-context placement verdict "
+        "(ISSUE-20); all compose "
         "with --dryrun and --faults, e.g. the ISSUE-16 acceptance "
         "line 'serving_multitenant --dryrun --faults \"seed=1; "
         "ReplicaDeath(replica=1, step=8)\"' — or train_step — the "
@@ -563,6 +568,7 @@ def main(argv=None) -> None:
             "serving_speculative": _bench_serving_speculative,
             "serving_elastic": _bench_serving_elastic,
             "serving_multitenant": _bench_serving_multitenant,
+            "serving_longcontext": _bench_serving_longcontext,
             "train_step": _bench_train_step,
         }
         bench_fn = scenarios.get(args.scenario)
@@ -1765,6 +1771,174 @@ def _bench_serving_continuous(mesh, n, on_tpu, spec, tiny=False,
             f"lens~U[{trace_kw['len_lo']},{trace_kw['len_hi']}] "
             f"poisson(seed=11) hidden={cfg.hidden} "
             f"kvq={cfg.kv_quant} "
+            + ("tiny-dryrun" if tiny or not on_tpu else "headline")
+        ),
+    }
+
+
+def _bench_serving_longcontext(mesh, n, on_tpu, spec, tiny=False):
+    """LONG-CONTEXT serving (ISSUE 20 tentpole acceptance): a tp×cp
+    mesh replica whose page-table walk is context-parallel — each cp
+    rank walks only its own pool shard and the per-rank (out, lse)
+    partials merge through the LSE-combine contract — serves a request
+    whose KV need is a MULTIPLE of one pool shard (inadmissible on any
+    cp-free replica of the same per-slice pool), token-exact against a
+    single-slice oracle engine given one pool of the combined size.
+    The paired row: (a) the capacity ratio the cp axis bought with
+    ``token_mismatches == 0``, (b) short-request goodput on the SAME
+    cp engine vs the cp-free engine (the hop tax short traffic pays),
+    (c) the PRICED placement verdict — what the fleet router tells a
+    cp-free replica refusing the long request, and the modeled
+    cp-vs-flat step cost crossover behind it."""
+    import jax
+
+    from triton_distributed_tpu.models import Transformer, TransformerConfig
+    from triton_distributed_tpu.serving import (
+        EngineConfig,
+        Request,
+        ServingEngine,
+        poisson_trace,
+    )
+    from triton_distributed_tpu.tune.perf_model import (
+        cp_decode_step_ms,
+        ragged_serving_step_ms,
+        refuse_long_context,
+    )
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return {"metric": "serving_longcontext",
+                "error": "needs >= 2 devices for a cp=2 axis"}
+    cp = 2
+    tp = 2 if len(devs) >= 4 else 1
+    mesh_cp = Mesh(
+        np.asarray(devs[:tp * cp]).reshape(tp, cp), ("x", "cp"))
+    mesh_flat = Mesh(np.asarray(devs[:tp]), ("x",))
+
+    import jax.numpy as jnp
+
+    n_kv = max(tp, 2)
+    cfg = TransformerConfig(
+        vocab=256, n_layers=2, hidden=128, ffn=128, n_heads=2 * n_kv,
+        n_kv_heads=n_kv, head_dim=32, dtype=jnp.float32,
+    )
+    # one pool shard: 8 pages of 8 tokens. The long request needs
+    # ~12 pages — inadmissible on one shard, admitted under cp=2.
+    npages_shard, page = 8, 8
+    ecfg = EngineConfig(slots=4, token_budget=32, chunk=16, page=page,
+                        npages=npages_shard, max_steps=5_000,
+                        temperature=0.0)
+    ecfg_oracle = EngineConfig(
+        slots=4, token_budget=32, chunk=16, page=page,
+        npages=cp * npages_shard, max_steps=5_000, temperature=0.0)
+
+    def build(m, cp_axis, use_pallas):
+        model = Transformer(cfg, m, tp_axis="x", cp_axis=cp_axis)
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s),
+            model.init(jax.random.PRNGKey(7)), model.shardings(),
+        )
+        return model, params, use_pallas
+
+    model_cp, params_cp, _ = build(mesh_cp, "cp", False)
+    model_fl, params_fl, _ = build(mesh_flat, None, False)
+    use_pallas = bool(on_tpu)
+
+    # ---- (a) capacity: long requests k× one pool shard, cp vs oracle
+    rng = np.random.default_rng(23)
+    long_prompt = rng.integers(1, 255, size=84).astype(np.int32)
+    short_prompts = [rng.integers(1, 255, size=12).astype(np.int32)
+                     for _ in range(3)]
+
+    def long_trace():
+        reqs = [Request(rid=0, prompt=long_prompt.copy(), max_new=10,
+                        arrival=0)]
+        reqs += [Request(rid=i + 1, prompt=p.copy(), max_new=4,
+                         arrival=0) for i, p in enumerate(short_prompts)]
+        return reqs
+
+    t_cp = long_trace()
+    eng_cp = ServingEngine(model_cp, params_cp, ecfg,
+                           use_pallas=use_pallas)
+    stats_cp = eng_cp.run(t_cp)
+    t_or = long_trace()
+    eng_or = ServingEngine(model_fl, params_fl, ecfg_oracle,
+                           use_pallas=use_pallas)
+    eng_or.run(t_or)
+    streams_cp = {r.rid: list(r.generated) for r in t_cp}
+    streams_or = {r.rid: list(r.generated) for r in t_or}
+    mismatches = sum(
+        1 for rid in streams_or
+        for a, b in zip(streams_cp.get(rid, []), streams_or[rid])
+        if a != b
+    ) + sum(
+        1 for rid in streams_or
+        if len(streams_cp.get(rid, [])) != len(streams_or[rid])
+    )
+    need_pages = -(-(len(long_prompt) + 10) // page)
+    leaked = int(np.asarray(eng_cp.pool.refs).sum())
+
+    # ---- (b) short-request goodput: cp engine vs cp-free engine on
+    # an identical short-only Poisson trace (both warmed once)
+    trace_kw = dict(n_requests=12, mean_interarrival=0.6, len_lo=8,
+                    len_hi=40, max_new_lo=3, max_new_hi=6, vocab=256)
+
+    def short_goodput(model, params, cfg_e):
+        for _warm in (False, True):
+            eng = ServingEngine(model, params, cfg_e,
+                                use_pallas=use_pallas)
+            st = eng.run(poisson_trace(seed=11, **trace_kw))
+        return st
+
+    st_cp = short_goodput(model_cp, params_cp, ecfg)
+    st_fl = short_goodput(model_fl, params_fl, ecfg)
+    ratio = (st_cp.goodput_tok_per_s / st_fl.goodput_tok_per_s
+             if st_fl.goodput_tok_per_s > 0 else float("inf"))
+
+    # ---- (c) priced placement verdict: what a cp-free replica of one
+    # pool shard says when refusing the long request, and the modeled
+    # cp-vs-flat step-cost pair behind the router's choice
+    verdict = refuse_long_context(
+        cfg, page, need_pages,
+        pool_pages=npages_shard,
+        pages_per_seq=min(npages_shard, 1024),
+        cp=1, spec=spec,
+    )
+    kv = need_pages * page
+    hkv = cfg.n_kv_heads // tp
+    g = cfg.n_heads // cfg.n_kv_heads
+    cp_ms = cp_decode_step_ms(
+        kv, cp=cp, page=page, hkv=hkv, g=g, d=cfg.head_dim,
+        hidden=cfg.hidden, n_layers=cfg.n_layers, spec=spec,
+        quant=cfg.kv_quant is not None)
+    flat_ms = ragged_serving_step_ms(
+        [kv], [1], page=page, hkv=hkv, g=g, d=cfg.head_dim,
+        hidden=cfg.hidden, n_layers=cfg.n_layers, spec=spec,
+        quant=cfg.kv_quant is not None)
+    return {
+        "metric": "serving_longcontext",
+        "value": round(need_pages / npages_shard, 3),
+        "unit": "x one-pool capacity served",
+        "token_mismatches": int(mismatches),
+        "leaked_pages": leaked,
+        "long_request_pages": need_pages,
+        "pool_pages_per_shard": npages_shard,
+        "cp": cp,
+        "tp": tp,
+        "completed_long": stats_cp.completed,
+        "evictions": stats_cp.evictions,
+        "short_goodput_cp_tok_per_s": round(
+            st_cp.goodput_tok_per_s, 1),
+        "short_goodput_flat_tok_per_s": round(
+            st_fl.goodput_tok_per_s, 1),
+        "short_goodput_ratio": round(ratio, 3),
+        "placement_verdict": verdict,
+        "model_cp_step_ms": round(cp_ms, 4),
+        "model_flat_step_ms": round(flat_ms, 4),
+        "config": (
+            f"tp={tp} cp={cp} slots={ecfg.slots} page={page} "
+            f"npages/shard={npages_shard} long={len(long_prompt)}+10 "
+            f"hidden={cfg.hidden} "
             + ("tiny-dryrun" if tiny or not on_tpu else "headline")
         ),
     }

@@ -637,7 +637,7 @@ assert dt <= 5.0, (
 print(f"servlint smoke: {stats['states']} states / "
       f"{stats['transitions']} transitions clean in {dt:.2f}s")
 EOF2
-for rule in SV001 SV002 SV003 SV004 SV005 SV006 SV007; do
+for rule in SV001 SV001cp SV002 SV003 SV004 SV005 SV006 SV007; do
   rc=0
   JAX_PLATFORMS=cpu python -m triton_distributed_tpu.analysis.lint \
     --serving-fixture "$rule" >/dev/null 2>&1 || rc=$?
@@ -646,4 +646,73 @@ for rule in SV001 SV002 SV003 SV004 SV005 SV006 SV007; do
     exit 1
   fi
 done
-echo "servlint smoke: all 7 seeded fixtures caught (exit 2 each)"
+echo "servlint smoke: all 8 seeded fixtures caught (exit 2 each)"
+
+# Long-context smoke (ISSUE 20 acceptance): a request whose end-to-end
+# KV need EXCEEDS one per-shard page pool must be ADMITTED on a cp=2
+# engine (sharded page walk + cross-rank LSE-combine) and produce a
+# token stream byte-identical to a single-pool oracle, with every page
+# back in the pool after the drain — exits nonzero on any mismatch or
+# leak.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2").strip()
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from triton_distributed_tpu.models import Transformer, TransformerConfig
+from triton_distributed_tpu.serving import (
+    EngineConfig, Request, ServingEngine,
+)
+from triton_distributed_tpu.serving.state import CpPagePool
+
+cfg = TransformerConfig(
+    vocab=128, n_layers=2, hidden=64, ffn=128, n_heads=4, n_kv_heads=2,
+    head_dim=16, dtype=jnp.float32, param_dtype=jnp.float32)
+devs = jax.devices()
+mesh_cp = Mesh(np.asarray(devs[:2]).reshape(1, 2), ("x", "cpx"))
+mesh_1 = Mesh(np.asarray(devs[:1]), ("x",))
+
+
+def run(mesh, cp_axis, npages):
+    model = Transformer(cfg, mesh, tp_axis="x", cp_axis=cp_axis)
+    params = model.init(jax.random.PRNGKey(0))
+    ecfg = EngineConfig(slots=2, token_budget=16, chunk=8, page=4,
+                        npages=npages, max_steps=600, temperature=0.0)
+    eng = ServingEngine(model, params, ecfg, use_pallas=False)
+    rng = np.random.default_rng(0)
+    reqs = [Request(rid=0, prompt=rng.integers(1, 127, 30, np.int32),
+                    max_new=10, arrival=0),
+            Request(rid=1, prompt=rng.integers(1, 127, 7, np.int32),
+                    max_new=6, arrival=0)]
+    done = {}
+    eng.on_complete = lambda req, slot: done.setdefault(
+        req.rid, list(req.generated)) or True
+    eng.run(reqs)
+    return eng, done
+
+# the long request needs 10 pages: > one 6-page shard pool, <= the
+# 12-page cp=2 total — admission is the capability under test
+eng_cp, done_cp = run(mesh_cp, "cpx", 6)
+assert isinstance(eng_cp.pool, CpPagePool), type(eng_cp.pool)
+_, done_1 = run(mesh_1, None, 12)
+assert set(done_cp) == {0, 1} == set(done_1), (done_cp, done_1)
+mism = sum(done_cp[r] != done_1[r] for r in done_cp)
+assert mism == 0, (
+    f"long-context smoke: {mism} token-stream mismatches vs the "
+    f"single-pool oracle")
+refs = int(np.asarray(eng_cp.pool.refs).sum())
+assert refs == 0, f"long-context smoke: {refs} leaked page refs"
+assert len(eng_cp.pool.free) + len(eng_cp.pool._reclaim) \
+    == eng_cp.pool.npages, "long-context smoke: pool accounting leak"
+print(f"long-context smoke: 10-page request admitted on cp=2 "
+      f"(6-page shards), 0 mismatches across {len(done_cp)} requests, "
+      f"0 leaked pages")
+EOF

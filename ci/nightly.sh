@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# nightly: the checks too slow for ci/fast.sh's inner loop but cheap
+# enough to run unattended once a day.
+#
+# Today that is the EXHAUSTIVE serving-protocol model check (ISSUE 20
+# satellite): `--serving-states 0` lifts the per-commit state cap so
+# the bounded BFS walks the ENTIRE reachable graph of the abstract
+# fleet — tractable because `_World.key()` canonicalizes page ids
+# (states identical up to a shard-preserving page relabeling share one
+# key), ~43k states / ~340k transitions in under a minute. The run
+# must come back with the HONEST "exhaustive" label (and the --json
+# `complete: true` field); a capped control run must come back
+# "state-capped" — a labeling bug that reports a truncated exploration
+# as exhaustive would quietly void the nightly's whole point.
+#
+#   ci/nightly.sh            # the nightly gate
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# 1. uncapped production exploration: clean AND labeled exhaustive
+out=$(JAX_PLATFORMS=cpu python -m triton_distributed_tpu.analysis.lint \
+  --serving --serving-states 0 2>&1)
+echo "$out"
+case "$out" in
+  *"(exhaustive)"*" 0 error(s), 0 warning(s)"*) ;;
+  *) echo "nightly: uncapped servlint run is not clean-and-exhaustive" >&2
+     exit 1 ;;
+esac
+
+# 2. the same, through --json: header must carry complete=true
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import subprocess
+import sys
+
+proc = subprocess.run(
+    [sys.executable, "-m", "triton_distributed_tpu.analysis.lint",
+     "--serving", "--serving-states", "0", "--json"],
+    capture_output=True, text=True)
+assert proc.returncode == 0, proc.stderr
+header = json.loads(proc.stdout.splitlines()[0])
+assert header["mode"] == "serving"
+assert header["complete"] is True, header
+assert header["states"] > 20_000, header
+print(f"nightly: exhaustive servlint json complete=true at "
+      f"{header['states']} states / {header['transitions']} "
+      f"transitions")
+EOF
+
+# 3. honest-label control: a capped run must say so
+out=$(JAX_PLATFORMS=cpu python -m triton_distributed_tpu.analysis.lint \
+  --serving --serving-states 500 2>&1)
+case "$out" in
+  *"(state-capped)"*) echo "nightly: capped control labeled state-capped" ;;
+  *) echo "nightly: capped control run did not label itself state-capped:" >&2
+     echo "$out" >&2
+     exit 1 ;;
+esac
+
+# 4. the cp-shard facet's clean half, also uncapped: the sharded pool
+# (CpPagePool ownership routing) explored to completion
+JAX_PLATFORMS=cpu python - <<'EOF'
+from triton_distributed_tpu.analysis import servlint
+
+findings, stats = servlint.lint_serving(servlint.CpProtocolOps(),
+                                        max_states=0)
+assert findings == [], [f.format() for f in findings]
+assert stats["complete"] is True, stats
+print(f"nightly: cp-facet exploration exhaustive and clean at "
+      f"{stats['states']} states / {stats['transitions']} transitions")
+EOF
+
+echo "nightly: all gates passed"

@@ -1,0 +1,379 @@
+"""ISSUE-20 long-context serving suite: context-parallel decode over
+the paged KV pool with the cross-rank LSE-combine.
+
+Four layers of the tentpole, pinned end to end:
+
+* **kernel** — the TOPO_CP row kind: a cp rank's shard-local pool walk
+  with the frontier shifted right by ``aux`` tokens, Pallas vs the XLA
+  twin, and the shard decomposition (per-shard partials merged by
+  ``combine_gqa_partials``) vs one full-length causal run;
+* **engine** — a cp=2 :class:`ServingEngine` whose page need EXCEEDS
+  one per-shard pool is admitted and produces token streams
+  byte-identical to a single-pool oracle — under chunked prefill,
+  eviction-under-pressure, int8 KV wire and a tp×cp mesh;
+* **wire analysis** — the ``cp_decode.lse_combine`` family lints clean
+  at mesh 4 and 8 including inferred contracts, and servlint's cp
+  facet (sharded pool, production verbs) explores clean while the
+  seeded wrong-shard-free fixture is caught by SV001;
+* **fleet/pricing** — the router places long requests only on
+  cp-capable replicas and refuses loudly with the perf-model-priced
+  reason (``cp_decode_step_ms`` vs the flat single-slice walk).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+from triton_distributed_tpu.analysis import servlint
+from triton_distributed_tpu.analysis.lint import lint_family
+from triton_distributed_tpu.kernels.flash_decode import (
+    NEG_INF,
+    combine_gqa_partials,
+)
+from triton_distributed_tpu.kernels.ragged_paged_attention import (
+    cp_topology_row,
+    pack_gqa_rows,
+    ragged_paged_attention,
+    ragged_paged_attention_xla,
+    topo_width,
+)
+from triton_distributed_tpu.models import Transformer, TransformerConfig
+from triton_distributed_tpu.serving.engine import (
+    EngineConfig,
+    Request,
+    ServingEngine,
+)
+from triton_distributed_tpu.serving.fleet import ServingFleet
+from triton_distributed_tpu.serving.state import CpPagePool
+from triton_distributed_tpu.tune import perf_model as pm
+from triton_distributed_tpu.tune.schedule import (
+    GridSchedule,
+    price_grid_schedule,
+)
+
+pytestmark = pytest.mark.fast
+
+PAGE = 4
+
+
+def _tcfg(kv_quant=None):
+    return TransformerConfig(
+        vocab=128, n_layers=2, hidden=64, ffn=128,
+        n_heads=4, n_kv_heads=2, head_dim=16, kv_quant=kv_quant,
+    )
+
+
+def _mesh_tp_cp():
+    devs = np.asarray(jax.devices()[:4]).reshape(2, 2)
+    return Mesh(devs, ("x", "cpx"))
+
+
+def _mesh_cp_only():
+    devs = np.asarray(jax.devices()[:2]).reshape(1, 2)
+    return Mesh(devs, ("x", "cpx"))
+
+
+def _mesh_tp():
+    return Mesh(np.asarray(jax.devices()[:2]), ("x",))
+
+
+def _engine(mesh, cp_axis, npages, *, kv_quant=None, slots=2,
+            budget=16, chunk=8):
+    model = Transformer(_tcfg(kv_quant), mesh, tp_axis="x",
+                        cp_axis=cp_axis)
+    params = model.init(jax.random.PRNGKey(0))
+    cfg = EngineConfig(slots=slots, token_budget=budget, chunk=chunk,
+                       page=PAGE, npages=npages, max_steps=800,
+                       temperature=0.0)
+    return ServingEngine(model, params, cfg, use_pallas=False)
+
+
+def _requests():
+    """A long request needing 10 pages (> one 6-page shard pool but
+    <= the 12-page cp=2 total) and a short fully-shard-resident one.
+    The 30-token prompt prefills in four chunk=8 pieces."""
+    rng = np.random.default_rng(0)
+    return [
+        Request(rid=0, prompt=rng.integers(1, 127, 30, np.int32),
+                max_new=10, arrival=0),
+        Request(rid=1, prompt=rng.integers(1, 127, 7, np.int32),
+                max_new=6, arrival=0),
+    ]
+
+
+def _run(eng):
+    done = {}
+    eng.on_complete = lambda req, slot: done.setdefault(
+        req.rid, list(req.generated)) or True
+    eng.run(_requests())
+    return done
+
+
+def _assert_drained(pool):
+    assert int(np.asarray(pool.refs).sum()) == 0
+    assert len(pool.free) + len(pool._reclaim) == pool.npages
+
+
+@pytest.fixture(scope="module")
+def oracle_streams():
+    """Single-pool (cp-free) oracle token streams for ``_requests``."""
+    return _run(_engine(_mesh_tp(), None, 12))
+
+
+class TestCpDecodeExactness:
+    def test_long_request_exceeds_one_pool_token_exact(
+            self, oracle_streams):
+        eng = _engine(_mesh_tp_cp(), "cpx", 6)
+        assert isinstance(eng.pool, CpPagePool)
+        assert eng.pool.npages == 12          # 2 shards x 6
+        done = _run(eng)
+        assert done == oracle_streams
+        # the long request really crossed a shard boundary
+        assert -(-(30 + 10) // PAGE) == 10 > 6
+        _assert_drained(eng.pool)
+
+    def test_eviction_mid_decode_stays_exact(self, oracle_streams):
+        """10 total pages against a 14-page working set: the scheduler
+        must evict mid-decode and recompute — greedy streams stay
+        byte-identical to the pressure-free oracle."""
+        eng = _engine(_mesh_tp_cp(), "cpx", 5)
+        done = _run(eng)
+        assert eng.stats.evictions > 0
+        assert done == oracle_streams
+        _assert_drained(eng.pool)
+
+    def test_int8_kv_exact(self):
+        """int8 KV wire: page-local quantization is identical across
+        pool layouts, so cp=2 still matches its int8 oracle exactly."""
+        oracle = _run(_engine(_mesh_tp(), None, 12, kv_quant="int8"))
+        eng = _engine(_mesh_tp_cp(), "cpx", 6, kv_quant="int8")
+        done = _run(eng)
+        assert done == oracle
+        _assert_drained(eng.pool)
+
+    def test_cp_without_tp_token_exact(self, oracle_streams):
+        """A pure cp mesh (tp=1) — cp is orthogonal to head sharding."""
+        eng = _engine(_mesh_cp_only(), "cpx", 6)
+        assert eng.model.cp == 2 and eng.model.tp == 1
+        assert _run(eng) == oracle_streams
+        _assert_drained(eng.pool)
+
+    def test_cp_rejects_prefix_share_and_speculation(self):
+        model = Transformer(_tcfg(), _mesh_tp_cp(), tp_axis="x",
+                            cp_axis="cpx")
+        params = model.init(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="context-parallel"):
+            ServingEngine(
+                model, params,
+                EngineConfig(slots=2, token_budget=16, chunk=8,
+                             page=PAGE, npages=6, prefix_cache=True,
+                             prefix_share=True),
+                use_pallas=False)
+
+
+class TestCpKernelTopology:
+    HKV, G, D, PPS = 2, 2, 32, 4
+    KPAGE = 8
+
+    def _pool(self, rng, npages):
+        k = jnp.asarray(rng.standard_normal(
+            (npages, self.HKV, self.KPAGE, self.D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal(
+            (npages, self.HKV, self.KPAGE, self.D)), jnp.float32)
+        return k, v
+
+    def test_shard_decomposition_matches_full_causal(self):
+        """kv=37 split as shard0=24 (shift 13) + shard1=13 (shift 0) +
+        an empty shard: the LSE-combined per-shard partials equal one
+        full-length causal decode, and the empty shard's lse is
+        NEG_INF (zero combine weight)."""
+        rng = np.random.default_rng(3)
+        kpool, vpool = self._pool(rng, 8)
+        kv = 37
+        q = pack_gqa_rows(jnp.asarray(
+            rng.standard_normal((8, self.HKV * self.G, self.D)),
+            jnp.float32), self.HKV)
+        width = topo_width(8)
+
+        def run(kv_len, table, topo):
+            return ragged_paged_attention_xla(
+                q, kpool, vpool,
+                jnp.asarray([kv_len], jnp.int32),
+                jnp.asarray([1], jnp.int32),
+                jnp.asarray([0], jnp.int32),
+                jnp.asarray([table], jnp.int32),
+                group=self.G, topologies=topo)
+
+        full_t = [0, 1, 2, 3, 4]                    # 37 tokens, 5 pages
+        out_ref, _ = run(kv, full_t, None)
+
+        shards = [(24, [0, 1, 2, -1, -1], 13),      # covered: shift 13
+                  (13, [3, 4, -1, -1, -1], 0),      # frontier: causal
+                  (0, [0, -1, -1, -1, -1], 0)]      # past the data
+        outs, lses = [], []
+        for kv_len, table, shift in shards:
+            topo = np.stack([cp_topology_row(shift, width)])
+            o, l = run(kv_len, table, topo)
+            outs.append(o)
+            lses.append(l)
+        assert bool((lses[2][:, :self.G] <= NEG_INF / 2).all())
+        merged, _ = combine_gqa_partials(
+            jnp.stack(outs), jnp.stack(lses))
+        np.testing.assert_allclose(
+            np.asarray(merged[:, :self.G]),
+            np.asarray(out_ref[:, :self.G]), atol=2e-5, rtol=2e-5)
+
+    def test_cp_rows_pallas_matches_xla(self):
+        """The TOPO_CP mask inside the Pallas kernel (interpreted)
+        against the dense twin: one fully-covered shard row (shift >=
+        q_len) and one frontier row (shift 0) in a single launch."""
+        rng = np.random.default_rng(4)
+        kpool, vpool = self._pool(rng, 16)
+        q = pack_gqa_rows(jnp.asarray(
+            rng.standard_normal((16, self.HKV * self.G, self.D)),
+            jnp.float32), self.HKV)
+        width = topo_width(8)
+        topo = np.stack([cp_topology_row(13, width),
+                         cp_topology_row(0, width)])
+        args = (
+            q, kpool, vpool,
+            jnp.asarray([24, 13], jnp.int32),    # kv_lens
+            jnp.asarray([1, 1], jnp.int32),      # q_lens
+            jnp.asarray([0, 8], jnp.int32),      # q_starts
+            jnp.asarray([[0, 1, 2, -1], [3, 4, -1, -1]], jnp.int32),
+        )
+        out_p, lse_p = ragged_paged_attention(
+            *args, group=self.G, topologies=topo, block_q=8)
+        out_x, lse_x = ragged_paged_attention_xla(
+            *args, group=self.G, topologies=topo)
+        for r, start in ((0, 0), (1, 8)):
+            s = slice(start * self.G, start * self.G + self.G)
+            np.testing.assert_allclose(
+                np.asarray(out_p[:, s]), np.asarray(out_x[:, s]),
+                atol=2e-5, rtol=2e-5)
+            np.testing.assert_allclose(
+                np.asarray(lse_p[:, s]), np.asarray(lse_x[:, s]),
+                atol=2e-5, rtol=2e-5)
+
+
+class TestCpCombineWireAnalysis:
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_family_lints_clean(self, n):
+        assert lint_family("cp_decode.lse_combine", n) == []
+
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_family_contracts_inferable(self, n):
+        assert lint_family("cp_decode.lse_combine", n,
+                           infer_contracts=True) == []
+
+    def test_servlint_cp_facet(self):
+        """The sharded-pool clean half explores clean; the seeded
+        wrong-shard free is caught by SV001 with a minimal repro."""
+        findings, _ = servlint.lint_serving(
+            servlint.CpProtocolOps(), max_states=1500)
+        assert findings == []
+        findings, _ = servlint.lint_serving(fixture="SV001cp",
+                                            max_states=4000)
+        assert [f.rule for f in findings] == ["SV001"]
+        assert "repro:" in findings[0].message
+
+
+class TestLongContextPlacement:
+    def _fleet(self, with_cp):
+        replicas = [_engine(_mesh_tp(), None, 6)]
+        if with_cp:
+            replicas.append(_engine(_mesh_tp_cp(), "cpx", 6))
+        return ServingFleet(replicas, seed=0)
+
+    def test_long_request_lands_on_cp_replica(self):
+        fleet = self._fleet(with_cp=True)
+        for r in _requests():
+            fleet.submit(r)
+        stats = fleet.run(max_ticks=800)
+        assert stats.completed == 2
+        assert stats.long_context_refusals == []
+        # the 10-page request can only have landed on replica 1
+        assert stats.routed.get(1, 0) >= 1
+
+    def test_refusal_priced_when_no_cp_replica(self):
+        fleet = self._fleet(with_cp=False)
+        reqs = _requests()
+        for r in reqs:
+            fleet.submit(r)
+        stats = fleet.run(max_ticks=800)
+        assert len(stats.long_context_refusals) == 1
+        rid, reason = stats.long_context_refusals[0]
+        assert rid == 0
+        for token in ("cp=", "ms/step", "LSE-combine"):
+            assert token in reason, reason
+        long_req = reqs[0]
+        assert long_req.done and long_req.refusal == reason
+        # the short request still completed normally
+        assert stats.records[1]["completion_tick"] is not None
+        assert any(ev[0] == "long_context_refusal"
+                   for ev in stats.events)
+
+    def test_replica_fits_context(self):
+        eng = _engine(_mesh_tp(), None, 6)
+        fleet = ServingFleet([eng], seed=0)
+        rep = fleet.replicas[0]
+        assert rep.cp == 1
+        ok, too_long = _requests()[1], _requests()[0]
+        assert rep.fits_context(ok)
+        assert not rep.fits_context(too_long)
+
+
+class TestCpPerfModel:
+    KW = dict(page=16, hkv=8, g=8, d=128, hidden=4096, n_layers=32)
+
+    def test_cp1_degenerates_to_flat_walk(self):
+        flat = pm.ragged_serving_step_ms([4096], [1], **self.KW)
+        assert pm.cp_decode_step_ms(4096, cp=1, **self.KW) == flat
+
+    def test_crossover_long_wins_short_pays_hop_tax(self):
+        long, short = 512 * 1024, 128
+        assert (pm.cp_decode_step_ms(long, cp=8, **self.KW)
+                < pm.cp_decode_step_ms(long, cp=1, **self.KW))
+        assert (pm.cp_decode_step_ms(short, cp=8, **self.KW)
+                > pm.cp_decode_step_ms(short, cp=1, **self.KW))
+
+    def test_refuse_long_context_contract(self):
+        cfg = _tcfg()
+        fits = pm.refuse_long_context(
+            cfg, PAGE, 5, pool_pages=6, pages_per_seq=12)
+        assert fits is None
+        reason = pm.refuse_long_context(
+            cfg, PAGE, 10, pool_pages=6, pages_per_seq=12)
+        assert reason is not None
+        for token in ("10 KV pages", "cp=2", "ms/step",
+                      "LSE-combine", "cp-capable"):
+            assert token in reason, reason
+        # a request deeper than 2x the shard prices a deeper cp
+        deep = pm.refuse_long_context(
+            cfg, PAGE, 23, pool_pages=6, pages_per_seq=64)
+        assert "cp=4" in deep
+
+
+class TestChunkTrafficKey:
+    def test_engine_grid_key_carries_chunk(self):
+        eng = _engine(_mesh_tp(), None, 12, chunk=8)
+        key = eng._grid_key
+        assert len(key) == 9
+        assert key[5] == PAGE and key[6] == 8
+
+    def test_pricer_chunk_tail_pad_term(self):
+        """The same geometry at a different prefill chunk prices
+        differently under a pinned block_q — chunk 33 wastes a near-
+        full 32-row block per prefill row, chunk 64 wastes none."""
+        geom = (8, 128, 2, 4, 128, 16)
+        sched = GridSchedule(block_q=32)
+        base = price_grid_schedule(
+            "flash_decode.ragged_paged", sched, shape=geom)
+        aligned = price_grid_schedule(
+            "flash_decode.ragged_paged", sched, shape=geom + (64,))
+        ragged = price_grid_schedule(
+            "flash_decode.ragged_paged", sched, shape=geom + (33,))
+        assert aligned == base          # zero pad: term vanishes
+        assert ragged > aligned         # 31 wasted q rows per prefill

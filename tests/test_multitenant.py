@@ -212,6 +212,9 @@ class _StubReplica:
     def can_accept(self, req):
         return self._room
 
+    def fits_context(self, req):
+        return True
+
 
 class _StubLedger:
     def __init__(self, states=None):

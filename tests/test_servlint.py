@@ -47,7 +47,9 @@ class TestFixturesAreTruePositives:
     def test_fixture_caught_by_exactly_its_rule(self, rule):
         findings, stats = servlint.lint_serving(fixture=rule,
                                                 max_states=20_000)
-        assert [f.rule for f in findings] == [rule], (
+        # faceted keys (e.g. SV001cp) seed their base rule
+        want = servlint.FIXTURES[rule].seeds_rule
+        assert [f.rule for f in findings] == [want], (
             f"fixture {rule} produced {[f.rule for f in findings]} "
             f"after {stats['states']} states")
         # the finding carries its minimal repro interleaving (BFS
@@ -56,11 +58,20 @@ class TestFixturesAreTruePositives:
 
     def test_fixture_rule_ids_cover_catalog(self):
         assert sorted(servlint.FIXTURES) == [
-            "SV001", "SV002", "SV003", "SV004", "SV005", "SV006",
-            "SV007"]
+            "SV001", "SV001cp", "SV002", "SV003", "SV004", "SV005",
+            "SV006", "SV007"]
         for rule, cls in servlint.FIXTURES.items():
-            assert cls.seeds_rule == rule
+            # a fixture key is its seeded rule plus an optional facet
+            # suffix (SV001cp seeds SV001 over a cp=2-sharded pool)
+            assert rule.startswith(cls.seeds_rule)
             assert issubclass(cls, ProtocolOps)
+
+    def test_cp_production_ops_clean(self):
+        # the cp facet's clean half: sharded pool, production verbs
+        findings, stats = servlint.lint_serving(
+            servlint.CpProtocolOps(), max_states=2000)
+        assert findings == []
+        assert stats["states"] >= 1000
 
     def test_unknown_fixture_refused(self):
         with pytest.raises(ValueError, match="unknown servlint"):
@@ -72,6 +83,15 @@ class TestServingCli:
         assert lint_main(["--serving", "--serving-states", "800"]) == 0
         err = capsys.readouterr().err
         assert "servlint:" in err and "0 error(s)" in err
+
+    def test_capped_run_labels_itself_honestly(self, capsys):
+        """A truncated exploration must SAY it was truncated — the
+        nightly's exhaustive claim rests on this label telling the
+        truth (ci/nightly.sh asserts the inverse, "exhaustive")."""
+        assert lint_main(["--serving", "--serving-states", "500"]) == 0
+        err = capsys.readouterr().err
+        assert "(state-capped)" in err
+        assert "(exhaustive)" not in err
 
     def test_fixture_exits_two(self, capsys):
         assert lint_main(["--serving-fixture", "SV004"]) == 2
@@ -101,3 +121,25 @@ class TestServingCli:
         out = capsys.readouterr().out
         # still printed, demoted to info — the SL/MC --allow contract
         assert "SV002 info" in out
+
+
+class TestExhaustiveNightly:
+    """The ci/nightly.sh gate in-process: ``--serving-states 0`` lifts
+    the cap and the BFS walks the ENTIRE reachable graph — tractable
+    because ``_World.key()`` canonicalizes page ids (shard-preserving
+    relabeling symmetry). Slow-marked: ~1 min of pure-python BFS."""
+
+    @pytest.mark.slow
+    def test_uncapped_production_exploration_is_exhaustive(self):
+        findings, stats = servlint.lint_serving(max_states=0)
+        assert findings == []
+        assert stats["complete"] is True
+        assert stats["states"] > 20_000
+
+    @pytest.mark.slow
+    def test_uncapped_cp_exploration_is_exhaustive(self):
+        findings, stats = servlint.lint_serving(
+            servlint.CpProtocolOps(), max_states=0)
+        assert findings == []
+        assert stats["complete"] is True
+        assert stats["states"] > 20_000

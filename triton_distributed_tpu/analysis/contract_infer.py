@@ -352,6 +352,26 @@ def _h_grad_ring(twin, n):
                        "replicated psum = exact x n fold")
 
 
+def _h_cp_decode(twin, n):
+    # cp_lse_combine_xla shards its stacked slab operand over the cp
+    # axis (in_specs P(axis)); rank r's whole contribution slab carries
+    # tag r, so every reduced destination element must decode to the
+    # full-mesh fold — a dropped rank is a token decoded against a
+    # silently missing KV shard
+    mesh = _mesh(n)
+    m = 8
+    x = (np.repeat(_tags(n), n * m)[:, None]
+         * np.ones((1, 128), np.float32)).astype(np.float32)
+    out = twin(x, mesh, "x")
+    cls = _decode_class(out, n)
+    if cls != FOLD:
+        raise ValueError(f"cp decode combine twin decoded as {cls}")
+    if not np.allclose(np.asarray(out), _tags(n).sum()):
+        raise ValueError("cp decode combine twin missed a contribution")
+    return TwinProfile(FOLD, "all", True,
+                       "one weighted partial folded per cp rank")
+
+
 def _h_ragged_local(twin, n):
     # a per-rank function: no mesh/axis operand at all. Execute at the
     # registry's lint geometry on one device so path rot still fails
@@ -402,6 +422,8 @@ _HARNESSES = {
     "dense_attention_reference": _h_cp_attention,
     "triton_distributed_tpu.train.grad_wire.grad_allreduce_xla":
         _h_grad_ring,
+    "triton_distributed_tpu.kernels.flash_decode.cp_lse_combine_xla":
+        _h_cp_decode,
     "triton_distributed_tpu.kernels.ragged_paged_attention."
     "ragged_paged_attention_xla": _h_ragged_local,
 }
@@ -420,6 +442,8 @@ _STATIC_CLASS = {
     "triton_distributed_tpu.kernels.ring_attention."
     "dense_attention_reference": (SINGLE, "all"),
     "triton_distributed_tpu.train.grad_wire.grad_allreduce_xla":
+        (FOLD, "all"),
+    "triton_distributed_tpu.kernels.flash_decode.cp_lse_combine_xla":
         (FOLD, "all"),
     "triton_distributed_tpu.kernels.ragged_paged_attention."
     "ragged_paged_attention_xla": (LOCAL, None),

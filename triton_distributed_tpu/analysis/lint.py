@@ -261,7 +261,10 @@ def main(argv=None) -> int:
     ap.add_argument("--serving-states", type=int, default=6000,
                     metavar="N",
                     help="distinct-state cap for the --serving "
-                    "exploration (default 6000)")
+                    "exploration (default 6000; 0 = uncapped, the "
+                    "nightly exhaustive run — the human label and "
+                    "--json 'complete' field then report whether the "
+                    "full reachable graph was walked)")
     ap.add_argument("--list", action="store_true",
                     help="list registered kernel families and exit")
     args = ap.parse_args(argv)

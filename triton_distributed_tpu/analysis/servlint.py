@@ -61,7 +61,7 @@ exploration affordable.
 
 from __future__ import annotations
 
-from collections import OrderedDict, deque
+from collections import deque
 
 import numpy as np
 
@@ -101,8 +101,21 @@ class _HostShell(ServingEngine):
         self.ops = ops
         self.state = _StateStub()
         self.table = np.full((_CFG.slots, _PAGES_PER_SEQ), -1, np.int32)
-        self.pool = PagePool(_CFG.npages, _CFG.page,
-                             prefix_cache=_CFG.prefix_cache)
+        # cp-shard facet: ops carrying ``cp = k`` (CpProtocolOps, the
+        # SV001cp fixture) run the SAME verbs over a cp-sharded pool —
+        # same total pages, same table width, so the explored state
+        # space stays comparable while every alloc/release/lookup now
+        # exercises the shard-ownership routing
+        cp = int(getattr(ops, "cp", 1))
+        if cp > 1:
+            from triton_distributed_tpu.serving.state import CpPagePool
+
+            self.pool = CpPagePool(
+                cp, _CFG.npages // cp, _CFG.page,
+                _PAGES_PER_SEQ // cp, prefix_cache=_CFG.prefix_cache)
+        else:
+            self.pool = PagePool(_CFG.npages, _CFG.page,
+                                 prefix_cache=_CFG.prefix_cache)
         self.slot_req = [None] * _CFG.slots
         self.pending: deque = deque()
         self.waiting: deque = deque()
@@ -127,16 +140,7 @@ class _HostShell(ServingEngine):
         c.ops = self.ops
         c.state = self.state
         c.table = self.table.copy()
-        pool = PagePool.__new__(PagePool)
-        pool.npages = self.pool.npages
-        pool.page = self.pool.page
-        pool.prefix_cache = self.pool.prefix_cache
-        pool.refs = self.pool.refs.copy()
-        pool.free = list(self.pool.free)
-        pool._by_hash = dict(self.pool._by_hash)
-        pool._hash_of = dict(self.pool._hash_of)
-        pool._reclaim = OrderedDict(self.pool._reclaim)
-        c.pool = pool
+        c.pool = self.pool.clone()
         c.slot_req = [None if r is None else reqs[r.rid]
                       for r in self.slot_req]
         c.pending = deque(reqs[r.rid] for r in self.pending)
@@ -234,32 +238,88 @@ class _World:
     def routable(self):
         return [k for k in self.alive() if k not in self.draining]
 
+    def _page_renames(self):
+        """Per-engine canonical page renaming — the symmetry reduction
+        that makes the uncapped nightly exploration terminate. Page ids
+        are opaque handles: every verb is equivariant under a per-pool
+        relabeling (a CpPagePool relabeling must additionally preserve
+        each page's SHARD, since ownership routing reads
+        ``pg // npages_shard``), so states identical up to renaming
+        have isomorphic futures and may share one key. The map assigns
+        ids in first-appearance order over a deterministic traversal —
+        block-table rows, in-flight ship pins (sorted by their
+        id-independent coordinates), the free list, then any leaked
+        straggler by original id — restarting the numbering at each
+        shard base so the relabeling is shard-preserving."""
+        maps = []
+        for k, e in enumerate(self.engines):
+            pool = e.pool
+            nps = getattr(pool, "npages_shard", pool.npages)
+            ren: dict = {}
+            nxt: dict = {}
+
+            def visit(pg, ren=ren, nxt=nxt, nps=nps):
+                pg = int(pg)
+                if pg < 0 or pg in ren:
+                    return
+                sh = pg // nps
+                ren[pg] = sh * nps + nxt.get(sh, 0)
+                nxt[sh] = nxt.get(sh, 0) + 1
+
+            for pg in e.table.flat:
+                visit(pg)
+            for s in sorted(self.ships, key=lambda s: (
+                    s.rid, s.src, s.pslot, s.dst, s.dslot)):
+                if s.src == k:
+                    for pg in s.src_pids:
+                        visit(pg)
+                if s.dst == k:
+                    for pg in s.dpids:
+                        visit(pg)
+            for pg in pool.free:
+                visit(pg)
+            for pg in range(pool.npages):
+                visit(pg)
+            maps.append(ren)
+        return maps
+
     def key(self):
         """Canonical hashable state (counters/stats excluded — they
-        grow without bound and never feed a scheduling decision)."""
+        grow without bound and never feed a scheduling decision; page
+        ids canonicalized via :meth:`_page_renames`)."""
         reqs = tuple(
             (rid, r.cursor, len(r.generated), r.parked, r.done)
             for rid, r in sorted(self.requests.items()))
+        maps = self._page_renames()
         engs = []
         for k, e in enumerate(self.engines):
             if k in self.dead:
                 engs.append("dead")
                 continue
+            ren = maps[k]
+            inv = {v: o for o, v in ren.items()}
             engs.append((
                 k in self.draining,
                 tuple(None if r is None else r.rid
                       for r in e.slot_req),
-                tuple(int(p) for p in e.table.flat),
-                tuple(e.pool.free),
-                tuple(int(x) for x in e.pool.refs),
-                tuple(sorted(int(p) for p in e.pool._reclaim)),
-                tuple(sorted(e.pool._hash_of.items())),
+                tuple(ren[int(p)] if int(p) >= 0 else -1
+                      for p in e.table.flat),
+                tuple(ren[int(p)] for p in e.pool.free),
+                tuple(int(e.pool.refs[inv[i]])
+                      for i in range(e.pool.npages)),
+                tuple(sorted(ren[int(p)] for p in e.pool._reclaim)),
+                tuple(sorted((ren[int(p)], h)
+                             for p, h in e.pool._hash_of.items())),
                 tuple(r.rid for r in e.waiting),
                 tuple(r.rid for r in e.pending),
             ))
+        ships = tuple(sorted(
+            (s.rid, s.src, s.pslot, s.dst, s.dslot,
+             tuple(maps[s.dst][int(p)] for p in s.dpids),
+             tuple(maps[s.src][int(p)] for p in s.src_pids))
+            for s in self.ships))
         return (reqs, tuple(engs),
-                tuple(r.rid for r in self.queue),
-                tuple(sorted(s.key() for s in self.ships)))
+                tuple(r.rid for r in self.queue), ships)
 
 
 # ------------------------------------------------------------ transitions
@@ -662,12 +722,15 @@ def explore(ops: ProtocolOps | None = None, *,
     """Exhaustive bounded BFS over the abstract fleet driven by
     ``ops`` (production :class:`ProtocolOps` by default). Stops at the
     FIRST finding (BFS order makes its repro interleaving minimal) or
-    when the reachable graph — capped at ``max_states`` — is
+    when the reachable graph — capped at ``max_states``; pass
+    ``max_states <= 0`` for an uncapped (truly exhaustive) run — is
     exhausted. Returns ``(findings, stats)`` where stats carries
     ``states`` (distinct states visited), ``transitions`` (edges
     executed) and ``complete`` (True when the full reachable graph fit
     under the cap)."""
     ops = ops if ops is not None else ProtocolOps()
+    if max_states <= 0:                     # 0 = uncapped (nightly CI)
+        max_states = float("inf")
     root = _World(ops)
     f = _check_state({}, root, None)
     if f is not None:
@@ -808,6 +871,34 @@ class _EagerCommit(ProtocolOps):
         self.release_parked(src_eng, pslot)
 
 
+class CpProtocolOps(ProtocolOps):
+    """Production verbs over a cp=2-sharded page pool — the cp-shard
+    ownership facet's CLEAN half. Every alloc routes by logical page
+    index and every release/register by global page id; the bounded
+    exploration proves the routing keeps SV001/SV002 across shards
+    (no page stranded on, or double-freed from, the wrong shard)."""
+
+    cp = 2
+
+
+class _CpWrongShardFree(CpProtocolOps):
+    """cp facet true positive (SV001): ``free_slot`` releases only the
+    pages the FIRST cp shard owns — the bug a cp port keeps when its
+    teardown loop still iterates the single-pool id space. A long
+    row's cross-shard tail (the pages the sharded pool parked on
+    shard 1) keeps its refcount with no table referent."""
+
+    seeds_rule = "SV001"
+
+    def free_slot(self, eng, slot):
+        pool = eng.pool
+        for pg in eng.table[slot]:
+            if pg >= 0 and pool.shard_of(int(pg)) == 0:  # BUG: shard 0 only
+                pool.release(int(pg))
+        eng.table[slot] = -1
+        eng.slot_req[slot] = None
+
+
 class _NeverAdmit(ProtocolOps):
     """SV007: admission sorts the queue and admits nothing."""
 
@@ -823,9 +914,13 @@ class _NeverAdmit(ProtocolOps):
             key=lambda r: (eng._eff_rank(r), r.arrival, r.rid)))
 
 
-#: rule id -> mutated-ops factory (the seeded true positives)
+#: rule id -> mutated-ops factory (the seeded true positives). Keys
+#: are the seeded RULE with an optional facet suffix: ``SV001cp`` is
+#: the cp-shard ownership facet, caught by SV001 over a cp=2-sharded
+#: pool (its clean twin is :class:`CpProtocolOps`).
 FIXTURES = {
     "SV001": _LeakOnFree,
+    "SV001cp": _CpWrongShardFree,
     "SV002": _DoubleFree,
     "SV003": _EvictParked,
     "SV004": _DropOnKill,

@@ -25,6 +25,17 @@ on-TPU fast path; production dev-box steps ride the XLA bodies):
   ``train.grad_wire``'s EF reduce-scatter. Schedule depth 2/3 executes;
   the mutated ``scale_rail="payload"`` candidate ships scales on the
   payload's semaphore — the SL009 torn-scale hazard.
+* ``cp_decode.lse_combine`` (collective id 18) — the long-context
+  decode merge: each cp rank's paged-attention partial rides the ring
+  as exp-weighted numerator rows (``w_r·out_r``) plus an additive
+  denominator row (``Σ w_r`` under the pre-agreed running max), so the
+  cross-rank softmax merge of ``flash_decode.combine_partials`` is
+  EXACTLY an add-reduce over ranks and the hop protocol is
+  :func:`~triton_distributed_tpu.kernels.ring.reduce_ring` on the raw
+  f32 wire (no quantization — the denominator row must fold exactly or
+  the normalize at the owner rank drifts). The XLA body serving
+  actually runs is ``flash_decode.cp_lse_combine_xla``; this twin puts
+  the reduce PROTOCOL under lint with a fold-class delivery contract.
 
 The collective ids are shared with the XLA bodies' heartbeat
 instrumentation (``ring_attention.RING_ATTENTION_COLLECTIVE_ID`` etc.)
@@ -52,6 +63,7 @@ CP_RING_GEOM = dict(rows=8, cols=128, grad_cols=2048)
 CP_RING_COLLECTIVE_ID = 15
 CP_ULYSSES_COLLECTIVE_ID = 16
 GRAD_RING_COLLECTIVE_ID = 17
+CP_DECODE_COMBINE_COLLECTIVE_ID = 18
 
 
 # ------------------------------------------------ cp.ring_attention (15)
@@ -286,4 +298,101 @@ def build_grad_ring_lint(mesh, n, token=(), schedule=None):
     return _build_grad_ring_w(
         mesh, "x", g["rows"] * n, g["grad_cols"], GRAD_RING_COLLECTIVE_ID,
         "int8", token, schedule,
+    )
+
+
+# --------------------------------------------- cp_decode.lse_combine (18)
+
+def _cp_lse_combine_kernel(
+    n, axis, mesh_axes, schedule,
+    x_hbm, out_hbm, w0, w1, r0, r1,
+    copy_sem, send_sem, recv_sem, ack_sem,
+):
+    """Cross-rank LSE-combine as an HBM-streaming add-reduce ring.
+
+    ``x_hbm`` rows ``[dst·m, (dst+1)·m)`` are this rank's exp-weighted
+    contribution to destination shard ``dst`` — numerator rows
+    ``w_r·out_r`` with the denominator row ``Σ w_r`` riding as the last
+    row of each block (the softmax merge is associative once every rank
+    weights against the pre-agreed max, so the ring core is a plain
+    add). The wire stays f32: a quantized denominator row would drift
+    the owner rank's final normalize. Fold provenance is the streamed
+    two-operand add (``ew_add_pipeline``) — the evidence SL008 replays."""
+    from triton_distributed_tpu.kernels.gemm_rs import ew_add_pipeline
+    from triton_distributed_tpu.kernels.ring import reduce_ring
+
+    m = out_hbm.shape[0]
+
+    def partial_into(dst, dst_ref):
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(dst * m, m)], dst_ref, copy_sem
+        )
+        cp.start()
+        cp.wait()
+
+    reduce_ring(
+        n, axis, mesh_axes, out_hbm, (w0, w1), (r0, r1),
+        send_sem, recv_sem, ack_sem, partial_into,
+        ew_add_pipeline(m, out_hbm.shape[1], out_hbm.dtype.itemsize),
+        site="cp_decode", schedule=schedule,
+    )
+
+
+def _cp_lse_combine_kernel3(
+    n, axis, mesh_axes, schedule,
+    x_hbm, out_hbm, w0, w1, w2, r0, r1, r2,
+    copy_sem, send_sem, recv_sem, ack_sem,
+):
+    """Depth-3 twin of :func:`_cp_lse_combine_kernel` (schedule depth 3)."""
+    from triton_distributed_tpu.kernels.gemm_rs import ew_add_pipeline
+    from triton_distributed_tpu.kernels.ring import reduce_ring
+
+    m = out_hbm.shape[0]
+
+    def partial_into(dst, dst_ref):
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(dst * m, m)], dst_ref, copy_sem
+        )
+        cp.start()
+        cp.wait()
+
+    reduce_ring(
+        n, axis, mesh_axes, out_hbm, (w0, w1, w2), (r0, r1, r2),
+        send_sem, recv_sem, ack_sem, partial_into,
+        ew_add_pipeline(m, out_hbm.shape[1], out_hbm.dtype.itemsize),
+        site="cp_decode", schedule=schedule,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _build_cp_lse_combine(mesh, axis, rows, cols, collective_id, token=(),
+                          schedule=None):
+    del token
+    n = mesh.shape[axis]
+    d = 2 if schedule is None else int(schedule.depth)
+    slab = jax.ShapeDtypeStruct((rows // n, cols), jnp.float32)
+    kernel = _cp_lse_combine_kernel if d == 2 else _cp_lse_combine_kernel3
+    return lang.shmem_call(
+        functools.partial(kernel, n, axis, mesh.axis_names, schedule),
+        # ring slabs ride as extra ANY outputs (Mosaic has no HBM scratch)
+        out_shape=[slab] * (1 + 2 * d),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (1 + 2 * d),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((d,)),
+            pltpu.SemaphoreType.DMA((d,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        collective_id=collective_id,
+        name="cp_decode_lse_combine",
+    )
+
+
+def build_cp_lse_combine_lint(mesh, n, token=(), schedule=None):
+    """Registry/pre-flight entry for ``cp_decode.lse_combine``."""
+    g = CP_RING_GEOM
+    return _build_cp_lse_combine(
+        mesh, "x", g["rows"] * n, g["cols"],
+        CP_DECODE_COMBINE_COLLECTIVE_ID, token, schedule,
     )

@@ -1364,6 +1364,59 @@ def combine_partials(outs, lses, out_dtype=None):
     return merged.astype(out_dtype), lse
 
 
+def combine_gqa_partials(outs, lses, out_dtype=None):
+    """Merge cp-rank partials in the ragged-kernel layout.
+
+    outs: (R, Hkv, TG, D); lses: (R, Hkv, TG) — the (out, lse) pair
+    :func:`~triton_distributed_tpu.kernels.ragged_paged_attention.
+    ragged_paged_attention` returns, stacked along the cp axis. Same
+    softmax merge as :func:`combine_partials`; the explicit where()
+    guard keeps rows every shard masked out (all lses at NEG_INF —
+    padding tokens, empty shards) at exactly zero weight instead of
+    degenerating exp(NEG_INF − NEG_INF) to 1. For a row fully resident
+    on one shard the merge is the identity on that shard's out
+    (weights 1/1 in f32 — bit-exact through the round trip), which is
+    what makes short-request streams byte-identical to the cp-free
+    engine.
+    """
+    out_dtype = out_dtype or outs.dtype
+    lses = lses.astype(jnp.float32)
+    m = jnp.max(lses, axis=0, keepdims=True)                 # (1, Hkv, TG)
+    w = jnp.where(lses > NEG_INF / 2, jnp.exp(lses - m), 0.0)
+    denom = jnp.maximum(jnp.sum(w, axis=0), 1e-30)           # (Hkv, TG)
+    merged = jnp.einsum(
+        "rht,rhtd->htd", w, outs.astype(jnp.float32)
+    ) / denom[..., None]
+    lse = jnp.where(
+        jnp.max(lses, axis=0) > NEG_INF / 2,
+        m[0] + jnp.log(denom),
+        NEG_INF,
+    )
+    return merged.astype(out_dtype), lse
+
+
+def cp_lse_combine_xla(x, mesh, axis: str = "x"):
+    """XLA body of the cp-decode LSE-combine — the degradation target
+    declared for the ``cp_decode.lse_combine`` lint family.
+
+    ``x``: per-rank (n·m, cols) contribution slabs stacked along
+    ``axis`` (rows ``[dst·m, (dst+1)·m)`` = this rank's exp-weighted
+    partial for destination shard ``dst``: numerator rows ``w_r·out_r``
+    with the additive denominator row ``Σ w_r`` riding in the block —
+    the weighting against the pre-agreed running max makes the merge a
+    pure add over ranks, cf. :func:`combine_partials`). Returns each
+    rank's (m, cols) reduced destination shard — ``psum_scatter``, the
+    ring kernel's semantics on the raw f32 wire.
+    """
+    fn = jax.shard_map(
+        lambda s: jax.lax.psum_scatter(
+            s.astype(jnp.float32), axis, scatter_dimension=0, tiled=True
+        ),
+        mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False,
+    )
+    return jax.jit(fn)(x)
+
+
 def _local_shard_decode(
     q, k_shard, v_shard, global_kv_lens, axis, *,
     scale, soft_cap, block_k, use_pallas, kv_layout="bhsd", interpret=None,

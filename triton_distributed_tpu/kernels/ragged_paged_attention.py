@@ -95,9 +95,20 @@ NEG_INF = -1.0e30
 #   attend each other), or TOPO_SHARED_PREFIX (positions below
 #   ``aux = split`` tokens are a prefix page run ALIASED across rows'
 #   block tables; the mask itself stays causal — the aliasing is a
-#   table-level fact the engine's PagePool refcounts make safe).
+#   table-level fact the engine's PagePool refcounts make safe), or
+#   TOPO_CP (context-parallel KV shard: this row's pool walk covers one
+#   cp rank's CONTIGUOUS slice of a longer global sequence, and the
+#   causal frontier is shifted RIGHT by ``aux`` tokens — local position
+#   ``p`` is visible to q token ``t`` iff
+#   ``p < kv_len - q_len + t + 1 + aux`` AND ``p < kv_len``. The owner
+#   shard (the one holding the frontier) runs ``aux = 0`` ≡ causal;
+#   earlier, fully-covered shards run ``aux >= q_len`` and attend their
+#   whole slice; a shard past the data runs ``kv_len = 0`` and masks
+#   everything, so its LSE comes back NEG_INF and the cross-rank
+#   LSE-combine weighs it zero).
 # * ``aux``: TREE → occupied q positions (1 + draft nodes);
-#   SHARED_PREFIX → the shared-prefix split in tokens.
+#   SHARED_PREFIX → the shared-prefix split in tokens; CP → the
+#   frontier shift ``(global_kv - r·slice) - kv_len`` in tokens.
 # * ``parent[t]``: q position of t's tree parent (-1 for the frontier)
 #   — NOT read by the kernel (the anc bitmask is self-contained); it is
 #   the analysis cross-check the masked-coverage SL008 facet validates
@@ -111,6 +122,7 @@ NEG_INF = -1.0e30
 TOPO_CAUSAL = 0
 TOPO_TREE = 1
 TOPO_SHARED_PREFIX = 2
+TOPO_CP = 3
 TOPO_MAX_NODES = 31
 
 
@@ -159,6 +171,21 @@ def shared_prefix_topology_row(split: int, width: int):
     row = np.zeros((2 + 2 * width,), np.int32)
     row[0] = TOPO_SHARED_PREFIX
     row[1] = int(split)
+    return row
+
+
+def cp_topology_row(shift: int, width: int):
+    """One CP descriptor row. ``shift`` is the frontier shift in
+    tokens: for cp rank r over a slice of ``s_loc`` positions serving a
+    row at global length G, ``shift = max((G - r·s_loc) - kv_len, 0)``
+    where ``kv_len = clip(G - r·s_loc, 0, s_loc)`` is the rank's local
+    length — 0 on the shard that owns the frontier (pure causal),
+    ``>= q_len`` on fully-covered earlier shards."""
+    if shift < 0:
+        raise ValueError(f"cp frontier shift must be >= 0, got {shift}")
+    row = np.zeros((2 + 2 * width,), np.int32)
+    row[0] = TOPO_CP
+    row[1] = int(shift)
     return row
 
 
@@ -351,6 +378,7 @@ def _ragged_kernel(
             # the vector-indexed gather Mosaic rejects (MC006) is
             # exactly what this unroll avoids.
             kind = topo_ref[r, 0]
+            aux = topo_ref[r, 1]
             anc_col = jnp.zeros((rows, 1), jnp.int32)
             for t in range(min(topo_w, block_q)):
                 anc_col = jnp.where(
@@ -413,6 +441,18 @@ def _ragged_kernel(
                         )
                         valid = jnp.where(
                             kind == TOPO_TREE, tree_valid, valid
+                        )
+                        # CP: this rank's slice sits ``aux`` tokens to
+                        # the LEFT of the causal frontier, so the limit
+                        # shifts right by aux; the ``pos < kv_len``
+                        # conjunct is load-bearing — on fully-covered
+                        # shards limit + aux exceeds kv_len and padding
+                        # rows must not read past the slice.
+                        cp_valid = jnp.logical_and(
+                            pos < kv_len, pos < limit + aux
+                        )
+                        valid = jnp.where(
+                            kind == TOPO_CP, cp_valid, valid
                         )
                 for h in range(hkv):          # static unroll
                     q = qbuf[qslot, h]        # (rows, d)
@@ -762,6 +802,14 @@ def ragged_paged_attention_xla(
         ok = jnp.where(
             ((kind_t == TOPO_TREE) & (row_of >= 0))[:, None],
             tree_ok, ok,
+        )
+        aux_t = topologies[row_c, 1]           # (T,) cp frontier shift
+        cp_ok = (pos_s[None, :] < kv_lens[row_c][:, None]) & (
+            pos_s[None, :] < (limit + aux_t)[:, None]
+        )
+        ok = jnp.where(
+            ((kind_t == TOPO_CP) & (row_of >= 0))[:, None],
+            cp_ok, ok,
         )
     mask = ok[None, :, None, :]
     s = jnp.where(mask, s, NEG_INF)

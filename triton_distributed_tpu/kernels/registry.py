@@ -178,6 +178,8 @@ DEGRADATION_TARGETS = {
         "dense_attention_reference",
     "grad_ring.stream_int8w":
         "triton_distributed_tpu.train.grad_wire.grad_allreduce_xla",
+    "cp_decode.lse_combine":
+        "triton_distributed_tpu.kernels.flash_decode.cp_lse_combine_xla",
 }
 
 
@@ -507,6 +509,17 @@ def _grad_ring(mesh, n, token):
     from triton_distributed_tpu.kernels.cp_ring import build_grad_ring_lint
 
     build_grad_ring_lint(mesh, n, token=(token, n))
+
+
+def _cp_lse_combine(mesh, n, token):
+    """The long-context decode merge (kernels/cp_ring.py): cross-rank
+    LSE-combine as an f32 add-reduce ring — the Pallas protocol twin of
+    ``flash_decode.cp_lse_combine_xla``."""
+    from triton_distributed_tpu.kernels.cp_ring import (
+        build_cp_lse_combine_lint,
+    )
+
+    build_cp_lse_combine_lint(mesh, n, token=(token, n))
 
 
 def _ragged_paged(mesh, n, token):
@@ -863,6 +876,20 @@ def families() -> dict:
             "grad_ring.stream_int8w", "grad_ring", "grad_ring_stream_int8w",
             _grad_ring,
             lambda n: [((8 * n, 2048), _F32)],
+            contract=reduce("out_hbm"),
+        ),
+        KernelFamily(
+            # long-context serving: each cp rank's paged-attention
+            # partial rides as exp-weighted numerator rows + an
+            # additive denominator row, so the softmax merge is a pure
+            # add-reduce and the ring stays on the raw f32 wire (a
+            # quantized denominator would drift the final normalize).
+            # The reduce contract (SL008) is what sees a dropped or
+            # double-folded rank — a token decoded against a silently
+            # missing KV shard.
+            "cp_decode.lse_combine", "cp_decode", "cp_decode_lse_combine",
+            _cp_lse_combine,
+            lambda n: [((8 * n, 128), _F32)],
             contract=reduce("out_hbm"),
         ),
         KernelFamily(

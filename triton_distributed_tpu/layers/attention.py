@@ -196,13 +196,16 @@ class RaggedPagedAttention:
 
     def __call__(self, qp, k_pool, v_pool, kv_lens, q_lens, q_starts,
                  block_table, *, topologies=None, block_q: int = 8,
-                 n_bufs: int = 2):
+                 n_bufs: int = 2, with_lse: bool = False):
         """qp: (Hkv, T·G, D) packed rows sharded P(axis) on dim 0;
         k_pool/v_pool: (npages, Hkv, page, D) arrays or int8
         ``{"q","scale"}`` dicts, sharded P(None, axis); metadata —
         including the optional (R, 2+2W) per-row attention-topology
         descriptors — replicated. Returns (Hkv, T·G, D) sharded like
-        qp."""
+        qp — or the ``((Hkv, T·G, D), (Hkv, T·G))`` partial pair under
+        ``with_lse`` (the cp-decode path merges per-shard partials with
+        ``flash_decode.combine_gqa_partials``; head sharding makes the
+        LSE per-rank-local, so the pair shards exactly like qp)."""
         from jax.sharding import PartitionSpec as P
 
         from triton_distributed_tpu.kernels.ragged_paged_attention import (
@@ -229,13 +232,13 @@ class RaggedPagedAttention:
                 kw["n_bufs"] = n_bufs
             if quant:
                 kq, ks, vq, vs = pools
-                out, _ = fn(qp, kq, vq, kv_lens, q_lens, q_starts,
-                            table, k_scale=ks, v_scale=vs, **kw)
+                out, lse = fn(qp, kq, vq, kv_lens, q_lens, q_starts,
+                              table, k_scale=ks, v_scale=vs, **kw)
             else:
                 kc, vc = pools
-                out, _ = fn(qp, kc, vc, kv_lens, q_lens, q_starts,
-                            table, **kw)
-            return out
+                out, lse = fn(qp, kc, vc, kv_lens, q_lens, q_starts,
+                              table, **kw)
+            return (out, lse) if with_lse else out
 
         pools = (
             (k_pool["q"], k_pool["scale"], v_pool["q"], v_pool["scale"])
@@ -247,7 +250,9 @@ class RaggedPagedAttention:
             mesh=self.mesh,
             in_specs=(P(self.axis), P(), P(), P(), P()) + meta
             + tuple(P(None, self.axis) for _ in pools),
-            out_specs=P(self.axis),
+            out_specs=(
+                (P(self.axis), P(self.axis)) if with_lse else P(self.axis)
+            ),
             check_vma=False,
         )
         extra = (topologies,) if has_topo else ()

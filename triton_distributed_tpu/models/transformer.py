@@ -222,10 +222,24 @@ class Transformer:
     mesh: Mesh
     tp_axis: str = "tp"
     dp_axes: tuple = ()
+    # context-parallel axis for LONG-CONTEXT SERVING (None = no cp):
+    # the serving page pool becomes cp stacked per-shard pools and each
+    # shard's paged-attention partial merges through the cross-rank
+    # LSE-combine (kernels/flash_decode.combine_gqa_partials; wire twin
+    # cp_decode.lse_combine). Orthogonal to tp (head sharding) — a
+    # tp×cp mesh shards heads within each cp group.
+    cp_axis: str | None = None
 
     @property
     def tp(self) -> int:
         return self.mesh.shape[self.tp_axis]
+
+    @property
+    def cp(self) -> int:
+        """Context-parallel degree of the serving pool (1 = no cp)."""
+        if not self.cp_axis:
+            return 1
+        return self.mesh.shape[self.cp_axis]
 
     @property
     def row_spec(self):
@@ -1390,13 +1404,23 @@ class Transformer:
         -1 = unallocated), per-slot kv_lens and cursors. Every leaf
         gets its own buffer (the serving-step jit donates the state).
         ``pages_per_seq`` is ``npages`` capped at 1024 table columns —
-        a slot may address the whole pool."""
+        a slot may address the whole pool.
+
+        Under ``cp > 1``, ``npages`` is the PER-SHARD pool size: the
+        pool rows become one stacked allocation of ``cp·npages`` pages
+        (shard r owns rows [r·npages, (r+1)·npages) — on a cp-sharded
+        TPU mesh this dim would carry P(cp_axis); this reproduction
+        keeps the stack replicated and shards the attention WALK), the
+        table columns split the same way, and one slot's capacity
+        grows to ``cp·pages_per_shard·page`` positions — the whole
+        point of long-context serving."""
         from triton_distributed_tpu.serving.state import (
             ServingState,
             fresh_table,
         )
 
         c = self.config
+        cp = self.cp
         if self.dp_axes:
             raise ValueError("ragged serving is tp-only (dp composes by "
                              "running one engine per dp group)")
@@ -1405,7 +1429,8 @@ class Transformer:
                 f"serving pools shard the {c.n_kv_heads} KV heads over "
                 f"tp={self.tp} — Hkv must divide"
             )
-        pps = min(npages, 1024)
+        pps = min(npages, max(1024 // cp, 1)) * cp
+        npages = npages * cp
         spec = self._serving_pool_sharding
         if c.kv_quant is not None:
             zq = jax.device_put(
@@ -1438,14 +1463,18 @@ class Transformer:
             kv_lens=jnp.zeros((slots,), jnp.int32),
             cursors=jnp.zeros((slots,), jnp.int32),
             page=page,
+            cp=cp,
         )
 
     def _ragged_attn(self, qp, k_pool, v_pool, state, q_lens, q_starts,
-                     block_q, use_pallas, n_bufs=2, topologies=None):
+                     block_q, use_pallas, n_bufs=2, topologies=None,
+                     with_lse=False):
         """One layer's ragged paged attention over the (updated) pools
         via the head-sharded serving layer. qp: (Hkv, T·G, D) packed
         GQA rows (already holding this step's tokens in the pools —
-        append-then-attend). Returns (Hkv, T·G, D)."""
+        append-then-attend). Returns (Hkv, T·G, D) — or the
+        ``(out, lse)`` partial pair under ``with_lse`` (the cp shard
+        loop merges those via ``combine_gqa_partials``)."""
         from triton_distributed_tpu.layers import RaggedPagedAttention
 
         c = self.config
@@ -1456,8 +1485,64 @@ class Transformer:
         return layer(
             qp, k_pool, v_pool, state.kv_lens, q_lens, q_starts,
             state.block_table, topologies=topologies, block_q=block_q,
-            n_bufs=n_bufs,
+            n_bufs=n_bufs, with_lse=with_lse,
         )
+
+    def _cp_ragged_attn(self, qp, kp, vp, state, q_lens, q_starts,
+                        block_q, use_pallas, n_bufs, topologies):
+        """Context-parallel attention: walk each cp shard's slice of
+        the stacked pool with a TOPO_CP row descriptor (the frontier
+        shift makes each shard's local causal mask exact against the
+        GLOBAL positions it holds), then merge the per-shard (out, lse)
+        partials with the cross-rank LSE-combine — the XLA body of the
+        ``cp_decode.lse_combine`` wire contract. Shard r of the table
+        columns/pool rows is sliced statically; its local kv length and
+        shift derive from the traced global ``state.kv_lens``. A row
+        fully resident on shard 0 merges bit-exactly to shard 0's out
+        (every other shard's lse is NEG_INF), which keeps short-request
+        streams byte-identical to a cp-free engine."""
+        from triton_distributed_tpu.kernels.flash_decode import (
+            combine_gqa_partials,
+        )
+        from triton_distributed_tpu.kernels.ragged_paged_attention import (
+            TOPO_CP,
+            topo_width,
+        )
+
+        cp = state.cp
+        pps_loc = state.pages_per_seq // cp
+        pool0 = kp["q"] if isinstance(kp, dict) else kp
+        nps = pool0.shape[0] // cp
+        s_loc = pps_loc * state.page
+        slots = state.slots
+        if topologies is None:
+            w = topo_width(block_q)
+            topologies = jnp.zeros((slots, 2 + 2 * w), jnp.int32)
+        outs, lses = [], []
+        for r in range(cp):
+            kp_r = jax.tree.map(lambda a: a[r * nps:(r + 1) * nps], kp)
+            vp_r = jax.tree.map(lambda a: a[r * nps:(r + 1) * nps], vp)
+            cols = state.block_table[:, r * pps_loc:(r + 1) * pps_loc]
+            table_r = jnp.where(cols >= 0, cols - r * nps, -1)
+            lens_r = jnp.clip(state.kv_lens - r * s_loc, 0, s_loc)
+            shift_r = jnp.maximum(state.kv_lens - r * s_loc, 0) - lens_r
+            topo_r = (
+                topologies.at[:, 0].set(TOPO_CP).at[:, 1].set(shift_r)
+            )
+            o_r, l_r = self._ragged_attn(
+                qp, kp_r, vp_r,
+                state.replace(
+                    layers=(), block_table=table_r, kv_lens=lens_r
+                ),
+                q_lens, q_starts, block_q, use_pallas, n_bufs, topo_r,
+                with_lse=True,
+            )
+            outs.append(o_r)
+            lses.append(l_r)
+        out, _ = combine_gqa_partials(
+            jnp.stack(outs), jnp.stack(lses), out_dtype=qp.dtype
+        )
+        return out
 
     def serving_step(self, params, state, tokens, token_rows, token_pos,
                      q_starts, q_lens, topologies=None, moe_state=None, *,
@@ -1562,10 +1647,16 @@ class Transformer:
             qp = pack_gqa_rows(
                 q.reshape(t, c.n_heads, c.head_dim), c.n_kv_heads
             )
-            o = self._ragged_attn(
-                qp, kp, vp, state.replace(layers=()), q_lens, q_starts,
-                block_q, use_pallas, n_bufs, topologies,
-            )
+            if state.cp > 1:
+                o = self._cp_ragged_attn(
+                    qp, kp, vp, state.replace(layers=()), q_lens,
+                    q_starts, block_q, use_pallas, n_bufs, topologies,
+                )
+            else:
+                o = self._ragged_attn(
+                    qp, kp, vp, state.replace(layers=()), q_lens,
+                    q_starts, block_q, use_pallas, n_bufs, topologies,
+                )
             o = unpack_gqa_rows(o, c.n_heads).reshape(t, c.q_dim)
             x = x + self._dmm(o.astype(c.dtype), blk["wo"])
             xn = self._rmsnorm(x, blk["norm_mlp"])

@@ -230,7 +230,8 @@ class EngineStats:
     # at each per-request draft budget k — empty on fixed-k engines
     adaptive_k_rows: dict = field(default_factory=dict)
     # per-shape-key step-time ledger: grid-schedule traffic key
-    # (slots, t_pad, hkv, g, d, page) -> [count, total_ms, max_pages].
+    # (slots, t_pad, hkv, g, d, page, chunk) ->
+    # [count, total_ms, max_pages].
     # tune.traffic re-searches the hot keys after a run and persists
     # winners the next engine build resolves.
     shape_ledger: dict = field(default_factory=dict)
@@ -396,8 +397,23 @@ class ServingEngine:
         self._jnp = jnp
         pps = self.state.pages_per_seq
         self.table = np.full((cfg.slots, pps), -1, np.int32)
-        self.pool = PagePool(cfg.npages, cfg.page,
-                             prefix_cache=cfg.prefix_cache)
+        # context-parallel decode: a model whose mesh carries a cp axis
+        # stacks cp pools of cfg.npages pages each; the host allocator
+        # mirrors that as cp per-shard pools behind one global page-id
+        # namespace (appends route to the shard owning the logical page
+        # index, matching the block-table column split the attention
+        # walk shards on). cp == 1 is the plain allocator, unchanged.
+        cp = getattr(model, "cp", 1)
+        if cp > 1:
+            from triton_distributed_tpu.serving.state import CpPagePool
+
+            self.pool = CpPagePool(
+                cp, cfg.npages, cfg.page, self.state.pages_per_shard,
+                prefix_cache=cfg.prefix_cache,
+            )
+        else:
+            self.pool = PagePool(cfg.npages, cfg.page,
+                                 prefix_cache=cfg.prefix_cache)
         # hook: called (req, slot) when a request completes (or, under
         # prefill_only, finishes its prefill + first token). Return True
         # (the default behavior) to free the slot and pages; False to
@@ -443,11 +459,15 @@ class ServingEngine:
         )
 
         c = model.config
-        # traffic key: geometry + the speculation coordinates (draft-k,
-        # spec_tree) so tune.traffic re-searches hot SPECULATIVE shapes
-        # separately from plain decode at the same geometry
+        # traffic key: geometry + the prefill chunk (chunking moves the
+        # packed-token histogram the schedule is tuned against, so a
+        # re-chunked engine is a DIFFERENT hot shape) + the speculation
+        # coordinates (draft-k, spec_tree) so tune.traffic re-searches
+        # hot SPECULATIVE shapes separately from plain decode at the
+        # same geometry
         self._grid_key = (cfg.slots, self._t_pad, c.n_kv_heads, g,
-                          c.head_dim, cfg.page) + self._spec_key()
+                          c.head_dim, cfg.page, cfg.chunk) \
+            + self._spec_key()
         sched = resolve_schedule(
             "flash_decode.ragged_paged", self._grid_key, (model.tp,),
             "int8" if c.kv_quant is not None else None, grid_schedule,
@@ -477,6 +497,21 @@ class ServingEngine:
             raise ValueError(
                 "prefix_share requires prefix_cache (the chain-hash "
                 "registry IS the dedup index)"
+            )
+        if cp > 1 and cfg.prefix_share:
+            raise ValueError(
+                "prefix_share is incompatible with context-parallel "
+                "decode: in-batch dedup retargets table columns to a "
+                "canonical page, but under cp a logical page index is "
+                "pinned to its owning shard — aliasing across rows "
+                "would break the shard-ownership invariant"
+            )
+        if cp > 1 and self._spec_key() != (0, 0):
+            raise ValueError(
+                "speculative decoding is incompatible with context-"
+                "parallel decode: verify-tree rows carry TREE topology "
+                "descriptors, and the cp shard loop overwrites the "
+                "topology row with its per-shard frontier shift"
             )
 
     def _spec_key(self) -> tuple:
@@ -604,7 +639,7 @@ class ServingEngine:
             and getattr(r, "tenant", "default") == tenant
         ]
         if tc.page_share < 1.0:
-            cap = int(tc.page_share * self.cfg.npages)
+            cap = int(tc.page_share * self.pool.npages)
             held = sum(self._pages_held(r.cursor) for r in resident)
             if held + self._pages_held(first) > cap:
                 return False
@@ -645,7 +680,7 @@ class ServingEngine:
         limit = min((len(req.seq) - 1) // page, self.state.pages_per_seq)
         matched = 0
         for h in self._page_hashes(req, limit):
-            pg = self.pool.lookup(h)
+            pg = self.pool.lookup(h, matched)
             if pg is None:
                 break
             self.pool.retain(pg)
@@ -709,7 +744,7 @@ class ServingEngine:
             run = 0
             for p, h in enumerate(self._page_hashes(req, frozen)):
                 pg = int(self.table[s, p])
-                canon = self.pool.lookup(h)
+                canon = self.pool.lookup(h, p)
                 if canon is not None and canon != pg:
                     self.pool.release(pg)
                     self.pool.retain(canon)
@@ -915,7 +950,7 @@ class ServingEngine:
         self.stats.step_generated.append(gen_this_step)
         self.stats.note_shape(
             self._grid_key, dt * 1e3,
-            self.cfg.npages - self.pool.available,
+            self.pool.npages - self.pool.available,
         )
         self.stats.prefill_tokens += prefill_this_step
         report.update(

@@ -90,6 +90,7 @@ def _u(*parts) -> float:
 FLEET_ENGINE_FAMILIES = (
     "flash_decode.ragged_paged",   # every replica's serving step
     "kv_ship.pages",               # disaggregated replicas' KV wire
+    "cp_decode.lse_combine",       # cp replicas' cross-rank LSE merge
 )
 
 #: Kernel families the replica→replica KV-page MIGRATION wire rides —
@@ -188,11 +189,30 @@ class Replica:
             h, n = 0, 0
             for p in range((len(seq) - 1) // page):
                 h = page_chain_hash(h, seq[p * page:(p + 1) * page])
-                if pool.lookup(h) is None:
+                if pool.lookup(h, p) is None:
                     break
                 n += 1
             best = max(best, n)
         return best
+
+    @property
+    def cp(self) -> int:
+        """Context-parallel factor of this replica's mesh (1 = no cp
+        axis) — the long-context capability the router places by."""
+        return max(
+            getattr(role.model, "cp", 1) for role in self._roles)
+
+    def fits_context(self, req) -> bool:
+        """Can this replica EVER hold ``req`` end-to-end — the
+        request's full KV (prompt plus every token it may generate)
+        within the pool AND the per-slot table width? False means
+        routing here can never admit it, whatever drains: the router's
+        long-context placement filter."""
+        role = self.admit_role
+        tokens = len(req.seq) + int(getattr(req, "max_new", 0) or 0)
+        need = max(-(-tokens // role.cfg.page), 1)
+        return (need <= role.state.pages_per_seq
+                and need <= role.pool.npages)
 
     def load_ms(self) -> float:
         """Queue-depth/step-time estimate — the perf term."""
@@ -334,6 +354,18 @@ class FleetRouter:
             raise RuntimeError(
                 "fleet router: no routable replica (every replica is "
                 "dead or condemned) — no survivor to fail over to")
+        # long-context placement: a request whose end-to-end KV exceeds
+        # a replica's pool can NEVER be admitted there — only replicas
+        # whose mesh carries a cp axis wide enough stay candidates.
+        # None left is a hard, priced refusal (capacity does not appear
+        # by waiting), not a queue-and-hope.
+        fits = [r for r in routable if r.fits_context(req)]
+        if not fits:
+            raise RuntimeError(
+                "fleet router: no routable replica can hold this "
+                "request's KV — "
+                + self.long_context_refusal(req, routable))
+        routable = fits
         if self.cfg.policy == "round_robin":
             r = routable[self._rr % len(routable)]
             self._rr += 1
@@ -382,6 +414,28 @@ class FleetRouter:
         if self.cfg.affinity and sess is not None:
             self.affinity[sess] = chosen.index   # affinity follows
         return chosen, spilled
+
+    def long_context_refusal(self, req, replicas: list) -> str:
+        """The priced reason no replica in ``replicas`` can hold
+        ``req``: :func:`~triton_distributed_tpu.tune.perf_model.
+        refuse_long_context` evaluated at the LARGEST-capacity
+        candidate (the one that came closest), so the message names
+        the cp factor that would have sufficed and its modeled
+        per-step price."""
+        from triton_distributed_tpu.tune import perf_model
+
+        big = max(replicas, key=lambda r: min(
+            r.admit_role.pool.npages,
+            r.admit_role.state.pages_per_seq))
+        role = big.admit_role
+        tokens = len(req.seq) + int(getattr(req, "max_new", 0) or 0)
+        need = max(-(-tokens // role.cfg.page), 1)
+        return perf_model.refuse_long_context(
+            role.model.config, role.cfg.page, need,
+            pool_pages=role.pool.npages,
+            pages_per_seq=role.state.pages_per_seq,
+            cp=big.cp,
+        ) or "long-context refusal with no over-capacity term (bug)"
 
 
 # ---------------------------------------------------------- autoscaler
@@ -634,6 +688,11 @@ class FleetStats:
     migration_priced: list = field(default_factory=list)
     migration_refusals: int = 0    # priced: re-prefill beat the wire
     migration_failures: int = 0    # wire exhausted; re-prefill fallback
+    # --- long-context placement ---
+    # (rid, priced reason) per arrival whose end-to-end KV fits NO
+    # routable replica — refused outright (perf_model.
+    # refuse_long_context prices the cp factor that would have held it)
+    long_context_refusals: list = field(default_factory=list)
 
     @property
     def migrations_cheaper(self) -> int:
@@ -890,6 +949,8 @@ class ServingFleet:
         n = 0
         while self.queue and self.queue[0].arrival <= self.ticks:
             req = self.queue.popleft()
+            if self._refuse_long_context(req):
+                continue
             if self._shed_brownout(req):
                 continue
             if self._reject_overload(req):
@@ -917,6 +978,27 @@ class ServingFleet:
                 self.stats.affinity_hits += 1
             n += 1
         return n
+
+    def _refuse_long_context(self, req) -> bool:
+        """Long-context placement gate: an arrival whose end-to-end KV
+        fits NO routable replica is refused OUTRIGHT with the priced
+        reason (``stats.long_context_refusals``). Unlike an overload
+        bounce there is no retry-after — waiting cannot make pool
+        capacity appear, so a priced retry would be a promise the
+        fleet can never honor. The request is marked done with its
+        ``refusal`` reason attached (the loud failure the client
+        sees), and the event log records it for replay pins."""
+        routable = self._routable()
+        if not routable:
+            return False       # route() raises the every-replica-dead error
+        if any(r.fits_context(req) for r in routable):
+            return False
+        reason = self.router.long_context_refusal(req, routable)
+        self.stats.long_context_refusals.append((req.rid, reason))
+        self._log_event("long_context_refusal", -1, f"rid={req.rid}")
+        req.refusal = reason
+        req.done = True
+        return True
 
     def _reject_overload(self, req) -> bool:
         """Admission control (``RouterConfig.queue_cap``): when every
@@ -1515,12 +1597,18 @@ class ServingFleet:
             return False
         if src_role.cfg.page != dst_role.cfg.page:
             return False
+        # cp-mismatched replicas shard their pools differently: a page
+        # chain gathered in one layout does not land 1:1 in the other,
+        # so the ship is refused here and admission re-prefills
+        if getattr(src_role.pool, "cp", 1) \
+                != getattr(dst_role.pool, "cp", 1):
+            return False
         page = src_role.cfg.page
         seq = req.seq
         src_pids, hashes, h = [], [], 0
         for p in range((len(seq) - 1) // page):
             h = page_chain_hash(h, seq[p * page:(p + 1) * page])
-            pg = src_role.pool.lookup(h)
+            pg = src_role.pool.lookup(h, p)
             if pg is None:
                 break
             src_pids.append(int(pg))
@@ -1534,7 +1622,7 @@ class ServingFleet:
             return False
         if npg > dst_role.pool.available - dst_role._committed_pages():
             return False
-        dpids = [dst_role.pool.alloc() for _ in range(npg)]
+        dpids = [dst_role.pool.alloc(i) for i in range(npg)]
         if any(pg is None for pg in dpids):
             for pg in dpids:
                 if pg is not None:

@@ -46,11 +46,15 @@ class ProtocolOps:
 
     def alloc(self, eng, slot: int, held: int, need: int) -> bool:
         """Grow ``slot``'s table from ``held`` to ``need`` pages;
-        all-or-nothing (no partial growth to unwind)."""
-        if need - held > eng.pool.available:
+        all-or-nothing (no partial growth to unwind). The logical page
+        index rides into the pool so a cp-sharded pool can land each
+        page on its owning shard (``can_hold`` is the matching exact
+        per-shard gate; a flat pool degenerates both to the old
+        headroom check)."""
+        if not eng.pool.can_hold(held, need):
             return False
         for pg in range(held, need):
-            eng.table[slot, pg] = eng.pool.alloc()
+            eng.table[slot, pg] = eng.pool.alloc(pg)
         return True
 
     def free_slot(self, eng, slot: int) -> None:
@@ -259,12 +263,13 @@ class ProtocolOps:
                 f"slot capacity {eng.state.capacity}"
             )
         need = eng._pages_held(req.cursor)
-        if need > eng.pool.available - eng._committed_pages():
+        if (need > eng.pool.available - eng._committed_pages()
+                or not eng.pool.can_hold(0, need)):
             return None
         s = free[0]
         pids = []
         for p in range(need):
-            pg = eng.pool.alloc()
+            pg = eng.pool.alloc(p)
             eng.table[s, p] = pg
             pids.append(int(pg))
         req.slot = s

@@ -47,6 +47,14 @@ class ServingState:
     kv_lens: object      # (slots,) int32 — includes the in-flight step
     cursors: object      # (slots,) int32
     page: int = 0        # static: rows per page
+    # static: context-parallel shards of the pool. Under cp > 1 the
+    # pool rows are ONE stacked allocation of cp per-shard pools (shard
+    # r owns global page ids [r·npages/cp, (r+1)·npages/cp)) and the
+    # block-table columns split the same way: logical page index p of a
+    # sequence lives in shard min(p // (pages_per_seq/cp), cp-1), so a
+    # long request's KV spreads over every shard while the table keeps
+    # GLOBAL ids and the scatter-append stays shard-oblivious.
+    cp: int = 1
 
     def replace(self, **kw) -> "ServingState":
         return _dc_replace(self, **kw)
@@ -58,6 +66,11 @@ class ServingState:
     @property
     def pages_per_seq(self) -> int:
         return int(self.block_table.shape[1])
+
+    @property
+    def pages_per_shard(self) -> int:
+        """Block-table columns owned by one cp shard."""
+        return self.pages_per_seq // max(self.cp, 1)
 
     @property
     def npages(self) -> int:
@@ -73,7 +86,7 @@ class ServingState:
 def _flatten(s: ServingState):
     return (
         (s.layers, s.block_table, s.kv_lens, s.cursors),
-        (s.page,),
+        (s.page, s.cp),
     )
 
 
@@ -81,7 +94,7 @@ def _unflatten(aux, children):
     layers, table, lens, cursors = children
     return ServingState(
         layers=layers, block_table=table, kv_lens=lens, cursors=cursors,
-        page=aux[0],
+        page=aux[0], cp=aux[1],
     )
 
 
@@ -143,9 +156,12 @@ class PagePool:
         completed, whatever was preempted mid-draft on the way)."""
         return int((self.refs >= 1).sum())
 
-    def alloc(self) -> int | None:
+    def alloc(self, idx: int | None = None) -> int | None:
         """Claim one page (refcount 1), reclaiming the LRU cached page
-        when the free list is dry. None when genuinely exhausted."""
+        when the free list is dry. None when genuinely exhausted.
+        ``idx`` — the logical page index within the owning sequence —
+        is the cp routing key; a flat pool ignores it."""
+        del idx
         if self.free:
             pg = self.free.pop()
         elif self._reclaim:
@@ -187,9 +203,175 @@ class PagePool:
         self._by_hash[chain_hash] = pg
         self._hash_of[pg] = chain_hash
 
-    def lookup(self, chain_hash) -> int | None:
-        """The resident page holding this prefix page, or None."""
+    def lookup(self, chain_hash, idx: int | None = None) -> int | None:
+        """The resident page holding this prefix page, or None. ``idx``
+        routes the probe to the owning cp shard; a flat pool ignores
+        it."""
+        del idx
         return self._by_hash.get(chain_hash)
+
+    def can_hold(self, held: int, need: int) -> bool:
+        """Whether growing a sequence from ``held`` to ``need`` pages
+        can be satisfied — the allocation gate the protocol's ``alloc``
+        verb asks before claiming anything (a cp pool answers per
+        owning shard; a flat pool is a simple headroom check)."""
+        return need - held <= self.available
+
+    def clone(self) -> "PagePool":
+        """Deep-copy the allocator state (servlint world forking)."""
+        q = PagePool.__new__(PagePool)
+        q.npages = self.npages
+        q.page = self.page
+        q.prefix_cache = self.prefix_cache
+        q.refs = self.refs.copy()
+        q.free = list(self.free)
+        q._by_hash = dict(self._by_hash)
+        q._hash_of = dict(self._hash_of)
+        q._reclaim = OrderedDict(self._reclaim)
+        return q
+
+
+class CpPagePool:
+    """Context-parallel page allocator: ``cp`` per-shard
+    :class:`PagePool` instances behind ONE global page-id namespace.
+
+    Shard ``s`` owns global page ids ``[s·npages_shard,
+    (s+1)·npages_shard)`` — the same rows of the stacked device pool —
+    and logical page index ``idx`` of any sequence is owned by shard
+    ``min(idx // pages_per_shard, cp-1)``, mirroring the block-table
+    column split. Appends therefore always land on the owning shard
+    (``alloc`` routes by ``idx``), releases route by the global id's
+    shard, and the prefix cache registers/looks up within the owning
+    shard (a prefix page at logical index p re-attaches on the shard
+    that held it — position determines owner, so the probe is exact).
+
+    The combined read-only views (``refs``/``free``/``_reclaim``/
+    ``_hash_of``/``_by_hash``, all in GLOBAL ids) exist for the
+    invariant checkers (servlint SV001/SV002 and the engine's leak
+    asserts), which see one coherent allocator regardless of cp.
+    """
+
+    def __init__(self, cp: int, npages: int, page: int,
+                 pages_per_shard: int, *, prefix_cache: bool = False):
+        assert cp >= 2, cp
+        self.cp = int(cp)
+        self.npages_shard = int(npages)
+        self.npages = int(cp) * int(npages)     # TOTAL pages
+        self.page = int(page)
+        self.pages_per_shard = int(pages_per_shard)
+        self.prefix_cache = bool(prefix_cache)
+        self.shards = tuple(
+            PagePool(npages, page, prefix_cache=prefix_cache)
+            for _ in range(self.cp)
+        )
+
+    # ---- routing
+
+    def owner_of(self, idx: int) -> int:
+        """Logical page index within a sequence → owning shard."""
+        return min(int(idx) // self.pages_per_shard, self.cp - 1)
+
+    def shard_of(self, pg: int) -> int:
+        """Global page id → owning shard."""
+        return int(pg) // self.npages_shard
+
+    # ---- combined views (global ids)
+
+    @property
+    def refs(self):
+        return np.concatenate([s.refs for s in self.shards])
+
+    @property
+    def free(self) -> list:
+        return [
+            i * self.npages_shard + lp
+            for i, s in enumerate(self.shards) for lp in s.free
+        ]
+
+    @property
+    def _reclaim(self) -> OrderedDict:
+        out = OrderedDict()
+        for i, s in enumerate(self.shards):
+            for lp in s._reclaim:
+                out[i * self.npages_shard + lp] = None
+        return out
+
+    @property
+    def _hash_of(self) -> dict:
+        return {
+            i * self.npages_shard + lp: h
+            for i, s in enumerate(self.shards)
+            for lp, h in s._hash_of.items()
+        }
+
+    @property
+    def _by_hash(self) -> dict:
+        return {
+            h: i * self.npages_shard + lp
+            for i, s in enumerate(self.shards)
+            for h, lp in s._by_hash.items()
+        }
+
+    @property
+    def available(self) -> int:
+        """Total claimable pages across shards — an UPPER bound for any
+        one sequence (growth routes to owners; :meth:`can_hold` is the
+        exact per-shard gate)."""
+        return sum(s.available for s in self.shards)
+
+    @property
+    def held_pages(self) -> int:
+        return sum(s.held_pages for s in self.shards)
+
+    # ---- allocator verbs
+
+    def alloc(self, idx: int | None = None) -> int | None:
+        """Claim one page ON THE SHARD OWNING logical index ``idx``
+        (None routes to shard 0 — only correct for idx-agnostic
+        callers that never coexist with cp, asserted away)."""
+        assert idx is not None, "cp pool allocation needs the page index"
+        s = self.owner_of(idx)
+        lp = self.shards[s].alloc()
+        return None if lp is None else s * self.npages_shard + lp
+
+    def retain(self, pg: int) -> None:
+        s = self.shard_of(pg)
+        self.shards[s].retain(pg - s * self.npages_shard)
+
+    def release(self, pg: int) -> None:
+        s = self.shard_of(pg)
+        self.shards[s].release(pg - s * self.npages_shard)
+
+    def register(self, pg: int, chain_hash) -> None:
+        s = self.shard_of(pg)
+        self.shards[s].register(pg - s * self.npages_shard, chain_hash)
+
+    def lookup(self, chain_hash, idx: int | None = None) -> int | None:
+        assert idx is not None, "cp pool lookup needs the page index"
+        s = self.owner_of(idx)
+        lp = self.shards[s].lookup(chain_hash)
+        return None if lp is None else s * self.npages_shard + lp
+
+    def can_hold(self, held: int, need: int) -> bool:
+        """Exact per-shard gate: pages ``held..need-1`` route to their
+        owners; every owner must have the headroom."""
+        want = [0] * self.cp
+        for p in range(held, need):
+            want[self.owner_of(p)] += 1
+        return all(
+            w <= s.available for w, s in zip(want, self.shards)
+        )
+
+    def clone(self) -> "CpPagePool":
+        q = CpPagePool.__new__(CpPagePool)
+        q.cp = self.cp
+        q.npages_shard = self.npages_shard
+        q.npages = self.npages
+        q.page = self.page
+        q.pages_per_shard = self.pages_per_shard
+        q.prefix_cache = self.prefix_cache
+        q.shards = tuple(s.clone() for s in self.shards)
+        return q
 
 
 def page_chain_hash(prev_hash, tokens) -> int:

@@ -639,3 +639,85 @@ def refuse_disaggregation(model_cfg, page: int, traffic: dict,
         "dominates the decode work it buys; keep prefill and decode "
         "colocated for this traffic"
     )
+
+
+# --------------------------------------- context-parallel decode term
+#
+# Long-context serving shards one request's page walk across a cp axis
+# (kernels/ragged_paged_attention.py TOPO_CP + the cp_decode.lse_combine
+# ring): each rank reads only its ~1/cp share of the KV pages, then the
+# per-rank (out, lse) partials merge over a cp-1-hop ring. The walk
+# term shrinks by cp while the combine term is kv-length-INDEPENDENT,
+# so long contexts win and short ones pay a fixed hop tax — these
+# terms price that crossover so the fleet router can place long
+# requests (and refuse them with numbers) before any hardware run.
+
+def cp_decode_step_ms(kv_len: int, *, cp: int, page: int, hkv: int,
+                      g: int, d: int, hidden: int, n_layers: int = 1,
+                      spec: TpuSpec | None = None, quant: bool = True,
+                      issue_ms: float | None = None) -> float:
+    """Per-step decode cost of ONE ``kv_len``-token request on a
+    ``cp``-sharded replica: the per-rank ragged walk over
+    ``ceil(kv_len/cp)`` tokens (ranks walk their shards concurrently —
+    the step pays the slowest, which under an even split is the 1/cp
+    share) plus the cross-rank LSE-combine ring — ``cp-1`` sequential
+    hops of the f32 ``(out, lse)`` partial slab per layer
+    (:func:`hop_critical_path_ms`; hops on one delivery chain cannot
+    overlap). ``cp=1`` degenerates to the single-slice walk exactly."""
+    spec = spec or detect_spec()
+    cp = max(int(cp), 1)
+    local = max(-(-int(kv_len) // cp), 1)
+    walk = ragged_serving_step_ms(
+        [local], [1], page=page, hkv=hkv, g=g, d=d, hidden=hidden,
+        n_layers=n_layers, spec=spec, quant=quant, issue_ms=issue_ms)
+    if cp == 1:
+        return walk
+    slab = 4 * hkv * g * (d + 1)       # one row's f32 (out, lse) partial
+    combine = n_layers * hop_critical_path_ms(cp - 1, slab, spec)
+    return walk + combine
+
+
+def refuse_long_context(model_cfg, page: int, need_pages: int, *,
+                        pool_pages: int, pages_per_seq: int,
+                        cp: int = 1,
+                        spec: TpuSpec | None = None) -> str | None:
+    """The long-context placement gate (the
+    :func:`refuse_disaggregation` shape): None when ``need_pages`` —
+    the request's END-TO-END KV, prompt plus every token it may
+    generate — fits this replica's page pool AND its per-slot table
+    width; else the priced refusal reason. Unlike an overload bounce,
+    no retry-after can make pool capacity appear, so the reason names
+    the missing capability and its price: the cp factor that WOULD
+    hold the request and the modeled per-step cost of serving it there
+    (:func:`cp_decode_step_ms` — the sharded walk plus the LSE-combine
+    ring) against the single-slice HBM walk it replaces."""
+    need = int(need_pages)
+    cap = min(int(pool_pages), int(pages_per_seq))
+    if need <= cap:
+        return None
+    spec = spec or detect_spec()
+    hkv = model_cfg.n_kv_heads
+    g = model_cfg.n_heads // max(hkv, 1)
+    d = model_cfg.head_dim
+    quant = getattr(model_cfg, "kv_quant", None) is not None
+    kv = need * page
+    # the smallest cp multiple of THIS replica's per-shard capacity
+    # that holds the request (its shards are the fleet's pool unit)
+    shard_cap = max(cap // max(int(cp), 1), 1)
+    want_cp = max(-(-need // shard_cap), 2)
+    cp_ms = cp_decode_step_ms(
+        kv, cp=want_cp, page=page, hkv=hkv, g=g, d=d,
+        hidden=model_cfg.hidden, n_layers=model_cfg.n_layers,
+        spec=spec, quant=quant)
+    flat_ms = ragged_serving_step_ms(
+        [kv], [1], page=page, hkv=hkv, g=g, d=d,
+        hidden=model_cfg.hidden, n_layers=model_cfg.n_layers,
+        spec=spec, quant=quant)
+    return (
+        f"request needs {need} KV pages but this replica holds "
+        f"{cap} (cp={max(int(cp), 1)}) — a cp={want_cp} replica would "
+        f"serve it at ~{cp_ms:.3f} ms/step (sharded walk + "
+        f"{want_cp - 1}-hop LSE-combine ring) vs the {flat_ms:.3f} ms "
+        "single-slice HBM walk it replaces; route long contexts to a "
+        "cp-capable replica"
+    )

@@ -690,6 +690,17 @@ def price_grid_schedule(family: str, schedule: GridSchedule, *, shape,
         waste = r * g * (max(0, int(schedule.block_q) - 8)
                          + max(0, int(schedule.pack_rows) - 8))
         ms += waste * d * 2 * 3 / (spec.hbm_gbps * 1e9) * 1e3
+        # serving traffic keys (engine ``_grid_key``) carry the prefill
+        # CHUNK after the geometry: a prefill row packs ``chunk``
+        # tokens through ceil(chunk/block_q) q blocks, so the block's
+        # tail pad is paid once per prefill row — the term that makes
+        # the same geometry at a different chunking a DIFFERENT hot
+        # shape, tuned to its own block_q winner
+        if len(shape) >= 7 and int(shape[6]) > 0:
+            chunk = int(shape[6])
+            bq = max(int(schedule.block_q), 8)
+            pad = -(-chunk // bq) * bq - chunk
+            ms += r * g * pad * d * 2 * 3 / (spec.hbm_gbps * 1e9) * 1e3
         # tree-packed verify rows widen the q block the row occupies
         # (1 + tree_pack positions attend the row's whole prefix) —
         # extra q/out traffic, paid back upstream by accepted tokens

@@ -2,12 +2,16 @@
 
 A serving run leaves an :class:`EngineStats` behind whose
 ``shape_ledger`` maps each grid-schedule traffic key
-``(slots, t_pad, hkv, g, d, page, spec_k, spec_tree)`` to the step
-time spent in it. The trailing pair is the engine's speculation
-signature (``(0, 0)`` for a plain engine): a schedule searched for
-1-token decode rows is the wrong answer for draft-k verify rows or
-tree-packed rows, so hot SPECULATIVE shapes re-search under their own
-key (the pricer's ``tree_pack`` term sees the wider rows). This
+``(slots, t_pad, hkv, g, d, page, chunk, spec_k, spec_tree)`` to the
+step time spent in it. ``chunk`` is the engine's prefill chunk: the
+same geometry re-chunked packs a different q-row histogram, so it
+re-searches under its own key and the pricer's chunk tail-pad term
+picks the block_q that fits the chunking. The trailing pair is the
+engine's speculation signature (``(0, 0)`` for a plain engine): a
+schedule searched for 1-token decode rows is the wrong answer for
+draft-k verify rows or tree-packed rows, so hot SPECULATIVE shapes
+re-search under their own key (the pricer's ``tree_pack`` term sees
+the wider rows). This
 module turns that ledger into persisted schedule winners: rank the hot
 keys, run :func:`search_grid_schedule` for each (oracle-gated,
 perf-model priced), persist the winners in the flock'd store — and the
